@@ -1,0 +1,161 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+A from-scratch framework with the capabilities of PaddlePaddle
+(reference at /root/reference, blueprint in SURVEY.md), built idiomatically
+on JAX/XLA/Pallas: eager mode is op-by-op dispatch to cached XLA
+executables; compiled mode (`jit`) is whole-graph trace; distribution is
+sharding over `jax` device meshes with XLA collectives over ICI/DCN.
+"""
+from __future__ import annotations
+
+from .core import (  # noqa: F401
+    CPUPlace,
+    CustomPlace,
+    Parameter,
+    Place,
+    TPUPlace,
+    Tensor,
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    device_count,
+    enable_grad,
+    float8_e4m3fn,
+    float8_e5m2,
+    float16,
+    float32,
+    float64,
+    get_device,
+    get_flags,
+    get_rng_state,
+    grad,
+    int8,
+    int16,
+    int32,
+    int64,
+    is_compiled_with_tpu,
+    is_grad_enabled,
+    no_grad,
+    seed,
+    set_device,
+    set_flags,
+    set_rng_state,
+    to_tensor,
+    uint8,
+)
+from .core.dtype import dtype  # noqa: F401
+
+# Functional op surface (paddle.* functions) — generated from ops.yaml.
+from .ops import *  # noqa: F401,F403
+from .ops import __all__ as _ops_all
+
+from . import amp  # noqa: F401
+from . import distributed  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import metric  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import vision  # noqa: F401
+
+# paddle-compat aliases
+from .ops import cast as as_type  # noqa: F401
+
+
+def rand(shape, dtype="float32"):
+    from .ops import uniform
+
+    return uniform(shape=shape, dtype=dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype="float32"):
+    from .ops import gaussian
+
+    return gaussian(shape=shape, mean=0.0, std=1.0, dtype=dtype)
+
+
+def empty(shape, dtype="float32"):
+    from .ops import zeros
+
+    return zeros(shape=shape, dtype=dtype)
+
+
+def empty_like(x, dtype=None):
+    from .ops import zeros_like
+
+    return zeros_like(x, dtype=dtype)
+
+
+def numel(x):
+    return x.size
+
+
+def shape(x):
+    return x.shape
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def get_default_dtype():
+    from .core.flags import flag
+
+    return flag("FLAGS_default_dtype")
+
+
+def set_default_dtype(d):
+    from .core.dtype import convert_dtype
+
+    set_flags({"FLAGS_default_dtype": convert_dtype(d).name})
+
+
+def save(obj, path, **kwargs):
+    from .framework.io import save as _save
+
+    return _save(obj, path, **kwargs)
+
+
+def load(path, **kwargs):
+    from .framework.io import load as _load
+
+    return _load(path, **kwargs)
+
+
+def summary(layer, input_size=None, dtypes=None):
+    from .hapi.summary import summary as _summary
+
+    return _summary(layer, input_size, dtypes)
+
+
+__version__ = "0.1.0"
+__all__ = (
+    list(_ops_all)
+    + [
+        "Tensor",
+        "Parameter",
+        "to_tensor",
+        "seed",
+        "no_grad",
+        "enable_grad",
+        "grad",
+        "set_device",
+        "get_device",
+        "device_count",
+        "rand",
+        "randn",
+        "empty",
+        "empty_like",
+        "nn",
+        "optimizer",
+        "io",
+        "amp",
+        "jit",
+        "distributed",
+        "vision",
+        "metric",
+        "save",
+        "load",
+    ]
+)

@@ -1,0 +1,33 @@
+from . import autograd, dtype, flags, place, random
+from .autograd import enable_grad, grad, is_grad_enabled, no_grad
+from .dtype import (
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    convert_dtype,
+    float8_e4m3fn,
+    float8_e5m2,
+    float16,
+    float32,
+    float64,
+    int8,
+    int16,
+    int32,
+    int64,
+    to_jax_dtype,
+    uint8,
+)
+from .flags import define_flag, get_flags, set_flags
+from .place import (
+    CPUPlace,
+    CustomPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .random import get_rng_state, seed, set_rng_state
+from .tensor import Parameter, Tensor, to_tensor

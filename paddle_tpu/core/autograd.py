@@ -1,0 +1,283 @@
+"""Eager autograd engine.
+
+Design (TPU-native analog of the reference's eager autograd,
+/root/reference/paddle/fluid/eager/backward.cc:105 ``RunBackward`` and
+grad_node_info.h ``GradNodeBase``):
+
+- Every differentiable op call records a ``GradNode`` holding the op's
+  backward rule plus the (jax array) values it needs. Edges point at the
+  producer nodes of the op's inputs.
+- ``backward(loss)`` runs a ref-counted topological sweep over the node
+  graph, accumulating gradients per node-output slot, exactly like the
+  reference's ``GradTensorHolder`` + ``node_in_degree_map`` scheme — but the
+  per-node compute is a jitted XLA executable, so the Python loop only
+  schedules; the math runs on device asynchronously.
+- Leaf tensors (``is_leaf`` and ``not stop_gradient``) receive ``.grad``.
+
+Under ``jax.jit`` tracing (``to_static`` / compiled train steps) recording is
+skipped: compiled training uses ``jax.grad`` over the functionalized program,
+which is the idiomatic XLA route; the tape exists for eager ergonomics.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from collections import defaultdict, deque
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GradNode", "no_grad", "enable_grad", "is_grad_enabled", "backward", "grad"]
+
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _state.enabled
+
+
+@contextlib.contextmanager
+def no_grad():
+    prev = _state.enabled
+    _state.enabled = False
+    try:
+        yield
+    finally:
+        _state.enabled = prev
+
+
+@contextlib.contextmanager
+def enable_grad():
+    prev = _state.enabled
+    _state.enabled = True
+    try:
+        yield
+    finally:
+        _state.enabled = prev
+
+
+class GradNode:
+    """One node in the backward graph = one forward op application.
+
+    ``backward_fn(grad_outputs: tuple) -> tuple`` returns gradients for the
+    op's tensor inputs (None where not needed). ``edges[i]`` is
+    ``(producer_node, output_slot)`` or ``None`` for each input; leaf inputs
+    get an ``AccumulationNode``.
+    """
+
+    __slots__ = ("name", "backward_fn", "edges", "num_outputs", "input_needs_grad", "__weakref__")
+
+    def __init__(self, name, backward_fn, edges, num_outputs, input_needs_grad):
+        self.name = name
+        self.backward_fn = backward_fn
+        self.edges = edges
+        self.num_outputs = num_outputs
+        self.input_needs_grad = input_needs_grad
+
+    def __repr__(self):
+        return f"<GradNode {self.name}>"
+
+
+class AccumulationNode:
+    """Terminal node: writes accumulated gradient into a leaf Tensor.
+
+    Analog of the reference's ``GradNodeAccumulation``.
+    """
+
+    __slots__ = ("tensor_ref", "hooks", "__weakref__")
+
+    def __init__(self, tensor):
+        import weakref
+
+        self.tensor_ref = weakref.ref(tensor)
+        self.hooks: list[Callable] = []
+
+    def apply(self, grad_value):
+        t = self.tensor_ref()
+        if t is None:
+            return
+        for h in self.hooks:
+            new = h(grad_value)
+            if new is not None:
+                grad_value = new
+        t._accumulate_grad(grad_value)
+
+    def __repr__(self):
+        return "<AccumulationNode>"
+
+
+def _add(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a + b
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """Run the backward sweep from ``tensors`` (typically a scalar loss)."""
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+
+    # Seed gradients.
+    ready: dict[tuple[int, int], jax.Array] = {}  # (id(node), slot) -> grad
+    node_by_id: dict[int, object] = {}
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        node, slot = t._grad_edge()
+        if node is None:
+            continue
+        if g is None:
+            if t._value.size != 1:
+                raise RuntimeError(
+                    "grad must be provided for non-scalar backward roots; "
+                    f"got shape {t.shape}"
+                )
+            seed = jnp.ones_like(t._value)
+        else:
+            seed = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        key = (id(node), slot)
+        ready[key] = _add(ready.get(key), seed)
+        node_by_id[id(node)] = node
+        roots.append(node)
+
+    if not roots:
+        return
+
+    # Discover reachable graph + in-degrees (number of consumers whose grads
+    # must arrive before a node can run) — reference: node_in_degree_map.
+    indeg: dict[int, int] = defaultdict(int)
+    seen: set[int] = set()
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        node_by_id[id(node)] = node
+        if isinstance(node, AccumulationNode):
+            continue
+        for edge in node.edges:
+            if edge is None:
+                continue
+            nxt, _ = edge
+            indeg[id(nxt)] += 1
+            if id(nxt) not in seen:
+                stack.append(nxt)
+
+    # Pending grad buffers per node: slot -> value.
+    buffers: dict[int, dict[int, jax.Array]] = defaultdict(dict)
+    for (nid, slot), g in ready.items():
+        buffers[nid][slot] = g
+
+    queue = deque(n for n in (node_by_id[i] for i in {id(r) for r in roots}) if indeg[id(n)] == 0)
+    # Roots with remaining in-degree (a root consumed elsewhere in the graph)
+    # wait until their consumers run.
+    processed: set[int] = set()
+
+    while queue:
+        node = queue.popleft()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+        slot_grads = buffers.pop(id(node), {})
+
+        if isinstance(node, AccumulationNode):
+            g = slot_grads.get(0)
+            if g is not None:
+                node.apply(g)
+            continue
+
+        grad_outputs = tuple(
+            slot_grads.get(i) for i in range(node.num_outputs)
+        )
+        grads_in = node.backward_fn(grad_outputs)
+        if not isinstance(grads_in, (tuple, list)):
+            grads_in = (grads_in,)
+        if len(grads_in) != len(node.edges):
+            raise RuntimeError(
+                f"{node}: backward returned {len(grads_in)} grads for "
+                f"{len(node.edges)} inputs"
+            )
+        for edge, g in zip(node.edges, grads_in):
+            if edge is None or g is None:
+                continue
+            nxt, slot = edge
+            buf = buffers[id(nxt)]
+            buf[slot] = _add(buf.get(slot), g)
+            if isinstance(nxt, AccumulationNode):
+                queue.append(nxt)
+            else:
+                indeg[id(nxt)] -= 1
+                if indeg[id(nxt)] <= 0:
+                    queue.append(nxt)
+        if not retain_graph:
+            node.backward_fn = _dead_backward
+
+
+def _dead_backward(*_):
+    raise RuntimeError(
+        "Trying to run backward through a graph a second time "
+        "(pass retain_graph=True to backward())."
+    )
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=False, allow_unused=False):
+    """``paddle.grad`` analog: gradients of outputs w.r.t. inputs without
+    touching ``.grad`` of other leaves (reference: general_grad.h)."""
+    from .tensor import Tensor
+
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+
+    # Temporarily intercept accumulation into the requested inputs.
+    captured: dict[int, jax.Array] = {}
+    saved_accs = []
+    for t in inputs:
+        acc = t._acc_node_for_grad_api()
+        saved_accs.append((t, acc, list(acc.hooks) if acc else None))
+
+    def make_hook(idx):
+        def hook(g):
+            captured[idx] = _add(captured.get(idx), g)
+            return g
+
+        return hook
+
+    saved_grads = [t._grad for t in inputs]
+    for i, (t, acc, _) in enumerate(saved_accs):
+        if acc is not None:
+            acc.hooks.append(make_hook(i))
+
+    try:
+        backward(outputs, grad_outputs, retain_graph=retain_graph)
+    finally:
+        for (t, acc, old_hooks), old_grad in zip(saved_accs, saved_grads):
+            if acc is not None:
+                acc.hooks[:] = old_hooks
+            t._grad = old_grad
+
+    results = []
+    for i, t in enumerate(inputs):
+        if i in captured:
+            results.append(Tensor._from_value(captured[i], stop_gradient=True))
+        elif allow_unused:
+            results.append(None)
+        else:
+            raise RuntimeError(f"input {i} of grad() was not used in the graph")
+    return results

@@ -1,0 +1,159 @@
+"""Data types for the TPU-native framework.
+
+Mirrors the dtype surface of the reference's ``phi::DataType``
+(/root/reference/paddle/phi/common/data_type.h) but is natively backed by
+JAX/XLA dtypes (including bfloat16 and fp8), which are first-class on TPU.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "dtype",
+    "bool_",
+    "uint8",
+    "int8",
+    "int16",
+    "int32",
+    "int64",
+    "float16",
+    "bfloat16",
+    "float32",
+    "float64",
+    "complex64",
+    "complex128",
+    "float8_e4m3fn",
+    "float8_e5m2",
+    "to_jax_dtype",
+    "convert_dtype",
+    "is_floating_point_dtype",
+    "is_integer_dtype",
+    "is_complex_dtype",
+]
+
+
+class dtype:
+    """A framework dtype: a named wrapper over a canonical numpy/jax dtype.
+
+    Compares equal to its string name, to other ``dtype`` instances with the
+    same name, and to the underlying numpy dtype — mirroring how the reference
+    lets users pass ``"float32"`` strings everywhere.
+    """
+
+    __slots__ = ("name", "np_dtype", "itemsize")
+
+    _registry: dict[str, "dtype"] = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = jnp.dtype(np_dtype)
+        self.itemsize = self.np_dtype.itemsize
+        dtype._registry[name] = self
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __str__(self):
+        return self.name
+
+    def __hash__(self):
+        return hash(self.name)
+
+    def __eq__(self, other):
+        if isinstance(other, dtype):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or str(self.np_dtype) == other
+        try:
+            return jnp.dtype(other) == self.np_dtype
+        except TypeError:
+            return NotImplemented
+
+    def __ne__(self, other):
+        result = self.__eq__(other)
+        if result is NotImplemented:
+            return result
+        return not result
+
+    @property
+    def is_floating_point(self) -> bool:
+        return jnp.issubdtype(self.np_dtype, jnp.floating)
+
+    @property
+    def is_integer(self) -> bool:
+        return jnp.issubdtype(self.np_dtype, jnp.integer)
+
+    @property
+    def is_complex(self) -> bool:
+        return jnp.issubdtype(self.np_dtype, jnp.complexfloating)
+
+
+bool_ = dtype("bool", jnp.bool_)
+uint8 = dtype("uint8", jnp.uint8)
+int8 = dtype("int8", jnp.int8)
+int16 = dtype("int16", jnp.int16)
+int32 = dtype("int32", jnp.int32)
+int64 = dtype("int64", jnp.int64)
+float16 = dtype("float16", jnp.float16)
+bfloat16 = dtype("bfloat16", jnp.bfloat16)
+float32 = dtype("float32", jnp.float32)
+float64 = dtype("float64", jnp.float64)
+complex64 = dtype("complex64", jnp.complex64)
+complex128 = dtype("complex128", jnp.complex128)
+float8_e4m3fn = dtype("float8_e4m3fn", jnp.float8_e4m3fn)
+float8_e5m2 = dtype("float8_e5m2", jnp.float8_e5m2)
+
+_ALIASES = {
+    "bool": bool_,
+    "float": float32,
+    "double": float64,
+    "half": float16,
+    "int": int32,
+    "long": int64,
+}
+
+
+def to_jax_dtype(d):
+    """Convert any user-facing dtype spec (dtype, str, np/jnp dtype) to a jnp dtype."""
+    if d is None:
+        return None
+    if isinstance(d, dtype):
+        return d.np_dtype
+    if isinstance(d, str):
+        if d in dtype._registry:
+            return dtype._registry[d].np_dtype
+        if d in _ALIASES:
+            return _ALIASES[d].np_dtype
+        return jnp.dtype(d)
+    return jnp.dtype(d)
+
+
+def convert_dtype(d) -> "dtype":
+    """Convert any dtype spec to the framework ``dtype`` object."""
+    if isinstance(d, dtype):
+        return d
+    if isinstance(d, str) and d in _ALIASES:
+        return _ALIASES[d]
+    jd = jnp.dtype(to_jax_dtype(d))
+    name = jd.name if jd.name in dtype._registry else str(jd)
+    if name in dtype._registry:
+        return dtype._registry[name]
+    raise TypeError(f"Unsupported dtype: {d!r}")
+
+
+def is_floating_point_dtype(d) -> bool:
+    return jnp.issubdtype(to_jax_dtype(d), jnp.floating)
+
+
+def is_integer_dtype(d) -> bool:
+    return jnp.issubdtype(to_jax_dtype(d), jnp.integer)
+
+
+def is_complex_dtype(d) -> bool:
+    return jnp.issubdtype(to_jax_dtype(d), jnp.complexfloating)
+
+
+# numpy does not know bfloat16 natively; expose the ml_dtypes-backed type for
+# zero-copy conversion in Tensor.numpy().
+np_bfloat16 = np.dtype(jnp.bfloat16)

@@ -1,0 +1,125 @@
+"""Device placement.
+
+The reference models devices with ``phi::Place`` variants
+(/root/reference/paddle/common/place.h). Here the native accelerator is TPU;
+``TPUPlace`` maps to a ``jax.Device`` of the default backend, ``CPUPlace`` to
+the host platform. Host↔device movement is explicit via ``Tensor.to``/``cpu``.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+__all__ = [
+    "Place",
+    "CPUPlace",
+    "TPUPlace",
+    "CustomPlace",
+    "set_device",
+    "get_device",
+    "device_count",
+    "is_compiled_with_tpu",
+]
+
+
+class Place:
+    """Base place: a (device_type, device_id) pair."""
+
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def jax_device(self) -> jax.Device:
+        devs = _devices_for(self.device_type)
+        if self.device_id >= len(devs):
+            raise ValueError(
+                f"{self!r}: only {len(devs)} {self.device_type} device(s) visible"
+            )
+        return devs[self.device_id]
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+
+class TPUPlace(Place):
+    """A single TPU chip (the native accelerator of this framework)."""
+
+    device_type = "tpu"
+
+
+class CustomPlace(Place):
+    def __init__(self, device_type: str, device_id: int = 0):
+        super().__init__(device_id)
+        self.device_type = device_type
+
+
+@functools.lru_cache(maxsize=None)
+def _devices_for(device_type: str):
+    if device_type == "cpu":
+        return jax.devices("cpu")
+    # On TPU machines the default backend is the accelerator; treat "tpu"
+    # as "default accelerator backend" so tests on CPU-only hosts still work.
+    return jax.devices()
+
+
+_current_place: Place | None = None
+
+
+def _default_place() -> Place:
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        return CPUPlace(0)
+    return TPUPlace(0)
+
+
+def set_device(device: str | Place) -> Place:
+    """``set_device("tpu:0")`` / ``set_device("cpu")`` — select default place."""
+    global _current_place
+    if isinstance(device, Place):
+        _current_place = device
+        return device
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    if name == "cpu":
+        _current_place = CPUPlace(idx)
+    elif name in ("tpu", "gpu", "xpu", "npu"):  # accept reference spellings
+        _current_place = TPUPlace(idx)
+    else:
+        _current_place = CustomPlace(name, idx)
+    return _current_place
+
+
+def get_device() -> str:
+    p = current_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def current_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        _current_place = _default_place()
+    return _current_place
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform != "cpu" for d in jax.devices())

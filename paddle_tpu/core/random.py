@@ -1,0 +1,97 @@
+"""Global RNG state.
+
+The reference carries per-device curand generators seeded by
+``paddle.seed`` (python/paddle/framework/random.py). The TPU-native analog
+is a stateless PRNG: a root ``jax.random`` key plus a fold-in counter.
+Every eager random op consumes ``fold_in(root, counter++)`` so results are
+reproducible given the seed, while jitted code takes explicit keys.
+
+Also hosts the TP-aware RNG tracker analog
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/parallel_layers/random.py
+``get_rng_state_tracker``): named states are distinct deterministic streams
+derived from the root seed, used to keep dropout identical (or deliberately
+different) across tensor-parallel ranks.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+__all__ = ["seed", "get_rng_state", "set_rng_state", "next_key", "RNGStatesTracker", "get_rng_state_tracker"]
+
+
+class _RNG(threading.local):
+    def __init__(self):
+        self.root_seed = 0
+        self.key = jax.random.key(0)
+        self.counter = 0
+
+
+_rng = _RNG()
+
+
+def seed(s: int):
+    _rng.root_seed = int(s)
+    _rng.key = jax.random.key(int(s))
+    _rng.counter = 0
+    return s
+
+
+def get_rng_state():
+    return (_rng.root_seed, _rng.counter)
+
+
+def set_rng_state(state):
+    root, counter = state
+    _rng.root_seed = root
+    _rng.key = jax.random.key(root)
+    _rng.counter = counter
+
+
+def next_key():
+    k = jax.random.fold_in(_rng.key, _rng.counter)
+    _rng.counter += 1
+    return k
+
+
+class RNGStatesTracker:
+    """Named RNG streams (TP-local vs global dropout streams)."""
+
+    def __init__(self):
+        self.states: dict[str, tuple[int, int]] = {}
+
+    def add(self, name: str, seed_: int):
+        if name in self.states:
+            raise ValueError(f"RNG state {name} already exists")
+        self.states[name] = (int(seed_), 0)
+
+    def get_states_tracker(self):
+        return dict(self.states)
+
+    def set_states_tracker(self, states):
+        self.states = dict(states)
+
+    def rng_state(self, name="model_parallel_rng"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            if name not in self.states:
+                raise ValueError(f"RNG state {name} was not added")
+            saved = get_rng_state()
+            set_rng_state(self.states[name])
+            try:
+                yield
+            finally:
+                self.states[name] = get_rng_state()
+                set_rng_state(saved)
+
+        return ctx()
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker

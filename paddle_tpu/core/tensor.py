@@ -1,0 +1,395 @@
+"""Eager Tensor.
+
+The user-facing tensor (analog of the reference's ``paddle::Tensor``,
+/root/reference/paddle/phi/api/include/tensor.h:82, with autograd meta as in
+eager/autograd_meta.h). It wraps a ``jax.Array`` — which is already a
+device-resident, possibly-sharded XLA buffer — so "DenseTensor +
+DistTensor" collapse into one type: a Tensor whose value carries a
+``NamedSharding`` over a mesh IS the distributed tensor.
+
+stop_gradient defaults to True (reference semantics); ``Parameter`` flips it.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import autograd
+from .autograd import AccumulationNode
+from .dtype import convert_dtype, to_jax_dtype
+
+__all__ = ["Tensor", "Parameter", "to_tensor"]
+
+
+def _is_tracer(v) -> bool:
+    return isinstance(v, jax.core.Tracer)
+
+
+class Tensor:
+    __slots__ = (
+        "_value",
+        "stop_gradient",
+        "_grad",
+        "_grad_node",
+        "_grad_slot",
+        "_acc_node",
+        "name",
+        "persistable",
+        "_placements_hint",
+        "__weakref__",
+    )
+
+    _id_counter = 0
+
+    def __init__(self, value=None, dtype=None, place=None, stop_gradient=True, name=None):
+        if value is None:
+            value = jnp.zeros((), dtype=to_jax_dtype(dtype) or jnp.float32)
+        elif isinstance(value, Tensor):
+            value = value._value
+        elif not isinstance(value, (jax.Array, jax.core.Tracer)):
+            value = jnp.asarray(value, dtype=to_jax_dtype(dtype))
+        if dtype is not None and value.dtype != to_jax_dtype(dtype):
+            value = value.astype(to_jax_dtype(dtype))
+        self._value = value
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._grad_node = None
+        self._grad_slot = 0
+        self._acc_node = None
+        self.name = name or f"tensor_{Tensor._next_id()}"
+        self.persistable = False
+        self._placements_hint = None
+
+    @classmethod
+    def _next_id(cls):
+        cls._id_counter += 1
+        return cls._id_counter
+
+    @classmethod
+    def _from_value(cls, value, stop_gradient=True, name=None):
+        t = cls.__new__(cls)
+        t._value = value
+        t.stop_gradient = stop_gradient
+        t._grad = None
+        t._grad_node = None
+        t._grad_slot = 0
+        t._acc_node = None
+        t.name = name or f"tensor_{cls._next_id()}"
+        t.persistable = False
+        t._placements_hint = None
+        return t
+
+    # ---------------- autograd plumbing ----------------
+
+    def _grad_edge(self):
+        """(node, slot) this tensor's gradient should flow into."""
+        if self._grad_node is not None:
+            return self._grad_node, self._grad_slot
+        if not self.stop_gradient:
+            if self._acc_node is None:
+                self._acc_node = AccumulationNode(self)
+            return self._acc_node, 0
+        return None, 0
+
+    def _acc_node_for_grad_api(self):
+        if self._grad_node is not None:
+            return None
+        if self._acc_node is None and not self.stop_gradient:
+            self._acc_node = AccumulationNode(self)
+        return self._acc_node
+
+    def _accumulate_grad(self, value):
+        if self._grad is None:
+            self._grad = Tensor._from_value(value, stop_gradient=True, name=self.name + "@GRAD")
+        else:
+            self._grad._value = self._grad._value + value
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        if g is None:
+            self._grad = None
+        elif isinstance(g, Tensor):
+            self._grad = g
+        else:
+            self._grad = Tensor._from_value(jnp.asarray(g))
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._grad_node is None
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        autograd.backward([self], [grad_tensor] if grad_tensor is not None else None,
+                          retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        return Tensor._from_value(self._value, stop_gradient=True, name=self.name + ".detach")
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..ops import assign
+
+        return assign(self)
+
+    def register_hook(self, hook):
+        node, slot = self._grad_edge()
+        if isinstance(node, AccumulationNode):
+            node.hooks.append(lambda g: _unwrap_opt(hook(Tensor._from_value(g))))
+            return
+        raise RuntimeError("register_hook on non-leaf tensors is not yet supported")
+
+    # ---------------- metadata ----------------
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self):
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return convert_dtype(self._value.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        from .place import CPUPlace, TPUPlace
+
+        if _is_tracer(self._value):
+            return TPUPlace(0)
+        dev = next(iter(self._value.devices()), None)
+        if dev is None or dev.platform == "cpu":
+            return CPUPlace(0)
+        return TPUPlace(dev.id)
+
+    @property
+    def T(self):
+        from ..ops import transpose
+
+        perm = list(range(self.ndim))[::-1]
+        return transpose(self, perm)
+
+    def numel(self):
+        return self.size
+
+    def dim(self):
+        return self.ndim
+
+    def element_size(self):
+        return self._value.dtype.itemsize
+
+    def is_floating_point(self):
+        return jnp.issubdtype(self._value.dtype, jnp.floating)
+
+    # ---------------- materialization ----------------
+
+    def numpy(self) -> np.ndarray:
+        if _is_tracer(self._value):
+            raise RuntimeError("Cannot call .numpy() inside a traced (to_static) region")
+        return np.asarray(self._value)
+
+    def item(self):
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def __float__(self):
+        return float(self.item())
+
+    def __int__(self):
+        return int(self.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of a multi-element Tensor is ambiguous")
+        return bool(self.item())
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        sg = self.stop_gradient
+        if _is_tracer(self._value):
+            return f"Tensor(shape={self.shape}, dtype={self.dtype.name}, traced, stop_gradient={sg})"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}, "
+            f"place={self.place}, stop_gradient={sg},\n{np.asarray(self._value)})"
+        )
+
+    # ---------------- conversion / movement ----------------
+
+    def astype(self, dtype) -> "Tensor":
+        from ..ops import cast
+
+        return cast(self, dtype)
+
+    cast = astype
+
+    def to(self, target) -> "Tensor":
+        from .place import Place
+
+        if isinstance(target, str) and target in ("cpu", "tpu") or isinstance(target, Place):
+            from .place import set_device, current_place
+
+            place = target if isinstance(target, Place) else None
+            if place is None:
+                from .place import CPUPlace, TPUPlace
+
+                place = CPUPlace(0) if target == "cpu" else TPUPlace(0)
+            return Tensor._from_value(
+                jax.device_put(self._value, place.jax_device()),
+                stop_gradient=self.stop_gradient,
+            )
+        return self.astype(target)
+
+    def cpu(self):
+        return self.to("cpu")
+
+    def tpu(self):
+        return self.to("tpu")
+
+    cuda = tpu  # reference-API compatibility spelling
+
+    def pin_memory(self):
+        return self
+
+    # ---------------- in-place-style mutation (leaf bookkeeping) ----------------
+
+    def set_value(self, value):
+        if isinstance(value, Tensor):
+            value = value._value
+        value = jnp.asarray(value, dtype=self._value.dtype)
+        if tuple(value.shape) != tuple(self._value.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {value.shape} vs {self._value.shape}"
+            )
+        # Preserve sharding of the old value when it had one.
+        old = self._value
+        if isinstance(old, jax.Array) and not _is_tracer(old) and hasattr(old, "sharding"):
+            try:
+                value = jax.device_put(value, old.sharding)
+            except Exception:
+                pass
+        self._value = value
+        return self
+
+    def copy_(self, other):
+        return self.set_value(other)
+
+    def fill_(self, v):
+        return self.set_value(jnp.full_like(self._value, v))
+
+    def zero_(self):
+        return self.set_value(jnp.zeros_like(self._value))
+
+    def scale_(self, scale):
+        self._value = self._value * scale
+        return self
+
+    def add_(self, other):
+        other = other._value if isinstance(other, Tensor) else other
+        self._value = self._value + other
+        return self
+
+    def subtract_(self, other):
+        other = other._value if isinstance(other, Tensor) else other
+        self._value = self._value - other
+        return self
+
+    def multiply_(self, other):
+        other = other._value if isinstance(other, Tensor) else other
+        self._value = self._value * other
+        return self
+
+    # ---------------- operators (populated by ops module at import) ----------------
+    # Methods like reshape/transpose/sum/... are monkey-patched in
+    # paddle_tpu/ops/__init__.py, mirroring the reference's math_op_patch.
+
+    def __getitem__(self, idx):
+        from ..ops import _getitem
+
+        return _getitem(self, idx)
+
+    def __setitem__(self, idx, value):
+        value = value._value if isinstance(value, Tensor) else value
+        self._value = self._value.at[idx].set(value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # jax pytree/array protocol helpers
+    def __jax_array__(self):
+        return self._value
+
+
+def _unwrap_opt(x):
+    if x is None:
+        return None
+    return x._value if isinstance(x, Tensor) else x
+
+
+class Parameter(Tensor):
+    """Trainable tensor: stop_gradient=False, persistable=True."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+
+    def __init__(self, value, name=None, trainable=True):
+        super().__init__(value, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.persistable = True
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+
+    @classmethod
+    def from_tensor(cls, t: Tensor, name=None, trainable=True):
+        p = cls.__new__(cls)
+        Tensor.__init__(p, t._value, stop_gradient=not trainable, name=name)
+        p.trainable = trainable
+        p.persistable = True
+        p.optimize_attr = {"learning_rate": 1.0}
+        p.regularizer = None
+        p.is_distributed = False
+        return p
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    """``paddle.to_tensor`` analog."""
+    if isinstance(data, Tensor):
+        t = Tensor._from_value(data._value, stop_gradient=stop_gradient)
+        if dtype is not None:
+            t = t.astype(dtype) if t.dtype != convert_dtype(dtype) else t
+        t.stop_gradient = stop_gradient
+        return t
+    value = jnp.asarray(data, dtype=to_jax_dtype(dtype))
+    if place is not None:
+        from .place import Place
+
+        if isinstance(place, Place):
+            value = jax.device_put(value, place.jax_device())
+    return Tensor._from_value(value, stop_gradient=stop_gradient)

@@ -1,0 +1,200 @@
+"""Functional op namespace, generated from ops/yaml/ops.yaml.
+
+This is the analog of the reference's generated Python-C bindings + the
+``paddle.*`` functional surface (/root/reference/python/paddle/_C_ops.py and
+python/paddle/tensor/*): the YAML registry is resolved into module-level
+functions here, and the common ones are monkey-patched onto ``Tensor`` the
+way the reference patches its eager tensor
+(python/paddle/base/dygraph/math_op_patch.py).
+"""
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import yaml
+
+from ..core.tensor import Tensor
+from . import backward as _backward_rules
+from . import kernels as _k
+from . import nn_kernels as _nn
+from .registry import OPS, apply_op, get_op, register_op
+
+_MODULES = {"k": _k, "nn": _nn}
+
+
+def _load_yaml_registry():
+    path = os.path.join(os.path.dirname(__file__), "yaml", "ops.yaml")
+    with open(path) as f:
+        entries = yaml.safe_load(f)
+    for e in entries:
+        mod_name, _, fn_name = e["kernel"].partition(".")
+        kernel = getattr(_MODULES[mod_name], fn_name)
+        bwd = _backward_rules.RULES.get(e["backward"]) if e.get("backward") else None
+        register_op(
+            e["op"],
+            kernel,
+            inputs=tuple(e.get("inputs", ())),
+            backward=bwd,
+            nojit=bool(e.get("nojit", False)),
+            differentiable=bool(e.get("differentiable", True)),
+        )
+
+
+_load_yaml_registry()
+
+
+def _make_public(op_name):
+    op = OPS[op_name]
+
+    def fn(*args, **kwargs):
+        return apply_op(op, *args, **kwargs)
+
+    fn.__name__ = op_name
+    fn.__qualname__ = op_name
+    fn.__doc__ = f"Eager op `{op_name}` (kernel: {op.kernel.__module__}.{op.kernel.__name__})"
+    return fn
+
+
+globals().update({name: _make_public(name) for name in OPS})
+
+__all__ = list(OPS)
+
+
+# -------------------- indexing --------------------
+
+
+def _getitem(t: Tensor, idx):
+    """Tensor.__getitem__: static indices go through a differentiable op."""
+
+    def _norm(i):
+        if isinstance(i, Tensor):
+            return i._value
+        return i
+
+    if isinstance(idx, tuple):
+        idx2 = tuple(_norm(i) for i in idx)
+    else:
+        idx2 = _norm(idx)
+    return apply_op(_GETITEM_OP, t, idx=_HashableIndex(idx2))
+
+
+class _HashableIndex:
+    """Wraps an arbitrary index expression so it can sit in a jit-cache key."""
+
+    __slots__ = ("idx", "_key")
+
+    def __init__(self, idx):
+        self.idx = idx
+        self._key = _index_key(idx)
+
+    def __hash__(self):
+        return hash(self._key)
+
+    def __eq__(self, other):
+        return isinstance(other, _HashableIndex) and self._key == other._key
+
+
+def _index_key(idx):
+    if isinstance(idx, tuple):
+        return ("t",) + tuple(_index_key(i) for i in idx)
+    if isinstance(idx, slice):
+        return ("s", idx.start, idx.stop, idx.step)
+    if isinstance(idx, (int, bool, type(None), type(Ellipsis))) or idx is Ellipsis:
+        return ("i", idx if idx is not Ellipsis else "...")
+    # array index: key by shape/dtype, pass value dynamically (nojit op anyway)
+    return ("a", getattr(idx, "shape", None), str(getattr(idx, "dtype", "")), id(idx))
+
+
+def _getitem_kernel(x, idx):
+    return x[idx.idx]
+
+
+_GETITEM_OP = register_op("_getitem", _getitem_kernel, inputs=("x",), nojit=True)
+
+
+# -------------------- Tensor method patching --------------------
+
+_TENSOR_METHODS = [
+    "reshape", "flatten", "transpose", "squeeze", "unsqueeze", "tile", "expand",
+    "broadcast_to", "expand_as", "gather", "gather_nd", "scatter", "index_select",
+    "masked_fill", "roll", "flip", "unbind", "repeat_interleave", "take_along_axis",
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "pow",
+    "maximum", "minimum", "scale", "abs", "sign", "exp", "expm1", "log", "log2",
+    "log10", "log1p", "sqrt", "rsqrt", "square", "reciprocal", "sin", "cos", "tan",
+    "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh", "acosh", "atanh",
+    "erf", "floor", "ceil", "round", "trunc", "clip", "isnan", "isinf", "isfinite",
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than", "less_equal",
+    "logical_and", "logical_or", "logical_not", "logical_xor",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "allclose", "isclose", "sum", "mean", "max", "min", "prod", "logsumexp",
+    "all", "any", "argmax", "argmin", "var", "std", "median", "cumsum", "cumprod",
+    "sort", "argsort", "topk", "unique", "nonzero", "matmul", "bmm", "dot", "mm",
+    "mv", "outer", "inner", "cross", "norm", "inverse", "det", "cholesky", "trace",
+    "diagonal", "kron", "tril", "triu", "where", "split", "chunk", "cast",
+    "softmax", "sigmoid",
+]
+
+_this = globals()
+for _name in _TENSOR_METHODS:
+    if not hasattr(Tensor, _name):
+        setattr(Tensor, _name, _this[_name])
+
+
+def _coerce_scalar(other, ref: Tensor):
+    """Convert python scalars to arrays matching paddle's promotion rules
+    (scalar adopts the tensor's dtype when compatible)."""
+    if isinstance(other, Tensor):
+        return other
+    if isinstance(other, bool):
+        return jnp.asarray(other)
+    if isinstance(other, int):
+        return jnp.asarray(other, dtype=ref._value.dtype)
+    if isinstance(other, float):
+        if jnp.issubdtype(ref._value.dtype, jnp.floating):
+            return jnp.asarray(other, dtype=ref._value.dtype)
+        return jnp.asarray(other, dtype=jnp.float32)
+    if isinstance(other, complex):
+        return jnp.asarray(other)
+    return other
+
+
+def _binop(op_name, reverse=False):
+    op = OPS[op_name]
+
+    def method(self, other):
+        other = _coerce_scalar(other, self)
+        if reverse:
+            if not isinstance(other, Tensor):
+                other = Tensor._from_value(other)
+            return apply_op(op, other, self)
+        return apply_op(op, self, other)
+
+    return method
+
+
+Tensor.__add__ = _binop("add")
+Tensor.__radd__ = _binop("add", reverse=True)
+Tensor.__sub__ = _binop("subtract")
+Tensor.__rsub__ = _binop("subtract", reverse=True)
+Tensor.__mul__ = _binop("multiply")
+Tensor.__rmul__ = _binop("multiply", reverse=True)
+Tensor.__truediv__ = _binop("divide")
+Tensor.__rtruediv__ = _binop("divide", reverse=True)
+Tensor.__floordiv__ = _binop("floor_divide")
+Tensor.__mod__ = _binop("remainder")
+Tensor.__pow__ = _binop("pow")
+Tensor.__rpow__ = _binop("pow", reverse=True)
+Tensor.__matmul__ = _binop("matmul")
+Tensor.__neg__ = lambda self: apply_op(OPS["negative"], self)
+Tensor.__abs__ = lambda self: apply_op(OPS["abs"], self)
+Tensor.__eq__ = _binop("equal")
+Tensor.__ne__ = _binop("not_equal")
+Tensor.__lt__ = _binop("less_than")
+Tensor.__le__ = _binop("less_equal")
+Tensor.__gt__ = _binop("greater_than")
+Tensor.__ge__ = _binop("greater_equal")
+Tensor.__hash__ = lambda self: id(self)
+Tensor.__and__ = _binop("logical_and")
+Tensor.__or__ = _binop("logical_or")
+Tensor.__invert__ = lambda self: apply_op(OPS["logical_not"], self)

@@ -1,0 +1,258 @@
+"""Explicit backward (VJP) rules for hot ops.
+
+Analog of the reference's backward.yaml + generated GradNodes
+(/root/reference/paddle/phi/ops/yaml/backward.yaml,
+paddle/fluid/eager/auto_code_generator/generator/eager_gen.py). Ops without a
+rule here fall back to jax.vjp recorded at forward time (registry.py); the
+explicit rules save residual memory on the hottest paths and express the
+no-need-buffer optimizations (e.g. relu keeps only the output).
+
+Rule signature: ``rule(ctx, *grad_outputs) -> tuple(grads per flat tensor
+input)``. ``ctx.inputs`` are kernel-positional values, ``ctx.outputs`` flat
+output values, ``ctx.attrs`` the static attributes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _unbroadcast(g, shape):
+    """Sum-reduce grad g to the given (possibly broadcast) input shape."""
+    if g.shape == tuple(shape):
+        return g
+    nd_extra = g.ndim - len(shape)
+    if nd_extra > 0:
+        g = jnp.sum(g, axis=tuple(range(nd_extra)))
+    axes = tuple(i for i, s in enumerate(shape) if s == 1 and g.shape[i] != 1)
+    if axes:
+        g = jnp.sum(g, axis=axes, keepdims=True)
+    return g.reshape(shape)
+
+
+def add_grad(ctx, gout):
+    x, y = ctx.inputs[0], ctx.inputs[1]
+    gx = _unbroadcast(gout, x.shape) if ctx.needs_grad(0) else None
+    gy = _unbroadcast(gout, y.shape) if ctx.needs_grad(1) else None
+    return gx, gy
+
+
+def subtract_grad(ctx, gout):
+    x, y = ctx.inputs[0], ctx.inputs[1]
+    gx = _unbroadcast(gout, x.shape) if ctx.needs_grad(0) else None
+    gy = _unbroadcast(-gout, y.shape) if ctx.needs_grad(1) else None
+    return gx, gy
+
+
+def multiply_grad(ctx, gout):
+    x, y = ctx.inputs[0], ctx.inputs[1]
+    gx = _unbroadcast(gout * y, x.shape) if ctx.needs_grad(0) else None
+    gy = _unbroadcast(gout * x, y.shape) if ctx.needs_grad(1) else None
+    return gx, gy
+
+
+def divide_grad(ctx, gout):
+    x, y = ctx.inputs[0], ctx.inputs[1]
+    gx = _unbroadcast(gout / y, x.shape) if ctx.needs_grad(0) else None
+    gy = _unbroadcast(-gout * x / (y * y), y.shape) if ctx.needs_grad(1) else None
+    return gx, gy
+
+
+def matmul_grad(ctx, gout):
+    x, y = ctx.inputs[0], ctx.inputs[1]
+    tx = ctx.attrs.get("transpose_x", False)
+    ty = ctx.attrs.get("transpose_y", False)
+    gx = gy = None
+    # Handle the common >=2D cases; vector edge cases go through einsum-free paths.
+    if x.ndim == 1 and y.ndim == 1:
+        if ctx.needs_grad(0):
+            gx = gout * y
+        if ctx.needs_grad(1):
+            gy = gout * x
+        return gx, gy
+    xm = x[None, :] if x.ndim == 1 else x
+    ym = y[:, None] if y.ndim == 1 else y
+    g = gout
+    if x.ndim == 1:
+        g = jnp.expand_dims(g, -2)
+    if y.ndim == 1:
+        g = jnp.expand_dims(g, -1)
+    xe = jnp.swapaxes(xm, -1, -2) if tx else xm
+    ye = jnp.swapaxes(ym, -1, -2) if ty else ym
+    if ctx.needs_grad(0):
+        if tx:
+            gx_full = jnp.matmul(ye, jnp.swapaxes(g, -1, -2))
+        else:
+            gx_full = jnp.matmul(g, jnp.swapaxes(ye, -1, -2))
+        gx = _unbroadcast(gx_full.reshape(gx_full.shape), xm.shape)
+        if x.ndim == 1:
+            gx = gx.reshape(x.shape)
+    if ctx.needs_grad(1):
+        if ty:
+            gy_full = jnp.matmul(jnp.swapaxes(g, -1, -2), xe)
+        else:
+            gy_full = jnp.matmul(jnp.swapaxes(xe, -1, -2), g)
+        gy = _unbroadcast(gy_full, ym.shape)
+        if y.ndim == 1:
+            gy = gy.reshape(y.shape)
+    return gx, gy
+
+
+def relu_grad(ctx, gout):
+    out = ctx.outputs[0]
+    return (jnp.where(out > 0, gout, 0.0),)
+
+
+def sigmoid_grad(ctx, gout):
+    out = ctx.outputs[0]
+    return (gout * out * (1 - out),)
+
+
+def tanh_grad(ctx, gout):
+    out = ctx.outputs[0]
+    return (gout * (1 - out * out),)
+
+
+def exp_grad(ctx, gout):
+    return (gout * ctx.outputs[0],)
+
+
+def log_grad(ctx, gout):
+    return (gout / ctx.inputs[0],)
+
+
+def sqrt_grad(ctx, gout):
+    return (gout * 0.5 / ctx.outputs[0],)
+
+
+def rsqrt_grad(ctx, gout):
+    out = ctx.outputs[0]
+    return (gout * (-0.5) * out * out * out,)
+
+
+def square_grad(ctx, gout):
+    return (gout * 2.0 * ctx.inputs[0],)
+
+
+def cast_grad(ctx, gout):
+    x = ctx.inputs[0]
+    return (gout.astype(x.dtype),)
+
+
+def reshape_grad(ctx, gout):
+    x = ctx.inputs[0]
+    return (jnp.reshape(gout, x.shape),)
+
+
+def transpose_grad(ctx, gout):
+    perm = ctx.attrs["perm"]
+    inv = [0] * len(perm)
+    for i, p in enumerate(perm):
+        inv[p] = i
+    return (jnp.transpose(gout, inv),)
+
+
+def scale_grad(ctx, gout):
+    return (gout * ctx.attrs.get("scale", 1.0),)
+
+
+def sum_grad(ctx, gout):
+    x = ctx.inputs[0]
+    axis = ctx.attrs.get("axis")
+    keepdim = ctx.attrs.get("keepdim", False)
+    g = gout
+    if axis is not None and not keepdim:
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        axes = tuple(a if a >= 0 else a + x.ndim for a in axes)
+        for a in sorted(axes):
+            g = jnp.expand_dims(g, a)
+    g = g.astype(x.dtype)
+    return (jnp.broadcast_to(g, x.shape),)
+
+
+def mean_grad(ctx, gout):
+    x = ctx.inputs[0]
+    axis = ctx.attrs.get("axis")
+    keepdim = ctx.attrs.get("keepdim", False)
+    if axis is None:
+        n = x.size
+        axes_norm = None
+    else:
+        axes = axis if isinstance(axis, tuple) else (axis,)
+        axes_norm = tuple(a if a >= 0 else a + x.ndim for a in axes)
+        n = 1
+        for a in axes_norm:
+            n *= x.shape[a]
+    g = gout
+    if axis is not None and not keepdim:
+        for a in sorted(axes_norm):
+            g = jnp.expand_dims(g, a)
+    return (jnp.broadcast_to(g / n, x.shape).astype(x.dtype),)
+
+
+def softmax_grad(ctx, gout):
+    out = ctx.outputs[0]
+    axis = ctx.attrs.get("axis", -1)
+    inner = jnp.sum(gout * out, axis=axis, keepdims=True)
+    return (out * (gout - inner),)
+
+
+def embedding_grad(ctx, gout):
+    # Inputs: (x, weight); only weight is differentiable. The weight is the
+    # last flat tensor input whether or not x was passed as a Tensor.
+    x, weight = ctx.inputs[0], ctx.inputs[1]
+    grads = [None] * len(ctx.needs)
+    if ctx.needs[-1]:
+        gw = jnp.zeros(weight.shape, dtype=gout.dtype).at[x].add(gout)
+        padding_idx = ctx.attrs.get("padding_idx")
+        if padding_idx is not None and padding_idx >= 0:
+            gw = gw.at[padding_idx].set(0.0)
+        grads[-1] = gw
+    return tuple(grads)
+
+
+def concat_grad(ctx, gout):
+    xs = ctx.inputs[0]
+    axis = ctx.attrs.get("axis", 0)
+    sizes = [v.shape[int(axis)] for v in xs]
+    idx = []
+    acc = 0
+    for s in sizes[:-1]:
+        acc += s
+        idx.append(acc)
+    parts = jnp.split(gout, idx, axis=int(axis))
+    return tuple(p if need else None for p, need in zip(parts, ctx.needs))
+
+
+def stack_grad(ctx, gout):
+    axis = ctx.attrs.get("axis", 0)
+    parts = jnp.moveaxis(gout, axis, 0)
+    return tuple(parts[i] if need else None for i, need in enumerate(ctx.needs))
+
+
+RULES = {
+    "add": add_grad,
+    "subtract": subtract_grad,
+    "multiply": multiply_grad,
+    "divide": divide_grad,
+    "matmul": matmul_grad,
+    "relu": relu_grad,
+    "sigmoid": sigmoid_grad,
+    "tanh": tanh_grad,
+    "exp": exp_grad,
+    "log": log_grad,
+    "sqrt": sqrt_grad,
+    "rsqrt": rsqrt_grad,
+    "square": square_grad,
+    "cast": cast_grad,
+    "reshape": reshape_grad,
+    "transpose": transpose_grad,
+    "scale": scale_grad,
+    "sum": sum_grad,
+    "mean": mean_grad,
+    "softmax": softmax_grad,
+    "embedding": embedding_grad,
+    "concat": concat_grad,
+    "stack": stack_grad,
+}

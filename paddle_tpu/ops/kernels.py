@@ -1,0 +1,919 @@
+"""Kernel library: pure-jax implementations of the op surface.
+
+TPU-native analog of /root/reference/paddle/phi/kernels — but where the
+reference hand-writes CUDA per (backend, dtype), every kernel here is a pure
+function on jax arrays that XLA fuses and tiles onto the MXU/VPU. One
+implementation serves CPU and TPU, all dtypes, sharded or not.
+
+Kernels take tensor inputs first (as declared in ops.yaml), then attributes
+(static under jit). No Tensor objects appear here — values only.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dtype import to_jax_dtype
+
+# ============================================================ creation
+
+
+def full(shape, fill_value, dtype="float32"):
+    return jnp.full(tuple(shape), fill_value, dtype=to_jax_dtype(dtype))
+
+
+def full_like(x, fill_value, dtype=None):
+    return jnp.full_like(x, fill_value, dtype=to_jax_dtype(dtype))
+
+
+def zeros(shape, dtype="float32"):
+    return jnp.zeros(tuple(shape), dtype=to_jax_dtype(dtype))
+
+
+def ones(shape, dtype="float32"):
+    return jnp.ones(tuple(shape), dtype=to_jax_dtype(dtype))
+
+
+def zeros_like(x, dtype=None):
+    return jnp.zeros_like(x, dtype=to_jax_dtype(dtype))
+
+
+def ones_like(x, dtype=None):
+    return jnp.ones_like(x, dtype=to_jax_dtype(dtype))
+
+
+def arange(start, end=None, step=1, dtype=None):
+    if end is None:
+        start, end = 0, start
+    return jnp.arange(start, end, step, dtype=to_jax_dtype(dtype))
+
+
+def linspace(start, stop, num, dtype="float32"):
+    return jnp.linspace(start, stop, int(num), dtype=to_jax_dtype(dtype))
+
+
+def eye(num_rows, num_columns=None, dtype="float32"):
+    return jnp.eye(num_rows, num_columns, dtype=to_jax_dtype(dtype))
+
+
+def tril(x, diagonal=0):
+    return jnp.tril(x, k=diagonal)
+
+
+def triu(x, diagonal=0):
+    return jnp.triu(x, k=diagonal)
+
+
+def assign(x):
+    return jnp.asarray(x)
+
+
+def diag(x, offset=0):
+    return jnp.diag(x, k=offset)
+
+
+def meshgrid(xs, indexing="ij"):
+    return tuple(jnp.meshgrid(*xs, indexing=indexing))
+
+
+# ============================================================ casting & shape
+
+
+def cast(x, dtype):
+    return x.astype(to_jax_dtype(dtype))
+
+
+def reshape(x, shape):
+    shape = tuple(int(s) for s in shape)
+    return jnp.reshape(x, shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1):
+    nd = x.ndim
+    if stop_axis < 0:
+        stop_axis += nd
+    if start_axis < 0:
+        start_axis += nd
+    new_shape = x.shape[:start_axis] + (-1,) + x.shape[stop_axis + 1 :]
+    return jnp.reshape(x, new_shape)
+
+
+def transpose(x, perm):
+    return jnp.transpose(x, tuple(perm))
+
+
+def squeeze(x, axis=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, int):
+        axis = (axis,)
+    axis = tuple(a for a in axis if x.shape[a if a >= 0 else a + x.ndim] == 1)
+    return jnp.squeeze(x, axis) if axis else x
+
+
+def unsqueeze(x, axis):
+    if isinstance(axis, int):
+        axis = (axis,)
+    out = x
+    for a in sorted(axis):
+        out = jnp.expand_dims(out, a)
+    return out
+
+
+def concat(xs, axis=0):
+    return jnp.concatenate(xs, axis=int(axis))
+
+
+def stack(xs, axis=0):
+    return jnp.stack(xs, axis=axis)
+
+
+def split(x, num_or_sections, axis=0):
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        return tuple(jnp.split(x, num_or_sections, axis=axis))
+    sections = list(num_or_sections)
+    total = x.shape[axis]
+    if any(s in (-1, None) for s in sections):
+        known = sum(s for s in sections if s not in (-1, None))
+        sections = [total - known if s in (-1, None) else s for s in sections]
+    idx = []
+    acc = 0
+    for s in sections[:-1]:
+        acc += s
+        idx.append(acc)
+    return tuple(jnp.split(x, idx, axis=axis))
+
+
+def chunk(x, chunks, axis=0):
+    return tuple(jnp.array_split(x, chunks, axis=axis))
+
+
+def tile(x, repeat_times):
+    return jnp.tile(x, tuple(repeat_times))
+
+
+def expand(x, shape):
+    shape = list(shape)
+    # -1 means keep original dim
+    x_shape = list(x.shape)
+    nd = len(shape)
+    x_shape = [1] * (nd - len(x_shape)) + x_shape
+    out_shape = [x_shape[i] if shape[i] == -1 else shape[i] for i in range(nd)]
+    return jnp.broadcast_to(jnp.reshape(x, x_shape), tuple(out_shape))
+
+
+def broadcast_to(x, shape):
+    return jnp.broadcast_to(x, tuple(shape))
+
+
+def expand_as(x, y):
+    return jnp.broadcast_to(x, y.shape)
+
+
+def slice_(x, axes, starts, ends):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        idx[ax] = slice(st, en)
+    return x[tuple(idx)]
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x[tuple(idx)]
+
+
+def gather(x, index, axis=0):
+    return jnp.take(x, index, axis=int(axis))
+
+
+def gather_nd(x, index):
+    return x[tuple(jnp.moveaxis(index, -1, 0))]
+
+
+def scatter(x, index, updates, overwrite=True):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter_nd_add(x, index, updates):
+    return x.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_put(x, indices, value, accumulate=False):
+    idx = tuple(indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+def masked_select(x, mask):
+    return x[mask]
+
+
+def masked_fill(x, mask, value):
+    return jnp.where(mask, jnp.asarray(value, dtype=x.dtype), x)
+
+
+def where(condition, x, y):
+    return jnp.where(condition, x, y)
+
+
+def roll(x, shifts, axis=None):
+    return jnp.roll(x, shifts, axis=axis)
+
+
+def flip(x, axis):
+    return jnp.flip(x, axis=axis)
+
+
+def pad(x, paddings, mode="constant", value=0.0):
+    # paddings: flat [lo0, hi0, lo1, hi1, ...] over trailing dims (paddle 'pad')
+    # or full per-dim pairs when len == 2*ndim
+    p = list(paddings)
+    nd = x.ndim
+    if len(p) == 2 * nd:
+        pairs = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+    else:
+        k = len(p) // 2
+        pairs = [(0, 0)] * (nd - k) + [(p[2 * i], p[2 * i + 1]) for i in range(k)]
+    if mode == "constant":
+        return jnp.pad(x, pairs, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+    return jnp.pad(x, pairs, mode=jmode)
+
+
+def unbind(x, axis=0):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def repeat_interleave(x, repeats, axis=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def take_along_axis(x, indices, axis):
+    return jnp.take_along_axis(x, indices, axis=axis)
+
+
+def put_along_axis(x, indices, values, axis):
+    return jnp.put_along_axis(x, indices, values, axis=axis, inplace=False)
+
+
+def as_strided(x, shape, stride, offset=0):
+    flat = jnp.ravel(x)
+    idx = jnp.full(tuple(shape), offset, dtype=jnp.int32)
+    for d, (s, st) in enumerate(zip(shape, stride)):
+        r = jnp.arange(s, dtype=jnp.int32) * st
+        idx = idx + jnp.reshape(r, (1,) * d + (s,) + (1,) * (len(shape) - d - 1))
+    return flat[idx]
+
+
+# ============================================================ elementwise math
+
+
+def add(x, y):
+    return jnp.add(x, y)
+
+
+def subtract(x, y):
+    return jnp.subtract(x, y)
+
+
+def multiply(x, y):
+    return jnp.multiply(x, y)
+
+
+def divide(x, y):
+    return jnp.divide(x, y)
+
+
+def floor_divide(x, y):
+    return jnp.floor_divide(x, y)
+
+
+def remainder(x, y):
+    return jnp.remainder(x, y)
+
+
+def pow_(x, y):
+    return jnp.power(x, y)
+
+
+def maximum(x, y):
+    return jnp.maximum(x, y)
+
+
+def minimum(x, y):
+    return jnp.minimum(x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True):
+    if bias_after_scale:
+        return x * scale + jnp.asarray(bias, dtype=x.dtype)
+    return (x + jnp.asarray(bias, dtype=x.dtype)) * scale
+
+
+def negative(x):
+    return jnp.negative(x)
+
+
+def abs_(x):
+    return jnp.abs(x)
+
+
+def sign(x):
+    return jnp.sign(x)
+
+
+def exp(x):
+    return jnp.exp(x)
+
+
+def expm1(x):
+    return jnp.expm1(x)
+
+
+def log(x):
+    return jnp.log(x)
+
+
+def log2(x):
+    return jnp.log2(x)
+
+
+def log10(x):
+    return jnp.log10(x)
+
+
+def log1p(x):
+    return jnp.log1p(x)
+
+
+def sqrt(x):
+    return jnp.sqrt(x)
+
+
+def rsqrt(x):
+    return lax.rsqrt(x)
+
+
+def square(x):
+    return jnp.square(x)
+
+
+def reciprocal(x):
+    return jnp.reciprocal(x)
+
+
+def sin(x):
+    return jnp.sin(x)
+
+
+def cos(x):
+    return jnp.cos(x)
+
+
+def tan(x):
+    return jnp.tan(x)
+
+
+def asin(x):
+    return jnp.arcsin(x)
+
+
+def acos(x):
+    return jnp.arccos(x)
+
+
+def atan(x):
+    return jnp.arctan(x)
+
+
+def atan2(x, y):
+    return jnp.arctan2(x, y)
+
+
+def sinh(x):
+    return jnp.sinh(x)
+
+
+def cosh(x):
+    return jnp.cosh(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def asinh(x):
+    return jnp.arcsinh(x)
+
+
+def acosh(x):
+    return jnp.arccosh(x)
+
+
+def atanh(x):
+    return jnp.arctanh(x)
+
+
+def erf(x):
+    return jax.scipy.special.erf(x)
+
+
+def erfinv(x):
+    return jax.scipy.special.erfinv(x)
+
+
+def floor(x):
+    return jnp.floor(x)
+
+
+def ceil(x):
+    return jnp.ceil(x)
+
+
+def round_(x):
+    return jnp.round(x)
+
+
+def trunc(x):
+    return jnp.trunc(x)
+
+
+def frac(x):
+    return x - jnp.trunc(x)
+
+
+def clip(x, min=None, max=None):
+    return jnp.clip(x, min, max)
+
+
+def lerp(x, y, weight):
+    return x + weight * (y - x)
+
+
+def logit(x, eps=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+def isnan(x):
+    return jnp.isnan(x)
+
+
+def isinf(x):
+    return jnp.isinf(x)
+
+
+def isfinite(x):
+    return jnp.isfinite(x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+# ============================================================ logical / compare
+
+
+def equal(x, y):
+    return jnp.equal(x, y)
+
+
+def not_equal(x, y):
+    return jnp.not_equal(x, y)
+
+
+def greater_than(x, y):
+    return jnp.greater(x, y)
+
+
+def greater_equal(x, y):
+    return jnp.greater_equal(x, y)
+
+
+def less_than(x, y):
+    return jnp.less(x, y)
+
+
+def less_equal(x, y):
+    return jnp.less_equal(x, y)
+
+
+def logical_and(x, y):
+    return jnp.logical_and(x, y)
+
+
+def logical_or(x, y):
+    return jnp.logical_or(x, y)
+
+
+def logical_not(x):
+    return jnp.logical_not(x)
+
+
+def logical_xor(x, y):
+    return jnp.logical_xor(x, y)
+
+
+def bitwise_and(x, y):
+    return jnp.bitwise_and(x, y)
+
+
+def bitwise_or(x, y):
+    return jnp.bitwise_or(x, y)
+
+
+def bitwise_xor(x, y):
+    return jnp.bitwise_xor(x, y)
+
+
+def bitwise_not(x):
+    return jnp.bitwise_not(x)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+# ============================================================ reductions
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (tuple, list)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum_(x, axis=None, dtype=None, keepdim=False):
+    return jnp.sum(x, axis=_norm_axis(axis), dtype=to_jax_dtype(dtype), keepdims=keepdim)
+
+
+def mean(x, axis=None, keepdim=False):
+    return jnp.mean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def max_(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def min_(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None):
+    return jnp.prod(x, axis=_norm_axis(axis), keepdims=keepdim, dtype=to_jax_dtype(dtype))
+
+
+def logsumexp(x, axis=None, keepdim=False):
+    return jax.scipy.special.logsumexp(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def all_(x, axis=None, keepdim=False):
+    return jnp.all(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def any_(x, axis=None, keepdim=False):
+    return jnp.any(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(to_jax_dtype(dtype))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64"):
+    out = jnp.argmin(x, axis=axis, keepdims=keepdim if axis is not None else False)
+    return out.astype(to_jax_dtype(dtype))
+
+
+def var(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.var(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False):
+    return jnp.std(x, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False):
+    return jnp.median(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return jnp.quantile(x, q, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def cumsum(x, axis=None, dtype=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=to_jax_dtype(dtype))
+
+
+def cumprod(x, dim=None, dtype=None):
+    if dim is None:
+        x = jnp.ravel(x)
+        dim = 0
+    return jnp.cumprod(x, axis=dim, dtype=to_jax_dtype(dtype))
+
+
+def cummax(x, axis=0):
+    vals = lax.associative_scan(jnp.maximum, x, axis=axis)
+    return vals
+
+
+def amax(x, axis=None, keepdim=False):
+    return jnp.max(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def amin(x, axis=None, keepdim=False):
+    return jnp.min(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return jnp.count_nonzero(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def nansum(x, axis=None, keepdim=False):
+    return jnp.nansum(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return jnp.nanmean(x, axis=_norm_axis(axis), keepdims=keepdim)
+
+
+# ============================================================ search / sort
+
+
+def sort(x, axis=-1, descending=False):
+    out = jnp.sort(x, axis=axis)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+def argsort(x, axis=-1, descending=False):
+    idx = jnp.argsort(x, axis=axis)
+    if descending:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(jnp.int64)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True):
+    axis = axis if axis >= 0 else axis + x.ndim
+    if axis != x.ndim - 1:
+        xm = jnp.moveaxis(x, axis, -1)
+    else:
+        xm = x
+    if largest:
+        vals, idx = lax.top_k(xm, k)
+    else:
+        vals, idx = lax.top_k(-xm, k)
+        vals = -vals
+    if axis != x.ndim - 1:
+        vals = jnp.moveaxis(vals, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    res = jnp.unique(
+        x,
+        return_index=return_index,
+        return_inverse=return_inverse,
+        return_counts=return_counts,
+        axis=axis,
+    )
+    return res if isinstance(res, tuple) else (res,)
+
+
+def nonzero(x, as_tuple=False):
+    # NOTE: dynamic output shape — host-side only (not jittable); nojit op.
+    idx = jnp.nonzero(x)
+    if as_tuple:
+        return tuple(i[:, None] for i in idx)
+    return jnp.stack(idx, axis=1).astype(jnp.int64)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+    out = jnp.searchsorted(sorted_sequence, values, side=side)
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def bincount(x, weights=None, minlength=0):
+    return jnp.bincount(x, weights=weights, minlength=minlength)
+
+
+def one_hot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes, dtype=jnp.float32)
+
+
+# ============================================================ linalg
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+def bmm(x, y):
+    return jnp.matmul(x, y)
+
+
+def dot(x, y):
+    return jnp.sum(x * y, axis=-1)
+
+
+def mm(x, y):
+    return jnp.matmul(x, y)
+
+
+def mv(x, vec):
+    return jnp.matmul(x, vec)
+
+
+def outer(x, y):
+    return jnp.outer(x, y)
+
+
+def inner(x, y):
+    return jnp.inner(x, y)
+
+
+def cross(x, y, axis=-1):
+    return jnp.cross(x, y, axis=axis)
+
+
+def einsum(equation, operands):
+    return jnp.einsum(equation, *operands)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return beta * input + alpha * jnp.matmul(x, y)
+
+
+def p_norm(x, porder=2.0, axis=None, keepdim=False, epsilon=1e-12):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    if porder == float("inf"):
+        return jnp.max(jnp.abs(x), axis=axis, keepdims=keepdim)
+    if porder == float("-inf"):
+        return jnp.min(jnp.abs(x), axis=axis, keepdims=keepdim)
+    return jnp.sum(jnp.abs(x) ** porder, axis=axis, keepdims=keepdim) ** (1.0 / porder)
+
+
+def norm(x, p="fro", axis=None, keepdim=False):
+    if p == "fro" or (p == 2 and axis is None):
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    return p_norm(x, porder=float(p), axis=axis, keepdim=keepdim)
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+
+def inverse(x):
+    return jnp.linalg.inv(x)
+
+
+def pinv(x, rcond=1e-15):
+    return jnp.linalg.pinv(x, rtol=rcond)
+
+
+def det(x):
+    return jnp.linalg.det(x)
+
+
+def slogdet(x):
+    sign, logabs = jnp.linalg.slogdet(x)
+    return sign, logabs
+
+
+def cholesky(x, upper=False):
+    L = jnp.linalg.cholesky(x)
+    if upper:
+        return jnp.swapaxes(L, -1, -2).conj()
+    return L
+
+
+def qr(x, mode="reduced"):
+    q, r = jnp.linalg.qr(x, mode=mode)
+    return q, r
+
+
+def svd(x, full_matrices=False):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, vh
+
+
+def eigh(x, UPLO="L"):
+    w, v = jnp.linalg.eigh(x, UPLO=UPLO)
+    return w, v
+
+
+def eig(x):
+    # CPU-only in XLA; used for host-side math
+    w, v = jnp.linalg.eig(x)
+    return w, v
+
+
+def solve(x, y):
+    return jnp.linalg.solve(x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False):
+    if transpose:
+        x = jnp.swapaxes(x, -1, -2)
+        upper = not upper
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, unit_diagonal=unitriangular
+    )
+
+
+def lstsq(x, y, rcond=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+def matrix_power(x, n):
+    return jnp.linalg.matrix_power(x, n)
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def kron(x, y):
+    return jnp.kron(x, y)
+
+
+def histogram(x, bins=100, min=0, max=0):
+    if min == 0 and max == 0:
+        range_ = None
+    else:
+        range_ = (min, max)
+    hist, _ = jnp.histogram(x, bins=bins, range=range_)
+    return hist
+
+
+# ============================================================ fft (backed by XLA FFT; reference: paddle/phi/kernels/funcs/fft.cc via cuFFT)
+
+
+def fft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.fft(x, n=n, axis=axis, norm=norm)
+
+
+def ifft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.ifft(x, n=n, axis=axis, norm=norm)
+
+
+def rfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.rfft(x, n=n, axis=axis, norm=norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward"):
+    return jnp.fft.irfft(x, n=n, axis=axis, norm=norm)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.fft2(x, s=s, axes=tuple(axes), norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return jnp.fft.ifft2(x, s=s, axes=tuple(axes), norm=norm)
+
+
+def fftshift(x, axes=None):
+    return jnp.fft.fftshift(x, axes=axes)
+
+
+def ifftshift(x, axes=None):
+    return jnp.fft.ifftshift(x, axes=axes)

@@ -1,0 +1,776 @@
+"""NN kernels: activations, normalization, conv/pool, attention, losses, RNG ops.
+
+TPU-native analog of the reference's nn kernel set, including the fusion set
+(/root/reference/paddle/phi/kernels/fusion/gpu/ — fused_attention_kernel.cu:40,
+fused_rope_kernel.cu:27, rms_norm; and gpu/flash_attn_kernel.cu:587). Here
+"fusion" is mostly XLA's job: these are pure-jax compositions that XLA fuses;
+the attention core additionally has a Pallas flash-attention path
+(paddle_tpu/ops/pallas/) selected by FLAGS_use_pallas_kernels when shapes
+allow.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dtype import to_jax_dtype
+from ..core import random as _random
+
+# ============================================================ activations
+
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def relu6(x):
+    return jnp.clip(x, 0, 6)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+def prelu(x, weight):
+    return jnp.where(x >= 0, x, weight * x)
+
+
+def elu(x, alpha=1.0):
+    return jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def celu(x, alpha=1.0):
+    return jnp.maximum(x, 0) + jnp.minimum(0, alpha * jnp.expm1(x / alpha))
+
+
+def gelu(x, approximate=False):
+    return jax.nn.gelu(x, approximate=bool(approximate))
+
+
+def silu(x):
+    return jax.nn.silu(x)
+
+
+def swish(x):
+    return jax.nn.silu(x)
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def hardswish(x):
+    return x * jnp.clip(x + 3, 0, 6) / 6
+
+
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5):
+    return jnp.clip(x * slope + offset, 0, 1)
+
+
+def hardtanh(x, min=-1.0, max=1.0):
+    return jnp.clip(x, min, max)
+
+
+def hardshrink(x, threshold=0.5):
+    return jnp.where(jnp.abs(x) > threshold, x, 0)
+
+
+def softshrink(x, threshold=0.5):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0))
+
+
+def tanhshrink(x):
+    return x - jnp.tanh(x)
+
+
+def softplus(x, beta=1.0, threshold=20.0):
+    return jnp.where(x * beta > threshold, x, (1.0 / beta) * jnp.log1p(jnp.exp(beta * x)))
+
+
+def softsign(x):
+    return x / (1 + jnp.abs(x))
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def log_sigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1):
+    key = _random.next_key()
+    g = jax.random.gumbel(key, x.shape, dtype=x.dtype)
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        idx = jnp.argmax(y, axis=axis, keepdims=True)
+        y_hard = jnp.zeros_like(y)
+        y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+        # straight-through: forward emits one-hot, gradient flows through soft y
+        return lax.stop_gradient(y_hard - y) + y
+    return y
+
+
+def maxout(x, groups, axis=1):
+    shape = list(x.shape)
+    c = shape[axis]
+    shape[axis : axis + 1] = [c // groups, groups]
+    return jnp.max(jnp.reshape(x, shape), axis=axis + 1)
+
+
+def glu(x, axis=-1):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+# ============================================================ normalization
+
+
+def layer_norm(x, weight=None, bias=None, epsilon=1e-05, begin_norm_axis=-1):
+    axes = tuple(range(begin_norm_axis if begin_norm_axis >= 0 else x.ndim + begin_norm_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def rms_norm(x, weight=None, bias=None, epsilon=1e-06):
+    """Root-mean-square norm (reference: paddle/phi/kernels/gpu/rms_norm_kernel.cu:1081)."""
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = xf * lax.rsqrt(var + epsilon)
+    out = out.astype(dt)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def batch_norm(
+    x,
+    running_mean,
+    running_var,
+    weight=None,
+    bias=None,
+    training=False,
+    momentum=0.9,
+    epsilon=1e-05,
+    data_format="NCHW",
+):
+    """Returns (out, new_mean, new_var). Channel axis from data_format."""
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    if training:
+        mean = jnp.mean(x, axis=axes)
+        var = jnp.var(x, axis=axes)
+        n = x.size // x.shape[ch_axis]
+        unbiased_var = var * (n / max(n - 1, 1))
+        new_mean = momentum * running_mean + (1 - momentum) * mean
+        new_var = momentum * running_var + (1 - momentum) * unbiased_var
+    else:
+        mean, var = running_mean, running_var
+        new_mean, new_var = running_mean, running_var
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    out = (x - mean.reshape(shape)) * lax.rsqrt(var.reshape(shape) + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, new_mean, new_var
+
+
+def group_norm(x, weight=None, bias=None, epsilon=1e-05, groups=1, data_format="NCHW"):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    if ch_axis != 1:
+        x = jnp.moveaxis(x, ch_axis, 1)
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    g = groups
+    xg = jnp.reshape(x, (n, g, c // g) + spatial)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    out = (xg - mean) * lax.rsqrt(var + epsilon)
+    out = jnp.reshape(out, x.shape)
+    if weight is not None:
+        shape = (1, c) + (1,) * len(spatial)
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = (1, c) + (1,) * len(spatial)
+        out = out + bias.reshape(shape)
+    if ch_axis != 1:
+        out = jnp.moveaxis(out, 1, ch_axis)
+    return out
+
+
+def instance_norm(x, weight=None, bias=None, epsilon=1e-05):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * lax.rsqrt(var + epsilon)
+    if weight is not None:
+        shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        shape = (1, x.shape[1]) + (1,) * (x.ndim - 2)
+        out = out + bias.reshape(shape)
+    return out
+
+
+def l2_normalize(x, axis=-1, epsilon=1e-12):
+    return x * lax.rsqrt(jnp.sum(jnp.square(x), axis=axis, keepdims=True) + epsilon)
+
+
+# ============================================================ linear / embedding
+
+
+def linear(x, weight, bias=None):
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def embedding(x, weight, padding_idx=None):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None and padding_idx >= 0:
+        mask = (x == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+# ============================================================ dropout & random
+
+
+def dropout(x, p=0.5, training=True, mode="upscale_in_train"):
+    if not training or p == 0.0:
+        return x
+    key = _random.next_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if mode == "upscale_in_train":
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0):
+    key = _random.next_key()
+    return jax.random.uniform(
+        key, tuple(shape), dtype=to_jax_dtype(dtype), minval=min, maxval=max
+    )
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype="float32"):
+    key = _random.next_key()
+    return mean + std * jax.random.normal(key, tuple(shape), dtype=to_jax_dtype(dtype))
+
+
+def randint(low, high=None, shape=(1,), dtype="int64"):
+    if high is None:
+        low, high = 0, low
+    key = _random.next_key()
+    return jax.random.randint(key, tuple(shape), low, high, dtype=to_jax_dtype(dtype))
+
+
+def randperm(n, dtype="int64"):
+    key = _random.next_key()
+    return jax.random.permutation(key, n).astype(to_jax_dtype(dtype))
+
+
+def bernoulli(x):
+    key = _random.next_key()
+    return jax.random.bernoulli(key, x).astype(x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False):
+    key = _random.next_key()
+    logits = jnp.log(x)
+    if replacement:
+        return jax.random.categorical(key, logits, axis=-1, shape=x.shape[:-1] + (num_samples,)).astype(jnp.int64)
+    # without replacement: gumbel top-k
+    g = jax.random.gumbel(key, x.shape, dtype=jnp.float32)
+    _, idx = lax.top_k(logits + g, num_samples)
+    return idx.astype(jnp.int64)
+
+
+def normal_(shape, mean=0.0, std=1.0, dtype="float32"):
+    return gaussian(shape, mean, std, dtype)
+
+
+# ============================================================ conv / pool
+
+# Conv uses NCHW layout as the reference default; XLA handles layout
+# assignment internally so no manual transposes are needed for TPU.
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1, data_format="NCHW"):
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    elif isinstance(padding, str):
+        padding = padding.upper()
+    else:
+        padding = list(padding)
+        if len(padding) == 2 and not isinstance(padding[0], (list, tuple)):
+            padding = [(padding[0], padding[0]), (padding[1], padding[1])]
+        elif len(padding) == 4 and not isinstance(padding[0], (list, tuple)):
+            padding = [(padding[0], padding[1]), (padding[2], padding[3])]
+    dn = ("NCHW", "OIHW", "NCHW") if data_format == "NCHW" else ("NHWC", "HWIO", "NHWC")
+    if data_format == "NHWC":
+        weight = jnp.transpose(weight, (2, 3, 1, 0))
+    out = lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=tuple(stride),
+        padding=padding,
+        rhs_dilation=tuple(dilation),
+        feature_group_count=groups,
+        dimension_numbers=dn,
+    )
+    if bias is not None:
+        shape = (1, -1, 1, 1) if data_format == "NCHW" else (1, 1, 1, -1)
+        out = out + bias.reshape(shape)
+    return out
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    if isinstance(stride, (list, tuple)):
+        stride = stride[0]
+    if isinstance(dilation, (list, tuple)):
+        dilation = dilation[0]
+    if isinstance(padding, (list, tuple)):
+        padding = padding[0]
+    out = lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=(stride,),
+        padding=[(padding, padding)] if isinstance(padding, int) else padding.upper(),
+        rhs_dilation=(dilation,),
+        feature_group_count=groups,
+        dimension_numbers=("NCH", "OIH", "NCH"),
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1)
+    return out
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    if isinstance(stride, int):
+        stride = (stride,) * 3
+    if isinstance(dilation, int):
+        dilation = (dilation,) * 3
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * 3
+    out = lax.conv_general_dilated(
+        x,
+        weight,
+        window_strides=tuple(stride),
+        padding=padding if not isinstance(padding, str) else padding.upper(),
+        rhs_dilation=tuple(dilation),
+        feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+def conv2d_transpose(
+    x, weight, bias=None, stride=1, padding=0, output_padding=0, dilation=1, groups=1
+):
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    if isinstance(padding, int):
+        padding = [(padding, padding), (padding, padding)]
+    if groups != 1:
+        raise NotImplementedError("grouped conv_transpose not yet supported")
+    # weight layout: (in, out, kh, kw) — paddle convention
+    out = lax.conv_transpose(
+        x,
+        jnp.transpose(weight, (2, 3, 0, 1)),  # HWIO with I=in
+        strides=tuple(stride),
+        padding=padding if not isinstance(padding, str) else padding.upper(),
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NCHW", "HWIO", "NCHW"),
+        transpose_kernel=True,
+    )
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+def _pool_dims(kernel_size, stride, padding, nd=2):
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size,) * nd
+    if stride is None:
+        stride = kernel_size
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * nd
+    elif isinstance(padding, (list, tuple)) and padding and isinstance(padding[0], int):
+        padding = [(p, p) for p in padding]
+    return tuple(kernel_size), tuple(stride), padding
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, data_format="NCHW"):
+    k, s, p = _pool_dims(kernel_size, stride, padding)
+    if data_format == "NCHW":
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = [(0, 0), (0, 0)] + list(p)
+    else:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = [(0, 0)] + list(p) + [(0, 0)]
+    init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) else jnp.iinfo(x.dtype).min
+    return lax.reduce_window(x, init, lax.max, window, strides, pads)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True, data_format="NCHW"):
+    k, s, p = _pool_dims(kernel_size, stride, padding)
+    if data_format == "NCHW":
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = [(0, 0), (0, 0)] + list(p)
+    else:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        pads = [(0, 0)] + list(p) + [(0, 0)]
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    if exclusive and any(lo or hi for lo, hi in pads):
+        ones = jnp.ones_like(x)
+        counts = lax.reduce_window(ones, 0.0, lax.add, window, strides, pads)
+        return summed / counts
+    return summed / math.prod(k)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW"):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    if data_format != "NCHW":
+        x = jnp.transpose(x, (0, 3, 1, 2))
+    n, c, h, w = x.shape
+    if h % oh == 0 and w % ow == 0:
+        out = jnp.mean(jnp.reshape(x, (n, c, oh, h // oh, ow, w // ow)), axis=(3, 5))
+    else:
+        out = jax.image.resize(x, (n, c, oh, ow), method="linear")
+    if data_format != "NCHW":
+        out = jnp.transpose(out, (0, 2, 3, 1))
+    return out
+
+
+def adaptive_max_pool2d(x, output_size):
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    n, c, h, w = x.shape
+    assert h % oh == 0 and w % ow == 0, "adaptive_max_pool2d needs divisible sizes"
+    return jnp.max(jnp.reshape(x, (n, c, oh, h // oh, ow, w // ow)), axis=(3, 5))
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0):
+    k, s, p = _pool_dims(kernel_size, stride, padding, nd=1)
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    pads = [(0, 0), (0, 0)] + list(p)
+    return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, pads)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0):
+    k, s, p = _pool_dims(kernel_size, stride, padding, nd=1)
+    window = (1, 1) + k
+    strides = (1, 1) + s
+    pads = [(0, 0), (0, 0)] + list(p)
+    summed = lax.reduce_window(x, 0.0, lax.add, window, strides, pads)
+    return summed / k[0]
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False, data_format="NCHW"):
+    n, c, h, w = x.shape
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = (scale_factor, scale_factor)
+        size = (int(h * scale_factor[0]), int(w * scale_factor[1]))
+    method = {"nearest": "nearest", "bilinear": "linear", "bicubic": "cubic", "linear": "linear"}[mode]
+    return jax.image.resize(x, (n, c, size[0], size[1]), method=method)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW"):
+    n, c, h, w = x.shape
+    r = upscale_factor
+    x = jnp.reshape(x, (n, c // (r * r), r, r, h, w))
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return jnp.reshape(x, (n, c // (r * r), h * r, w * r))
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    if isinstance(kernel_sizes, int):
+        kernel_sizes = (kernel_sizes, kernel_sizes)
+    if isinstance(strides, int):
+        strides = (strides, strides)
+    if isinstance(paddings, int):
+        paddings = (paddings, paddings)
+    if isinstance(dilations, int):
+        dilations = (dilations, dilations)
+    n, c, h, w = x.shape
+    patches = lax.conv_general_dilated_patches(
+        x,
+        filter_shape=kernel_sizes,
+        window_strides=strides,
+        padding=[(paddings[0], paddings[0]), (paddings[1], paddings[1])],
+        rhs_dilation=dilations,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    n_, ck, oh, ow = patches.shape
+    return jnp.reshape(patches, (n_, ck, oh * ow))
+
+
+# ============================================================ attention
+
+
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, training=True):
+    """Attention core, (B, S, H, D) layout like the reference's flash_attn
+    (/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu:587).
+
+    The Pallas flash-attention kernel (ops/pallas/flash_attention.py) is used
+    by nn.functional when shapes/dtypes allow; this is the XLA fallback.
+    """
+    b, sq, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qh = jnp.swapaxes(q, 1, 2)  # B,H,S,D
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    # grouped-query attention: repeat kv heads
+    if kh.shape[1] != qh.shape[1]:
+        rep = qh.shape[1] // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * scale
+    logits = logits.astype(jnp.float32)
+    if is_causal:
+        sk = kh.shape[2]
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, -jnp.inf)
+        else:
+            logits = logits + attn_mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and training:
+        probs = dropout(probs, p=dropout_p, training=True)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def rotary_position_embedding(q, k, cos, sin, position_ids=None, use_neox_rotary_style=True):
+    """Fused RoPE analog (/root/reference/paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu:27).
+
+    q, k: (B, S, H, D); cos/sin: (1, S, 1, D) or (S, D).
+    """
+
+    def rope(x):
+        if x is None:
+            return None
+        c = cos.astype(x.dtype)
+        s = sin.astype(x.dtype)
+        if c.ndim == 2:
+            c = c[None, :, None, :]
+            s = s[None, :, None, :]
+        c = c[:, : x.shape[1]]
+        s = s[:, : x.shape[1]]
+        if use_neox_rotary_style:
+            half = x.shape[-1] // 2
+            x1, x2 = x[..., :half], x[..., half:]
+            rotated = jnp.concatenate([-x2, x1], axis=-1)
+        else:
+            x1 = x[..., 0::2]
+            x2 = x[..., 1::2]
+            rotated = jnp.reshape(jnp.stack([-x2, x1], axis=-1), x.shape)
+        return x * c + rotated * s
+
+    return rope(q), rope(k)
+
+
+# ============================================================ losses
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, axis=-1):
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+    if soft_label:
+        loss = -jnp.sum(label * logp, axis=axis, keepdims=True)
+    else:
+        lab = label
+        if lab.ndim == logits.ndim and lab.shape[axis] == 1:
+            lab = jnp.squeeze(lab, axis=axis)
+        picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32), axis=axis)
+        loss = -picked
+        if ignore_index >= 0 or ignore_index != -100:
+            mask = (lab != ignore_index)[..., None]
+            loss = jnp.where(mask, loss, 0.0)
+    return loss
+
+
+def cross_entropy(
+    input,
+    label,
+    weight=None,
+    ignore_index=-100,
+    reduction="mean",
+    soft_label=False,
+    axis=-1,
+    label_smoothing=0.0,
+):
+    logp = jax.nn.log_softmax(input.astype(jnp.float32), axis=axis)
+    nclass = input.shape[axis]
+    if soft_label:
+        target = label
+        loss = -jnp.sum(target * logp, axis=axis)
+        valid = jnp.ones_like(loss, dtype=bool)
+    else:
+        lab = label
+        if lab.ndim == input.ndim and lab.shape[axis] == 1:
+            lab = jnp.squeeze(lab, axis=axis)
+        lab = lab.astype(jnp.int32)
+        valid = lab != ignore_index
+        safe_lab = jnp.where(valid, lab, 0)
+        if label_smoothing > 0.0:
+            eps = label_smoothing
+            onehot = jax.nn.one_hot(safe_lab, nclass, dtype=logp.dtype)
+            target = onehot * (1 - eps) + eps / nclass
+            loss = -jnp.sum(target * logp, axis=axis)
+        else:
+            loss = -jnp.take_along_axis(logp, safe_lab[..., None], axis=axis)[..., 0]
+        if weight is not None:
+            w = jnp.take(weight, safe_lab)
+            loss = loss * w
+        loss = jnp.where(valid, loss, 0.0)
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    if weight is not None and not soft_label:
+        denom = jnp.sum(jnp.where(valid, jnp.take(weight, jnp.where(valid, lab, 0)), 0.0))
+    else:
+        denom = jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return jnp.sum(loss) / denom
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean"):
+    lab = label.astype(jnp.int32)
+    valid = lab != ignore_index
+    safe = jnp.where(valid, lab, 0)
+    loss = -jnp.take_along_axis(input, safe[..., None], axis=-1)[..., 0]
+    if weight is not None:
+        loss = loss * jnp.take(weight, safe)
+    loss = jnp.where(valid, loss, 0.0)
+    if reduction == "none":
+        return loss
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+
+
+def mse_loss(input, label, reduction="mean"):
+    loss = jnp.square(input - label)
+    if reduction == "none":
+        return loss
+    return jnp.mean(loss) if reduction == "mean" else jnp.sum(loss)
+
+
+def l1_loss(input, label, reduction="mean"):
+    loss = jnp.abs(input - label)
+    if reduction == "none":
+        return loss
+    return jnp.mean(loss) if reduction == "mean" else jnp.sum(loss)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta, diff - 0.5 * delta)
+    if reduction == "none":
+        return loss
+    return jnp.mean(loss) if reduction == "mean" else jnp.sum(loss)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean"):
+    eps = 1e-12
+    loss = -(label * jnp.log(input + eps) + (1 - label) * jnp.log(1 - input + eps))
+    if weight is not None:
+        loss = loss * weight
+    if reduction == "none":
+        return loss
+    return jnp.mean(loss) if reduction == "mean" else jnp.sum(loss)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean", pos_weight=None):
+    max_val = jnp.clip(-logit, 0, None)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1) * label + 1
+        loss = (1 - label) * logit + log_w * (jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val
+    if weight is not None:
+        loss = loss * weight
+    if reduction == "none":
+        return loss
+    return jnp.mean(loss) if reduction == "mean" else jnp.sum(loss)
+
+
+def kl_div(input, label, reduction="mean"):
+    loss = label * (jnp.log(jnp.clip(label, 1e-12, None)) - input)
+    if reduction == "none":
+        return loss
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return jnp.mean(loss) if reduction == "mean" else jnp.sum(loss)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    loss = jnp.where(label == 1, input, jnp.maximum(0.0, margin - input))
+    if reduction == "none":
+        return loss
+    return jnp.mean(loss) if reduction == "mean" else jnp.sum(loss)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / n

@@ -1,0 +1,253 @@
+"""YAML-driven op registry + eager dispatcher.
+
+The reference generates its whole op surface from YAML
+(/root/reference/paddle/phi/ops/yaml/ops.yaml — args/output/infer_meta/
+kernel/backward per op) through ~10 build-time code generators. We keep the
+single-source-of-truth idea but resolve it at import time: ``ops.yaml``
+declares each op's tensor inputs, kernel and backward rule; this module
+binds them into dispatchable ops.
+
+Dispatch (analog of phi KernelFactory + the generated ad_func chain,
+/root/reference/paddle/phi/core/kernel_factory.cc:267):
+
+- no grad needed → kernel runs through a cached ``jax.jit`` executable keyed
+  by (op, attrs); jax adds shape/dtype/sharding specialization on top. This
+  executable cache is the phi-dispatch analog that makes eager viable on TPU.
+- grad needed, explicit backward rule → jitted forward now, rule at backward.
+- grad needed, no rule → ``jax.vjp`` at forward time (one forward pass, XLA
+  residuals saved in the node; no replay at backward).
+"""
+from __future__ import annotations
+
+import inspect
+from dataclasses import dataclass, field
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd
+from ..core.autograd import GradNode
+from ..core.flags import flag
+from ..core.tensor import Tensor
+
+__all__ = ["OpDef", "register_op", "get_op", "apply_op", "OPS"]
+
+OPS: dict[str, "OpDef"] = {}
+
+
+@dataclass
+class OpDef:
+    name: str
+    kernel: Callable
+    inputs: tuple  # tensor input names; trailing '*' marks a variadic list
+    attrs: tuple = ()  # attribute names (static under jit)
+    backward: Callable | None = None
+    nojit: bool = False  # creation/random ops: skip the per-op jit cache
+    differentiable: bool = True
+    sig: inspect.Signature = field(default=None, repr=False)
+    _jit_cache: dict = field(default_factory=dict, repr=False)
+
+    def __post_init__(self):
+        self.sig = inspect.signature(self.kernel)
+        self.input_names = tuple(n.rstrip("*") for n in self.inputs)
+        self.is_variadic = tuple(n.endswith("*") for n in self.inputs)
+
+    def call_kernel(self, in_vals: list, attrs: dict):
+        if self.nojit or not flag("FLAGS_eager_op_jit"):
+            return self.kernel(*in_vals, **attrs)
+        key = (_freeze(attrs), tuple(_struct_key(v) for v in in_vals))
+        fn = self._jit_cache.get(key)
+        if fn is None:
+            kernel = self.kernel
+
+            def run(*vals):
+                return kernel(*vals, **attrs)
+
+            fn = jax.jit(run)
+            self._jit_cache[key] = fn
+        return fn(*in_vals)
+
+
+def _struct_key(v):
+    if v is None:
+        return "n"
+    if isinstance(v, list):
+        return ("l", len(v), tuple("n" if x is None else "t" for x in v))
+    if isinstance(v, (jax.Array, jax.core.Tracer)):
+        return "t"
+    return ("s", v)  # non-tensor positional (python scalar passed where tensor allowed)
+
+
+def _freeze(obj):
+    if isinstance(obj, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in obj.items()))
+    if isinstance(obj, (list, tuple)):
+        return tuple(_freeze(v) for v in obj)
+    if isinstance(obj, (set, frozenset)):
+        return frozenset(_freeze(v) for v in obj)
+    return obj
+
+
+def register_op(name, kernel, inputs, backward=None, nojit=False, differentiable=True):
+    params = list(inspect.signature(kernel).parameters)
+    input_names = [n.rstrip("*") for n in inputs]
+    for n in input_names:
+        if n not in params:
+            raise ValueError(f"op {name}: declared input {n!r} not in kernel signature {params}")
+    attrs = tuple(p for p in params if p not in input_names)
+    op = OpDef(
+        name=name,
+        kernel=kernel,
+        inputs=tuple(inputs),
+        attrs=attrs,
+        backward=backward,
+        nojit=nojit,
+        differentiable=differentiable,
+    )
+    OPS[name] = op
+    return op
+
+
+def get_op(name) -> OpDef:
+    return OPS[name]
+
+
+def _is_tracer(v):
+    return isinstance(v, jax.core.Tracer)
+
+
+class Ctx:
+    """Context passed to explicit backward rules: saved forward values."""
+
+    __slots__ = ("inputs", "attrs", "outputs", "needs")
+
+    def __init__(self, inputs, attrs, outputs, needs):
+        self.inputs = inputs  # kernel-positional input values (lists kept as lists)
+        self.attrs = attrs
+        self.outputs = outputs  # flat list of output values
+        self.needs = needs  # per-flat-tensor-input needs-grad mask
+
+    def needs_grad(self, i):
+        return i < len(self.needs) and self.needs[i]
+
+
+def apply_op(op: OpDef, *args, **kwargs):
+    """Dispatch one eager op call. Returns Tensor or tuple of Tensors."""
+    bound = op.sig.bind(*args, **kwargs)
+    bound.apply_defaults()
+    arguments = bound.arguments
+
+    in_tensors: list[Tensor] = []  # flat tensor inputs, in kernel order
+    in_specs: list = []  # ("arg", pos, None) or ("list_item", pos, sub)
+    in_vals: list = []
+    for name, is_var in zip(op.input_names, op.is_variadic):
+        v = arguments[name]
+        if is_var:
+            vals = []
+            for item in (list(v) if v is not None else []):
+                if isinstance(item, Tensor):
+                    in_tensors.append(item)
+                    in_specs.append(("list_item", len(in_vals), len(vals)))
+                    vals.append(item._value)
+                elif item is None:
+                    vals.append(None)
+                else:
+                    vals.append(jnp.asarray(item))
+            in_vals.append(vals)
+        elif isinstance(v, Tensor):
+            in_tensors.append(v)
+            in_specs.append(("arg", len(in_vals), None))
+            in_vals.append(v._value)
+        else:
+            in_vals.append(v)
+
+    attrs = {}
+    for name in op.attrs:
+        a = arguments[name]
+        if isinstance(a, Tensor):  # attrs must be static: concretize
+            a = a.numpy()
+            a = a.item() if a.size == 1 else tuple(a.tolist())
+        if isinstance(a, (list, tuple, dict, set)):
+            a = _freeze(a)
+        attrs[name] = a
+
+    tracing = any(
+        _is_tracer(x)
+        for v in in_vals
+        for x in (v if isinstance(v, list) else [v])
+        if x is not None
+    )
+    requires_grad = (
+        op.differentiable
+        and not tracing
+        and autograd.is_grad_enabled()
+        and any(not t.stop_gradient for t in in_tensors)
+    )
+
+    vjp_fn = None
+    if requires_grad and op.backward is None:
+        # Forward through jax.vjp: one pass, residuals kept for backward.
+        def fwd(*tensor_vals):
+            vals = [list(v) if isinstance(v, list) else v for v in in_vals]
+            for spec, tv in zip(in_specs, tensor_vals):
+                kind, pos, sub = spec
+                if kind == "arg":
+                    vals[pos] = tv
+                else:
+                    vals[pos][sub] = tv
+            out = op.kernel(*vals, **attrs)
+            return out if isinstance(out, (tuple, list)) else (out,)
+
+        primals = [t._value for t in in_tensors]
+        outs_flat, vjp_fn = jax.vjp(fwd, *primals)
+        outs_flat = list(outs_flat)
+        single = len(outs_flat) == 1
+    else:
+        out_vals = op.call_kernel(in_vals, attrs)
+        single = not isinstance(out_vals, (tuple, list))
+        outs_flat = [out_vals] if single else list(out_vals)
+
+    out_tensors = [None if v is None else Tensor._from_value(v) for v in outs_flat]
+
+    if requires_grad:
+        edges = []
+        needs = []
+        for t in in_tensors:
+            if not t.stop_gradient:
+                edges.append(t._grad_edge())
+                needs.append(True)
+            else:
+                edges.append(None)
+                needs.append(False)
+
+        if vjp_fn is not None:
+            out_shapes = [(v.shape, v.dtype) for v in outs_flat]
+
+            def backward_fn(grad_outputs, _vjp=vjp_fn, _shapes=out_shapes):
+                gouts = tuple(
+                    g if g is not None else jnp.zeros(s, d)
+                    for g, (s, d) in zip(grad_outputs, _shapes)
+                )
+                grads = _vjp(gouts)
+                return tuple(g if need else None for g, need in zip(grads, needs))
+
+        else:
+            rule = op.backward
+            saved_in = in_vals
+            saved_out = outs_flat
+
+            def backward_fn(grad_outputs, _rule=rule):
+                ctx = Ctx(saved_in, attrs, saved_out, tuple(needs))
+                return _rule(ctx, *grad_outputs)
+
+        node = GradNode(op.name, backward_fn, edges, len(outs_flat), tuple(needs))
+        for i, t in enumerate(out_tensors):
+            if t is not None:
+                t.stop_gradient = False
+                t._grad_node = node
+                t._grad_slot = i
+
+    if single:
+        return out_tensors[0]
+    return tuple(out_tensors)
