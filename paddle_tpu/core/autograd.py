@@ -99,15 +99,20 @@ class AccumulationNode:
         self.tensor_ref = weakref.ref(tensor)
         self.hooks: list[Callable] = []
 
-    def apply(self, grad_value):
-        t = self.tensor_ref()
-        if t is None:
-            return
+    def run_hooks(self, grad_value):
         for h in self.hooks:
             new = h(grad_value)
             if new is not None:
                 grad_value = new
-        t._accumulate_grad(grad_value)
+        return grad_value
+
+    def write(self, grad_value):
+        t = self.tensor_ref()
+        if t is not None:
+            t._accumulate_grad(grad_value)
+
+    def apply(self, grad_value):
+        self.write(self.run_hooks(grad_value))
 
     def __repr__(self):
         return "<AccumulationNode>"
@@ -121,8 +126,16 @@ def _add(a, b):
     return a + b
 
 
-def backward(tensors, grad_tensors=None, retain_graph=False):
-    """Run the backward sweep from ``tensors`` (typically a scalar loss)."""
+def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
+             write_grads=True):
+    """Run the backward sweep from ``tensors`` (typically a scalar loss).
+
+    ``capture``: optional dict mapping ``(id(node), slot)`` → list; when that
+    node is processed, the accumulated gradient arriving at ``slot`` is
+    appended. This is how ``grad()`` observes gradients of *intermediate*
+    tensors (the analog of the reference's general_grad.h edge interception).
+    ``write_grads=False`` skips writing ``.grad`` on leaves (grad() mode).
+    """
     from .tensor import Tensor
 
     if isinstance(tensors, Tensor):
@@ -198,7 +211,34 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         if isinstance(node, AccumulationNode):
             g = slot_grads.get(0)
             if g is not None:
-                node.apply(g)
+                g = node.run_hooks(g)
+                if capture is not None:
+                    sink = capture.get((id(node), 0))
+                    if sink is not None:
+                        sink.append(g)
+                if write_grads:
+                    node.write(g)
+            continue
+
+        if capture is not None:
+            for slot, g in slot_grads.items():
+                sink = capture.get((id(node), slot))
+                if sink is not None:
+                    sink.append(g)
+
+        if not slot_grads:
+            # Every consumer returned None for this node's outputs: nothing to
+            # differentiate; propagate "no gradient" downstream without
+            # invoking the rule (explicit rules assume >=1 real grad).
+            for edge in node.edges:
+                if edge is None:
+                    continue
+                nxt, _ = edge
+                indeg[id(nxt)] -= 1
+                if indeg[id(nxt)] <= 0:
+                    queue.append(nxt)
+            if not retain_graph:
+                node.backward_fn = _dead_backward
             continue
 
         grad_outputs = tuple(
@@ -213,17 +253,19 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
                 f"{len(node.edges)} inputs"
             )
         for edge, g in zip(node.edges, grads_in):
-            if edge is None or g is None:
+            if edge is None:
                 continue
+            # Decrement-always policy: a backward rule may legitimately
+            # return None for a connected input (unreached branch); the
+            # consumer count still drops so downstream nodes can fire
+            # (reference: node_in_degree_map in eager/backward.cc).
             nxt, slot = edge
-            buf = buffers[id(nxt)]
-            buf[slot] = _add(buf.get(slot), g)
-            if isinstance(nxt, AccumulationNode):
+            if g is not None:
+                buf = buffers[id(nxt)]
+                buf[slot] = _add(buf.get(slot), g)
+            indeg[id(nxt)] -= 1
+            if indeg[id(nxt)] <= 0:
                 queue.append(nxt)
-            else:
-                indeg[id(nxt)] -= 1
-                if indeg[id(nxt)] <= 0:
-                    queue.append(nxt)
         if not retain_graph:
             node.backward_fn = _dead_backward
 
@@ -236,8 +278,10 @@ def _dead_backward(*_):
 
 
 def grad(outputs, inputs, grad_outputs=None, retain_graph=False, allow_unused=False):
-    """``paddle.grad`` analog: gradients of outputs w.r.t. inputs without
-    touching ``.grad`` of other leaves (reference: general_grad.h)."""
+    """``paddle.grad`` analog: gradients of outputs w.r.t. inputs (leaf OR
+    intermediate) without touching ``.grad`` of any leaf (reference:
+    general_grad.h). An intermediate tensor's gradient is observed at the
+    ``(producer_node, slot)`` edge where its consumers deposited grads."""
     from .tensor import Tensor
 
     if isinstance(outputs, Tensor):
@@ -245,37 +289,25 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, allow_unused=Fa
     if isinstance(inputs, Tensor):
         inputs = [inputs]
 
-    # Temporarily intercept accumulation into the requested inputs.
-    captured: dict[int, jax.Array] = {}
-    saved_accs = []
+    capture: dict[tuple[int, int], list] = {}
+    edges = []
     for t in inputs:
-        acc = t._acc_node_for_grad_api()
-        saved_accs.append((t, acc, list(acc.hooks) if acc else None))
+        node, slot = t._grad_edge()
+        edges.append((node, slot))
+        if node is not None:
+            capture.setdefault((id(node), slot), [])
 
-    def make_hook(idx):
-        def hook(g):
-            captured[idx] = _add(captured.get(idx), g)
-            return g
-
-        return hook
-
-    saved_grads = [t._grad for t in inputs]
-    for i, (t, acc, _) in enumerate(saved_accs):
-        if acc is not None:
-            acc.hooks.append(make_hook(i))
-
-    try:
-        backward(outputs, grad_outputs, retain_graph=retain_graph)
-    finally:
-        for (t, acc, old_hooks), old_grad in zip(saved_accs, saved_grads):
-            if acc is not None:
-                acc.hooks[:] = old_hooks
-            t._grad = old_grad
+    backward(outputs, grad_outputs, retain_graph=retain_graph,
+             capture=capture, write_grads=False)
 
     results = []
-    for i, t in enumerate(inputs):
-        if i in captured:
-            results.append(Tensor._from_value(captured[i], stop_gradient=True))
+    for i, (t, (node, slot)) in enumerate(zip(inputs, edges)):
+        vals = capture.get((id(node), slot)) if node is not None else None
+        if vals:
+            g = vals[0]
+            for v in vals[1:]:
+                g = _add(g, v)
+            results.append(Tensor._from_value(g, stop_gradient=True))
         elif allow_unused:
             results.append(None)
         else:

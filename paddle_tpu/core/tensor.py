@@ -92,13 +92,6 @@ class Tensor:
             return self._acc_node, 0
         return None, 0
 
-    def _acc_node_for_grad_api(self):
-        if self._grad_node is not None:
-            return None
-        if self._acc_node is None and not self.stop_gradient:
-            self._acc_node = AccumulationNode(self)
-        return self._acc_node
-
     def _accumulate_grad(self, value):
         if self._grad is None:
             self._grad = Tensor._from_value(value, stop_gradient=True, name=self.name + "@GRAD")

@@ -47,8 +47,32 @@ _load_yaml_registry()
 def _make_public(op_name):
     op = OPS[op_name]
 
-    def fn(*args, **kwargs):
-        return apply_op(op, *args, **kwargs)
+    if "rng_key" in op.input_names:
+        # Stateful-RNG ops (dropout, sdpa-with-dropout): thread fresh key data
+        # from the global RNG as a *traced operand* so the per-op executable
+        # cache stays valid (a None key inside jit would bake a constant mask).
+        # The key is only drawn when randomness will actually be consumed
+        # (p>0 and training), so eval passes don't perturb seeded runs.
+        import jax as _jax
+
+        from ..core.random import next_key as _next_key
+
+        def fn(*args, **kwargs):
+            ba = op.sig.bind_partial(*args, **kwargs)
+            ba.apply_defaults()
+            bound = ba.arguments
+            if bound.get("rng_key") is None:
+                p = bound.get("p", bound.get("dropout_p", 1.0))
+                if bound.get("training", True) and (
+                    not isinstance(p, (int, float)) or p > 0.0
+                ):
+                    kwargs["rng_key"] = _jax.random.key_data(_next_key())
+            return apply_op(op, *args, **kwargs)
+
+    else:
+
+        def fn(*args, **kwargs):
+            return apply_op(op, *args, **kwargs)
 
     fn.__name__ = op_name
     fn.__qualname__ = op_name

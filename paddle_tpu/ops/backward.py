@@ -7,9 +7,12 @@ rule here fall back to jax.vjp recorded at forward time (registry.py); the
 explicit rules save residual memory on the hottest paths and express the
 no-need-buffer optimizations (e.g. relu keeps only the output).
 
-Rule signature: ``rule(ctx, *grad_outputs) -> tuple(grads per flat tensor
-input)``. ``ctx.inputs`` are kernel-positional values, ``ctx.outputs`` flat
-output values, ``ctx.attrs`` the static attributes.
+Rule signature: ``rule(ctx, *grad_outputs) -> tuple(one grad per DECLARED
+input position)`` — None for non-tensor/no-grad positions, a list of grads
+for a variadic input; the dispatcher (registry.apply_op) flattens these onto
+the actual tensor edges, so rules never care whether an operand was a Tensor
+or a python scalar. ``ctx.inputs`` are kernel-positional values,
+``ctx.outputs`` flat output values, ``ctx.attrs`` the static attributes.
 """
 from __future__ import annotations
 
@@ -199,17 +202,15 @@ def softmax_grad(ctx, gout):
 
 
 def embedding_grad(ctx, gout):
-    # Inputs: (x, weight); only weight is differentiable. The weight is the
-    # last flat tensor input whether or not x was passed as a Tensor.
+    # Declared inputs: (x, weight); only weight is differentiable.
     x, weight = ctx.inputs[0], ctx.inputs[1]
-    grads = [None] * len(ctx.needs)
-    if ctx.needs[-1]:
-        gw = jnp.zeros(weight.shape, dtype=gout.dtype).at[x].add(gout)
-        padding_idx = ctx.attrs.get("padding_idx")
-        if padding_idx is not None and padding_idx >= 0:
-            gw = gw.at[padding_idx].set(0.0)
-        grads[-1] = gw
-    return tuple(grads)
+    if not ctx.needs_grad(1):
+        return None, None
+    gw = jnp.zeros(weight.shape, dtype=gout.dtype).at[x].add(gout)
+    padding_idx = ctx.attrs.get("padding_idx")
+    if padding_idx is not None and padding_idx >= 0:
+        gw = gw.at[padding_idx].set(0.0)
+    return None, gw
 
 
 def concat_grad(ctx, gout):
@@ -222,13 +223,13 @@ def concat_grad(ctx, gout):
         acc += s
         idx.append(acc)
     parts = jnp.split(gout, idx, axis=int(axis))
-    return tuple(p if need else None for p, need in zip(parts, ctx.needs))
+    return (list(parts),)
 
 
 def stack_grad(ctx, gout):
     axis = ctx.attrs.get("axis", 0)
     parts = jnp.moveaxis(gout, axis, 0)
-    return tuple(parts[i] if need else None for i, need in enumerate(ctx.needs))
+    return (list(parts),)
 
 
 RULES = {

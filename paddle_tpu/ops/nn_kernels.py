@@ -91,7 +91,12 @@ def tanhshrink(x):
 
 
 def softplus(x, beta=1.0, threshold=20.0):
-    return jnp.where(x * beta > threshold, x, (1.0 / beta) * jnp.log1p(jnp.exp(beta * x)))
+    # Double-where: clamp the exp argument in the untaken branch so jax.vjp
+    # never sees inf * 0 (which poisons gradients with NaN for x*beta > threshold).
+    xb = x * beta
+    big = xb > threshold
+    safe = jnp.where(big, 0.0, xb)
+    return jnp.where(big, x, (1.0 / beta) * jnp.log1p(jnp.exp(safe)))
 
 
 def softsign(x):
@@ -265,10 +270,13 @@ def embedding(x, weight, padding_idx=None):
 # ============================================================ dropout & random
 
 
-def dropout(x, p=0.5, training=True, mode="upscale_in_train"):
+def dropout(x, rng_key=None, p=0.5, training=True, mode="upscale_in_train"):
+    """``rng_key`` is raw uint32 key data (a traced operand) so this kernel is
+    jit-cacheable; callers (nn.functional) thread it from the global RNG. A
+    bare eager call without a key still works (stateful fallback)."""
     if not training or p == 0.0:
         return x
-    key = _random.next_key()
+    key = jax.random.wrap_key_data(rng_key) if rng_key is not None else _random.next_key()
     keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
     if mode == "upscale_in_train":
         return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
@@ -557,12 +565,14 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
 # ============================================================ attention
 
 
-def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False, training=True):
+def scaled_dot_product_attention(q, k, v, attn_mask=None, rng_key=None,
+                                 dropout_p=0.0, is_causal=False, training=True):
     """Attention core, (B, S, H, D) layout like the reference's flash_attn
     (/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu:587).
 
-    The Pallas flash-attention kernel (ops/pallas/flash_attention.py) is used
-    by nn.functional when shapes/dtypes allow; this is the XLA fallback.
+    This is the XLA fallback path; nn.functional routes to the Pallas
+    flash-attention kernel (ops/pallas/flash_attention.py) when shapes/dtypes
+    allow. ``rng_key`` is raw uint32 key data for dropout (jit-cacheable).
     """
     b, sq, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
@@ -587,7 +597,7 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0, is_caus
             logits = logits + attn_mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     if dropout_p > 0.0 and training:
-        probs = dropout(probs, p=dropout_p, training=True)
+        probs = dropout(probs, rng_key, p=dropout_p, training=True)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
     return jnp.swapaxes(out, 1, 2)
 

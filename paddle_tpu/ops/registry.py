@@ -53,8 +53,8 @@ class OpDef:
         self.input_names = tuple(n.rstrip("*") for n in self.inputs)
         self.is_variadic = tuple(n.endswith("*") for n in self.inputs)
 
-    def call_kernel(self, in_vals: list, attrs: dict):
-        if self.nojit or not flag("FLAGS_eager_op_jit"):
+    def call_kernel(self, in_vals: list, attrs: dict, force_nojit=False):
+        if self.nojit or force_nojit or not flag("FLAGS_eager_op_jit"):
             return self.kernel(*in_vals, **attrs)
         key = (_freeze(attrs), tuple(_struct_key(v) for v in in_vals))
         fn = self._jit_cache.get(key)
@@ -118,7 +118,14 @@ def _is_tracer(v):
 
 
 class Ctx:
-    """Context passed to explicit backward rules: saved forward values."""
+    """Context passed to explicit backward rules: saved forward values.
+
+    Rule contract: ``rule(ctx, *grad_outputs)`` returns one gradient per
+    *declared input position* (None for non-tensor/no-grad positions; a
+    list/tuple of grads for a variadic input). The dispatcher flattens these
+    onto the actual tensor edges, so a rule never needs to know whether a
+    given operand was passed as a Tensor or a python scalar.
+    """
 
     __slots__ = ("inputs", "attrs", "outputs", "needs")
 
@@ -126,7 +133,7 @@ class Ctx:
         self.inputs = inputs  # kernel-positional input values (lists kept as lists)
         self.attrs = attrs
         self.outputs = outputs  # flat list of output values
-        self.needs = needs  # per-flat-tensor-input needs-grad mask
+        self.needs = needs  # per-declared-input needs-grad mask
 
     def needs_grad(self, i):
         return i < len(self.needs) and self.needs[i]
@@ -204,7 +211,11 @@ def apply_op(op: OpDef, *args, **kwargs):
         outs_flat = list(outs_flat)
         single = len(outs_flat) == 1
     else:
-        out_vals = op.call_kernel(in_vals, attrs)
+        # A None rng_key means the kernel's stateful-RNG fallback would run at
+        # trace time and bake a constant key into the cached executable —
+        # bypass the jit cache for that call (public wrappers thread real keys).
+        stateful_rng = "rng_key" in op.input_names and arguments.get("rng_key") is None
+        out_vals = op.call_kernel(in_vals, attrs, force_nojit=stateful_rng)
         single = not isinstance(out_vals, (tuple, list))
         outs_flat = [out_vals] if single else list(out_vals)
 
@@ -226,7 +237,7 @@ def apply_op(op: OpDef, *args, **kwargs):
 
             def backward_fn(grad_outputs, _vjp=vjp_fn, _shapes=out_shapes):
                 gouts = tuple(
-                    g if g is not None else jnp.zeros(s, d)
+                    g if g is not None else _zero_cotangent(s, d)
                     for g, (s, d) in zip(grad_outputs, _shapes)
                 )
                 grads = _vjp(gouts)
@@ -236,18 +247,59 @@ def apply_op(op: OpDef, *args, **kwargs):
             rule = op.backward
             saved_in = in_vals
             saved_out = outs_flat
+            # Declared-aligned needs mask (any tensor at that position).
+            needs_decl = [False] * len(in_vals)
+            for (kind, pos, sub), nd in zip(in_specs, needs):
+                needs_decl[pos] = needs_decl[pos] or nd
+            needs_decl = tuple(needs_decl)
+            specs = tuple(in_specs)
 
             def backward_fn(grad_outputs, _rule=rule):
-                ctx = Ctx(saved_in, attrs, saved_out, tuple(needs))
-                return _rule(ctx, *grad_outputs)
+                ctx = Ctx(saved_in, attrs, saved_out, needs_decl)
+                decl = _rule(ctx, *grad_outputs)
+                if not isinstance(decl, (tuple, list)):
+                    decl = (decl,)
+                flat = []
+                for (kind, pos, sub), need in zip(specs, needs):
+                    g = decl[pos] if pos < len(decl) else None
+                    if kind == "list_item":
+                        g = (
+                            g[sub]
+                            if isinstance(g, (list, tuple)) and sub < len(g)
+                            else None
+                        )
+                    flat.append(g if need else None)
+                return tuple(flat)
 
         node = GradNode(op.name, backward_fn, edges, len(outs_flat), tuple(needs))
         for i, t in enumerate(out_tensors):
-            if t is not None:
+            # Integer/bool outputs (indices from topk/argsort/...) carry no
+            # gradient: keep them stop_gradient=True so jax.vjp never sees a
+            # dense cotangent for them (it requires float0 there).
+            if t is not None and jnp.issubdtype(t._value.dtype, jnp.inexact):
                 t.stop_gradient = False
                 t._grad_node = node
                 t._grad_slot = i
 
+    if flag("FLAGS_check_nan_inf") and not tracing:
+        for v in outs_flat:
+            if v is not None and jnp.issubdtype(v.dtype, jnp.inexact):
+                if not bool(jnp.all(jnp.isfinite(v))):
+                    raise FloatingPointError(
+                        f"Op `{op.name}` produced NaN/Inf output "
+                        f"(FLAGS_check_nan_inf is enabled)"
+                    )
+
     if single:
         return out_tensors[0]
     return tuple(out_tensors)
+
+
+def _zero_cotangent(shape, dtype):
+    """Zero cotangent matching jax.vjp's expectation: dense zeros for inexact
+    primal outputs, float0 for integer/bool outputs."""
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    import numpy as _np
+
+    return _np.zeros(shape, jax.dtypes.float0)
