@@ -346,7 +346,7 @@ def _unwrap_opt(x):
 class Parameter(Tensor):
     """Trainable tensor: stop_gradient=False, persistable=True."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed", "need_clip")
 
     def __init__(self, value, name=None, trainable=True):
         super().__init__(value, stop_gradient=not trainable, name=name)
@@ -355,6 +355,7 @@ class Parameter(Tensor):
         self.optimize_attr = {"learning_rate": 1.0}
         self.regularizer = None
         self.is_distributed = False
+        self.need_clip = True
 
     @classmethod
     def from_tensor(cls, t: Tensor, name=None, trainable=True):
@@ -365,6 +366,7 @@ class Parameter(Tensor):
         p.optimize_attr = {"learning_rate": 1.0}
         p.regularizer = None
         p.is_distributed = False
+        p.need_clip = True
         return p
 
     def __repr__(self):

@@ -1,0 +1,36 @@
+"""paddle_tpu.nn — the neural-network module system.
+
+Analog of /root/reference/python/paddle/nn/: Layer tree, layers, losses,
+initializers, functional surface, and gradient clipping.
+"""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .clip import (  # noqa: F401
+    ClipGradByGlobalNorm,
+    ClipGradByNorm,
+    ClipGradByValue,
+)
+from .layer_base import Layer, ParamAttr  # noqa: F401
+from .layers_attention import (  # noqa: F401
+    MultiHeadAttention,
+    Transformer,
+    TransformerDecoder,
+    TransformerDecoderLayer,
+    TransformerEncoder,
+    TransformerEncoderLayer,
+)
+from .layers_common import *  # noqa: F401,F403
+from .layers_conv import *  # noqa: F401,F403
+from .layers_norm import *  # noqa: F401,F403
+from .layers_rnn import (  # noqa: F401
+    GRU,
+    GRUCell,
+    LSTM,
+    LSTMCell,
+    SimpleRNN,
+    SimpleRNNCell,
+)
+from .losses import *  # noqa: F401,F403
+
+from . import clip  # noqa: F401
+from . import utils  # noqa: F401

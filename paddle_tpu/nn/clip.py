@@ -1,0 +1,110 @@
+"""Gradient clipping strategies.
+
+Analog of /root/reference/python/paddle/nn/clip.py (ClipGradByValue,
+ClipGradByNorm, ClipGradByGlobalNorm). Clips operate on raw jax arrays so
+the optimizer can fold them into its jitted update step; the global-norm
+reduction is a single fused XLA reduction over all grads.
+
+The hybrid-parallel-aware variant (TP/PP-distributed global norm, reference
+hybrid_parallel_optimizer.py) lives in distributed/fleet and reuses
+``ClipGradByGlobalNorm._clip_arrays`` with a mesh all-reduce.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm", "clip_grad_norm_"]
+
+
+class ClipGradBase:
+    def _clip_arrays(self, grads: list, params=None) -> list:
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        """paddle-style interface: list of (param, grad) Tensors."""
+        from ..core.tensor import Tensor
+
+        grads = [g._value if isinstance(g, Tensor) else g for _, g in params_grads]
+        params = [p for p, _ in params_grads]
+        clipped = self._clip_arrays(grads, params)
+        out = []
+        for (p, g), c in zip(params_grads, clipped):
+            out.append((p, Tensor._from_value(c) if not isinstance(c, Tensor) else c))
+        return out
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _clip_arrays(self, grads, params=None):
+        return [jnp.clip(g, self.min, self.max) for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    """Per-tensor L2-norm clip."""
+
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_arrays(self, grads, params=None):
+        out = []
+        for g in grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global L2-norm clip over all grads — one fused reduction."""
+
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.auto_skip_clip = auto_skip_clip
+
+    def global_norm(self, grads):
+        if not grads:
+            return jnp.asarray(0.0, jnp.float32)
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+        return jnp.sqrt(sq)
+
+    def _clip_arrays(self, grads, params=None):
+        if not grads:
+            return grads
+        # Respect per-param need_clip (ParamAttr.need_clip=False exempts).
+        if params is not None:
+            clip_mask = [getattr(p, "need_clip", True) for p in params]
+        else:
+            clip_mask = [True] * len(grads)
+        gnorm = self.global_norm([g for g, m in zip(grads, clip_mask) if m])
+        scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
+        return [
+            (g.astype(jnp.float32) * scale).astype(g.dtype) if m else g
+            for g, m in zip(grads, clip_mask)
+        ]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """torch-style utility over live .grad tensors (reference:
+    python/paddle/nn/utils/clip_grad_norm_.py)."""
+    from ..core.tensor import Tensor
+
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p._grad for p in parameters if p._grad is not None]
+    if not grads:
+        return None
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._value)) for g in grads]))
+    else:
+        total = jnp.power(
+            sum(jnp.sum(jnp.power(jnp.abs(g._value.astype(jnp.float32)), norm_type)) for g in grads),
+            1.0 / norm_type,
+        )
+    scale = max_norm / jnp.maximum(total, 1e-6)
+    scale = jnp.minimum(scale, 1.0)
+    for g in grads:
+        g._value = (g._value.astype(jnp.float32) * scale).astype(g._value.dtype)
+    return Tensor._from_value(total)

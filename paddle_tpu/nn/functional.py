@@ -1,0 +1,112 @@
+"""nn.functional — functional neural-net ops.
+
+Analog of the reference's ``paddle.nn.functional``
+(/root/reference/python/paddle/nn/functional/*.py). Thin aliases over the
+YAML-registered op surface (paddle_tpu.ops); everything dispatches through
+the same cached-executable path, so F.* calls are jit-cacheable eager ops.
+"""
+from __future__ import annotations
+
+from ..ops import (  # noqa: F401
+    adaptive_avg_pool2d,
+    adaptive_max_pool2d,
+    avg_pool1d,
+    avg_pool2d,
+    batch_norm,
+    binary_cross_entropy,
+    binary_cross_entropy_with_logits,
+    celu,
+    conv1d,
+    conv2d,
+    conv2d_transpose,
+    conv3d,
+    cosine_similarity,
+    cross_entropy,
+    dropout,
+    elu,
+    embedding,
+    gelu,
+    glu,
+    group_norm,
+    gumbel_softmax,
+    hardshrink,
+    hardsigmoid,
+    hardswish,
+    hardtanh,
+    hinge_embedding_loss,
+    instance_norm,
+    interpolate,
+    kl_div,
+    l1_loss,
+    label_smooth,
+    layer_norm,
+    leaky_relu,
+    linear,
+    log_sigmoid,
+    log_softmax,
+    max_pool1d,
+    max_pool2d,
+    maxout,
+    mish,
+    mse_loss,
+    nll_loss,
+    one_hot,
+    pad,
+    pixel_shuffle,
+    prelu,
+    relu,
+    relu6,
+    rms_norm,
+    scaled_dot_product_attention,
+    selu,
+    sigmoid,
+    silu,
+    smooth_l1_loss,
+    softmax,
+    softmax_with_cross_entropy,
+    softplus,
+    softshrink,
+    softsign,
+    swish,
+    tanhshrink,
+    unfold,
+)
+from ..ops import l2_normalize as normalize  # noqa: F401
+from ..ops import rotary_position_embedding  # noqa: F401
+from ..ops import tanh  # noqa: F401
+
+
+def relu_(x):
+    return relu(x)
+
+
+def softmax_(x, axis=-1):
+    return softmax(x, axis=axis)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    import jax.numpy as jnp
+
+    from ..core.dtype import to_jax_dtype
+    from ..core.tensor import Tensor
+
+    lv = lengths._value if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+    if maxlen is None:
+        maxlen = int(lv.max())
+    row = jnp.arange(maxlen)
+    mask = row[None, :] < lv[..., None]
+    return Tensor._from_value(mask.astype(to_jax_dtype(dtype)))
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False, *, training=True):
+    """Reference-compatible alias (python/paddle/nn/functional/flash_attention.py):
+    dispatches to the Pallas flash-attention path when enabled, else the
+    fused-by-XLA sdpa composition."""
+    out = scaled_dot_product_attention(
+        query, key, value, attn_mask=None, dropout_p=dropout, is_causal=causal,
+        training=training,
+    )
+    return out, None  # (out, softmax_lse placeholder)
+
+
+__all__ = [n for n in dir() if not n.startswith("_")]

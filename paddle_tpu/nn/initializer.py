@@ -1,0 +1,223 @@
+"""Parameter initializers.
+
+Analog of the reference's ``paddle.nn.initializer``
+(/root/reference/python/paddle/nn/initializer/*.py). TPU-native design:
+initializers are pure functions of (shape, dtype, rng key) — they return a
+``jax.Array`` instead of mutating a buffer in place, so layer construction
+composes with jit and with sharded parameter creation.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from ..core.dtype import to_jax_dtype
+
+__all__ = [
+    "Initializer",
+    "Constant",
+    "Normal",
+    "TruncatedNormal",
+    "Uniform",
+    "XavierNormal",
+    "XavierUniform",
+    "KaimingNormal",
+    "KaimingUniform",
+    "Assign",
+    "Orthogonal",
+    "Dirac",
+    "calculate_gain",
+]
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    gains = {
+        "sigmoid": 1.0,
+        "linear": 1.0,
+        "conv1d": 1.0,
+        "conv2d": 1.0,
+        "conv3d": 1.0,
+        "tanh": 5.0 / 3,
+        "relu": math.sqrt(2.0),
+        "leaky_relu": math.sqrt(2.0 / (1 + (param if param is not None else 0.01) ** 2)),
+        "selu": 3.0 / 4,
+    }
+    if nonlinearity not in gains:
+        raise ValueError(f"Unsupported nonlinearity: {nonlinearity}")
+    return gains[nonlinearity]
+
+
+def _fan_in_out(shape):
+    """fan_in/fan_out following the reference's convention: for a Linear
+    weight [in, out] fan_in=in; for Conv [out, in, *k] receptive field
+    multiplies in/out channels."""
+    shape = tuple(shape)
+    if len(shape) < 2:
+        return (1, 1) if not shape else (shape[0], shape[0])
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = 1
+    for s in shape[2:]:
+        receptive *= s
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype="float32", key=None):
+        if key is None:
+            key = _random.next_key()
+        return self.generate(tuple(shape), to_jax_dtype(dtype), key)
+
+    def generate(self, shape, dtype, key):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def generate(self, shape, dtype, key):
+        return jnp.full(shape, self.value, dtype=dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def generate(self, shape, dtype, key):
+        sample_dt = dtype if jnp.issubdtype(dtype, jnp.floating) else jnp.float32
+        return (self.mean + self.std * jax.random.normal(key, shape, sample_dt)).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    """Normal truncated to [mean - 2*std, mean + 2*std] (reference default)."""
+
+    def __init__(self, mean=0.0, std=1.0, a=-2.0, b=2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def generate(self, shape, dtype, key):
+        sample_dt = dtype if jnp.issubdtype(dtype, jnp.floating) else jnp.float32
+        z = jax.random.truncated_normal(key, self.a, self.b, shape, sample_dt)
+        return (self.mean + self.std * z).astype(dtype)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def generate(self, shape, dtype, key):
+        sample_dt = dtype if jnp.issubdtype(dtype, jnp.floating) else jnp.float32
+        return jax.random.uniform(key, shape, sample_dt, self.low, self.high).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def generate(self, shape, dtype, key):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return Normal(0.0, std).generate(shape, dtype, key)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def generate(self, shape, dtype, key):
+        fi, fo = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return Uniform(-limit, limit).generate(shape, dtype, key)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def generate(self, shape, dtype, key):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        std = gain / math.sqrt(fi)
+        return Normal(0.0, std).generate(shape, dtype, key)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def generate(self, shape, dtype, key):
+        fi, _ = _fan_in_out(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = calculate_gain(self.nonlinearity, self.negative_slope)
+        limit = gain * math.sqrt(3.0 / fi)
+        return Uniform(-limit, limit).generate(shape, dtype, key)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def generate(self, shape, dtype, key):
+        from ..core.tensor import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v._value
+        arr = jnp.asarray(v, dtype=dtype)
+        if tuple(arr.shape) != tuple(shape):
+            raise ValueError(f"Assign initializer shape {arr.shape} != parameter shape {shape}")
+        return arr
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def generate(self, shape, dtype, key):
+        if len(shape) < 2:
+            raise ValueError("Orthogonal initializer requires >= 2 dims")
+        rows = shape[0]
+        cols = 1
+        for s in shape[1:]:
+            cols *= s
+        sample_dt = dtype if jnp.issubdtype(dtype, jnp.floating) else jnp.float32
+        flat = jax.random.normal(key, (max(rows, cols), min(rows, cols)), sample_dt)
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        if rows < cols:
+            q = q.T
+        return (self.gain * q[:rows, :cols]).reshape(shape).astype(dtype)
+
+
+class Dirac(Initializer):
+    """Identity-preserving conv kernel init (reference nn/initializer/dirac.py)."""
+
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def generate(self, shape, dtype, key):
+        if len(shape) < 3:
+            raise ValueError("Dirac initializer requires conv-shaped (>=3D) parameters")
+        out_c, in_c = shape[0], shape[1]
+        w = jnp.zeros(shape, dtype=dtype)
+        centers = tuple(s // 2 for s in shape[2:])
+        per_group = out_c // self.groups
+        for g in range(self.groups):
+            for i in range(min(per_group, in_c)):
+                idx = (g * per_group + i, i) + centers
+                w = w.at[idx].set(1.0)
+        return w
+
+
+# Short aliases matching the reference's spellings in paddle.nn.initializer
+constant = Constant
+normal = Normal
+uniform = Uniform

@@ -1,0 +1,234 @@
+"""Normalization layers.
+
+Analogs of /root/reference/python/paddle/nn/layer/norm.py. BatchNorm keeps
+running statistics as non-trainable buffers (``_mean``/``_variance``, the
+reference's buffer names) and updates them in eager mode; under jit tracing
+the updated stats are returned through ``raw_state`` so compiled train steps
+carry them functionally.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops import batch_norm as _batch_norm_op
+from . import functional as F
+from . import initializer as I
+from .layer_base import Layer
+
+__all__ = [
+    "LayerNorm",
+    "RMSNorm",
+    "GroupNorm",
+    "InstanceNorm2D",
+    "BatchNorm",
+    "BatchNorm1D",
+    "BatchNorm2D",
+    "BatchNorm3D",
+    "SyncBatchNorm",
+    "LocalResponseNorm",
+    "SpectralNorm",
+]
+
+
+class LayerNorm(Layer):
+    def __init__(self, normalized_shape, epsilon=1e-05, weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            self.normalized_shape, attr=weight_attr, default_initializer=I.Constant(1.0)
+        )
+        self.bias = self.create_parameter(self.normalized_shape, attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.layer_norm(
+            x, self.weight, self.bias, epsilon=self.epsilon,
+            begin_norm_axis=-len(self.normalized_shape),
+        )
+
+    def extra_repr(self):
+        return f"normalized_shape={self.normalized_shape}, epsilon={self.epsilon}"
+
+
+class RMSNorm(Layer):
+    """Root-mean-square norm (the LLaMA norm; reference kernel:
+    paddle/phi/kernels/gpu/rms_norm_kernel.cu:1081)."""
+
+    def __init__(self, normalized_shape, epsilon=1e-6, weight_attr=None, bias_attr=False, name=None):
+        super().__init__()
+        if isinstance(normalized_shape, int):
+            normalized_shape = (normalized_shape,)
+        self.normalized_shape = tuple(normalized_shape)
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            self.normalized_shape, attr=weight_attr, default_initializer=I.Constant(1.0)
+        )
+        self.bias = self.create_parameter(self.normalized_shape, attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.rms_norm(x, self.weight, self.bias, epsilon=self.epsilon)
+
+
+class GroupNorm(Layer):
+    def __init__(self, num_groups, num_channels, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.num_groups = num_groups
+        self.num_channels = num_channels
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_channels,), attr=weight_attr, default_initializer=I.Constant(1.0)
+        )
+        self.bias = self.create_parameter((num_channels,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.group_norm(x, self.weight, self.bias, epsilon=self.epsilon,
+                            groups=self.num_groups, data_format=self.data_format)
+
+
+class InstanceNorm2D(Layer):
+    def __init__(self, num_features, epsilon=1e-05, momentum=0.9, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr, default_initializer=I.Constant(1.0)
+        )
+        self.bias = self.create_parameter((num_features,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, self.weight, self.bias, epsilon=self.epsilon)
+
+
+class _BatchNormBase(Layer):
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-05, weight_attr=None,
+                 bias_attr=None, data_format="NCHW", use_global_stats=None, name=None):
+        super().__init__()
+        self.num_features = num_features
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.data_format = data_format
+        self.use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr, default_initializer=I.Constant(1.0)
+        )
+        self.bias = self.create_parameter((num_features,), attr=bias_attr, is_bias=True)
+        self.register_buffer("_mean", Tensor(jnp.zeros((num_features,), jnp.float32)))
+        self.register_buffer("_variance", Tensor(jnp.ones((num_features,), jnp.float32)))
+
+    def forward(self, x):
+        training = self.training and not self.use_global_stats
+        out, new_mean, new_var = _batch_norm_op(
+            x, self._mean, self._variance, self.weight, self.bias,
+            training=training, momentum=self.momentum, epsilon=self.epsilon,
+            data_format=self.data_format,
+        )
+        if training:
+            # Running stats are state, not differentiable outputs.
+            self._mean._value = (
+                new_mean._value if isinstance(new_mean, Tensor) else new_mean
+            )
+            self._variance._value = (
+                new_var._value if isinstance(new_var, Tensor) else new_var
+            )
+        return out
+
+    def extra_repr(self):
+        return f"num_features={self.num_features}, momentum={self.momentum}, epsilon={self.epsilon}"
+
+
+class BatchNorm(_BatchNormBase):
+    pass
+
+
+class BatchNorm1D(_BatchNormBase):
+    pass
+
+
+class BatchNorm2D(_BatchNormBase):
+    pass
+
+
+class BatchNorm3D(_BatchNormBase):
+    pass
+
+
+class SyncBatchNorm(_BatchNormBase):
+    """Under jit + sharding, XLA computes batch statistics over the global
+    (sharded) batch automatically, which IS sync-BN; eager single-process
+    falls back to local stats (reference: nn/layer/norm.py SyncBatchNorm).
+    """
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        if isinstance(layer, _BatchNormBase) and not isinstance(layer, SyncBatchNorm):
+            new = SyncBatchNorm(layer.num_features, layer.momentum, layer.epsilon,
+                                data_format=layer.data_format)
+            new.weight = layer.weight
+            new.bias = layer.bias
+            new._buffers["_mean"] = layer._mean
+            new._buffers["_variance"] = layer._variance
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
+class LocalResponseNorm(Layer):
+    def __init__(self, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+        super().__init__()
+        self.size = size
+        self.alpha = alpha
+        self.beta = beta
+        self.k = k
+
+    def forward(self, x):
+        from ..ops import pad as _pad, avg_pool2d  # noqa: F401
+        import jax
+
+        v = x._value if isinstance(x, Tensor) else x
+        sq = v * v
+        # sum over channel window: pad channels then moving sum
+        half = self.size // 2
+        padded = jnp.pad(sq, [(0, 0), (half, self.size - 1 - half)] + [(0, 0)] * (v.ndim - 2))
+        win = sum(padded[:, i : i + v.shape[1]] for i in range(self.size))
+        den = jnp.power(self.k + self.alpha * win, self.beta)
+        return Tensor._from_value(v / den)
+
+
+class SpectralNorm(Layer):
+    """Power-iteration spectral normalization of a weight tensor
+    (reference nn/layer/norm.py SpectralNorm)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12, dtype="float32"):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        h = weight_shape[dim]
+        w = 1
+        for i, s in enumerate(weight_shape):
+            if i != dim:
+                w *= s
+        self.weight_u = self.create_parameter((h,), default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v = self.create_parameter((w,), default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        v = weight._value if isinstance(weight, Tensor) else weight
+        mat = jnp.moveaxis(v, self.dim, 0).reshape(v.shape[self.dim], -1)
+        u, vv = self.weight_u._value, self.weight_v._value
+        for _ in range(self.power_iters):
+            vv = mat.T @ u
+            vv = vv / (jnp.linalg.norm(vv) + self.eps)
+            u = mat @ vv
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        self.weight_u._value = u
+        self.weight_v._value = vv
+        sigma = u @ mat @ vv
+        return Tensor._from_value(v / sigma)

@@ -1,0 +1,276 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU.
+
+Analog of /root/reference/python/paddle/nn/layer/rnn.py. TPU-native design:
+the time loop is ``lax.scan`` (compiler-friendly structured control flow —
+no Python loop unrolled into the graph), and each cell step is a single
+fused matmul over the stacked gates so it maps onto the MXU.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from ..ops.registry import register_op, apply_op
+from . import initializer as I
+from .layer_base import Layer
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "RNNCellBase", "LSTMCell", "GRUCell", "SimpleRNNCell"]
+
+
+# ---------------- scan kernels (registered ops so autograd flows via jax.vjp)
+
+
+def _rnn_scan_kernel(x, h0, wi, wh, bi, bh, activation="tanh"):
+    act = jnp.tanh if activation == "tanh" else lambda v: jnp.maximum(v, 0)
+
+    def step(h, xt):
+        h_new = act(xt @ wi.T + bi + h @ wh.T + bh)
+        return h_new, h_new
+
+    xs = jnp.swapaxes(x, 0, 1)  # T,B,I
+    h_last, ys = lax.scan(step, h0, xs)
+    return jnp.swapaxes(ys, 0, 1), h_last
+
+
+def _lstm_scan_kernel(x, h0, c0, wi, wh, bi, bh):
+    def step(carry, xt):
+        h, c = carry
+        gates = xt @ wi.T + bi + h @ wh.T + bh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return (h_new, c_new), h_new
+
+    xs = jnp.swapaxes(x, 0, 1)
+    (h_last, c_last), ys = lax.scan(step, (h0, c0), xs)
+    return jnp.swapaxes(ys, 0, 1), h_last, c_last
+
+
+def _gru_scan_kernel(x, h0, wi, wh, bi, bh):
+    def step(h, xt):
+        gi = xt @ wi.T + bi
+        gh = h @ wh.T + bh
+        ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+        hr, hz, hn = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(in_ + r * hn)
+        h_new = (1 - z) * n + z * h
+        return h_new, h_new
+
+    xs = jnp.swapaxes(x, 0, 1)
+    h_last, ys = lax.scan(step, h0, xs)
+    return jnp.swapaxes(ys, 0, 1), h_last
+
+
+_RNN_SCAN = register_op("_rnn_scan", _rnn_scan_kernel, inputs=("x", "h0", "wi", "wh", "bi", "bh"))
+_LSTM_SCAN = register_op("_lstm_scan", _lstm_scan_kernel, inputs=("x", "h0", "c0", "wi", "wh", "bi", "bh"))
+_GRU_SCAN = register_op("_gru_scan", _gru_scan_kernel, inputs=("x", "h0", "wi", "wh", "bi", "bh"))
+
+
+class RNNCellBase(Layer):
+    pass
+
+
+class _CellBase(RNNCellBase):
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        g = self.GATES
+        self.weight_ih = self.create_parameter(
+            (g * hidden_size, input_size), attr=weight_ih_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.weight_hh = self.create_parameter(
+            (g * hidden_size, hidden_size), attr=weight_hh_attr,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_ih = self.create_parameter(
+            (g * hidden_size,), attr=bias_ih_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+        self.bias_hh = self.create_parameter(
+            (g * hidden_size,), attr=bias_hh_attr, is_bias=True,
+            default_initializer=I.Uniform(-std, std))
+
+
+class SimpleRNNCell(_CellBase):
+    GATES = 1
+
+    def __init__(self, input_size, hidden_size, activation="tanh", **kwargs):
+        super().__init__(input_size, hidden_size, **kwargs)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            from ..ops import zeros
+
+            states = zeros(shape=[inputs.shape[0], self.hidden_size], dtype=inputs.dtype.name)
+        out, h = apply_op(
+            _RNN_SCAN,
+            inputs.unsqueeze(1) if inputs.ndim == 2 else inputs,
+            states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+            activation=self.activation,
+        )
+        if inputs.ndim == 2:
+            return h, h
+        return out, h
+
+
+class LSTMCell(_CellBase):
+    GATES = 4
+
+    def forward(self, inputs, states=None):
+        from ..ops import zeros
+
+        if states is None:
+            z = zeros(shape=[inputs.shape[0], self.hidden_size], dtype=inputs.dtype.name)
+            states = (z, z.clone())
+        h0, c0 = states
+        out, h, c = apply_op(
+            _LSTM_SCAN, inputs.unsqueeze(1), h0, c0,
+            self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+        )
+        return h, (h, c)
+
+
+class GRUCell(_CellBase):
+    GATES = 3
+
+    def forward(self, inputs, states=None):
+        from ..ops import zeros
+
+        if states is None:
+            states = zeros(shape=[inputs.shape[0], self.hidden_size], dtype=inputs.dtype.name)
+        out, h = apply_op(
+            _GRU_SCAN, inputs.unsqueeze(1), states,
+            self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh,
+        )
+        return h, h
+
+
+class _RNNBase(Layer):
+    """Stacked (optionally bidirectional) recurrent network over a cell kind."""
+
+    MODE = "RNN"
+
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if self.bidirectional else 1
+        gates = {"RNN": 1, "LSTM": 4, "GRU": 3}[self.MODE]
+        std = 1.0 / math.sqrt(hidden_size)
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 else hidden_size * self.num_directions
+                suffix = f"_l{layer}" + ("_reverse" if d == 1 else "")
+                self.add_parameter(
+                    "weight_ih" + suffix,
+                    self.create_parameter((gates * hidden_size, in_sz),
+                                          default_initializer=I.Uniform(-std, std)))
+                self.add_parameter(
+                    "weight_hh" + suffix,
+                    self.create_parameter((gates * hidden_size, hidden_size),
+                                          default_initializer=I.Uniform(-std, std)))
+                self.add_parameter(
+                    "bias_ih" + suffix,
+                    self.create_parameter((gates * hidden_size,), is_bias=True,
+                                          default_initializer=I.Uniform(-std, std)))
+                self.add_parameter(
+                    "bias_hh" + suffix,
+                    self.create_parameter((gates * hidden_size,), is_bias=True,
+                                          default_initializer=I.Uniform(-std, std)))
+
+    def _run_direction(self, x, layer, d, h0, c0):
+        suffix = f"_l{layer}" + ("_reverse" if d == 1 else "")
+        wi = self._parameters["weight_ih" + suffix]
+        wh = self._parameters["weight_hh" + suffix]
+        bi = self._parameters["bias_ih" + suffix]
+        bh = self._parameters["bias_hh" + suffix]
+        if d == 1:
+            x = x.flip(axis=[1])
+        if self.MODE == "LSTM":
+            out, h, c = apply_op(_LSTM_SCAN, x, h0, c0, wi, wh, bi, bh)
+        elif self.MODE == "GRU":
+            out, h = apply_op(_GRU_SCAN, x, h0, wi, wh, bi, bh)
+            c = None
+        else:
+            out, h = apply_op(_RNN_SCAN, x, h0, wi, wh, bi, bh, activation=self.activation)
+            c = None
+        if d == 1:
+            out = out.flip(axis=[1])
+        return out, h, c
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ..ops import concat, dropout as drop, stack, zeros
+
+        x = inputs
+        if self.time_major:
+            x = x.transpose([1, 0, 2])
+        b = x.shape[0]
+        n_state = self.num_layers * self.num_directions
+        if self.MODE == "LSTM":
+            if initial_states is None:
+                z = zeros(shape=[n_state, b, self.hidden_size], dtype=x.dtype.name)
+                initial_states = (z, z.clone())
+            h0s, c0s = initial_states
+        else:
+            if initial_states is None:
+                initial_states = zeros(shape=[n_state, b, self.hidden_size], dtype=x.dtype.name)
+            h0s, c0s = initial_states, None
+
+        h_finals, c_finals = [], []
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(self.num_directions):
+                idx = layer * self.num_directions + d
+                h0 = h0s[idx]
+                c0 = c0s[idx] if c0s is not None else None
+                out, h, c = self._run_direction(x, layer, d, h0, c0)
+                outs.append(out)
+                h_finals.append(h)
+                if c is not None:
+                    c_finals.append(c)
+            x = outs[0] if len(outs) == 1 else concat(outs, axis=-1)
+            if self.dropout > 0 and layer < self.num_layers - 1 and self.training:
+                x = drop(x, p=self.dropout, training=True)
+
+        out = x
+        if self.time_major:
+            out = out.transpose([1, 0, 2])
+        h_final = stack(h_finals, axis=0)
+        if self.MODE == "LSTM":
+            c_final = stack(c_finals, axis=0)
+            return out, (h_final, c_final)
+        return out, h_final
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN"
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"

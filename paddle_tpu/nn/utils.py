@@ -1,0 +1,23 @@
+"""nn.utils — parameter vector helpers (reference: python/paddle/nn/utils/)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .clip import clip_grad_norm_  # noqa: F401
+
+__all__ = ["parameters_to_vector", "vector_to_parameters", "clip_grad_norm_"]
+
+
+def parameters_to_vector(parameters):
+    vals = [p._value.reshape(-1) for p in parameters]
+    return Tensor._from_value(jnp.concatenate(vals))
+
+
+def vector_to_parameters(vec, parameters):
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    offset = 0
+    for p in parameters:
+        n = p.size
+        p.set_value(v[offset : offset + n].reshape(p._value.shape))
+        offset += n

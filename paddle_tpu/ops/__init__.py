@@ -120,9 +120,11 @@ class _HashableIndex:
 
 
 def _index_key(idx):
+    import builtins
+
     if isinstance(idx, tuple):
         return ("t",) + tuple(_index_key(i) for i in idx)
-    if isinstance(idx, slice):
+    if isinstance(idx, builtins.slice):  # `slice` op shadows the builtin here
         return ("s", idx.start, idx.stop, idx.step)
     if isinstance(idx, (int, bool, type(None), type(Ellipsis))) or idx is Ellipsis:
         return ("i", idx if idx is not Ellipsis else "...")

@@ -270,10 +270,12 @@ def embedding(x, weight, padding_idx=None):
 # ============================================================ dropout & random
 
 
-def dropout(x, rng_key=None, p=0.5, training=True, mode="upscale_in_train"):
+def dropout(x, p=0.5, training=True, mode="upscale_in_train", *, rng_key=None):
     """``rng_key`` is raw uint32 key data (a traced operand) so this kernel is
     jit-cacheable; callers (nn.functional) thread it from the global RNG. A
-    bare eager call without a key still works (stateful fallback)."""
+    bare eager call without a key still works (stateful fallback). It is
+    keyword-only so the positional surface matches the reference's
+    ``dropout(x, p, ...)`` (python/paddle/nn/functional/common.py:1041)."""
     if not training or p == 0.0:
         return x
     key = jax.random.wrap_key_data(rng_key) if rng_key is not None else _random.next_key()
@@ -565,8 +567,8 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
 # ============================================================ attention
 
 
-def scaled_dot_product_attention(q, k, v, attn_mask=None, rng_key=None,
-                                 dropout_p=0.0, is_causal=False, training=True):
+def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, *, rng_key=None):
     """Attention core, (B, S, H, D) layout like the reference's flash_attn
     (/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu:587).
 
@@ -597,7 +599,7 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, rng_key=None,
             logits = logits + attn_mask.astype(logits.dtype)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     if dropout_p > 0.0 and training:
-        probs = dropout(probs, rng_key, p=dropout_p, training=True)
+        probs = dropout(probs, p=dropout_p, training=True, rng_key=rng_key)
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
     return jnp.swapaxes(out, 1, 2)
 

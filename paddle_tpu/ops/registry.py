@@ -54,15 +54,18 @@ class OpDef:
         self.is_variadic = tuple(n.endswith("*") for n in self.inputs)
 
     def call_kernel(self, in_vals: list, attrs: dict, force_nojit=False):
+        # Inputs are passed by name (keyword-only params like rng_key sit
+        # after reference-API attrs in kernel signatures).
         if self.nojit or force_nojit or not flag("FLAGS_eager_op_jit"):
-            return self.kernel(*in_vals, **attrs)
+            return self.kernel(**dict(zip(self.input_names, in_vals)), **attrs)
         key = (_freeze(attrs), tuple(_struct_key(v) for v in in_vals))
         fn = self._jit_cache.get(key)
         if fn is None:
             kernel = self.kernel
+            names = self.input_names
 
             def run(*vals):
-                return kernel(*vals, **attrs)
+                return kernel(**dict(zip(names, vals)), **attrs)
 
             fn = jax.jit(run)
             self._jit_cache[key] = fn
@@ -203,7 +206,7 @@ def apply_op(op: OpDef, *args, **kwargs):
                     vals[pos] = tv
                 else:
                     vals[pos][sub] = tv
-            out = op.kernel(*vals, **attrs)
+            out = op.kernel(**dict(zip(op.input_names, vals)), **attrs)
             return out if isinstance(out, (tuple, list)) else (out,)
 
         primals = [t._value for t in in_tensors]

@@ -1,0 +1,16 @@
+"""paddle_tpu.optimizer — optimizers + LR schedulers.
+
+Analog of /root/reference/python/paddle/optimizer/.
+"""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Adagrad,
+    Adam,
+    Adamax,
+    AdamW,
+    Lamb,
+    Momentum,
+    Optimizer,
+    RMSProp,
+    SGD,
+)

@@ -1,0 +1,429 @@
+"""Optimizer base + SGD/Momentum/Adagrad/RMSProp/Adam/AdamW/Lamb.
+
+Analog of /root/reference/python/paddle/optimizer/optimizer.py:127 and the
+per-optimizer phi kernels (adamw_kernel etc.). TPU-native design: the whole
+update — every parameter, its accumulators, weight decay, and the LR — runs
+as ONE jitted XLA program over the flat list of arrays (the analog of the
+reference's fused multi_tensor adam paths), compiled once per parameter
+structure. The learning rate and step count enter as traced scalars so LR
+schedules never trigger recompilation.
+
+``multi_precision=True`` keeps fp32 master weights for bf16/fp16 params
+(reference: multi-precision kernel variants + master_weights in AMP O2).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from ..nn.clip import ClipGradBase
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "RMSProp", "Adam", "AdamW", "Lamb", "Adamax"]
+
+
+class Optimizer:
+    # names of per-param accumulator slots, e.g. ("moment1", "moment2")
+    _accumulator_names: tuple = ()
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided (eager mode, reference semantics)")
+        self._parameter_list = list(parameters)
+        for p in self._parameter_list:
+            if isinstance(p, dict):
+                raise NotImplementedError("parameter groups not yet supported")
+        self._learning_rate = learning_rate
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        # Accumulator keys are positional ("slot@<index in parameter list>")
+        # so optimizer state_dicts restore across processes regardless of the
+        # auto-generated tensor names' global counter.
+        self._param_index = {id(p): i for i, p in enumerate(self._parameter_list)}
+        self._accumulators: dict[str, jax.Array] = {}  # "slot@index" -> array
+        self._master_weights: dict[str, jax.Array] = {}
+        self._step_count = 0
+        self._update_fn = None  # compiled fused update
+
+    # ------------------------------------------------ lr
+
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("optimizer's learning rate is a scheduler; use scheduler.step()")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # ------------------------------------------------ accumulators
+
+    def _acc_key(self, slot, p):
+        return f"{slot}@{self._param_index[id(p)]}"
+
+    def _master_key(self, p):
+        return str(self._param_index[id(p)])
+
+    def _ensure_state(self, params):
+        for p in params:
+            for slot in self._accumulator_names:
+                key = self._acc_key(slot, p)
+                if key not in self._accumulators:
+                    self._accumulators[key] = self._init_slot(slot, p)
+            if self._multi_precision and p._value.dtype in (jnp.bfloat16, jnp.float16):
+                if self._master_key(p) not in self._master_weights:
+                    self._master_weights[self._master_key(p)] = p._value.astype(jnp.float32)
+
+    def _init_slot(self, slot, p):
+        return jnp.zeros_like(
+            p._value, dtype=jnp.float32 if self._multi_precision else p._value.dtype
+        )
+
+    # ------------------------------------------------ the update rule (override)
+
+    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+        """Pure function: (param, grad, accumulator dict, lr scalar, step t)
+        -> (new_param, new accumulator dict). Runs inside jit.
+        ``apply_decay`` carries the per-param weight-decay exemption for
+        decoupled-decay optimizers (AdamW/Lamb)."""
+        raise NotImplementedError
+
+    def _decay_grad(self, p, g):
+        """L2 regularization folded into the gradient (reference: L2Decay for
+        non-decoupled optimizers). AdamW overrides with decoupled decay."""
+        wd = self._weight_decay
+        if wd is None or isinstance(wd, str):
+            return g
+        coeff = float(wd.coeff) if hasattr(wd, "coeff") else float(wd)
+        if coeff == 0.0:
+            return g
+        return g + coeff * p.astype(g.dtype)
+
+    def _decay_flag(self, p) -> bool:
+        """Whether decoupled decay applies to this param (AdamW/Lamb override
+        consult apply_decay_param_fun / exclude_from_weight_decay_fn)."""
+        return True
+
+    def _lr_scale(self, p) -> float:
+        """Per-parameter LR multiplier (ParamAttr.learning_rate, reference:
+        optimizer.py _create_param_lr)."""
+        return float(getattr(p, "optimize_attr", {}).get("learning_rate", 1.0))
+
+    # ------------------------------------------------ step
+
+    @classmethod
+    def _build_update(cls, self_ref, params):
+        """One jitted program updating every param+accumulator in one go.
+        Per-param static facts (decay exemption, LR multiplier) are baked in
+        as compile-time constants for this exact parameter list."""
+        decay_flags = [self_ref._decay_flag(p) for p in params]
+        lr_scales = [self_ref._lr_scale(p) for p in params]
+
+        def update(param_vals, grad_vals, master_vals, acc_vals, lr, t):
+            new_params, new_masters, new_accs = [], [], []
+            for i, (p, g) in enumerate(zip(param_vals, grad_vals)):
+                master = master_vals[i]
+                work = master if master is not None else p
+                g = g.astype(work.dtype)
+                g = self_ref._decay_grad(work, g)
+                accs = {name: acc_vals[i][j] for j, name in enumerate(self_ref._accumulator_names)}
+                lr_i = lr * lr_scales[i] if lr_scales[i] != 1.0 else lr
+                new_p, accs_out = self_ref._rule(work, g, accs, lr_i, t,
+                                                 apply_decay=decay_flags[i])
+                if master is not None:
+                    new_masters.append(new_p)
+                    new_params.append(new_p.astype(p.dtype))
+                else:
+                    new_masters.append(None)
+                    new_params.append(new_p)
+                new_accs.append([accs_out[name] for name in self_ref._accumulator_names])
+            return new_params, new_masters, new_accs
+
+        # No donation here: freshly-initialized accumulators can alias (XLA
+        # dedupes identical zero constants) and aliased buffers cannot be
+        # donated twice. The compiled TrainStep path donates instead.
+        return jax.jit(update)
+
+    def step(self):
+        params = [p for p in self._parameter_list
+                  if p.trainable and p._grad is not None]
+        if not params:
+            self._step_count += 1
+            return
+        grads = [p._grad._value for p in params]
+        if self._grad_clip is not None:
+            grads = self._grad_clip._clip_arrays(grads, params)
+        self._ensure_state(params)
+        self._step_count += 1
+
+        # Cache the compiled update per exact param subset (a param without
+        # grads this step changes the program structure).
+        key = tuple(id(p) for p in params)
+        if self._update_fn is None or self._update_fn[0] != key:
+            self._update_fn = (key, type(self)._build_update(self, params))
+
+        param_vals = [p._value for p in params]
+        master_vals = [self._master_weights.get(self._master_key(p)) for p in params]
+        acc_vals = [
+            [self._accumulators[self._acc_key(slot, p)] for slot in self._accumulator_names]
+            for p in params
+        ]
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        t = jnp.asarray(self._step_count, jnp.int32)
+        new_params, new_masters, new_accs = self._update_fn[1](
+            param_vals, grads, master_vals, acc_vals, lr, t
+        )
+        for p, np_, nm, na in zip(params, new_params, new_masters, new_accs):
+            p._value = np_
+            if nm is not None:
+                self._master_weights[self._master_key(p)] = nm
+            for slot, v in zip(self._accumulator_names, na):
+                self._accumulators[self._acc_key(slot, p)] = v
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # ------------------------------------------------ functional form (jit path)
+
+    def functional_state(self):
+        """(accumulators, master_weights, step_count) as pytrees of arrays, for
+        compiled train steps (paddle_tpu.jit.TrainStep)."""
+        return dict(self._accumulators), dict(self._master_weights), self._step_count
+
+    def load_functional_state(self, accs, masters, step_count):
+        self._accumulators = dict(accs)
+        self._master_weights = dict(masters)
+        self._step_count = int(step_count)
+
+    def functional_update(self, named_params: dict, named_grads: dict, accs: dict,
+                          masters: dict, lr, t):
+        """Pure update over name-keyed pytrees; used inside jitted train steps.
+        Returns (new_params, new_accs, new_masters)."""
+        new_params, new_accs, new_masters = {}, {}, {}
+        for name, p in named_params.items():
+            g = named_grads.get(name)
+            if g is None:
+                new_params[name] = p
+                for slot in self._accumulator_names:
+                    key = f"{slot}@{name}"
+                    if key in accs:
+                        new_accs[key] = accs[key]
+                if name in masters:
+                    new_masters[name] = masters[name]
+                continue
+            master = masters.get(name)
+            work = master if master is not None else p
+            g = g.astype(work.dtype)
+            g = self._decay_grad(work, g)
+            slot_vals = {slot: accs[f"{slot}@{name}"] for slot in self._accumulator_names}
+            new_p, slots_out = self._rule(work, g, slot_vals, lr, t)
+            if master is not None:
+                new_masters[name] = new_p
+                new_params[name] = new_p.astype(p.dtype)
+            else:
+                new_params[name] = new_p
+            for slot in self._accumulator_names:
+                new_accs[f"{slot}@{name}"] = slots_out[slot]
+        return new_params, new_accs, new_masters
+
+    def init_functional_state(self, named_params: dict):
+        """name-keyed accumulators/masters for functional_update."""
+        accs, masters = {}, {}
+        for name, p in named_params.items():
+            for slot in self._accumulator_names:
+                accs[f"{slot}@{name}"] = jnp.zeros_like(
+                    p, dtype=jnp.float32 if self._multi_precision else p.dtype
+                )
+            if self._multi_precision and p.dtype in (jnp.bfloat16, jnp.float16):
+                masters[name] = p.astype(jnp.float32)
+        return accs, masters
+
+    # ------------------------------------------------ state dict
+
+    def state_dict(self):
+        out = {}
+        for key, v in self._accumulators.items():
+            out[key] = Tensor._from_value(v)
+        for key, v in self._master_weights.items():
+            out["master@" + key] = Tensor._from_value(v)
+        out["@step_count"] = self._step_count
+        if isinstance(self._learning_rate, LRScheduler):
+            out["LR_Scheduler"] = self._learning_rate.state_dict()
+        return out
+
+    def set_state_dict(self, state):
+        for key, v in state.items():
+            if key == "@step_count":
+                self._step_count = int(v)
+            elif key == "LR_Scheduler":
+                if isinstance(self._learning_rate, LRScheduler):
+                    self._learning_rate.set_state_dict(v)
+            elif key.startswith("master@"):
+                val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                self._master_weights[key[len("master@"):]] = val
+            else:
+                val = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+                self._accumulators[key] = val
+
+
+class SGD(Optimizer):
+    def _rule(self, p, g, accs, lr, t):
+        return p - lr.astype(p.dtype) * g, accs
+
+
+class Momentum(Optimizer):
+    _accumulator_names = ("velocity",)
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _rule(self, p, g, accs, lr, t):
+        v = self._momentum * accs["velocity"].astype(p.dtype) + g
+        if self._use_nesterov:
+            step = g + self._momentum * v
+        else:
+            step = v
+        return p - lr.astype(p.dtype) * step, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    _accumulator_names = ("moment",)
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._epsilon = epsilon
+        self._initial = initial_accumulator_value
+
+    def _init_slot(self, slot, p):
+        return jnp.full_like(p._value, self._initial)
+
+    def _rule(self, p, g, accs, lr, t):
+        m = accs["moment"] + g * g
+        return p - lr.astype(p.dtype) * g / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class RMSProp(Optimizer):
+    _accumulator_names = ("mean_square", "moment")
+
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+
+    def _rule(self, p, g, accs, lr, t):
+        ms = self._rho * accs["mean_square"] + (1 - self._rho) * g * g
+        mom = self._momentum * accs["moment"] + lr.astype(p.dtype) * g / jnp.sqrt(ms + self._epsilon)
+        return p - mom, {"mean_square": ms, "moment": mom}
+
+
+class Adam(Optimizer):
+    _accumulator_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _rule(self, p, g, accs, lr, t):
+        dt = p.dtype
+        b1 = jnp.asarray(self._beta1, dt)
+        b2 = jnp.asarray(self._beta2, dt)
+        m = b1 * accs["moment1"].astype(dt) + (1 - b1) * g
+        v = b2 * accs["moment2"].astype(dt) + (1 - b2) * g * g
+        tf = t.astype(dt)
+        mhat = m / (1 - jnp.power(b1, tf))
+        vhat = v / (1 - jnp.power(b2, tf))
+        new_p = p - lr.astype(dt) * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return new_p, {"moment1": m, "moment2": v}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None,
+                 apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = float(weight_decay) if not hasattr(weight_decay, "coeff") else float(weight_decay.coeff)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decay_grad(self, p, g):
+        return g  # decoupled: decay applied in _rule
+
+    def _rule(self, p, g, accs, lr, t):
+        # p *= (1 - lr*coeff) before the adam update (reference adamw kernel)
+        p = p * (1.0 - lr.astype(p.dtype) * self._coeff)
+        return super()._rule(p, g, accs, lr, t)
+
+
+class Adamax(Optimizer):
+    _accumulator_names = ("moment", "inf_norm")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, False, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _rule(self, p, g, accs, lr, t):
+        m = self._beta1 * accs["moment"] + (1 - self._beta1) * g
+        inf = jnp.maximum(self._beta2 * accs["inf_norm"], jnp.abs(g))
+        tf = t.astype(p.dtype)
+        lr_t = lr.astype(p.dtype) / (1 - jnp.power(jnp.asarray(self._beta1, p.dtype), tf))
+        return p - lr_t * m / (inf + self._epsilon), {"moment": m, "inf_norm": inf}
+
+
+class Lamb(Optimizer):
+    _accumulator_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._lamb_wd = lamb_weight_decay
+
+    def _rule(self, p, g, accs, lr, t):
+        dt = p.dtype
+        b1 = jnp.asarray(self._beta1, dt)
+        b2 = jnp.asarray(self._beta2, dt)
+        m = b1 * accs["moment1"].astype(dt) + (1 - b1) * g
+        v = b2 * accs["moment2"].astype(dt) + (1 - b2) * g * g
+        tf = t.astype(dt)
+        mhat = m / (1 - jnp.power(b1, tf))
+        vhat = v / (1 - jnp.power(b2, tf))
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._lamb_wd * p
+        w_norm = jnp.linalg.norm(p.reshape(-1).astype(jnp.float32))
+        r_norm = jnp.linalg.norm(r.reshape(-1).astype(jnp.float32))
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0).astype(dt)
+        return p - lr.astype(dt) * trust * r, {"moment1": m, "moment2": v}

@@ -1,0 +1,17 @@
+"""Test configuration: force CPU backend with 8 virtual devices so sharding
+logic is testable without a TPU pod (SURVEY.md §4: FakeCommBackend analog)."""
+import os
+
+# Must happen before jax (via paddle_tpu) initializes a backend. Force cpu:
+# the driver environment presets JAX_PLATFORMS to the TPU platform (and the
+# axon site hook re-forces it at interpreter start), but correctness CI runs
+# on the host — the single-tenant chip stays free and matmuls are exact f32
+# instead of TPU-default bf16.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

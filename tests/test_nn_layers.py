@@ -1,0 +1,206 @@
+"""nn.Layer system tests (reference analog: test/legacy_test layer tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_linear_forward_matches_numpy():
+    paddle.seed(0)
+    m = nn.Linear(6, 3)
+    x = paddle.randn([4, 6])
+    y = m(x)
+    ref = x.numpy() @ m.weight.numpy() + m.bias.numpy()
+    np.testing.assert_allclose(y.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_parameters_and_named_parameters():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    names = [n for n, _ in m.named_parameters()]
+    assert names == ["0.weight", "0.bias", "2.weight", "2.bias"]
+    assert len(m.parameters()) == 4
+
+
+def test_state_dict_roundtrip():
+    paddle.seed(1)
+    m1 = nn.Sequential(nn.Linear(4, 8), nn.Sigmoid(), nn.Linear(8, 2))
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.Sigmoid(), nn.Linear(8, 2))
+    m2.set_state_dict(m1.state_dict())
+    x = paddle.randn([3, 4])
+    np.testing.assert_allclose(m1(x).numpy(), m2(x).numpy(), rtol=1e-6)
+
+
+def test_state_dict_shape_mismatch_raises():
+    m = nn.Linear(4, 8)
+    bad = {"weight": paddle.randn([3, 3]), "bias": paddle.randn([8])}
+    with pytest.raises(ValueError):
+        m.set_state_dict(bad)
+
+
+def test_train_eval_mode_propagates():
+    m = nn.Sequential(nn.Linear(4, 4), nn.Dropout(0.5))
+    assert m.training
+    m.eval()
+    assert not m[1].training
+    m.train()
+    assert m[1].training
+
+
+def test_dropout_eval_is_identity():
+    m = nn.Dropout(0.9)
+    m.eval()
+    x = paddle.randn([10, 10])
+    np.testing.assert_allclose(m(x).numpy(), x.numpy())
+
+
+def test_buffers_in_state_dict_not_in_parameters():
+    bn = nn.BatchNorm2D(3)
+    sd = bn.state_dict()
+    assert "_mean" in sd and "_variance" in sd
+    assert all(n in ("weight", "bias") for n, _ in bn.named_parameters())
+
+
+def test_batchnorm_updates_running_stats():
+    paddle.seed(0)
+    bn = nn.BatchNorm1D(4)
+    before = bn._mean.numpy().copy()
+    bn(paddle.randn([16, 4]) + 3.0)
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after)
+    bn.eval()
+    frozen = bn._mean.numpy().copy()
+    bn(paddle.randn([16, 4]))
+    np.testing.assert_allclose(bn._mean.numpy(), frozen)
+
+
+def test_layernorm_normalizes():
+    x = paddle.randn([2, 5, 16]) * 10 + 3
+    ln = nn.LayerNorm(16)
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), 0, atol=1e-4)
+    np.testing.assert_allclose(y.std(-1), 1, atol=1e-2)
+
+
+def test_rmsnorm_llama_semantics():
+    x = paddle.randn([2, 8])
+    m = nn.RMSNorm(8)
+    y = m(x).numpy()
+    xr = x.numpy()
+    ref = xr / np.sqrt((xr ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_conv2d_shape_and_grad():
+    m = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+    x = paddle.randn([2, 3, 16, 16])
+    y = m(x)
+    assert y.shape == [2, 8, 8, 8]
+    y.sum().backward()
+    assert m.weight.grad is not None
+    assert m.weight.grad.shape == [8, 3, 3, 3]
+
+
+def test_embedding_padding_idx_zero_and_frozen_row():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    idx = paddle.to_tensor(np.array([[0, 3]]))
+    out = emb(idx)
+    np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4))
+
+
+def test_mha_self_attention_shape_and_grad():
+    m = nn.MultiHeadAttention(16, 4)
+    x = paddle.randn([2, 5, 16])
+    y = m(x)
+    assert y.shape == [2, 5, 16]
+    y.sum().backward()
+    assert m.q_proj.weight.grad is not None
+
+
+def test_transformer_encoder_stack():
+    layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+    enc = nn.TransformerEncoder(layer, 3)
+    y = enc(paddle.randn([2, 6, 16]))
+    assert y.shape == [2, 6, 16]
+    # layers are distinct objects with distinct parameters
+    p0 = enc.layers[0].linear1.weight
+    p1 = enc.layers[1].linear1.weight
+    assert p0 is not p1
+
+
+def test_lstm_shapes_bidirectional():
+    m = nn.LSTM(8, 16, num_layers=2, direction="bidirectional")
+    out, (h, c) = m(paddle.randn([3, 7, 8]))
+    assert out.shape == [3, 7, 32]
+    assert h.shape == [4, 3, 16]
+    assert c.shape == [4, 3, 16]
+
+
+def test_gru_grad_flows():
+    m = nn.GRU(4, 8)
+    out, h = m(paddle.randn([2, 5, 4]))
+    out.sum().backward()
+    assert m._parameters["weight_ih_l0"].grad is not None
+
+
+def test_sequential_and_layerlist_containers():
+    ll = nn.LayerList([nn.Linear(4, 4) for _ in range(3)])
+    ll.append(nn.Linear(4, 4))
+    assert len(ll) == 4
+    ll.insert(0, nn.Linear(4, 4))
+    assert len(ll) == 5
+    del ll[0]
+    assert len(ll) == 4
+    x = paddle.randn([2, 4])
+    for l in ll:
+        x = l(x)
+    assert x.shape == [2, 4]
+
+
+def test_forward_hooks():
+    m = nn.Linear(4, 4)
+    calls = []
+    pre = m.register_forward_pre_hook(lambda layer, inp: calls.append("pre"))
+    post = m.register_forward_post_hook(lambda layer, inp, out: calls.append("post"))
+    m(paddle.randn([2, 4]))
+    assert calls == ["pre", "post"]
+    pre.remove()
+    post.remove()
+    calls.clear()
+    m(paddle.randn([2, 4]))
+    assert calls == []
+
+
+def test_layer_to_dtype():
+    m = nn.Linear(4, 4)
+    m.to(dtype="bfloat16")
+    assert m.weight.dtype == paddle.bfloat16
+
+
+def test_cross_entropy_matches_manual():
+    paddle.seed(0)
+    logits = paddle.randn([6, 5])
+    labels = paddle.to_tensor(np.array([0, 1, 2, 3, 4, 0]))
+    loss = nn.CrossEntropyLoss()(logits, labels)
+    lp = logits.numpy() - np.log(np.exp(logits.numpy()).sum(-1, keepdims=True))
+    ref = -lp[np.arange(6), labels.numpy()].mean()
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_ce_ignore_index():
+    logits = paddle.randn([4, 5])
+    labels = paddle.to_tensor(np.array([0, -100, 2, -100]))
+    loss = nn.CrossEntropyLoss(ignore_index=-100)(logits, labels)
+    lp = logits.numpy() - np.log(np.exp(logits.numpy()).sum(-1, keepdims=True))
+    ref = -(lp[0, 0] + lp[2, 2]) / 2
+    np.testing.assert_allclose(float(loss), ref, rtol=1e-5)
+
+
+def test_clip_grad_by_global_norm():
+    m = nn.Linear(4, 4)
+    (m(paddle.randn([2, 4])) ** 2).sum().backward()
+    clip = nn.ClipGradByGlobalNorm(0.001)
+    grads = [p.grad._value for p in m.parameters()]
+    clipped = clip._clip_arrays(grads, m.parameters())
+    total = np.sqrt(sum(float((np.asarray(g, dtype=np.float64) ** 2).sum()) for g in clipped))
+    assert total <= 0.001 + 1e-6
