@@ -16,6 +16,15 @@ import jax.numpy as jnp
 __all__ = ["ClipGradBase", "ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm", "clip_grad_norm_"]
 
 
+def _need_clip_mask(grads, params):
+    """Per-param clip exemption (ParamAttr.need_clip=False), honored by all
+    clip strategies like the reference's _allow_pure_fp16_global_norm_clip
+    path in python/paddle/nn/clip.py."""
+    if params is None:
+        return [True] * len(grads)
+    return [getattr(p, "need_clip", True) for p in params]
+
+
 class ClipGradBase:
     def _clip_arrays(self, grads: list, params=None) -> list:
         raise NotImplementedError
@@ -39,7 +48,8 @@ class ClipGradByValue(ClipGradBase):
         self.min = float(min) if min is not None else -float(max)
 
     def _clip_arrays(self, grads, params=None):
-        return [jnp.clip(g, self.min, self.max) for g in grads]
+        mask = _need_clip_mask(grads, params)
+        return [jnp.clip(g, self.min, self.max) if m else g for g, m in zip(grads, mask)]
 
 
 class ClipGradByNorm(ClipGradBase):
@@ -49,8 +59,12 @@ class ClipGradByNorm(ClipGradBase):
         self.clip_norm = float(clip_norm)
 
     def _clip_arrays(self, grads, params=None):
+        mask = _need_clip_mask(grads, params)
         out = []
-        for g in grads:
+        for g, m in zip(grads, mask):
+            if not m:
+                out.append(g)
+                continue
             norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
             scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
             out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
@@ -73,11 +87,7 @@ class ClipGradByGlobalNorm(ClipGradBase):
     def _clip_arrays(self, grads, params=None):
         if not grads:
             return grads
-        # Respect per-param need_clip (ParamAttr.need_clip=False exempts).
-        if params is not None:
-            clip_mask = [getattr(p, "need_clip", True) for p in params]
-        else:
-            clip_mask = [True] * len(grads)
+        clip_mask = _need_clip_mask(grads, params)
         gnorm = self.global_norm([g for g, m in zip(grads, clip_mask) if m])
         scale = self.clip_norm / jnp.maximum(gnorm, self.clip_norm)
         return [

@@ -10,6 +10,7 @@ from __future__ import annotations
 from ..ops import (  # noqa: F401
     adaptive_avg_pool2d,
     adaptive_max_pool2d,
+    alpha_dropout,
     avg_pool1d,
     avg_pool2d,
     batch_norm,
@@ -42,6 +43,7 @@ from ..ops import (  # noqa: F401
     layer_norm,
     leaky_relu,
     linear,
+    local_response_norm,
     log_sigmoid,
     log_softmax,
     max_pool1d,

@@ -19,6 +19,7 @@ __all__ = [
     "Embedding",
     "Dropout",
     "Dropout2D",
+    "Dropout3D",
     "AlphaDropout",
     "Flatten",
     "Identity",
@@ -127,22 +128,57 @@ class Dropout(Layer):
     def __init__(self, p=0.5, axis=None, mode="upscale_in_train", name=None):
         super().__init__()
         self.p = p
+        self.axis = axis
         self.mode = mode
 
     def forward(self, x):
-        return F.dropout(x, p=self.p, training=self.training, mode=self.mode)
+        return F.dropout(x, p=self.p, axis=self.axis, training=self.training,
+                         mode=self.mode)
 
     def extra_repr(self):
         return f"p={self.p}, mode={self.mode}"
 
 
-class Dropout2D(Dropout):
+class Dropout2D(Layer):
+    """Drops whole channels of a 4-D (N,C,H,W)/(N,H,W,C) feature map
+    (reference nn/layer/common.py Dropout2D → F.dropout2d)."""
+
     def __init__(self, p=0.5, data_format="NCHW", name=None):
-        super().__init__(p=p)
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        axis = (0, 1) if self.data_format == "NCHW" else (0, 3)
+        return F.dropout(x, p=self.p, axis=axis, training=self.training)
+
+    def extra_repr(self):
+        return f"p={self.p}, data_format={self.data_format}"
 
 
-class AlphaDropout(Dropout):
-    pass
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+        self.data_format = data_format
+
+    def forward(self, x):
+        axis = (0, 1) if self.data_format == "NCDHW" else (0, 4)
+        return F.dropout(x, p=self.p, axis=axis, training=self.training)
+
+
+class AlphaDropout(Layer):
+    """SELU-preserving dropout (reference nn/layer/common.py AlphaDropout)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, p=self.p, training=self.training)
+
+    def extra_repr(self):
+        return f"p={self.p}"
 
 
 class Flatten(Layer):

@@ -185,19 +185,15 @@ class LocalResponseNorm(Layer):
         self.alpha = alpha
         self.beta = beta
         self.k = k
+        self.data_format = data_format
 
     def forward(self, x):
-        from ..ops import pad as _pad, avg_pool2d  # noqa: F401
-        import jax
+        from ..ops import local_response_norm
 
-        v = x._value if isinstance(x, Tensor) else x
-        sq = v * v
-        # sum over channel window: pad channels then moving sum
-        half = self.size // 2
-        padded = jnp.pad(sq, [(0, 0), (half, self.size - 1 - half)] + [(0, 0)] * (v.ndim - 2))
-        win = sum(padded[:, i : i + v.shape[1]] for i in range(self.size))
-        den = jnp.power(self.k + self.alpha * win, self.beta)
-        return Tensor._from_value(v / den)
+        return local_response_norm(
+            x, size=self.size, alpha=self.alpha, beta=self.beta, k=self.k,
+            data_format=self.data_format,
+        )
 
 
 class SpectralNorm(Layer):
@@ -220,15 +216,12 @@ class SpectralNorm(Layer):
         self.weight_v.stop_gradient = True
 
     def forward(self, weight):
-        v = weight._value if isinstance(weight, Tensor) else weight
-        mat = jnp.moveaxis(v, self.dim, 0).reshape(v.shape[self.dim], -1)
-        u, vv = self.weight_u._value, self.weight_v._value
-        for _ in range(self.power_iters):
-            vv = mat.T @ u
-            vv = vv / (jnp.linalg.norm(vv) + self.eps)
-            u = mat @ vv
-            u = u / (jnp.linalg.norm(u) + self.eps)
-        self.weight_u._value = u
-        self.weight_v._value = vv
-        sigma = u @ mat @ vv
-        return Tensor._from_value(v / sigma)
+        from ..ops import spectral_norm
+
+        out, new_u, new_v = spectral_norm(
+            weight, self.weight_u, self.weight_v,
+            dim=self.dim, power_iters=self.power_iters, eps=self.eps,
+        )
+        self.weight_u._value = new_u._value
+        self.weight_v._value = new_v._value
+        return out

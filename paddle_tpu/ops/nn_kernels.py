@@ -270,19 +270,77 @@ def embedding(x, weight, padding_idx=None):
 # ============================================================ dropout & random
 
 
-def dropout(x, p=0.5, training=True, mode="upscale_in_train", *, rng_key=None):
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", *, rng_key=None):
     """``rng_key`` is raw uint32 key data (a traced operand) so this kernel is
     jit-cacheable; callers (nn.functional) thread it from the global RNG. A
     bare eager call without a key still works (stateful fallback). It is
     keyword-only so the positional surface matches the reference's
-    ``dropout(x, p, ...)`` (python/paddle/nn/functional/common.py:1041)."""
+    ``dropout(x, p, ...)`` (python/paddle/nn/functional/common.py:1041).
+
+    ``axis`` restricts mask generation to those dims (mask broadcasts over the
+    rest) — this is how Dropout2D/3D drop whole channels."""
     if not training or p == 0.0:
         return x
     key = jax.random.wrap_key_data(rng_key) if rng_key is not None else _random.next_key()
-    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if axis is None:
+        mask_shape = x.shape
+    else:
+        ax = (axis,) if isinstance(axis, int) else tuple(axis)
+        mask_shape = tuple(s if i in ax else 1 for i, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
     if mode == "upscale_in_train":
         return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
     return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+def alpha_dropout(x, p=0.5, training=True, *, rng_key=None):
+    """SELU-preserving dropout (reference python/paddle/nn/functional/common.py
+    alpha_dropout): dropped units are set to alpha' and an affine correction
+    keeps zero mean / unit variance."""
+    if not training or p == 0.0:
+        return x
+    key = jax.random.wrap_key_data(rng_key) if rng_key is not None else _random.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    q = 1.0 - p
+    a = (q + alpha_p * alpha_p * p * q) ** -0.5
+    b = -a * alpha_p * p
+    keep = jax.random.bernoulli(key, q, x.shape)
+    return (a * jnp.where(keep, x, alpha_p) + b).astype(x.dtype)
+
+
+def local_response_norm(x, size=5, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW"):
+    """y = x / (k + alpha/size * sum_window(x^2))^beta (reference
+    python/paddle/nn/functional/norm.py local_response_norm — the window term
+    is an average pool, i.e. sum/size)."""
+    channel_last = data_format.endswith("C") or data_format in ("NHWC", "NDHWC", "NLC")
+    v = jnp.moveaxis(x, -1, 1) if channel_last else x
+    sq = v * v
+    half = size // 2
+    pad_cfg = [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (v.ndim - 2)
+    padded = jnp.pad(sq, pad_cfg)
+    win = sum(padded[:, i : i + v.shape[1]] for i in range(size))
+    den = jnp.power(k + (alpha / size) * win, beta)
+    out = v / den
+    return jnp.moveaxis(out, 1, -1) if channel_last else out
+
+
+def spectral_norm(weight, u, v, dim=0, power_iters=1, eps=1e-12):
+    """Power-iteration spectral normalization (reference
+    paddle/phi/kernels/impl/spectral_norm_kernel_impl.h). Returns
+    (weight/sigma, new_u, new_v); u/v iteration runs under stop_gradient so
+    gradients flow to ``weight`` only through sigma = u^T W v."""
+    mat = jnp.moveaxis(weight, dim, 0).reshape(weight.shape[dim], -1)
+    for _ in range(power_iters):
+        v = jax.lax.stop_gradient(mat).T @ u
+        v = v / (jnp.linalg.norm(v) + eps)
+        u = jax.lax.stop_gradient(mat) @ v
+        u = u / (jnp.linalg.norm(u) + eps)
+    u = jax.lax.stop_gradient(u)
+    v = jax.lax.stop_gradient(v)
+    sigma = u @ mat @ v
+    return weight / sigma, u, v
 
 
 def uniform(shape, dtype="float32", min=-1.0, max=1.0):

@@ -273,9 +273,12 @@ class ReduceOnPlateau(LRScheduler):
 
         current = float(metrics.item() if isinstance(metrics, Tensor) else metrics)
         self.last_epoch += 1
+        # Metrics are ignored entirely while cooling down (reference
+        # python/paddle/optimizer/lr.py ReduceOnPlateau.step).
         if self.cooldown_counter > 0:
             self.cooldown_counter -= 1
             self.num_bad_epochs = 0
+            return
         if self._is_better(current):
             self.best = current
             self.num_bad_epochs = 0

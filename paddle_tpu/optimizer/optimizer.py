@@ -82,9 +82,7 @@ class Optimizer:
                     self._master_weights[self._master_key(p)] = p._value.astype(jnp.float32)
 
     def _init_slot(self, slot, p):
-        return jnp.zeros_like(
-            p._value, dtype=jnp.float32 if self._multi_precision else p._value.dtype
-        )
+        return self._init_slot_value(slot, p._value)
 
     # ------------------------------------------------ the update rule (override)
 
@@ -110,6 +108,35 @@ class Optimizer:
         """Whether decoupled decay applies to this param (AdamW/Lamb override
         consult apply_decay_param_fun / exclude_from_weight_decay_fn)."""
         return True
+
+    def _decay_flag_by_name(self, name) -> bool:
+        """Decay exemption looked up by parameter name — the functional/jit
+        path carries name-keyed arrays, not Parameter objects. Keys MUST be
+        ``Tensor.name`` (``register_param_names`` adds alternative keyspaces,
+        e.g. state_dict keys, for compiled train steps)."""
+        if self.__dict__.get("_decay_flag_name_cache") is None:
+            self._decay_flag_name_cache = {
+                p.name: self._decay_flag(p) for p in self._parameter_list
+            }
+        return self._decay_flag_name_cache.get(name, True)
+
+    def _lr_scale_by_name(self, name) -> float:
+        if self.__dict__.get("_lr_scale_name_cache") is None:
+            self._lr_scale_name_cache = {
+                p.name: self._lr_scale(p) for p in self._parameter_list
+            }
+        return self._lr_scale_name_cache.get(name, 1.0)
+
+    def register_param_names(self, mapping: dict):
+        """Register alternative names (e.g. Layer state_dict keys) for the
+        functional path: ``{alt_name: Parameter}``. Compiled train steps that
+        key arrays by structured names call this so per-param decay exemptions
+        and LR multipliers still resolve."""
+        self._decay_flag_by_name("")  # build caches
+        self._lr_scale_by_name("")
+        for alt, p in mapping.items():
+            self._decay_flag_name_cache[alt] = self._decay_flag(p)
+            self._lr_scale_name_cache[alt] = self._lr_scale(p)
 
     def _lr_scale(self, p) -> float:
         """Per-parameter LR multiplier (ParamAttr.learning_rate, reference:
@@ -231,7 +258,10 @@ class Optimizer:
             g = g.astype(work.dtype)
             g = self._decay_grad(work, g)
             slot_vals = {slot: accs[f"{slot}@{name}"] for slot in self._accumulator_names}
-            new_p, slots_out = self._rule(work, g, slot_vals, lr, t)
+            scale = self._lr_scale_by_name(name)
+            lr_i = lr * scale if scale != 1.0 else lr
+            new_p, slots_out = self._rule(work, g, slot_vals, lr_i, t,
+                                          apply_decay=self._decay_flag_by_name(name))
             if master is not None:
                 new_masters[name] = new_p
                 new_params[name] = new_p.astype(p.dtype)
@@ -246,12 +276,17 @@ class Optimizer:
         accs, masters = {}, {}
         for name, p in named_params.items():
             for slot in self._accumulator_names:
-                accs[f"{slot}@{name}"] = jnp.zeros_like(
-                    p, dtype=jnp.float32 if self._multi_precision else p.dtype
-                )
+                accs[f"{slot}@{name}"] = self._init_slot_value(slot, p)
             if self._multi_precision and p.dtype in (jnp.bfloat16, jnp.float16):
                 masters[name] = p.astype(jnp.float32)
         return accs, masters
+
+    def _init_slot_value(self, slot, value):
+        """Slot init on a raw array — shared by eager _init_slot and the
+        functional path so e.g. Adagrad's initial_accumulator_value matches."""
+        return jnp.zeros_like(
+            value, dtype=jnp.float32 if self._multi_precision else value.dtype
+        )
 
     # ------------------------------------------------ state dict
 
@@ -282,7 +317,7 @@ class Optimizer:
 
 
 class SGD(Optimizer):
-    def _rule(self, p, g, accs, lr, t):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True):
         return p - lr.astype(p.dtype) * g, accs
 
 
@@ -296,7 +331,7 @@ class Momentum(Optimizer):
         self._momentum = momentum
         self._use_nesterov = use_nesterov
 
-    def _rule(self, p, g, accs, lr, t):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True):
         v = self._momentum * accs["velocity"].astype(p.dtype) + g
         if self._use_nesterov:
             step = g + self._momentum * v
@@ -314,10 +349,10 @@ class Adagrad(Optimizer):
         self._epsilon = epsilon
         self._initial = initial_accumulator_value
 
-    def _init_slot(self, slot, p):
-        return jnp.full_like(p._value, self._initial)
+    def _init_slot_value(self, slot, value):
+        return jnp.full_like(value, self._initial)
 
-    def _rule(self, p, g, accs, lr, t):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True):
         m = accs["moment"] + g * g
         return p - lr.astype(p.dtype) * g / (jnp.sqrt(m) + self._epsilon), {"moment": m}
 
@@ -332,7 +367,7 @@ class RMSProp(Optimizer):
         self._epsilon = epsilon
         self._momentum = momentum
 
-    def _rule(self, p, g, accs, lr, t):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True):
         ms = self._rho * accs["mean_square"] + (1 - self._rho) * g * g
         mom = self._momentum * accs["moment"] + lr.astype(p.dtype) * g / jnp.sqrt(ms + self._epsilon)
         return p - mom, {"mean_square": ms, "moment": mom}
@@ -349,7 +384,7 @@ class Adam(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
 
-    def _rule(self, p, g, accs, lr, t):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True):
         dt = p.dtype
         b1 = jnp.asarray(self._beta1, dt)
         b2 = jnp.asarray(self._beta2, dt)
@@ -377,9 +412,15 @@ class AdamW(Adam):
     def _decay_grad(self, p, g):
         return g  # decoupled: decay applied in _rule
 
-    def _rule(self, p, g, accs, lr, t):
+    def _decay_flag(self, p):
+        if self._apply_decay_param_fun is not None:
+            return bool(self._apply_decay_param_fun(p.name))
+        return True
+
+    def _rule(self, p, g, accs, lr, t, apply_decay=True):
         # p *= (1 - lr*coeff) before the adam update (reference adamw kernel)
-        p = p * (1.0 - lr.astype(p.dtype) * self._coeff)
+        if apply_decay:
+            p = p * (1.0 - lr.astype(p.dtype) * self._coeff)
         return super()._rule(p, g, accs, lr, t)
 
 
@@ -393,7 +434,7 @@ class Adamax(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
 
-    def _rule(self, p, g, accs, lr, t):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True):
         m = self._beta1 * accs["moment"] + (1 - self._beta1) * g
         inf = jnp.maximum(self._beta2 * accs["inf_norm"], jnp.abs(g))
         tf = t.astype(p.dtype)
@@ -412,8 +453,14 @@ class Lamb(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
         self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
 
-    def _rule(self, p, g, accs, lr, t):
+    def _decay_flag(self, p):
+        if self._exclude_fn is not None:
+            return not bool(self._exclude_fn(p))
+        return True
+
+    def _rule(self, p, g, accs, lr, t, apply_decay=True):
         dt = p.dtype
         b1 = jnp.asarray(self._beta1, dt)
         b2 = jnp.asarray(self._beta2, dt)
@@ -422,7 +469,8 @@ class Lamb(Optimizer):
         tf = t.astype(dt)
         mhat = m / (1 - jnp.power(b1, tf))
         vhat = v / (1 - jnp.power(b2, tf))
-        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._lamb_wd * p
+        wd = self._lamb_wd if apply_decay else 0.0
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + wd * p
         w_norm = jnp.linalg.norm(p.reshape(-1).astype(jnp.float32))
         r_norm = jnp.linalg.norm(r.reshape(-1).astype(jnp.float32))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0).astype(dt)

@@ -204,3 +204,57 @@ def test_clip_grad_by_global_norm():
     clipped = clip._clip_arrays(grads, m.parameters())
     total = np.sqrt(sum(float((np.asarray(g, dtype=np.float64) ** 2).sum()) for g in clipped))
     assert total <= 0.001 + 1e-6
+
+
+def test_local_response_norm_grad_and_value():
+    paddle.seed(0)
+    x = paddle.randn([2, 6, 4, 4])
+    x.stop_gradient = False
+    y = nn.LocalResponseNorm(size=5)(x)
+    # matches y = x / (k + alpha/size * window_sum)^beta with hand computation at one point
+    xv = np.asarray(x._value)
+    sq = xv * xv
+    padded = np.pad(sq, [(0, 0), (2, 2), (0, 0), (0, 0)])
+    win = sum(padded[:, i:i + 6] for i in range(5))
+    expect = xv / np.power(1.0 + (1e-4 / 5) * win, 0.75)
+    np.testing.assert_allclose(np.asarray(y._value), expect, rtol=1e-5)
+    y.sum().backward()
+    assert x.grad is not None
+    assert np.isfinite(np.asarray(x.grad._value)).all()
+
+
+def test_dropout2d_drops_whole_channels():
+    paddle.seed(0)
+    d = nn.Dropout2D(0.5)
+    x = paddle.ones([4, 8, 5, 5])
+    y = np.asarray(d(x)._value)
+    # every (n, c) slice must be all-zero or all-2.0
+    for n in range(4):
+        for c in range(8):
+            sl = y[n, c]
+            assert (sl == 0).all() or np.allclose(sl, 2.0), sl
+
+
+def test_alpha_dropout_stats():
+    paddle.seed(0)
+    d = nn.AlphaDropout(0.3)
+    x = paddle.randn([20000])
+    y = np.asarray(d(x)._value)
+    assert abs(y.mean()) < 0.1
+    assert abs(y.std() - 1.0) < 0.1
+    d.eval()
+    np.testing.assert_allclose(np.asarray(d(x)._value), np.asarray(x._value))
+
+
+def test_spectral_norm_grad_flows():
+    paddle.seed(0)
+    w = paddle.randn([8, 4])
+    w.stop_gradient = False
+    sn = nn.SpectralNorm([8, 4], power_iters=3)
+    out = sn(w)
+    # spectral norm of the output should be ~1
+    s = np.linalg.svd(np.asarray(out._value), compute_uv=False)
+    assert abs(s[0] - 1.0) < 0.1
+    out.sum().backward()
+    assert w.grad is not None
+    assert np.isfinite(np.asarray(w.grad._value)).all()

@@ -103,7 +103,8 @@ def test_multi_precision_master_weights():
     o.step()
     assert m.weight.dtype == paddle.bfloat16
     import jax.numpy as jnp
-    assert o._master_weights[m.weight.name].dtype == jnp.float32
+    key = o._master_key(m.weight)
+    assert o._master_weights[key].dtype == jnp.float32
 
 
 def test_lr_scheduler_drives_optimizer():
@@ -150,3 +151,58 @@ def test_reduce_on_plateau():
     s.step(metrics=1.0)
     s.step(metrics=1.0)  # 2 bad epochs > patience
     assert s() == pytest.approx(0.1)
+
+
+def test_adamw_decay_exemption():
+    # apply_decay_param_fun=False must equal weight_decay=0 exactly
+    paddle.seed(7)
+    m1 = nn.Linear(4, 4)
+    m2 = nn.Linear(4, 4)
+    for pa, pb in zip(m1.parameters(), m2.parameters()):
+        pb._value = pa._value
+    oa = opt.AdamW(0.01, parameters=m1.parameters(), weight_decay=0.9,
+                   apply_decay_param_fun=lambda name: False)
+    ob = opt.AdamW(0.01, parameters=m2.parameters(), weight_decay=0.0)
+    x = paddle.randn([8, 4])
+    for _ in range(3):
+        m1(x).sum().backward(); oa.step(); oa.clear_grad()
+        m2(x).sum().backward(); ob.step(); ob.clear_grad()
+    np.testing.assert_allclose(np.asarray(m1.weight._value),
+                               np.asarray(m2.weight._value), rtol=1e-6)
+
+
+def test_lamb_decay_exemption():
+    paddle.seed(7)
+    m1 = nn.Linear(4, 4)
+    m2 = nn.Linear(4, 4)
+    for pa, pb in zip(m1.parameters(), m2.parameters()):
+        pb._value = pa._value
+    oa = opt.Lamb(0.01, lamb_weight_decay=0.9, parameters=m1.parameters(),
+                  exclude_from_weight_decay_fn=lambda p: True)
+    ob = opt.Lamb(0.01, lamb_weight_decay=0.0, parameters=m2.parameters())
+    x = paddle.randn([8, 4])
+    for _ in range(3):
+        m1(x).sum().backward(); oa.step(); oa.clear_grad()
+        m2(x).sum().backward(); ob.step(); ob.clear_grad()
+    np.testing.assert_allclose(np.asarray(m1.weight._value),
+                               np.asarray(m2.weight._value), rtol=1e-6)
+
+
+def test_functional_update_honors_decay_exemption():
+    paddle.seed(3)
+    m = nn.Linear(4, 4)
+    o = opt.AdamW(0.01, parameters=m.parameters(), weight_decay=0.9,
+                  apply_decay_param_fun=lambda name: False)
+    named = {p.name: p._value for p in m.parameters()}
+    import jax.numpy as jnp
+    grads = {k: jnp.ones_like(v) for k, v in named.items()}
+    accs, masters = o.init_functional_state(named)
+    lr = jnp.asarray(0.01, jnp.float32)
+    t = jnp.asarray(1, jnp.int32)
+    new_p, _, _ = o.functional_update(named, grads, accs, masters, lr, t)
+    # with decay exempted, result must equal weight_decay=0 update
+    o2 = opt.AdamW(0.01, parameters=m.parameters(), weight_decay=0.0)
+    accs2, masters2 = o2.init_functional_state(named)
+    new_p2, _, _ = o2.functional_update(named, grads, accs2, masters2, lr, t)
+    for k in named:
+        np.testing.assert_allclose(np.asarray(new_p[k]), np.asarray(new_p2[k]), rtol=1e-6)
