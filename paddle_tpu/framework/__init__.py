@@ -1,0 +1,7 @@
+"""paddle_tpu.framework — serialization + framework-level helpers.
+
+Analog of /root/reference/python/paddle/framework/ (io.py save/load,
+random seed helpers).
+"""
+from . import io  # noqa: F401
+from .io import load, save  # noqa: F401

@@ -1,0 +1,161 @@
+"""paddle.save / paddle.load — checkpoint serialization.
+
+Analog of the reference's ``python/paddle/framework/io.py`` (paddle.save /
+paddle.load over pickled state_dicts). TPU-native design: a self-describing
+binary container — JSON header (structure tree + per-tensor dtype/shape/
+offset) followed by raw little-endian tensor bytes — rather than pickle, so
+checkpoints are safe to load from untrusted sources, independent of Python
+class layout, and memory-mappable. bf16/fp8 round-trip via ml_dtypes.
+
+This single-file format is also the per-shard payload of the distributed
+checkpoint (paddle_tpu.distributed.checkpoint), mirroring how the
+reference's .distcp shards reuse its serialization.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+
+import numpy as np
+
+__all__ = ["save", "load", "save_arrays", "load_arrays"]
+
+_MAGIC = b"PTPU0001"
+
+# dtype name <-> numpy dtype (ml_dtypes supplies the TPU dtypes numpy lacks)
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _to_numpy(value):
+    """Tensor/jax.Array/np.ndarray -> np.ndarray (no copy when possible)."""
+    from ..core.tensor import Tensor
+
+    if isinstance(value, Tensor):
+        value = value._value
+    return np.asarray(value)
+
+
+def _is_tensor_like(v):
+    from ..core.tensor import Tensor
+    import jax
+
+    return isinstance(v, (Tensor, jax.Array, np.ndarray))
+
+
+def _flatten(obj, tensors: list):
+    """Structure tree with {"@t": idx} marking tensor leaves. Scalars,
+    strings, None, bools pass through as JSON natives."""
+    if _is_tensor_like(obj):
+        tensors.append(_to_numpy(obj))
+        return {"@t": len(tensors) - 1}
+    if isinstance(obj, dict):
+        return {"@d": [[_flatten(k, tensors) if not isinstance(k, str) else k,
+                        _flatten(v, tensors)] for k, v in obj.items()]}
+    if isinstance(obj, (list, tuple)):
+        return {"@l" if isinstance(obj, list) else "@tp": [_flatten(v, tensors) for v in obj]}
+    if isinstance(obj, (int, float, str, bool)) or obj is None:
+        return obj
+    if isinstance(obj, (np.integer,)):
+        return int(obj)
+    if isinstance(obj, (np.floating,)):
+        return float(obj)
+    raise TypeError(
+        f"paddle.save cannot serialize object of type {type(obj)!r}; "
+        "supported: Tensor/ndarray, dict, list, tuple, scalars, str, None"
+    )
+
+
+def _unflatten(tree, tensors, return_tensor):
+    if isinstance(tree, dict):
+        if "@t" in tree:
+            arr = tensors[tree["@t"]]
+            if return_tensor:
+                from ..core.tensor import Tensor
+                import jax.numpy as jnp
+
+                return Tensor._from_value(jnp.asarray(arr))
+            return arr
+        if "@d" in tree:
+            return {((k if isinstance(k, str) else _unflatten(k, tensors, return_tensor))):
+                    _unflatten(v, tensors, return_tensor) for k, v in tree["@d"]}
+        if "@l" in tree:
+            return [_unflatten(v, tensors, return_tensor) for v in tree["@l"]]
+        if "@tp" in tree:
+            return tuple(_unflatten(v, tensors, return_tensor) for v in tree["@tp"])
+    return tree
+
+
+def save(obj, path, protocol=None, **configs):
+    """Serialize ``obj`` (state_dict / nested containers of Tensors) to
+    ``path``. Reference API: python/paddle/framework/io.py ``paddle.save``."""
+    from ..core.tensor import Tensor
+
+    path = str(path)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+
+    tensors: list[np.ndarray] = []
+    tree = _flatten(obj, tensors)
+    metas = []
+    offset = 0
+    blobs = []
+    for arr in tensors:
+        arr = np.ascontiguousarray(arr)
+        blob = arr.tobytes()
+        metas.append({
+            "dtype": arr.dtype.name,
+            "shape": list(arr.shape),
+            "offset": offset,
+            "nbytes": len(blob),
+        })
+        offset += len(blob)
+        blobs.append(blob)
+
+    header = json.dumps({"tree": tree, "tensors": metas}).encode("utf-8")
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(header)))
+        f.write(header)
+        for blob in blobs:
+            f.write(blob)
+
+
+def load(path, return_numpy=False, **configs):
+    """Load an object saved by ``paddle.save``. Tensor leaves come back as
+    Tensors (or ndarrays with ``return_numpy=True``)."""
+    path = str(path)
+    with open(path, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise ValueError(
+                f"{path} is not a paddle_tpu checkpoint (bad magic {magic!r})"
+            )
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        header = json.loads(f.read(hlen).decode("utf-8"))
+        payload = f.read()
+
+    tensors = []
+    for meta in header["tensors"]:
+        dt = _np_dtype(meta["dtype"])
+        raw = payload[meta["offset"] : meta["offset"] + meta["nbytes"]]
+        # copy: frombuffer views over `bytes` are read-only, and callers of
+        # return_numpy=True may mutate in place
+        tensors.append(np.frombuffer(raw, dtype=dt).reshape(meta["shape"]).copy())
+    return _unflatten(header["tree"], tensors, return_tensor=not return_numpy)
+
+
+def save_arrays(named_arrays: dict, path):
+    """Flat name->array save (used by distributed checkpoint shards)."""
+    save(named_arrays, path)
+
+
+def load_arrays(path) -> dict:
+    return load(path, return_numpy=True)

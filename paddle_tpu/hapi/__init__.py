@@ -1,0 +1,7 @@
+"""paddle_tpu.hapi — high-level Keras-like training API.
+
+Analog of /root/reference/python/paddle/hapi/ (Model.fit/evaluate/predict,
+callbacks, model_summary).
+"""
+from . import summary as _summary_mod  # noqa: F401
+from .summary import summary  # noqa: F401

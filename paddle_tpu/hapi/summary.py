@@ -1,0 +1,94 @@
+"""paddle.summary — layer-by-layer model summary.
+
+Analog of /root/reference/python/paddle/hapi/model_summary.py: runs a dummy
+forward with post-hooks on every sublayer collecting output shapes and
+parameter counts, then prints a table and returns totals.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["summary"]
+
+
+def _shape_of(out):
+    from ..core.tensor import Tensor
+
+    if isinstance(out, Tensor):
+        return list(out.shape)
+    if isinstance(out, (tuple, list)) and out:
+        return _shape_of(out[0])
+    return []
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer summary. ``input_size`` is a shape tuple (batch dim
+    may be -1/None → 1) or list of shape tuples; or pass a ready ``input``."""
+    import paddle_tpu as paddle
+
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        is_single = (
+            isinstance(input_size, (tuple, list))
+            and input_size
+            and all(isinstance(s, int) or s is None for s in input_size)
+        )
+        shapes = [input_size] if is_single else list(input_size)
+        dtypes = dtypes or ["float32"] * len(shapes)
+        if isinstance(dtypes, str):
+            dtypes = [dtypes] * len(shapes)
+        inputs = []
+        for shp, dt in zip(shapes, dtypes):
+            shp = [1 if (s is None or s == -1) else s for s in shp]
+            if dt.startswith("int"):
+                inputs.append(paddle.zeros(shape=shp, dtype=dt))
+            else:
+                inputs.append(paddle.randn(shp).astype(dt))
+    else:
+        inputs = input if isinstance(input, (tuple, list)) else [input]
+
+    rows = []
+    hooks = []
+
+    def make_hook(name, layer):
+        def hook(l, ins, outs):
+            n_params = sum(int(np.prod(p.shape)) for p in l._parameters.values() if p is not None)
+            rows.append((f"{name} ({type(l).__name__})", _shape_of(outs), n_params))
+
+        return hook
+
+    leaf_found = False
+    for name, sub in net.named_sublayers(include_self=False):
+        if not sub._sub_layers:  # leaf layers only, like the reference
+            hooks.append(sub.register_forward_post_hook(make_hook(name, sub)))
+            leaf_found = True
+    if not leaf_found:  # the net itself is a leaf layer
+        hooks.append(net.register_forward_post_hook(make_hook(type(net).__name__.lower(), net)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        net(*inputs)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+
+    total_params = sum(int(np.prod(p.shape)) for p in net.parameters())
+    trainable = sum(int(np.prod(p.shape)) for p in net.parameters() if p.trainable)
+
+    w1 = max([len(r[0]) for r in rows] + [20]) + 2
+    line = "-" * (w1 + 40)
+    print(line)
+    print(f"{'Layer (type)':<{w1}}{'Output Shape':<24}{'Param #':>12}")
+    print("=" * (w1 + 40))
+    for name, shape, n in rows:
+        print(f"{name:<{w1}}{str(shape):<24}{n:>12,}")
+    print("=" * (w1 + 40))
+    print(f"Total params: {total_params:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total_params - trainable:,}")
+    print(line)
+    return {"total_params": total_params, "trainable_params": trainable}

@@ -1,0 +1,440 @@
+"""paddle_tpu.jit — whole-graph compilation (`to_static`) + compiled train steps.
+
+Analog of /root/reference/python/paddle/jit/ (34.7K LoC: SOT bytecode
+capture + AST dy2static, python/paddle/jit/dy2static/partial_program.py:231).
+The TPU-native design needs none of that machinery: eager ops already run on
+jax arrays, so `to_static` simply traces the Layer/function under `jax.jit`
+— parameters and buffers enter as pytree *inputs* (so optimizer updates
+never trigger recompilation) and the compiled region composes with the eager
+tape through one GradNode whose backward is the XLA-compiled VJP (the analog
+of the reference's RunProgramGradNode,
+paddle/fluid/eager/to_static/run_program_op_node.h).
+
+`TrainStep` goes further and fuses forward + backward + optimizer update
+into ONE donated-buffer XLA program — whole-step compilation is the
+performance story on TPU (SURVEY.md §7 M2).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd, random as _random
+from ..core.autograd import GradNode
+from ..core.tensor import Tensor
+
+__all__ = [
+    "to_static", "TrainStep", "cond", "while_loop", "scan",
+    "ignore_module", "not_to_static", "StaticFunction",
+]
+
+
+# ------------------------------------------------------------ traced RNG
+
+@contextlib.contextmanager
+def _traced_rng(base_key):
+    """Swap the global RNG root for a traced key while tracing so stateful
+    random ops (dropout without explicit keys) consume traced randomness
+    instead of baking a constant mask into the compiled program. The host
+    counter still increments per call site, giving each random op in the
+    graph a distinct fold-in of the traced base key."""
+    saved = (_random._rng.key, _random._rng.counter)
+    _random._rng.key = base_key
+    _random._rng.counter = 0
+    try:
+        yield
+    finally:
+        _random._rng.key, _random._rng.counter = saved
+
+
+def _as_tensor_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda v: Tensor._from_value(v) if isinstance(v, jax.Array) else v,
+        tree,
+    )
+
+
+def _as_array_tree(tree):
+    return jax.tree_util.tree_map(
+        lambda v: v._value if isinstance(v, Tensor) else v,
+        tree,
+        is_leaf=lambda v: isinstance(v, Tensor),
+    )
+
+
+from ..ops.registry import _freeze  # shared cache-key freezer
+
+
+_IS_TENSOR = lambda v: isinstance(v, Tensor)  # noqa: E731
+
+
+class _FunctionalModel:
+    """Pure-function view of a Layer (or plain function): swap traced arrays
+    into the live Parameters, run forward, capture buffer updates, restore."""
+
+    def __init__(self, layer, fn=None):
+        self.layer = layer
+        self.fn = fn
+
+    def __call__(self, params, buffers, args, kwargs, rng_key):
+        layer = self.layer
+        if layer is None:
+            with _traced_rng(jax.random.wrap_key_data(rng_key)):
+                out = self.fn(*_as_tensor_tree(args), **_as_tensor_tree(kwargs))
+            return _as_array_tree(out), {}
+        saved_p = {k: p._value for k, p in layer.named_parameters()}
+        saved_b = {k: b._value for k, b in layer.named_buffers()}
+        try:
+            layer.load_raw_state(params, buffers)
+            with _traced_rng(jax.random.wrap_key_data(rng_key)):
+                out = layer(*_as_tensor_tree(args), **_as_tensor_tree(kwargs))
+            new_buffers = {k: b._value for k, b in layer.named_buffers()}
+            return _as_array_tree(out), new_buffers
+        finally:
+            layer.load_raw_state(saved_p, saved_b)
+
+
+class StaticFunction:
+    """Returned by ``to_static``: runs the traced, XLA-compiled whole-graph
+    program while still composing with eager autograd."""
+
+    def __init__(self, fn_or_layer, input_spec=None, full_graph=True, backend=None):
+        from ..nn import Layer
+
+        if isinstance(fn_or_layer, Layer):
+            self._layer, self._fn = fn_or_layer, None
+        else:
+            self._layer, self._fn = None, fn_or_layer
+        self._functional = _FunctionalModel(self._layer, self._fn)
+        # One compiled executable per (training mode, arg tree, static leaves);
+        # jax.jit adds shape/dtype specialization beneath this.
+        self._compiled: dict = {}
+
+    def _get_compiled(self, key, tree, static_leaves, n_leaves):
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        functional = self._functional
+
+        def pure(params, buffers, dyn, rng_key):
+            flat = [
+                dyn[i] if i in dyn else static_leaves[i] for i in range(n_leaves)
+            ]
+            a, kw = jax.tree_util.tree_unflatten(tree, flat)
+            return functional(params, buffers, a, kw, rng_key)
+
+        fn = jax.jit(pure)
+        self._compiled[key] = fn
+        return fn
+
+    def __call__(self, *args, **kwargs):
+        layer = self._layer
+        if layer is not None:
+            param_objs = dict(layer.named_parameters())
+            params = {k: p._value for k, p in param_objs.items()}
+            buffers = {k: b._value for k, b in layer.named_buffers()}
+            training = layer.training
+        else:
+            param_objs, params, buffers, training = {}, {}, {}, False
+
+        flat, tree = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_IS_TENSOR)
+        dyn: dict[int, jax.Array] = {}
+        diff_pos: list[int] = []
+        diff_tensors: list[Tensor] = []
+        static_leaves: dict[int, object] = {}
+        for i, v in enumerate(flat):
+            if isinstance(v, Tensor):
+                dyn[i] = v._value
+                if not v.stop_gradient:
+                    diff_pos.append(i)
+                    diff_tensors.append(v)
+            elif isinstance(v, (jax.Array, np.ndarray)):
+                dyn[i] = jnp.asarray(v)
+            else:
+                static_leaves[i] = v
+
+        key = (training, tree, _freeze(static_leaves))
+        compiled = self._get_compiled(key, tree, static_leaves, len(flat))
+        rng_key = jax.random.key_data(_random.next_key())
+
+        diff_params = {
+            k: p for k, p in param_objs.items()
+            if p.trainable and not p.stop_gradient
+        }
+        needs_grad = autograd.is_grad_enabled() and (diff_params or diff_tensors)
+
+        if not needs_grad:
+            out, new_buffers = compiled(params, buffers, dyn, rng_key)
+            self._write_buffers(new_buffers)
+            return _as_tensor_tree(out)
+
+        frozen = {k: v for k, v in params.items() if k not in diff_params}
+
+        def fwd(p_diff, diff_vals):
+            full = dict(frozen)
+            full.update(p_diff)
+            dyn2 = dict(dyn)
+            for pos, val in zip(diff_pos, diff_vals):
+                dyn2[pos] = val
+            return compiled(full, buffers, dyn2, rng_key)
+
+        (out, new_buffers), vjp_fn = jax.vjp(
+            fwd,
+            {k: p._value for k, p in diff_params.items()},
+            [t._value for t in diff_tensors],
+        )
+        self._write_buffers(new_buffers)
+
+        out_flat, out_tree = jax.tree_util.tree_flatten(out)
+        edge_tensors = list(diff_params.values()) + diff_tensors
+        edges = [t._grad_edge() for t in edge_tensors]
+        param_names = list(diff_params)
+        out_shapes = [(v.shape, v.dtype) for v in out_flat]
+        zero_buf_cot = jax.tree_util.tree_map(jnp.zeros_like, new_buffers)
+
+        def backward_fn(grad_outputs, _vjp=vjp_fn):
+            gflat = [
+                g if g is not None else jnp.zeros(s, d)
+                for g, (s, d) in zip(grad_outputs, out_shapes)
+            ]
+            gout = jax.tree_util.tree_unflatten(out_tree, gflat)
+            gp, gt = _vjp((gout, zero_buf_cot))
+            return tuple([gp[k] for k in param_names] + list(gt))
+
+        node = GradNode("to_static", backward_fn, edges, len(out_flat),
+                        tuple(True for _ in edges))
+        out_tensors = []
+        for i, v in enumerate(out_flat):
+            t = Tensor._from_value(v)
+            if jnp.issubdtype(v.dtype, jnp.inexact):
+                t.stop_gradient = False
+                t._grad_node = node
+                t._grad_slot = i
+            out_tensors.append(t)
+        return jax.tree_util.tree_unflatten(out_tree, out_tensors)
+
+    def _write_buffers(self, new_buffers):
+        if self._layer is not None and new_buffers:
+            bindex = dict(self._layer.named_buffers())
+            for k, v in new_buffers.items():
+                if k in bindex and not isinstance(v, jax.core.Tracer):
+                    bindex[k]._value = v
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=True, **kwargs):
+    """Compile a Layer or function into a whole-graph XLA program.
+
+    Reference API: python/paddle/jit/api.py ``paddle.jit.to_static``::
+
+        model = paddle.jit.to_static(model)   # Layer -> compiled proxy
+        @paddle.jit.to_static                 # or decorate a function
+        def f(x): ...
+    """
+    if function is None:
+        return lambda f: to_static(f, input_spec=input_spec, full_graph=full_graph)
+    from ..nn import Layer
+
+    static_fn = StaticFunction(function, input_spec=input_spec, full_graph=full_graph)
+    if isinstance(function, Layer):
+        return _StaticLayerProxy(function, static_fn)
+    functools.update_wrapper(static_fn, function)
+    return static_fn
+
+
+class _StaticLayerProxy:
+    """Layer-like proxy whose __call__ is compiled; everything else
+    (state_dict, parameters, train/eval, attribute access) delegates to the
+    wrapped Layer — the analog of the reference's TranslatedLayer."""
+
+    def __init__(self, layer, static_fn):
+        object.__setattr__(self, "_layer", layer)
+        object.__setattr__(self, "_static_fn", static_fn)
+
+    def __call__(self, *args, **kwargs):
+        return self._static_fn(*args, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_layer"), name)
+
+    def __setattr__(self, name, value):
+        setattr(object.__getattribute__(self, "_layer"), name, value)
+
+    def __repr__(self):
+        return f"ToStatic({object.__getattribute__(self, '_layer')!r})"
+
+
+# ------------------------------------------------------------ TrainStep
+
+class TrainStep:
+    """ONE compiled XLA program for forward + backward + optimizer update.
+
+    TPU-native replacement for the reference's static-graph training
+    executors (SURVEY.md §2.4): parameters, optimizer accumulators and master
+    weights are donated pytree inputs; the loss gradient comes from
+    ``jax.grad`` inside the trace; the optimizer's functional update runs in
+    the same program so XLA fuses the whole step into one executable launch.
+
+    Usage::
+
+        step = TrainStep(model, loss_fn, optimizer)
+        for x, y in loader:
+            loss = step(x, y)      # model/optimizer state updated in place
+    """
+
+    def __init__(self, model, loss_fn, optimizer):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._functional = _FunctionalModel(model)
+        params = dict(model.named_parameters())
+        optimizer.register_param_names(params)
+        self._trainable = {k for k, p in params.items() if p.trainable}
+        named = {k: p._value for k, p in params.items() if k in self._trainable}
+        self._accs, self._masters = optimizer.init_functional_state(named)
+        # Static per-param clip exemptions for the functional clip call
+        # (Parameter objects don't exist inside the trace).
+        self._clip_attrs = {
+            k: type("P", (), {"need_clip": getattr(p, "need_clip", True)})()
+            for k, p in params.items()
+        }
+        self._compiled = None
+
+    def _build(self):
+        functional = self._functional
+        optimizer = self.optimizer
+        loss_fn = self.loss_fn
+        trainable = self._trainable
+        grad_clip = optimizer._grad_clip
+        clip_attrs = self._clip_attrs
+
+        def one_step(params, buffers, accs, masters, lr, t, rng_key, args, kwargs):
+            p_train = {k: v for k, v in params.items() if k in trainable}
+            p_frozen = {k: v for k, v in params.items() if k not in trainable}
+
+            def loss_of(p_t):
+                full = dict(p_frozen)
+                full.update(p_t)
+                out, new_bufs = functional(full, buffers, args, kwargs, rng_key)
+                out_t = (
+                    tuple(Tensor._from_value(o) for o in out)
+                    if isinstance(out, tuple)
+                    else Tensor._from_value(out)
+                )
+                loss = loss_fn(*out_t) if isinstance(out_t, tuple) else loss_fn(out_t)
+                loss_val = loss._value if isinstance(loss, Tensor) else loss
+                return loss_val, new_bufs
+
+            (loss_val, new_buffers), grads = jax.value_and_grad(
+                loss_of, has_aux=True
+            )(p_train)
+
+            if grad_clip is not None:
+                names = list(grads)
+                clipped = grad_clip._clip_arrays(
+                    [grads[k] for k in names], [clip_attrs[k] for k in names]
+                )
+                grads = dict(zip(names, clipped))
+
+            new_p, new_accs, new_masters = optimizer.functional_update(
+                p_train, grads, accs, masters, lr, t
+            )
+            out_params = dict(p_frozen)
+            out_params.update(new_p)
+            return loss_val, out_params, new_buffers, new_accs, new_masters
+
+        return jax.jit(one_step, donate_argnums=(0, 2, 3))
+
+    def __call__(self, *args, **kwargs):
+        if self._compiled is None:
+            self._compiled = self._build()
+        model, optimizer = self.model, self.optimizer
+        params = {k: p._value for k, p in model.named_parameters()}
+        buffers = {k: b._value for k, b in model.named_buffers()}
+        optimizer._step_count += 1
+        lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+        t = jnp.asarray(optimizer._step_count, jnp.int32)
+        rng_key = jax.random.key_data(_random.next_key())
+
+        loss, new_params, new_buffers, self._accs, self._masters = self._compiled(
+            params, buffers, self._accs, self._masters, lr, t, rng_key,
+            _as_array_tree(args), _as_array_tree(kwargs),
+        )
+        model.load_raw_state(new_params, new_buffers)
+        return Tensor._from_value(loss)
+
+    def state_dict(self):
+        """Optimizer accumulator state for checkpointing the compiled path.
+        Copies the arrays — the live buffers are donated on the next step."""
+        out = {k: jnp.copy(v) for k, v in self._accs.items()}
+        out.update({f"master@{k}": jnp.copy(v) for k, v in self._masters.items()})
+        out["@step_count"] = self.optimizer._step_count
+        return out
+
+    def set_state_dict(self, state):
+        accs, masters = {}, {}
+        for k, v in state.items():
+            if k == "@step_count":
+                self.optimizer._step_count = int(v)
+            elif k.startswith("master@"):
+                masters[k[len("master@"):]] = getattr(v, "_value", v)
+            else:
+                accs[k] = getattr(v, "_value", v)
+        self._accs, self._masters = accs, masters
+
+
+# ------------------------------------------------------------ control flow
+
+def cond(pred, true_fn, false_fn, *operands):
+    """Structured conditional (reference paddle.static.nn.cond / PIR IfOp,
+    paddle/fluid/pir/dialect/operator/ir/control_flow_op.h:27) →
+    ``lax.cond``: both branches traced, selected at run time."""
+    pv = pred._value if isinstance(pred, Tensor) else pred
+    ops = _as_array_tree(operands)
+    out = jax.lax.cond(
+        pv,
+        lambda o: _as_array_tree(true_fn(*_as_tensor_tree(o))),
+        lambda o: _as_array_tree(false_fn(*_as_tensor_tree(o))),
+        ops,
+    )
+    return _as_tensor_tree(out)
+
+
+def while_loop(cond_fn, body_fn, loop_vars):
+    """Reference paddle.static.nn.while_loop (WhileOp) → ``lax.while_loop``."""
+    init = _as_array_tree(tuple(loop_vars))
+    out = jax.lax.while_loop(
+        lambda vs: (lambda r: r._value if isinstance(r, Tensor) else r)(
+            cond_fn(*_as_tensor_tree(vs))
+        ),
+        lambda vs: _as_array_tree(tuple(body_fn(*_as_tensor_tree(vs)))),
+        init,
+    )
+    return list(_as_tensor_tree(out))
+
+
+def scan(f, init, xs):
+    """``lax.scan`` surface for compiler-friendly loops over a leading axis
+    (the TPU-idiomatic replacement for python loops in traced code)."""
+    carry, ys = jax.lax.scan(
+        lambda c, x: tuple(
+            _as_array_tree(f(_as_tensor_tree(c), _as_tensor_tree(x)))
+        ),
+        _as_array_tree(init),
+        _as_array_tree(xs),
+    )
+    return _as_tensor_tree(carry), _as_tensor_tree(ys)
+
+
+def ignore_module(modules):  # reference-compat no-op (we trace values, not code)
+    return None
+
+
+def not_to_static(fn):
+    """reference-compat marker; tracing follows values so this is advisory."""
+    fn.__jit_not_to_static__ = True
+    return fn

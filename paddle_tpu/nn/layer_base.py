@@ -311,7 +311,10 @@ class Layer:
                         f"state_dict[{key!r}] shape {tuple(v.shape)} does not match "
                         f"parameter shape {tuple(target._value.shape)}"
                     )
-                target.set_value(v.astype(target._value.dtype))
+                # fresh buffer (astype can alias): compiled train steps donate
+                # parameter buffers, so shared storage across models would be
+                # invalidated by the first donated step.
+                target.set_value(jnp.array(v, dtype=target._value.dtype))
                 matched.add(key)
             else:
                 missing.append(key)

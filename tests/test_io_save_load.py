@@ -1,0 +1,70 @@
+"""paddle.save/paddle.load + paddle.summary (reference analog:
+test/legacy_test/test_paddle_save_load.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+
+
+def test_save_load_model_roundtrip(tmp_path):
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    o = opt.Adam(0.01, parameters=m.parameters())
+    x = paddle.randn([4, 4])
+    m(x).sum().backward(); o.step(); o.clear_grad()
+
+    paddle.save(m.state_dict(), str(tmp_path / "model.pdparams"))
+    paddle.save(o.state_dict(), str(tmp_path / "opt.pdopt"))
+
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    missing, unexpected = m2.set_state_dict(paddle.load(str(tmp_path / "model.pdparams")))
+    assert not missing and not unexpected
+    np.testing.assert_allclose(np.asarray(m2(x)._value), np.asarray(m(x)._value), rtol=1e-6)
+
+    o2 = opt.Adam(0.01, parameters=m2.parameters())
+    o2.set_state_dict(paddle.load(str(tmp_path / "opt.pdopt")))
+    assert o2._step_count == 1
+    for k, v in o._accumulators.items():
+        np.testing.assert_allclose(np.asarray(o2._accumulators[k]), np.asarray(v))
+
+
+def test_save_load_bf16(tmp_path):
+    m = nn.Linear(4, 4)
+    m.to(dtype="bfloat16")
+    paddle.save(m.state_dict(), str(tmp_path / "m.pdparams"))
+    sd = paddle.load(str(tmp_path / "m.pdparams"))
+    assert "bfloat16" in str(sd["weight"].dtype)
+    np.testing.assert_array_equal(
+        np.asarray(sd["weight"]._value, dtype=np.float32),
+        np.asarray(m.weight._value, dtype=np.float32),
+    )
+
+
+def test_save_load_nested_containers(tmp_path):
+    obj = {"a": [1, 2.5, None, "s"], "b": (paddle.ones([2]), {"c": True})}
+    paddle.save(obj, str(tmp_path / "misc"))
+    back = paddle.load(str(tmp_path / "misc"))
+    assert back["a"] == [1, 2.5, None, "s"]
+    assert back["b"][1]["c"] is True
+    np.testing.assert_allclose(np.asarray(back["b"][0]._value), np.ones(2))
+
+
+def test_load_numpy_mode(tmp_path):
+    paddle.save({"w": paddle.ones([3])}, str(tmp_path / "f"))
+    back = paddle.load(str(tmp_path / "f"), return_numpy=True)
+    assert isinstance(back["w"], np.ndarray)
+
+
+def test_load_rejects_non_checkpoint(tmp_path):
+    p = tmp_path / "junk"
+    p.write_bytes(b"not a checkpoint at all")
+    with pytest.raises(ValueError):
+        paddle.load(str(p))
+
+
+def test_summary_counts_params():
+    m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+    info = paddle.summary(m, input_size=(1, 4))
+    assert info["total_params"] == 4 * 8 + 8 + 8 * 2 + 2

@@ -1,0 +1,150 @@
+"""paddle.jit.to_static + TrainStep (reference analog: test/dygraph_to_static/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.optimizer as opt
+from paddle_tpu import jit
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.l1 = nn.Linear(8, 32)
+        self.l2 = nn.Linear(32, 1)
+
+    def forward(self, x):
+        return self.l2(paddle.tanh(self.l1(x)))
+
+
+def test_function_to_static_forward_and_backward():
+    paddle.seed(0)
+
+    @jit.to_static
+    def f(x, y):
+        return paddle.matmul(x, y) + 1.0
+
+    x = paddle.randn([4, 8])
+    y = paddle.randn([8, 4])
+    out = f(x, y)
+    expect = np.asarray(x._value) @ np.asarray(y._value) + 1.0
+    np.testing.assert_allclose(np.asarray(out._value), expect, rtol=1e-5)
+
+    x.stop_gradient = False
+    f(x, y).sum().backward()
+    gx = np.asarray(x.grad._value)
+    np.testing.assert_allclose(
+        gx, np.asarray(y._value).sum(1)[None, :].repeat(4, 0), rtol=1e-5
+    )
+
+
+def test_layer_to_static_matches_eager_training():
+    paddle.seed(1)
+    m_eager = MLP()
+    m_inner = MLP()
+    m_inner.set_state_dict(m_eager.state_dict())
+    m_static = jit.to_static(m_inner)
+
+    xb = paddle.randn([16, 8])
+    yb = paddle.randn([16, 1])
+    np.testing.assert_allclose(
+        np.asarray(m_static(xb)._value), np.asarray(m_eager(xb)._value), rtol=1e-5
+    )
+
+    oe = opt.SGD(0.1, parameters=m_eager.parameters())
+    os_ = opt.SGD(0.1, parameters=m_inner.parameters())
+    le, ls = [], []
+    for _ in range(8):
+        loss = ((m_eager(xb) - yb) ** 2).mean()
+        loss.backward(); oe.step(); oe.clear_grad(); le.append(float(loss))
+        loss2 = ((m_static(xb) - yb) ** 2).mean()
+        loss2.backward(); os_.step(); os_.clear_grad(); ls.append(float(loss2))
+    np.testing.assert_allclose(le, ls, rtol=1e-4)
+    assert le[-1] < le[0]
+
+
+def test_train_step_matches_eager_trajectory():
+    paddle.seed(2)
+    m1 = MLP()
+    m2 = MLP()
+    m2.set_state_dict(m1.state_dict())
+    xb = paddle.randn([16, 8])
+    yb = paddle.randn([16, 1])
+    mse = nn.MSELoss()
+
+    o1 = opt.Adam(0.01, parameters=m1.parameters())
+    step = jit.TrainStep(m1, lambda pred: mse(pred, yb), o1)
+    o2 = opt.Adam(0.01, parameters=m2.parameters())
+    l1, l2 = [], []
+    for _ in range(8):
+        l1.append(float(step(xb)))
+        loss = mse(m2(xb), yb)
+        loss.backward(); o2.step(); o2.clear_grad(); l2.append(float(loss))
+    np.testing.assert_allclose(l1, l2, rtol=1e-4)
+    assert l1[-1] < l1[0]
+
+
+def test_train_step_with_grad_clip_and_weight_decay():
+    paddle.seed(3)
+    m = MLP()
+    xb = paddle.randn([8, 8])
+    yb = paddle.randn([8, 1])
+    mse = nn.MSELoss()
+    o = opt.AdamW(0.01, parameters=m.parameters(), weight_decay=0.01,
+                  grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    step = jit.TrainStep(m, lambda pred: mse(pred, yb), o)
+    losses = [float(step(xb)) for _ in range(10)]
+    assert losses[-1] < losses[0]
+
+
+def test_to_static_dropout_not_frozen():
+    class DropNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.d = nn.Dropout(0.5)
+
+        def forward(self, x):
+            return self.d(x)
+
+    dn = jit.to_static(DropNet())
+    a = np.asarray(dn(paddle.ones([100]))._value)
+    b = np.asarray(dn(paddle.ones([100]))._value)
+    assert not np.allclose(a, b)
+    dn.eval()
+    np.testing.assert_allclose(np.asarray(dn(paddle.ones([100]))._value), np.ones(100))
+
+
+def test_to_static_batchnorm_updates_running_stats():
+    class BN(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = nn.BatchNorm1D(4)
+
+        def forward(self, x):
+            return self.bn(x)
+
+    net = BN()
+    snet = jit.to_static(net)
+    before = np.asarray(net.bn._mean._value).copy()
+    snet(paddle.randn([32, 4]) + 5.0)
+    after = np.asarray(net.bn._mean._value)
+    assert not np.allclose(before, after), "running mean not updated under jit"
+
+
+def test_cond_and_while_loop():
+    c = jit.cond(paddle.to_tensor(True), lambda a: a + 1, lambda a: a - 1,
+                 paddle.ones([2]))
+    cv = c[0] if isinstance(c, (tuple, list)) else c
+    np.testing.assert_allclose(np.asarray(cv._value), np.full(2, 2.0))
+    i, s = jit.while_loop(lambda i, s: i < 5, lambda i, s: (i + 1, s + i),
+                          [paddle.to_tensor(0), paddle.to_tensor(0)])
+    assert int(s) == 10
+
+
+def test_scan():
+    def body(carry, x):
+        return carry + x, carry
+
+    carry, ys = jit.scan(body, paddle.to_tensor(0.0), paddle.arange(5).astype("float32"))
+    assert float(carry) == 10.0
