@@ -1,0 +1,67 @@
+"""paddle_tpu.distributed — distribution over TPU device meshes.
+
+Reference: /root/reference/python/paddle/distributed/ (148K LoC across
+fleet/, auto_parallel/, communication/, launch/, checkpoint/). The
+TPU-native design (SURVEY.md §7) folds the reference's runtime machinery
+into XLA: sharding propagation ← GSPMD (replacing 113 SPMD rule files),
+reshard ← compile-time collectives (replacing the reshard function library),
+ProcessGroupNCCL ← HLO collectives over ICI/DCN. What remains host-side is
+this package: mesh/placement metadata, the collective API surface, hybrid-
+parallel layer wrappers, and checkpointing.
+"""
+from . import comm_ops  # noqa: F401
+from .api import (  # noqa: F401
+    dtensor_from_fn,
+    reshard,
+    shard_constraint,
+    shard_layer,
+    shard_optimizer,
+    shard_tensor,
+    to_named_sharding,
+    placements_to_spec,
+    unshard_dtensor,
+)
+from .collective import (  # noqa: F401
+    Group,
+    P2POp,
+    ReduceOp,
+    all_gather,
+    all_gather_object,
+    all_reduce,
+    all_to_all,
+    barrier,
+    batch_isend_irecv,
+    broadcast,
+    destroy_process_group,
+    get_group,
+    get_rank,
+    get_world_size,
+    init_parallel_env,
+    is_initialized,
+    new_group,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+)
+from .parallel import DataParallel, ParallelEnv  # noqa: F401
+from .placement import Partial, Placement, Replicate, Shard  # noqa: F401
+from .process_mesh import (  # noqa: F401
+    ProcessMesh,
+    get_mesh,
+    init_mesh,
+    set_mesh,
+)
+
+__all__ = [
+    "ProcessMesh", "get_mesh", "set_mesh", "init_mesh",
+    "Placement", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "shard_constraint", "dtensor_from_fn",
+    "shard_layer", "shard_optimizer", "unshard_dtensor",
+    "Group", "ReduceOp", "new_group", "get_rank", "get_world_size",
+    "init_parallel_env", "is_initialized", "barrier",
+    "all_reduce", "all_gather", "broadcast", "reduce", "scatter",
+    "all_to_all", "reduce_scatter", "send", "recv",
+    "DataParallel", "ParallelEnv", "comm_ops",
+]

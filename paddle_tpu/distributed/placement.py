@@ -1,0 +1,95 @@
+"""Placement types: how one tensor dimension maps onto one mesh dimension.
+
+Analog of the reference's C++ placement types
+(/root/reference/paddle/phi/core/distributed/auto_parallel/placement_types.h
+and python/paddle/distributed/auto_parallel/placement_type.py): a DistTensor
+carries one Placement per mesh dimension — ``Shard(d)`` (tensor dim *d* is
+split over that mesh dim), ``Replicate()`` (full copy on every device of
+that mesh dim), or ``Partial(op)`` (each device holds an unreduced partial
+term; a pending ``psum``).
+
+TPU-native mapping: a placements list compiles to a
+``jax.sharding.PartitionSpec`` — ``Shard(d)`` on mesh dim *i* puts that mesh
+axis name at spec position *d*; ``Replicate`` contributes nothing. ``Partial``
+has no on-device representation in a single-controller jax array (arrays are
+always globally-consistent values); it exists transiently inside compiled
+programs as an unreduced collective operand, and the placements metadata
+records it so reshard semantics match the reference.
+"""
+from __future__ import annotations
+
+__all__ = ["Placement", "Shard", "Replicate", "Partial"]
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self._dim = int(dim)
+
+    def get_dim(self) -> int:
+        return self._dim
+
+    @property
+    def dim(self) -> int:
+        return self._dim
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self._dim
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other._dim == self._dim
+
+    def __hash__(self):
+        return hash(("Shard", self._dim))
+
+    def __repr__(self):
+        return f"Shard(dim={self._dim})"
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("Replicate")
+
+    def __repr__(self):
+        return "Replicate()"
+
+
+class Partial(Placement):
+    """Pending reduction; ``reduce_type`` in {"sum", "avg", "max", "min"}."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        if reduce_type not in ("sum", "avg", "max", "min"):
+            raise ValueError(f"unsupported Partial reduce_type {reduce_type!r}")
+        self._reduce_type = reduce_type
+
+    @property
+    def reduce_type(self) -> str:
+        return self._reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __eq__(self, other):
+        return isinstance(other, Partial) and other._reduce_type == self._reduce_type
+
+    def __hash__(self):
+        return hash(("Partial", self._reduce_type))
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self._reduce_type!r})"
