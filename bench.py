@@ -1,0 +1,157 @@
+#!/usr/bin/env python
+"""bench.py — end-of-round benchmark run by the driver on real TPU hardware.
+
+Measures (a) big-matmul TFLOP/s vs chip peak and (b) LLaMA train-step
+throughput (tokens/sec + MFU) through the whole-step compiled path
+(paddle_tpu.jit.TrainStep: fwd + bwd + AdamW in ONE donated XLA program).
+
+Single process (the chip is single-tenant), tolerant of minutes-long first
+device contact, progress on stderr, and EXACTLY ONE JSON line on stdout:
+  {"metric": "llama_train_mfu", "value": <pct>, "unit": "%", "vs_baseline": R}
+vs_baseline = MFU / 0.50 — the fraction of the BASELINE.md north-star target
+(>=50% MFU on the auto-parallel LLaMA configs); the reference publishes no
+absolute in-tree numbers to compare against (BASELINE.json.published = {}).
+
+Local CPU smoke test: python bench.py --cpu
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+t0 = time.time()
+
+
+def log(msg):
+    print(f"[bench +{time.time()-t0:7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+SMOKE = "--cpu" in sys.argv
+if SMOKE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+log("importing jax (first TPU contact can take minutes)...")
+import jax  # noqa: E402
+
+if SMOKE:
+    jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+log("initializing backend / discovering devices...")
+devices = jax.devices()
+dev = devices[0]
+platform = dev.platform
+kind = getattr(dev, "device_kind", platform)
+log(f"backend up: {len(devices)}x {kind} ({platform})")
+
+# bf16 peak FLOP/s by device kind (public spec sheets; conservative default)
+PEAKS = {
+    "v4": 275e12,
+    "v5 lite": 197e12, "v5e": 197e12,
+    "v5p": 459e12, "v5": 459e12,
+    "v6 lite": 918e12, "v6e": 918e12, "trillium": 918e12,
+}
+
+
+def chip_peak(kind: str) -> float | None:
+    k = kind.lower()
+    for key in ("v6 lite", "v6e", "trillium", "v5 lite", "v5e", "v5p",
+                "v5", "v4"):
+        if key in k:
+            return PEAKS[key]
+    return None
+
+
+peak = chip_peak(kind)
+
+# ------------------------------------------------------------ (a) matmul
+N = 1024 if SMOKE else 8192
+log(f"matmul bench: {N}^3 bf16...")
+key = jax.random.PRNGKey(0)
+a = jax.random.normal(key, (N, N), jnp.bfloat16)
+b = jax.random.normal(key, (N, N), jnp.bfloat16)
+mm = jax.jit(lambda a, b: a @ b)
+mm(a, b).block_until_ready()  # compile + warm
+iters = 3 if SMOKE else 20
+t = time.time()
+for _ in range(iters):
+    out = mm(a, b)
+out.block_until_ready()
+dt = (time.time() - t) / iters
+matmul_tflops = 2 * N**3 / dt / 1e12
+log(f"matmul: {matmul_tflops:.1f} TFLOP/s"
+    + (f" ({100*matmul_tflops*1e12/peak:.0f}% of {peak/1e12:.0f}T peak)" if peak else ""))
+if peak is None:
+    # unknown chip (or CPU smoke): use measured matmul rate as the peak proxy
+    peak = matmul_tflops * 1e12
+
+# ------------------------------------------------------------ (b) LLaMA step
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models import (  # noqa: E402
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaPretrainingCriterion,
+)
+
+if SMOKE:
+    cfg = LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=256,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=256)
+    BATCH, SEQ, STEPS = 2, 128, 3
+else:
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                      intermediate_size=2816, num_hidden_layers=8,
+                      num_attention_heads=16, max_position_embeddings=1024)
+    BATCH, SEQ, STEPS = 8, 1024, 10
+
+log(f"building LLaMA h={cfg.hidden_size} L={cfg.num_hidden_layers} "
+    f"batch={BATCH} seq={SEQ}...")
+paddle.seed(0)
+model = LlamaForCausalLM(cfg)
+model.to(dtype="bfloat16")
+n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+log(f"{n_params/1e6:.1f}M params (bf16, fp32 master weights)")
+
+crit = LlamaPretrainingCriterion()
+opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                             multi_precision=True)
+ids = paddle.to_tensor(
+    np.random.randint(0, cfg.vocab_size, (BATCH, SEQ)).astype(np.int32))
+step = paddle.jit.TrainStep(model, lambda logits: crit(logits, ids), opt)
+
+log("compiling whole train step (first call)...")
+loss = step(ids)
+log(f"compiled; warmup loss={float(loss):.3f}")
+loss = step(ids)  # second warm call (donation steady state)
+
+log(f"timing {STEPS} steps...")
+t = time.time()
+for _ in range(STEPS):
+    loss = step(ids)
+loss._value.block_until_ready()
+dt = (time.time() - t) / STEPS
+tokens_per_sec = BATCH * SEQ / dt
+
+# PaLM-style MFU: 6N matmul flops/token + attention 12*L*h*s
+flops_per_token = 6 * n_params + 12 * cfg.num_hidden_layers * cfg.hidden_size * SEQ
+mfu = tokens_per_sec * flops_per_token / peak
+log(f"step={dt*1e3:.1f}ms  tokens/s={tokens_per_sec:,.0f}  "
+    f"MFU={100*mfu:.1f}% (loss={float(loss):.3f})")
+
+result = {
+    "metric": "llama_train_mfu",
+    "value": round(100 * mfu, 2),
+    "unit": "%",
+    "vs_baseline": round(mfu / 0.50, 3),
+    "tokens_per_sec": round(tokens_per_sec, 1),
+    "step_ms": round(dt * 1e3, 2),
+    "matmul_tflops": round(matmul_tflops, 1),
+    "n_params_m": round(n_params / 1e6, 1),
+    "device": kind,
+    "platform": platform,
+}
+print(json.dumps(result), flush=True)
