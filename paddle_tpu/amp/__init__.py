@@ -1,0 +1,36 @@
+"""paddle_tpu.amp — automatic mixed precision.
+
+Analog of /root/reference/python/paddle/amp/ (auto_cast.py, grad_scaler.py,
+amp_lists.py). bf16 is the TPU-native low dtype (no loss scaling needed);
+fp16 + GradScaler are provided for reference parity.
+"""
+from . import amp_lists  # noqa: F401
+from .auto_cast import (  # noqa: F401
+    amp_decorate,
+    amp_guard,
+    amp_state,
+    auto_cast,
+    decorate,
+)
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401
+
+# install the cast hook into the eager dispatcher
+from ..ops import registry as _registry
+from .auto_cast import _state as _amp_state
+from .auto_cast import amp_transform_arguments as _amp_transform
+
+_registry.install_amp(_amp_state, _amp_transform)
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+__all__ = [
+    "auto_cast", "amp_guard", "decorate", "amp_decorate", "GradScaler",
+    "AmpScaler", "amp_lists", "is_bfloat16_supported", "is_float16_supported",
+]

@@ -1,0 +1,47 @@
+"""AMP op lists — which ops run in low precision under O1.
+
+Analog of /root/reference/python/paddle/amp/amp_lists.py
+(white_list/black_list/gray_list) and the C++ eager AMP hooks
+(paddle/fluid/eager/amp_auto_cast.h). Names refer to this repo's
+ops.yaml registry.
+
+* WHITE: matmul-class ops — the MXU work; always worth bf16/fp16.
+* BLACK: numerically fragile reductions/exponentials — keep fp32.
+* everything else (gray): runs in whatever dtype its inputs arrived in.
+"""
+
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "conv1d", "conv2d", "conv3d",
+    "conv2d_transpose", "einsum", "linear", "addmm",
+    "scaled_dot_product_attention",
+}
+
+BLACK_LIST = {
+    "exp", "expm1", "log", "log2", "log10", "log1p", "pow", "square",
+    "sqrt", "rsqrt", "reciprocal", "cosh", "sinh", "erfinv",
+    "sum", "mean", "prod", "logsumexp", "norm", "p_norm", "dist",
+    "softmax", "log_softmax", "softmax_with_cross_entropy",
+    "cross_entropy", "nll_loss", "kl_div", "binary_cross_entropy",
+    "binary_cross_entropy_with_logits", "mse_loss", "smooth_l1_loss",
+    "sigmoid_cross_entropy_with_logits",
+    "layer_norm", "rms_norm", "group_norm", "instance_norm", "batch_norm",
+    "cumsum", "cumprod", "var", "std",
+}
+
+
+def white_list(custom_white=None, custom_black=None):
+    w = set(WHITE_LIST)
+    if custom_white:
+        w |= set(custom_white)
+    if custom_black:
+        w -= set(custom_black)
+    return w
+
+
+def black_list(custom_black=None, custom_white=None):
+    b = set(BLACK_LIST)
+    if custom_black:
+        b |= set(custom_black)
+    if custom_white:
+        b -= set(custom_white)
+    return b

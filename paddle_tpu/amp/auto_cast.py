@@ -1,0 +1,143 @@
+"""auto_cast — automatic mixed precision casting for the eager dispatcher.
+
+Analog of /root/reference/python/paddle/amp/auto_cast.py (amp_guard) and
+the AMP section of the generated ad_func chain
+(paddle/fluid/eager/amp_auto_cast.h): under O1, inputs of white-list ops
+are cast to the low dtype and black-list ops to fp32 before dispatch; under
+O2 everything but the black list runs low. The cast is a *real* ``cast`` op
+through the tape, so gradients cast back to the source dtype automatically
+(the reference gets the same effect from cast grad nodes).
+
+TPU notes: bf16 is the native low dtype (MXU-preferred, full fp32 exponent
+range — loss scaling unnecessary); fp16 is supported for parity and pairs
+with GradScaler. The cast hook also fires while tracing under jit, so
+compiled train steps inherit the same policy.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import amp_lists
+
+__all__ = ["auto_cast", "amp_guard", "amp_state", "decorate", "amp_decorate"]
+
+_LOW = {"float16": jnp.float16, "bfloat16": jnp.bfloat16}
+
+
+class _AmpState:
+    __slots__ = ("enabled", "level", "dtype", "white", "black")
+
+    def __init__(self):
+        self.enabled = False
+        self.level = "O1"
+        self.dtype = jnp.bfloat16
+        self.white = amp_lists.white_list()
+        self.black = amp_lists.black_list()
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def _cast_tensor(t: Tensor, target) -> Tensor:
+    from ..ops import cast as cast_op
+
+    return cast_op(t, target)
+
+
+def amp_transform_arguments(op, arguments):
+    """Called by ops.registry.apply_op before dispatch. Mutates the bound
+    ``arguments`` dict, casting floating Tensor inputs per the active policy.
+    Returns True if any cast happened (for no-op fast path, False)."""
+    s = _state
+    name = op.name
+    if name in s.black:
+        target = jnp.float32
+    elif s.level == "O2" or name in s.white:
+        target = s.dtype
+    else:
+        return False  # gray: run in arrival dtype
+
+    changed = False
+    for in_name, is_var in zip(op.input_names, op.is_variadic):
+        v = arguments.get(in_name)
+        if v is None:
+            continue
+        if is_var:
+            new_list, touched = [], False
+            for item in v:
+                if (isinstance(item, Tensor)
+                        and jnp.issubdtype(item._value.dtype, jnp.floating)
+                        and item._value.dtype != target):
+                    new_list.append(_cast_tensor(item, target))
+                    touched = True
+                else:
+                    new_list.append(item)
+            if touched:
+                arguments[in_name] = new_list
+                changed = True
+        elif (isinstance(v, Tensor)
+              and jnp.issubdtype(v._value.dtype, jnp.floating)
+              and v._value.dtype != target):
+            arguments[in_name] = _cast_tensor(v, target)
+            changed = True
+    return changed
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """Reference ``paddle.amp.auto_cast`` context manager."""
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"level must be O0/O1/O2, got {level!r}")
+    if dtype not in _LOW:
+        raise ValueError(f"dtype must be float16/bfloat16, got {dtype!r}")
+    prev = (_state.enabled, _state.level, _state.dtype, _state.white, _state.black)
+    _state.enabled = bool(enable) and level != "O0"
+    _state.level = level
+    _state.dtype = _LOW[dtype]
+    _state.white = amp_lists.white_list(custom_white_list, custom_black_list)
+    _state.black = amp_lists.black_list(custom_black_list, custom_white_list)
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.level, _state.dtype,
+         _state.white, _state.black) = prev
+
+
+amp_guard = auto_cast  # legacy alias (reference amp_guard)
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2 decoration (reference python/paddle/amp/auto_cast.py ``decorate``):
+    cast model parameters to the low dtype; enable fp32 master weights in the
+    optimizer (multi_precision), which our optimizers maintain natively."""
+    if level not in ("O1", "O2"):
+        raise ValueError("decorate level must be O1 or O2")
+    single_model = not isinstance(models, (list, tuple))
+    single_opt = optimizers is not None and not isinstance(optimizers, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    opt_list = ([optimizers] if single_opt else list(optimizers or []))
+
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=dtype)
+        for opt in opt_list:
+            opt._multi_precision = True if master_weight is None else bool(master_weight)
+
+    if optimizers is None:
+        return models if single_model else model_list
+    return (
+        models if single_model else model_list,
+        optimizers if single_opt else opt_list,
+    )
+
+
+amp_decorate = decorate

@@ -35,6 +35,18 @@ __all__ = ["OpDef", "register_op", "get_op", "apply_op", "OPS"]
 
 OPS: dict[str, "OpDef"] = {}
 
+# AMP integration: paddle_tpu.amp installs its state + cast hook here at
+# import (the ad_func AMP slot of the reference's eager codegen,
+# paddle/fluid/eager/amp_auto_cast.h). Kept as module globals so the
+# disabled-path cost is one attribute check per op call.
+_amp_state = None
+_amp_transform = None
+
+
+def install_amp(state, transform):
+    global _amp_state, _amp_transform
+    _amp_state, _amp_transform = state, transform
+
 
 @dataclass
 class OpDef:
@@ -147,6 +159,9 @@ def apply_op(op: OpDef, *args, **kwargs):
     bound = op.sig.bind(*args, **kwargs)
     bound.apply_defaults()
     arguments = bound.arguments
+
+    if _amp_state is not None and _amp_state.enabled and op.name != "cast":
+        _amp_transform(op, arguments)
 
     in_tensors: list[Tensor] = []  # flat tensor inputs, in kernel order
     in_specs: list = []  # ("arg", pos, None) or ("list_item", pos, sub)

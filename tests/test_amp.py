@@ -1,0 +1,129 @@
+"""AMP: auto_cast O1/O2 casting policy, grads cast back to fp32,
+GradScaler dynamic scaling, O2 decorate with master weights.
+
+Mirrors reference test/amp/ behaviors.
+"""
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_o1_white_op_runs_bf16():
+    x = paddle.to_tensor(np.random.rand(4, 8).astype(np.float32))
+    w = paddle.to_tensor(np.random.rand(8, 4).astype(np.float32))
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        y = paddle.matmul(x, w)
+    assert y._value.dtype == jnp.bfloat16
+    # outside the context, fp32 again
+    y2 = paddle.matmul(x, w)
+    assert y2._value.dtype == jnp.float32
+
+
+def test_o1_black_op_stays_fp32():
+    x = paddle.to_tensor(np.random.rand(4, 8).astype(np.float32))
+    xb = paddle.cast(x, "bfloat16")
+    with paddle.amp.auto_cast(level="O1"):
+        s = paddle.nn.functional.softmax(xb)
+    assert s._value.dtype == jnp.float32
+
+
+def test_o1_gray_op_keeps_dtype():
+    x = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+    with paddle.amp.auto_cast(level="O1"):
+        y = x + x
+    assert y._value.dtype == jnp.float32
+
+
+def test_grads_cast_back_to_param_dtype():
+    layer = nn.Linear(8, 4)
+    x = paddle.to_tensor(np.random.rand(2, 8).astype(np.float32))
+    with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+        y = layer(x)
+        loss = y.astype("float32").sum()
+    loss.backward()
+    g = layer.weight.grad
+    assert g is not None
+    assert g._value.dtype == jnp.float32  # cast-back through the tape
+
+
+def test_custom_lists():
+    x = paddle.to_tensor(np.random.rand(4, 8).astype(np.float32))
+    w = paddle.to_tensor(np.random.rand(8, 4).astype(np.float32))
+    with paddle.amp.auto_cast(level="O1", custom_black_list=["matmul"]):
+        y = paddle.matmul(x, w)
+    assert y._value.dtype == jnp.float32
+
+
+def test_o2_decorate_master_weights():
+    model = nn.Linear(8, 4)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    assert model.weight._value.dtype == jnp.bfloat16
+    x = paddle.to_tensor(np.random.rand(2, 8).astype(np.float32))
+    with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+        loss = model(x).astype("float32").sum()
+    loss.backward()
+    opt.step()
+    # master weights materialized in fp32
+    assert opt._master_weights
+    for mv in opt._master_weights.values():
+        assert mv.dtype == jnp.float32
+
+
+def test_grad_scaler_dynamic():
+    p = paddle.Parameter(jnp.ones(4, jnp.float32))
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                                   incr_every_n_steps=2,
+                                   decr_every_n_nan_or_inf=1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+
+    loss = (p * 2).sum()
+    scaler.scale(loss).backward()
+    assert float(p.grad._value[0]) == 16.0  # scaled grad
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(np.asarray(p.grad._value), 2.0 * np.ones(4))
+    # param updated with unscaled grad
+    np.testing.assert_allclose(np.asarray(p._value), 1.0 - 0.1 * 2.0)
+
+    # non-finite grad: skip step, decrease scale
+    opt.clear_grad()
+    before = np.asarray(p._value).copy()
+    bad = (p * float("inf")).sum()
+    scaler.scale(bad).backward()
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(np.asarray(p._value), before)
+    assert scaler.get_loss_scaling() == 4.0
+
+
+def test_bf16_training_matches_fp32_trajectory():
+    """O1 bf16 loss curve tracks fp32 within tolerance (VERDICT item 7)."""
+    def run(amp_on):
+        paddle.seed(7)
+        model = nn.Linear(16, 1)
+        opt = paddle.optimizer.SGD(learning_rate=0.05,
+                                   parameters=model.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0).rand(8, 16).astype(np.float32))
+        t = paddle.to_tensor(np.random.RandomState(1).rand(8, 1).astype(np.float32))
+        losses = []
+        for _ in range(10):
+            if amp_on:
+                with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+                    y = model(x)
+                loss = ((y.astype("float32") - t) ** 2).mean()
+            else:
+                loss = ((model(x) - t) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        return losses
+
+    l32 = run(False)
+    lbf = run(True)
+    assert lbf[-1] < lbf[0]
+    np.testing.assert_allclose(lbf[-1], l32[-1], rtol=0.2)
