@@ -73,14 +73,16 @@ N = 1024 if SMOKE else 8192
 log(f"matmul bench: {N}^3 bf16...")
 key = jax.random.PRNGKey(0)
 a = jax.random.normal(key, (N, N), jnp.bfloat16)
-b = jax.random.normal(key, (N, N), jnp.bfloat16)
+# scale so chained products stay in bf16 range (x <- x @ b each iter)
+b = jax.random.normal(key, (N, N), jnp.bfloat16) / np.sqrt(N).astype(np.float32)
 mm = jax.jit(lambda a, b: a @ b)
-mm(a, b).block_until_ready()  # compile + warm
+x = mm(a, b)
+x.block_until_ready()  # compile + warm
 iters = 3 if SMOKE else 20
 t = time.time()
 for _ in range(iters):
-    out = mm(a, b)
-out.block_until_ready()
+    x = mm(x, b)  # chained: forces sequential real execution
+x.block_until_ready()
 dt = (time.time() - t) / iters
 matmul_tflops = 2 * N**3 / dt / 1e12
 log(f"matmul: {matmul_tflops:.1f} TFLOP/s"
