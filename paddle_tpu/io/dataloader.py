@@ -1,0 +1,188 @@
+"""DataLoader — batched, prefetching iteration over a Dataset.
+
+Analog of /root/reference/python/paddle/io/reader.py:262 (``DataLoader``)
+and dataloader/dataloader_iter.py. The reference forks worker *processes*
+feeding a shared-memory blocking queue because CUDA work and Python
+decode contend for the GIL. The TPU-native tradeoff differs: device work is
+dispatched async by jax and the heavy decode is numpy (GIL-releasing), so a
+small *thread* pool with a bounded prefetch queue gives the same overlap
+without fork/shared-memory machinery. ``num_workers`` sizes the pool;
+``prefetch_factor`` bounds in-flight batches.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def _to_tensor(value):
+    arr = np.asarray(value)
+    if arr.dtype == np.float64:
+        arr = arr.astype(np.float32)
+    return Tensor(arr)
+
+
+def default_collate_fn(batch):
+    """Stack a list of samples into batched Tensors (reference
+    dataloader/collate.py default_collate_fn): dict → dict of batches,
+    tuple → tuple of batches, ndarray/number → stacked Tensor."""
+    sample = batch[0]
+    if isinstance(sample, (Tensor,)):
+        return _to_tensor(np.stack([np.asarray(s._value) for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return _to_tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.number)):
+        return _to_tensor(np.asarray(batch))
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (tuple, list)):
+        transposed = list(zip(*batch))
+        return tuple(default_collate_fn(list(col)) for col in transposed)
+    return list(batch)
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler=None, batch_size=1,
+                 shuffle=False, drop_last=False, collate_fn=None,
+                 num_workers=0, use_buffer_reader=True, prefetch_factor=2,
+                 use_shared_memory=False, timeout=0, worker_init_fn=None,
+                 persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = int(num_workers)
+        self.prefetch_factor = max(int(prefetch_factor), 1)
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            if batch_sampler is not None:
+                raise ValueError("batch_sampler is invalid for IterableDataset")
+            self.batch_sampler = None
+            self.batch_size = None if batch_size is None else int(batch_size)
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+            self.batch_size = batch_sampler.batch_size
+        else:
+            self.batch_size = None if batch_size is None else int(batch_size)
+            self.batch_sampler = BatchSampler(
+                dataset=dataset, shuffle=shuffle,
+                batch_size=batch_size or 1, drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    # ------------------------------------------------ iteration
+
+    def _batches_iterable(self):
+        """IterableDataset: stream, group into batches host-side."""
+        if self.batch_size is None:
+            for sample in self.dataset:
+                yield sample
+            return
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def _load_batch(self, indices):
+        return self.collate_fn([self.dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._iterable_mode:
+            yield from self._batches_iterable()
+            return
+        if self.num_workers <= 0:
+            for indices in self.batch_sampler:
+                yield self._load_batch(indices)
+            return
+        yield from self._prefetch_iter()
+
+    def _prefetch_iter(self):
+        """Thread-pool prefetch preserving batch order: workers pull index
+        lists from a task queue; results are delivered through per-batch
+        slots so ordering matches the sampler."""
+        batches = list(self.batch_sampler)
+        out_q: "queue.Queue" = queue.Queue()
+        task_q: "queue.Queue" = queue.Queue()
+        n_workers = min(self.num_workers, max(len(batches), 1))
+        capacity = self.prefetch_factor * n_workers
+        stop = threading.Event()
+
+        for i, idxs in enumerate(batches[:capacity]):
+            task_q.put((i, idxs))
+        next_to_submit = min(capacity, len(batches))
+
+        def worker(wid):
+            _worker_info.info = WorkerInfo(wid, n_workers, self.dataset)
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(wid)
+            while not stop.is_set():
+                try:
+                    item = task_q.get(timeout=0.1)
+                except queue.Empty:
+                    continue
+                if item is None:
+                    break
+                i, idxs = item
+                try:
+                    out_q.put((i, self._load_batch(idxs), None))
+                except Exception as e:  # propagate to consumer
+                    out_q.put((i, None, e))
+
+        threads = [
+            threading.Thread(target=worker, args=(w,), daemon=True)
+            for w in range(n_workers)
+        ]
+        for t in threads:
+            t.start()
+
+        pending = {}
+        next_to_yield = 0
+        try:
+            while next_to_yield < len(batches):
+                while next_to_yield not in pending:
+                    i, batch, err = out_q.get(
+                        timeout=self.timeout if self.timeout else None)
+                    if err is not None:
+                        raise err
+                    pending[i] = batch
+                yield pending.pop(next_to_yield)
+                next_to_yield += 1
+                if next_to_submit < len(batches):
+                    task_q.put((next_to_submit, batches[next_to_submit]))
+                    next_to_submit += 1
+        finally:
+            stop.set()
+            for _ in threads:
+                task_q.put(None)

@@ -1,0 +1,150 @@
+"""Dataset types.
+
+Analog of /root/reference/python/paddle/io/dataloader/dataset.py:
+map-style ``Dataset`` (__getitem__/__len__), ``IterableDataset``,
+``TensorDataset``, ``ComposeDataset``, ``ChainDataset``, ``ConcatDataset``,
+``Subset`` and ``random_split``.
+"""
+from __future__ import annotations
+
+import bisect
+
+import numpy as np
+
+__all__ = [
+    "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+    "ChainDataset", "ConcatDataset", "Subset", "random_split",
+]
+
+
+class Dataset:
+    def __getitem__(self, idx):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement __getitem__")
+
+    def __len__(self):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement __len__")
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError(
+            f"{type(self).__name__} must implement __iter__")
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset is not indexable")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    """Wrap a list of tensors; sample i is tuple(t[i] for t in tensors)."""
+
+    def __init__(self, tensors):
+        from ..core.tensor import Tensor
+
+        if not tensors:
+            raise ValueError("TensorDataset needs at least one tensor")
+        self.tensors = [
+            t if isinstance(t, Tensor) else None or t for t in tensors
+        ]
+        n = len(tensors[0])
+        for t in tensors:
+            if len(t) != n:
+                raise ValueError("all tensors must have the same first dim")
+
+    def __getitem__(self, idx):
+        return tuple(t[idx] for t in self.tensors)
+
+    def __len__(self):
+        return len(self.tensors[0])
+
+
+class ComposeDataset(Dataset):
+    """Zip datasets: sample i concatenates the fields of each dataset's i."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ComposeDataset needs at least one dataset")
+        n = len(self.datasets[0])
+        for d in self.datasets:
+            if len(d) != n:
+                raise ValueError("datasets must have equal lengths")
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            sample = d[idx]
+            out.extend(sample if isinstance(sample, (tuple, list)) else [sample])
+        return tuple(out)
+
+
+class ChainDataset(IterableDataset):
+    """Concatenate iterable datasets, streamed in order."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class ConcatDataset(Dataset):
+    """Concatenate map-style datasets."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ConcatDataset needs at least one dataset")
+        self.cumulative_sizes = np.cumsum([len(d) for d in self.datasets]).tolist()
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        di = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = self.cumulative_sizes[di - 1] if di > 0 else 0
+        return self.datasets[di][idx - prev]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    """Split into non-overlapping subsets (reference dataset.py random_split).
+    ``lengths`` may be absolute sizes or fractions summing to 1."""
+    n = len(dataset)
+    if all(0.0 < l < 1.0 for l in lengths) or (
+        any(isinstance(l, float) for l in lengths) and abs(sum(lengths) - 1.0) < 1e-6
+    ):
+        sizes = [int(np.floor(n * l)) for l in lengths]
+        for i in range(n - sum(sizes)):
+            sizes[i % len(sizes)] += 1
+        lengths = sizes
+    if sum(lengths) != n:
+        raise ValueError("sum of input lengths does not equal dataset length")
+    rng = np.random.default_rng(generator)
+    perm = rng.permutation(n).tolist()
+    out, off = [], 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[off:off + l]))
+        off += l
+    return out

@@ -1,0 +1,148 @@
+"""io: datasets, samplers, DataLoader (sync + threaded prefetch).
+
+Mirrors reference test/legacy_test/test_dataloader_* behaviors.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import (
+    BatchSampler,
+    ConcatDataset,
+    DataLoader,
+    Dataset,
+    DistributedBatchSampler,
+    IterableDataset,
+    RandomSampler,
+    SequenceSampler,
+    Subset,
+    TensorDataset,
+    WeightedRandomSampler,
+    random_split,
+)
+
+
+class SquaresDataset(Dataset):
+    def __init__(self, n=20):
+        self.n = n
+
+    def __getitem__(self, i):
+        return np.float32(i), np.float32(i * i)
+
+    def __len__(self):
+        return self.n
+
+
+class CountStream(IterableDataset):
+    def __init__(self, n=10):
+        self.n = n
+
+    def __iter__(self):
+        for i in range(self.n):
+            yield np.float32(i)
+
+
+def test_tensor_dataset():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(6, 2))
+    y = paddle.to_tensor(np.arange(6, dtype=np.float32))
+    ds = TensorDataset([x, y])
+    assert len(ds) == 6
+    xi, yi = ds[2]
+    np.testing.assert_allclose(np.asarray(xi._value), [4.0, 5.0])
+
+
+def test_concat_subset_split():
+    a, b = SquaresDataset(5), SquaresDataset(7)
+    cat = ConcatDataset([a, b])
+    assert len(cat) == 12
+    assert cat[6][0] == 1.0  # second dataset idx 1
+    sub = Subset(a, [3, 4])
+    assert sub[0][0] == 3.0
+    parts = random_split(SquaresDataset(10), [7, 3])
+    assert [len(p) for p in parts] == [7, 3]
+    seen = sorted(int(p[i][0]) for p in parts for i in range(len(p)))
+    assert seen == list(range(10))
+
+
+def test_samplers():
+    ds = SquaresDataset(10)
+    assert list(SequenceSampler(ds)) == list(range(10))
+    rs = list(RandomSampler(ds, generator=0))
+    assert sorted(rs) == list(range(10))
+    ws = list(WeightedRandomSampler([0.0, 1.0, 0.0], 5))
+    assert ws == [1] * 5
+    bs = list(BatchSampler(dataset=ds, batch_size=3))
+    assert bs[0] == [0, 1, 2] and bs[-1] == [9]
+    bs = list(BatchSampler(dataset=ds, batch_size=3, drop_last=True))
+    assert len(bs) == 3
+
+
+def test_distributed_batch_sampler():
+    ds = SquaresDataset(10)
+    all_idx = []
+    for rank in range(4):
+        s = DistributedBatchSampler(ds, batch_size=2, num_replicas=4, rank=rank)
+        assert len(s) == 2  # ceil(10/4)=3 samples -> 2 batches of <=2
+        for batch in s:
+            all_idx.extend(batch)
+    assert sorted(set(all_idx)) == list(range(10))  # full coverage (with pad)
+    assert len(all_idx) == 12  # padded to 4*3
+
+
+def test_dataloader_sync():
+    dl = DataLoader(SquaresDataset(10), batch_size=4, drop_last=False)
+    batches = list(dl)
+    assert len(batches) == 3
+    x, y = batches[0]
+    assert x.shape == [4]
+    np.testing.assert_allclose(np.asarray(y._value), [0, 1, 4, 9])
+
+
+def test_dataloader_shuffle_covers_all():
+    dl = DataLoader(SquaresDataset(12), batch_size=4, shuffle=True)
+    seen = []
+    for x, _ in dl:
+        seen.extend(np.asarray(x._value).tolist())
+    assert sorted(seen) == list(range(12))
+
+
+def test_dataloader_threaded_prefetch_order():
+    dl = DataLoader(SquaresDataset(50), batch_size=5, num_workers=4)
+    xs = [np.asarray(x._value) for x, _ in dl]
+    flat = np.concatenate(xs)
+    np.testing.assert_allclose(flat, np.arange(50, dtype=np.float32))
+
+
+def test_dataloader_worker_error_propagates():
+    class Bad(Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            if i == 2:
+                raise ValueError("boom")
+            return np.float32(i)
+
+    dl = DataLoader(Bad(), batch_size=1, num_workers=2)
+    with pytest.raises(ValueError, match="boom"):
+        list(dl)
+
+
+def test_iterable_dataset_loader():
+    dl = DataLoader(CountStream(10), batch_size=4)
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[2].shape == [2]
+
+
+def test_dict_collate():
+    class DictDs(Dataset):
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return {"a": np.float32(i), "b": np.ones(3, np.float32) * i}
+
+    batch = next(iter(DataLoader(DictDs(), batch_size=4)))
+    assert set(batch) == {"a", "b"}
+    assert batch["b"].shape == [4, 3]
