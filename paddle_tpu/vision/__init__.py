@@ -1,0 +1,9 @@
+"""paddle_tpu.vision — transforms, datasets, model zoo.
+
+Analog of /root/reference/python/paddle/vision/.
+"""
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+
+__all__ = ["datasets", "models", "transforms"]

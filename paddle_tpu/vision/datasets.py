@@ -1,0 +1,159 @@
+"""vision.datasets — CIFAR-10/100, MNIST/FashionMNIST, FakeData.
+
+Analog of /root/reference/python/paddle/vision/datasets/{cifar,mnist}.py.
+This environment has zero network egress, so ``download=True`` raises; the
+parsers read the standard on-disk formats (CIFAR python pickle tar, MNIST
+idx-ubyte) from ``data_file``/``image_path``, and ``FakeData`` provides a
+deterministic synthetic set for benchmarks/CI (the reference has no
+synthetic dataset; benches here use FakeData explicitly, never silently).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData"]
+
+
+def _no_download(download):
+    if download:
+        raise RuntimeError(
+            "this environment has no network egress; place the dataset "
+            "archive locally and pass data_file=/path (download=False)"
+        )
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from the standard python-version tar.gz
+    (reference python/paddle/vision/datasets/cifar.py)."""
+
+    _label_key = b"labels"
+    _prefix = "cifar-10-batches-py"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode must be train/test, got {mode}")
+        _no_download(download and data_file is None)
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"CIFAR archive not found at {data_file!r}")
+        self.mode = mode
+        self.transform = transform
+        self.data, self.labels = self._load(data_file)
+
+    def _load(self, path):
+        images, labels = [], []
+        with tarfile.open(path, "r:*") as tf:
+            names = [
+                n for n in tf.getnames()
+                if ("data_batch" in n if self.mode == "train" else "test_batch" in n)
+            ]
+            for name in sorted(names):
+                d = pickle.load(tf.extractfile(name), encoding="bytes")
+                images.append(d[b"data"])
+                labels.extend(d[self._label_key])
+        data = np.concatenate(images).reshape(-1, 3, 32, 32)
+        data = data.transpose(0, 2, 3, 1)  # HWC for transforms
+        return data, np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        img, label = self.data[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    _label_key = b"fine_labels"
+    _prefix = "cifar-100-python"
+
+    def _load(self, path):
+        images, labels = [], []
+        with tarfile.open(path, "r:*") as tf:
+            names = [n for n in tf.getnames()
+                     if n.endswith("train" if self.mode == "train" else "test")]
+            for name in sorted(names):
+                d = pickle.load(tf.extractfile(name), encoding="bytes")
+                images.append(d[b"data"])
+                labels.extend(d[self._label_key])
+        data = np.concatenate(images).reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        return data, np.asarray(labels, np.int64)
+
+
+class MNIST(Dataset):
+    """MNIST idx-ubyte files (reference python/paddle/vision/datasets/mnist.py)."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend="cv2"):
+        _no_download(download and image_path is None)
+        for p in (image_path, label_path):
+            if p is None or not os.path.exists(p):
+                raise FileNotFoundError(f"MNIST file not found: {p!r}")
+        self.transform = transform
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, f"bad MNIST image magic {magic}"
+            buf = f.read(n * rows * cols)
+        return np.frombuffer(buf, np.uint8).reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, f"bad MNIST label magic {magic}"
+            buf = f.read(n)
+        return np.frombuffer(buf, np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image classification data (for benches/CI)."""
+
+    def __init__(self, num_samples=1024, image_shape=(3, 32, 32),
+                 num_classes=10, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        img = rng.rand(*self.image_shape).astype(np.float32)
+        label = np.int64(idx % self.num_classes)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.num_samples

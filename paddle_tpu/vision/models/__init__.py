@@ -1,0 +1,32 @@
+"""vision.models — reference model zoo (python/paddle/vision/models/)."""
+from .mobilenet import (  # noqa: F401
+    MobileNetV1,
+    MobileNetV2,
+    mobilenet_v1,
+    mobilenet_v2,
+)
+from .resnet import (  # noqa: F401
+    ResNet,
+    resnet18,
+    resnet34,
+    resnet50,
+    resnet101,
+    resnet152,
+    resnext50_32x4d,
+    resnext50_64x4d,
+    resnext101_32x4d,
+    resnext101_64x4d,
+    resnext152_32x4d,
+    resnext152_64x4d,
+    wide_resnet50_2,
+    wide_resnet101_2,
+)
+from .small import (  # noqa: F401
+    AlexNet,
+    LeNet,
+    SqueezeNet,
+    alexnet,
+    squeezenet1_0,
+    squeezenet1_1,
+)
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401

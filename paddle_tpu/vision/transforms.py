@@ -1,0 +1,270 @@
+"""vision.transforms — image preprocessing.
+
+Analog of /root/reference/python/paddle/vision/transforms/ (transforms.py +
+functional.py). Numpy host-side preprocessing (runs in DataLoader workers);
+images are HWC uint8/float ndarrays in, CHW float32 Tensors out of
+``ToTensor`` — matching the reference's conventions.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = [
+    "Compose", "ToTensor", "Normalize", "Resize", "CenterCrop", "RandomCrop",
+    "RandomHorizontalFlip", "RandomVerticalFlip", "Pad", "Transpose",
+    "BrightnessTransform", "ContrastTransform", "RandomResizedCrop",
+    "to_tensor", "normalize", "resize", "center_crop", "hflip", "vflip", "pad",
+]
+
+
+def _as_hwc(img):
+    img = np.asarray(img)
+    if img.ndim == 2:
+        img = img[:, :, None]
+    return img
+
+
+def resize(img, size, interpolation="bilinear"):
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    if isinstance(size, numbers.Number):
+        if h < w:
+            oh, ow = int(size), int(size * w / h)
+        else:
+            oh, ow = int(size * h / w), int(size)
+    else:
+        oh, ow = size
+    if (oh, ow) == (h, w):
+        return img
+    # bilinear via jax-free numpy sampling (nearest for 'nearest')
+    ys = np.linspace(0, h - 1, oh)
+    xs = np.linspace(0, w - 1, ow)
+    if interpolation == "nearest":
+        out = img[np.round(ys).astype(int)[:, None],
+                  np.round(xs).astype(int)[None, :]]
+        return out
+    y0 = np.floor(ys).astype(int)
+    x0 = np.floor(xs).astype(int)
+    y1 = np.minimum(y0 + 1, h - 1)
+    x1 = np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    f = img.astype(np.float32)
+    out = ((1 - wy) * (1 - wx) * f[y0[:, None], x0[None, :]]
+           + (1 - wy) * wx * f[y0[:, None], x1[None, :]]
+           + wy * (1 - wx) * f[y1[:, None], x0[None, :]]
+           + wy * wx * f[y1[:, None], x1[None, :]])
+    return out.astype(img.dtype) if img.dtype == np.uint8 else out
+
+
+def center_crop(img, output_size):
+    img = _as_hwc(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = img.shape[:2]
+    th, tw = output_size
+    i = max((h - th) // 2, 0)
+    j = max((w - tw) // 2, 0)
+    return img[i:i + th, j:j + tw]
+
+
+def hflip(img):
+    return _as_hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _as_hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    img = _as_hwc(img)
+    if isinstance(padding, numbers.Number):
+        padding = (padding,) * 4
+    if len(padding) == 2:
+        padding = (padding[0], padding[1]) * 2
+    pl, pt, pr, pb = padding
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kwargs = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(img, ((pt, pb), (pl, pr), (0, 0)), mode=mode, **kwargs)
+
+
+def to_tensor(img, data_format="CHW"):
+    from ..core.tensor import Tensor
+
+    img = _as_hwc(img)
+    arr = img.astype(np.float32)
+    if img.dtype == np.uint8:
+        arr = arr / 255.0
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    from ..core.tensor import Tensor
+
+    arr = np.asarray(img._value if isinstance(img, Tensor) else img,
+                     dtype=np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        arr = (arr - mean[:, None, None]) / std[:, None, None]
+    else:
+        arr = (arr - mean) / std
+    return Tensor(arr) if isinstance(img, Tensor) else arr
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW"):
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return to_tensor(img, self.data_format)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean, self.std = mean, std
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear"):
+        self.size, self.interpolation = size, interpolation
+
+    def __call__(self, img):
+        return resize(img, self.size, self.interpolation)
+
+
+class CenterCrop:
+    def __init__(self, size):
+        self.size = size
+
+    def __call__(self, img):
+        return center_crop(img, self.size)
+
+
+class RandomCrop:
+    def __init__(self, size, padding=None, pad_if_needed=False, fill=0):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size, self.padding, self.pad_if_needed, self.fill = (
+            size, padding, pad_if_needed, fill)
+
+    def __call__(self, img):
+        img = _as_hwc(img)
+        if self.padding is not None:
+            img = pad(img, self.padding, self.fill)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        if self.pad_if_needed and (h < th or w < tw):
+            img = pad(img, (0, max(th - h, 0), 0, max(tw - w, 0)), self.fill)
+            h, w = img.shape[:2]
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear"):
+        if isinstance(size, numbers.Number):
+            size = (int(size), int(size))
+        self.size, self.scale, self.ratio = size, scale, ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            tw = int(round(np.sqrt(target * ar)))
+            th = int(round(np.sqrt(target / ar)))
+            if 0 < tw <= w and 0 < th <= h:
+                i = np.random.randint(0, h - th + 1)
+                j = np.random.randint(0, w - tw + 1)
+                return resize(img[i:i + th, j:j + tw], self.size,
+                              self.interpolation)
+        return resize(center_crop(img, min(h, w)), self.size,
+                      self.interpolation)
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return hflip(img) if np.random.rand() < self.prob else _as_hwc(img)
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5):
+        self.prob = prob
+
+    def __call__(self, img):
+        return vflip(img) if np.random.rand() < self.prob else _as_hwc(img)
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant"):
+        self.padding, self.fill, self.padding_mode = padding, fill, padding_mode
+
+    def __call__(self, img):
+        return pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1)):
+        self.order = order
+
+    def __call__(self, img):
+        return _as_hwc(img).transpose(self.order)
+
+
+class BrightnessTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        img = _as_hwc(img)
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        out = img.astype(np.float32) * alpha
+        return np.clip(out, 0, 255).astype(img.dtype) \
+            if img.dtype == np.uint8 else out
+
+
+class ContrastTransform:
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, img):
+        img = _as_hwc(img)
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        f = img.astype(np.float32)
+        mean = f.mean()
+        out = mean + alpha * (f - mean)
+        return np.clip(out, 0, 255).astype(img.dtype) \
+            if img.dtype == np.uint8 else out

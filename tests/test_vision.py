@@ -1,0 +1,126 @@
+"""vision: transforms, FakeData/parsers, model zoo forward/train.
+
+Mirrors reference test/legacy_test/test_vision_models.py and
+test/legacy_test/test_transforms.py behaviors.
+"""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.io import DataLoader
+from paddle_tpu.vision import datasets, models, transforms as T
+
+
+def test_transforms_pipeline():
+    img = (np.random.rand(32, 32, 3) * 255).astype(np.uint8)
+    tf = T.Compose([
+        T.Resize(40), T.CenterCrop(32), T.RandomHorizontalFlip(0.0),
+        T.ToTensor(), T.Normalize([0.5] * 3, [0.5] * 3),
+    ])
+    out = tf(img)
+    assert out.shape == [3, 32, 32]
+    v = np.asarray(out._value)
+    assert v.min() >= -1.001 and v.max() <= 1.001
+
+
+def test_resize_and_crop_shapes():
+    img = np.zeros((10, 20, 3), np.uint8)
+    assert T.resize(img, 5).shape[0] == 5  # short side
+    assert T.resize(img, (7, 9)).shape[:2] == (7, 9)
+    assert T.center_crop(img, 6).shape[:2] == (6, 6)
+    assert T.pad(img, 2).shape[:2] == (14, 24)
+    rc = T.RandomCrop(8)(img)
+    assert rc.shape[:2] == (8, 8)
+
+
+def test_fake_data_loader():
+    ds = datasets.FakeData(num_samples=16, image_shape=(3, 8, 8))
+    x, y = ds[3]
+    assert x.shape == (3, 8, 8) and int(y) == 3
+    batch = next(iter(DataLoader(ds, batch_size=8)))
+    assert batch[0].shape == [8, 3, 8, 8]
+
+
+def test_cifar10_parser(tmp_path):
+    # build a miniature cifar-10 archive in the standard format
+    data = {b"data": (np.random.rand(4, 3072) * 255).astype(np.uint8),
+            b"labels": [0, 1, 2, 3]}
+    tar_path = os.path.join(tmp_path, "cifar10.tar.gz")
+    import io as _io
+
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for name in ["data_batch_1", "test_batch"]:
+            payload = pickle.dumps(data)
+            info = tarfile.TarInfo(f"cifar-10-batches-py/{name}")
+            info.size = len(payload)
+            tf.addfile(info, _io.BytesIO(payload))
+    ds = datasets.Cifar10(data_file=tar_path, mode="train")
+    assert len(ds) == 4
+    img, label = ds[1]
+    assert img.shape == (32, 32, 3) and label == 1
+
+
+def test_mnist_parser(tmp_path):
+    imgs = (np.random.rand(3, 28, 28) * 255).astype(np.uint8)
+    labels = np.array([1, 2, 3], np.uint8)
+    ip = os.path.join(tmp_path, "img.gz")
+    lp = os.path.join(tmp_path, "lab.gz")
+    with gzip.open(ip, "wb") as f:
+        f.write(struct.pack(">IIII", 2051, 3, 28, 28) + imgs.tobytes())
+    with gzip.open(lp, "wb") as f:
+        f.write(struct.pack(">II", 2049, 3) + labels.tobytes())
+    ds = datasets.MNIST(image_path=ip, label_path=lp)
+    assert len(ds) == 3
+    img, label = ds[2]
+    assert img.shape == (28, 28) and label == 3
+
+
+def test_download_refused():
+    with pytest.raises(RuntimeError, match="egress"):
+        datasets.Cifar10(download=True)
+
+
+@pytest.mark.parametrize("ctor,shape", [
+    (models.LeNet, (2, 1, 28, 28)),
+    (lambda: models.resnet18(num_classes=10), (2, 3, 32, 32)),
+    (lambda: models.mobilenet_v2(num_classes=10), (2, 3, 32, 32)),
+    (lambda: models.squeezenet1_1(num_classes=10), (2, 3, 64, 64)),
+])
+def test_model_forward(ctor, shape):
+    model = ctor()
+    x = paddle.to_tensor(np.random.rand(*shape).astype(np.float32))
+    y = model(x)
+    assert y.shape[0] == 2 and y.shape[-1] == 10
+
+
+def test_resnet18_trains_on_fake_cifar():
+    """BASELINE config 1 slice: resnet on synthetic CIFAR-shaped data."""
+    paddle.seed(0)
+    model = models.resnet18(num_classes=10)
+    opt = paddle.optimizer.Momentum(learning_rate=0.01,
+                                    parameters=model.parameters())
+    crit = paddle.nn.CrossEntropyLoss()
+    ds = datasets.FakeData(num_samples=32, image_shape=(3, 32, 32),
+                           num_classes=10)
+    loader = DataLoader(ds, batch_size=16)
+    losses = []
+    for _ in range(2):
+        for x, y in loader:
+            loss = crit(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_vgg11_forward():
+    model = models.vgg11(num_classes=10)
+    x = paddle.to_tensor(np.random.rand(1, 3, 224, 224).astype(np.float32))
+    assert model(x).shape == [1, 10]
