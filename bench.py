@@ -68,6 +68,30 @@ def chip_peak(kind: str) -> float | None:
 
 peak = chip_peak(kind)
 
+# Timing methodology for this setup: the chip sits behind a tunnel whose
+# client (a) memoizes repeat (executable, args) calls and (b) returns from
+# block_until_ready before execution finishes. The only reliable sync point
+# is a host VALUE FETCH. So every measurement (1) runs its loop device-side
+# inside one executable, (2) uses inputs not seen before, and (3) is
+# bracketed by scalar fetches, with the fetch RTT measured and subtracted.
+
+
+def sync_fetch(x) -> float:
+    return float(jnp.asarray(x).sum())
+
+
+def measure_rtt() -> float:
+    z = jnp.zeros(())
+    sync_fetch(z)
+    t = time.time()
+    for _ in range(3):
+        sync_fetch(z + 1.0)
+    return (time.time() - t) / 3
+
+
+RTT = measure_rtt()
+log(f"host<->device sync round-trip: {RTT*1e3:.1f}ms")
+
 # ------------------------------------------------------------ (a) matmul
 N = 1024 if SMOKE else 8192
 log(f"matmul bench: {N}^3 bf16...")
@@ -75,20 +99,17 @@ key = jax.random.PRNGKey(0)
 a = jax.random.normal(key, (N, N), jnp.bfloat16)
 # scale so chained products stay in bf16 range (x <- x @ b each iter)
 b = (jax.random.normal(key, (N, N)) / np.sqrt(N)).astype(jnp.bfloat16)
-iters = 3 if SMOKE else 50
+iters = 3 if SMOKE else 100
 
-# The whole chain runs inside ONE executable: the host link to the chip (a
-# tunnel here) adds tens of ms per dispatch, so per-call host loops measure
-# RTT, not the MXU. fori_loop keeps it device-side.
 @jax.jit
 def mm_chain(x, b):
     return jax.lax.fori_loop(0, iters, lambda i, x: x @ b, x)
 
-mm_chain(a, b).block_until_ready()  # compile + warm
+sync_fetch(mm_chain(a, b))  # compile + warm
+a2 = a + 0.01  # fresh input: defeat call memoization
 t = time.time()
-x = mm_chain(a, b)
-x.block_until_ready()
-dt = (time.time() - t) / iters
+sync_fetch(mm_chain(a2, b))
+dt = max(time.time() - t - RTT, 1e-9) / iters
 matmul_tflops = 2 * N**3 / dt / 1e12
 log(f"matmul: {matmul_tflops:.1f} TFLOP/s"
     + (f" ({100*matmul_tflops*1e12/peak:.0f}% of {peak/1e12:.0f}T peak)" if peak else ""))
@@ -169,15 +190,14 @@ def run_steps(p, a, m):
 
 log("compiling multi-step training program...")
 params, accs, masters, losses = run_steps(params, accs, masters)
-jax.block_until_ready(losses)
-log(f"compiled; warmup losses {float(losses[0]):.3f} -> {float(losses[-1]):.3f}")
+l_first, l_last = float(losses[0]), float(losses[-1])  # value fetch = sync
+log(f"compiled; warmup losses {l_first:.3f} -> {l_last:.3f}")
 
 log(f"timing {STEPS} steps (one dispatch)...")
 t = time.time()
 params, accs, masters, losses = run_steps(params, accs, masters)
-jax.block_until_ready(losses)
-dt = (time.time() - t) / STEPS
-loss = float(losses[-1])
+loss = float(losses[-1])  # value fetch = the only real sync on this setup
+dt = max(time.time() - t - RTT, 1e-9) / STEPS
 tokens_per_sec = BATCH * SEQ / dt
 
 # PaLM-style MFU: 6N matmul flops/token + attention 12*L*h*s

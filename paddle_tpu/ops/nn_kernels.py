@@ -630,10 +630,19 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
     """Attention core, (B, S, H, D) layout like the reference's flash_attn
     (/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu:587).
 
-    This is the XLA fallback path; nn.functional routes to the Pallas
-    flash-attention kernel (ops/pallas/flash_attention.py) when shapes/dtypes
-    allow. ``rng_key`` is raw uint32 key data for dropout (jit-cacheable).
+    Routes to the Pallas flash-attention kernel
+    (ops/pallas/flash_attention.py) when FLAGS_use_pallas_kernels is set and
+    the call qualifies (no mask/dropout, block-aligned seq); otherwise runs
+    the XLA composition below. ``rng_key`` is raw uint32 key data for
+    dropout (jit-cacheable).
     """
+    from ..core.flags import flag as _flag
+
+    if _flag("FLAGS_use_pallas_kernels"):
+        from .pallas import flash_attention as _fa
+
+        if _fa.flash_attention_supported(q, k, v, attn_mask, dropout_p):
+            return _fa.flash_attention(q, k, v, is_causal=is_causal)
     b, sq, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
     qh = jnp.swapaxes(q, 1, 2)  # B,H,S,D

@@ -1,0 +1,10 @@
+"""paddle_tpu.ops.pallas — hand-written TPU kernels (Mosaic/Pallas).
+
+The re-emission of the reference's fused kernel set
+(/root/reference/paddle/phi/kernels/fusion/gpu/) and its KPS portable
+kernel DSL (paddle/phi/kernels/primitive/): flash attention here, with the
+XLA-composition fallbacks living in ops/nn_kernels.py. Gated by
+FLAGS_use_pallas_kernels; kernels run in interpreter mode off-TPU so CI
+covers them.
+"""
+from . import flash_attention  # noqa: F401

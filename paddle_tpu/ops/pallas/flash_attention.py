@@ -1,0 +1,295 @@
+"""Flash attention — Pallas TPU kernel, forward + backward.
+
+The TPU-native re-emission of the reference's FA2 integration
+(/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu:587, which
+dynloads libflashattn.so) and of the fused attention kernel family
+(paddle/phi/kernels/fusion/gpu/fused_attention_kernel.cu:40): tiled online-
+softmax attention that never materializes the (S, S) score matrix in HBM.
+
+Layout: (B, S, H, D) at the public boundary (matching the reference's
+flash_attn), transposed to (B, H, S, D) for the kernel. Block sizes are
+MXU/VPU aligned (q/k blocks of 128 rows); accumulation is f32; the backward
+is the standard two-kernel FA2 split (dkdv over k-blocks, dq over q-blocks)
+with the usual ``delta = rowsum(dO * O)`` trick.
+
+Gating (ops/nn_kernels.py): FLAGS_use_pallas_kernels on TPU, no mask, no
+dropout, seq divisible by the block size; otherwise the XLA sdpa
+composition runs. ``interpret=True`` is used automatically off-TPU so CI
+exercises the same code path.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu import works everywhere; kernels interpret off-TPU
+    from jax.experimental.pallas import tpu as pltpu
+
+    _VMEM = pltpu.VMEM
+except Exception:  # pragma: no cover
+    pltpu = None
+    _VMEM = None
+
+__all__ = ["flash_attention", "flash_attention_supported"]
+
+BLOCK_Q = 128
+BLOCK_K = 128
+NEG_INF = -1e30
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def flash_attention_supported(q, k, v, attn_mask=None, dropout_p=0.0):
+    """Whether the Pallas path can serve this call."""
+    if attn_mask is not None or dropout_p > 0.0:
+        return False
+    if q.ndim != 4:
+        return False
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if sq % BLOCK_Q or sk % BLOCK_K:
+        return False
+    if d > 256:
+        return False
+    return True
+
+
+# ------------------------------------------------------------------ forward
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k, seq_k):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0, :, :].astype(jnp.float32) * scale  # (bq, d)
+    bq = q.shape[0]
+    d = q.shape[1]
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    num_k = seq_k // block_k
+    # causal: k blocks strictly after the q block contribute nothing
+    num_k_eff = jnp.minimum(num_k, qi + 1) if causal else num_k
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (bq, bk)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        l_new = alpha * l + jnp.sum(p, axis=1, keepdims=True)
+        acc_new = alpha * acc + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, num_k_eff, body, (m0, l0, acc0))
+    o_ref[0, 0, :, :] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0, 0, :, :] = m + jnp.log(l)
+
+
+def _fwd(q, k, v, causal, scale):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    grid = (b, h, sq // BLOCK_Q)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, block_k=BLOCK_K, seq_k=sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q, 1), lambda b_, h_, i: (b_, h_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ------------------------------------------------------------------ backward
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     dk_ref, dv_ref, *, scale, causal, block_q, seq_q):
+    ki = pl.program_id(2)
+    k = k_ref[0, 0, :, :].astype(jnp.float32)  # (bk, d)
+    v = v_ref[0, 0, :, :].astype(jnp.float32)
+    bk, d = k.shape
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    num_q = seq_q // block_q
+    # causal: q blocks strictly before this k block see nothing
+    q_start = ki * bk // block_q if causal else 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q), :]
+        dlt = delta_ref[0, 0, pl.ds(qi * block_q, block_q), :]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # (bq, bk)
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * bk
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)  # (bq, bk)
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # p^T @ do
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)  # (bq, bk)
+        ds = p * (dp - dlt) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # ds^T @ q
+        return dk_new, dv_new
+
+    dk, dv = jax.lax.fori_loop(q_start, num_q, body, (dk0, dv0))
+    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_k, seq_k):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0, :, :].astype(jnp.float32)
+    do = do_ref[0, 0, :, :].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, :]
+    dlt = delta_ref[0, 0, :, :]
+    bq, d = q.shape
+
+    dq0 = jnp.zeros((bq, d), jnp.float32)
+    num_k = seq_k // block_k
+    num_k_eff = jnp.minimum(num_k, qi * bq // block_k + bq // block_k) \
+        if causal else num_k
+
+    def body(ki, dq):
+        k = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_k
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - dlt) * scale
+        return dq + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, num_k_eff, body, dq0)
+    dq_ref[0, 0, :, :] = dq.astype(dq_ref.dtype)
+
+
+def _bwd(causal, scale, res, g):
+    q, k, v, out, lse = res
+    do = g
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
+                    keepdims=True)
+
+    dkdv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
+                          block_q=BLOCK_Q, seq_q=sq),
+        grid=(b, h, sk // BLOCK_K),
+        in_specs=[
+            pl.BlockSpec((1, 1, sq, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, sq, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, i: (b_, h_, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, BLOCK_K, d), lambda b_, h_, i: (b_, h_, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    dk, dv = dkdv
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=BLOCK_K, seq_k=sk),
+        grid=(b, h, sq // BLOCK_Q),
+        in_specs=[
+            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q, 1), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, BLOCK_Q, 1), lambda b_, h_, i: (b_, h_, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, BLOCK_Q, d),
+                               lambda b_, h_, i: (b_, h_, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_bhsd(q, k, v, causal, scale):
+    out, _ = _fwd(q, k, v, causal, scale)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, scale):
+    out, lse = _fwd(q, k, v, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
+
+
+def flash_attention(q, k, v, is_causal=False):
+    """(B, S, H, D) flash attention. GQA: kv heads are repeated to the query
+    head count before the kernel (head-repeat is memory-light relative to
+    the O(S^2) work the kernel saves)."""
+    b, sq, h, d = q.shape
+    scale = 1.0 / math.sqrt(d)
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    if kh.shape[1] != h:
+        rep = h // kh.shape[1]
+        kh = jnp.repeat(kh, rep, axis=1)
+        vh = jnp.repeat(vh, rep, axis=1)
+    out = _flash_bhsd(qh, kh, vh, bool(is_causal), scale)
+    return jnp.swapaxes(out, 1, 2)
