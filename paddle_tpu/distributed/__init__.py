@@ -10,6 +10,7 @@ this package: mesh/placement metadata, the collective API surface, hybrid-
 parallel layer wrappers, and checkpointing.
 """
 from . import comm_ops  # noqa: F401
+from . import fleet  # noqa: F401
 from .api import (  # noqa: F401
     dtensor_from_fn,
     reshard,

@@ -1,0 +1,133 @@
+"""paddle_tpu.distributed.fleet — hybrid-parallel training.
+
+Analog of /root/reference/python/paddle/distributed/fleet/ (48.3K LoC):
+Fleet entry (fleet.py:151), DistributedStrategy
+(base/distributed_strategy.py:284), HybridCommunicateGroup topology, TP
+layers, sequence parallel, recompute, GroupSharded, pipeline, MoE.
+"""
+from __future__ import annotations
+
+from . import mp_layers  # noqa: F401
+from . import sequence_parallel_utils  # noqa: F401
+from .moe import MoELayer, NaiveGate, SwitchGate  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+)
+from .pipeline import (  # noqa: F401
+    LayerDesc,
+    PipelineLayer,
+    PipelineParallel,
+    SharedLayerDesc,
+    spmd_pipeline,
+)
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from .sharding import ShardedOptimizer, group_sharded_parallel  # noqa: F401
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+
+__all__ = [
+    "init", "Fleet", "DistributedStrategy", "fleet",
+    "distributed_model", "distributed_optimizer",
+    "get_hybrid_communicate_group",
+    "CommunicateTopology", "HybridCommunicateGroup",
+    "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
+    "ParallelCrossEntropy", "recompute", "recompute_sequential",
+    "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
+    "spmd_pipeline", "group_sharded_parallel", "ShardedOptimizer",
+    "MoELayer", "NaiveGate", "SwitchGate",
+]
+
+
+class DistributedStrategy:
+    """Knob tree (reference base/distributed_strategy.py:284 over a proto;
+    here plain attributes with the same names/defaults)."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 2.0**15, "use_pure_bf16": False}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1}
+        self.fuse_all_reduce_ops = True
+        self.find_unused_parameters = False
+
+
+class Fleet:
+    """Entry object (reference fleet.py:151): init builds the HCG + mesh."""
+
+    def __init__(self):
+        self._hcg = None
+        self._strategy = None
+        self._is_initialized = False
+
+    def init(self, role_maker=None, is_collective=True, strategy=None):
+        from .. import collective as C
+        from ..process_mesh import set_mesh
+
+        self._strategy = strategy or DistributedStrategy()
+        h = self._strategy.hybrid_configs
+        C.init_parallel_env()
+        self._hcg = HybridCommunicateGroup(
+            dp_degree=h.get("dp_degree", 1),
+            mp_degree=h.get("mp_degree", 1),
+            pp_degree=h.get("pp_degree", 1),
+            sharding_degree=h.get("sharding_degree", 1),
+            sep_degree=h.get("sep_degree", 1),
+        )
+        set_mesh(self._hcg.mesh)
+        self._is_initialized = True
+        return self
+
+    def is_initialized(self):
+        return self._is_initialized
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def worker_num(self):
+        return self._hcg.nranks if self._hcg else 1
+
+    def worker_index(self):
+        return 0
+
+    def distributed_model(self, model):
+        """Wrap per strategy (reference fleet/model.py:32): pipeline degree
+        → PipelineParallel; otherwise DataParallel over the dp axis (TP
+        layers shard themselves at construction)."""
+        if self._hcg is None:
+            raise RuntimeError("call fleet.init() first")
+        if self._hcg.get_pipe_parallel_world_size() > 1:
+            return PipelineParallel(
+                model, hcg=self._hcg,
+                accumulate_steps=self._strategy.pipeline_configs[
+                    "accumulate_steps"])
+        if self._hcg.get_data_parallel_world_size() > 1:
+            from ..parallel import DataParallel
+
+            return DataParallel(model, mesh=self._hcg.mesh)
+        return model
+
+    def distributed_optimizer(self, optimizer):
+        if self._hcg and self._hcg.get_sharding_parallel_world_size() > 1:
+            return ShardedOptimizer(optimizer, self._hcg.mesh,
+                                    axis="sharding")
+        return optimizer
+
+
+fleet = Fleet()
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group

@@ -1,0 +1,252 @@
+"""Pipeline parallelism.
+
+Analogs of /root/reference/python/paddle/distributed/fleet/meta_parallel/
+parallel_layers/pp_layers.py (LayerDesc:56, SharedLayerDesc:76,
+PipelineLayer with uniform segmentation) and pipeline_parallel.py
+(PipelineParallel:255, train_batch:820 — the 1F1B loop over p2p
+send/recv).
+
+TPU-native design (SURVEY.md §7 "hard parts"): two complementary routes.
+
+* ``PipelineParallel.train_batch`` — the host-driven schedule: splits the
+  batch into micro-batches, runs fwd/bwd per micro-batch with gradient
+  accumulation (GPipe semantics; on a sharded model the per-stage placement
+  comes from the layer shardings). This is the API-parity route.
+* ``spmd_pipeline`` — the compiled schedule: stages stacked on the leading
+  axis of a parameter pytree, sharded over the ``pp`` mesh axis; one
+  shard_map program runs the fill-drain schedule with ``lax.ppermute``
+  moving activations stage→stage over ICI (the collective-permute
+  pipelining of the GSPMD paper — replacing p2p_communication.py:327's
+  batched NCCL isend/irecv). Differentiable end-to-end, so ``jax.grad``
+  produces the backward schedule automatically.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...nn.layer_base import Layer
+from ...nn.layers_common import LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
+           "PipelineParallel", "spmd_pipeline"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError(f"{layer_cls} must be a Layer subclass")
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-shared layer (embedding ↔ lm_head tying across stages,
+    pp_layers.py:76). Single-controller: sharing is plain object identity."""
+
+    def __init__(self, key, layer_cls, *args, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    """Container that builds a LayerDesc list and segments it into stages."""
+
+    def __init__(self, layers, num_stages=1, loss_fn=None, seg_method="uniform",
+                 topology=None, recompute_interval=0, **kwargs):
+        super().__init__()
+        self._num_stages = (topology.get_dim("pipe")
+                            if topology is not None else num_stages)
+        self._loss_fn = loss_fn
+        self._recompute_interval = recompute_interval
+        self._shared = {}
+        built = []
+        for desc in layers:
+            if isinstance(desc, SharedLayerDesc):
+                if desc.layer_name in self._shared:
+                    layer = self._shared[desc.layer_name]
+                else:
+                    layer = desc.build_layer()
+                    self._shared[desc.layer_name] = layer
+                built.append((layer, desc.forward_func))
+            elif isinstance(desc, LayerDesc):
+                built.append((desc.build_layer(), None))
+            elif isinstance(desc, Layer):
+                built.append((desc, None))
+            elif callable(desc):
+                built.append((desc, "fn"))
+            else:
+                raise TypeError(f"cannot interpret pipeline entry {desc!r}")
+        self.run_functions = built
+        self._layers = LayerList(
+            [l for l, tag in built if isinstance(l, Layer)])
+        self._segment()
+
+    def _segment(self):
+        """Uniform segmentation (pp_layers.py segment_uniform)."""
+        n = len(self.run_functions)
+        per = int(np.ceil(n / self._num_stages))
+        self._stage_bounds = [
+            (s * per, min((s + 1) * per, n)) for s in range(self._num_stages)
+        ]
+
+    def get_num_stages(self):
+        return self._num_stages
+
+    def stage_layers(self, stage_id):
+        lo, hi = self._stage_bounds[stage_id]
+        return self.run_functions[lo:hi]
+
+    def forward_stage(self, x, stage_id):
+        from .recompute import recompute
+
+        for i, (layer, tag) in enumerate(self.stage_layers(stage_id)):
+            fn = layer if tag is None or tag == "fn" else \
+                (lambda v, l=layer, f=tag: f(l, v))
+            if (self._recompute_interval > 0
+                    and i % self._recompute_interval == 0
+                    and isinstance(x, Tensor) and not x.stop_gradient):
+                x = recompute(fn, x)
+            else:
+                x = fn(x)
+        return x
+
+    def forward(self, x):
+        for s in range(self._num_stages):
+            x = self.forward_stage(x, s)
+        return x
+
+
+class PipelineParallel(Layer):
+    """Micro-batched pipeline trainer (pipeline_parallel.py:255).
+
+    ``train_batch(data, optimizer, lr_scheduler, scaler)`` splits along the
+    batch dim into ``accumulate_steps`` micro-batches and accumulates
+    gradients across them before one optimizer step — numerically the 1F1B
+    result (schedules differ only in peak memory/bubble, not gradients).
+    """
+
+    def __init__(self, layers, hcg=None, strategy=None, accumulate_steps=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self.accumulate_steps = accumulate_steps or (
+            strategy.pipeline_configs.get("accumulate_steps", 1)
+            if strategy is not None and hasattr(strategy, "pipeline_configs")
+            else 1)
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        inputs, labels = data
+        n_micro = self.accumulate_steps
+        batch = inputs.shape[0]
+        assert batch % n_micro == 0, (
+            f"batch {batch} not divisible by accumulate_steps {n_micro}")
+        mb = batch // n_micro
+        total = None
+        loss_fn = getattr(self._layers, "_loss_fn", None)
+        for m in range(n_micro):
+            x = inputs[m * mb:(m + 1) * mb]
+            y = labels[m * mb:(m + 1) * mb]
+            out = self._layers(x)
+            loss = loss_fn(out, y) if loss_fn is not None else out
+            loss = loss / n_micro
+            if scaler is not None:
+                scaler.scale(loss).backward()
+            else:
+                loss.backward()
+            total = loss if total is None else total + loss.detach()
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        out = self._layers(inputs)
+        loss_fn = getattr(self._layers, "_loss_fn", None)
+        if compute_loss and loss_fn is not None:
+            return loss_fn(out, labels)
+        return out
+
+
+# ------------------------------------------------------------ compiled route
+
+def spmd_pipeline(stage_fn, stage_params, x, n_microbatches, mesh,
+                  pp_axis="pp"):
+    """Compiled fill-drain pipeline over the ``pp`` mesh axis.
+
+    stage_fn(params_slice, activation) -> activation — one stage's compute;
+    stage_params: pytree whose leaves have leading axis ``n_stages``
+    (device_put Shard(0) over pp before calling, or let GSPMD move them);
+    x: (n_microbatches, mb, ...) input activations.
+
+    Inside one jitted shard_map program each device runs its stage;
+    activations advance stage→stage with ``lax.ppermute`` per tick. Total
+    ticks = n_micro + n_stages - 1 (the GPipe bubble). Returns
+    (n_microbatches, mb, ...) outputs. Differentiable (ppermute transposes
+    to the reverse permutation, so jax.grad yields the backward schedule).
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    jm = mesh.jax_mesh()
+    n_stages = mesh.get_dim_size(pp_axis)
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def body(params, xs):
+        # params leaves: (1, ...) local stage slice; xs: full (replicated)
+        p_local = jax.tree_util.tree_map(lambda v: v[0], params)
+        stage = jax.lax.axis_index(pp_axis)
+        mb_shape = xs.shape[1:]
+        # mark the carries device-varying over pp (shard_map vma typing)
+        state = jax.lax.pcast(jnp.zeros(mb_shape, xs.dtype), (pp_axis,),
+                              to="varying")
+        out_buf = jax.lax.pcast(jnp.zeros_like(xs), (pp_axis,), to="varying")
+        total = n_microbatches + n_stages - 1
+
+        def tick(t, carry):
+            state, out_buf = carry
+            # stage 0 ingests microbatch t (while available)
+            feed = jax.lax.dynamic_index_in_dim(
+                xs, jnp.minimum(t, n_microbatches - 1), 0, keepdims=False)
+            inp = jnp.where(stage == 0, feed, state)
+            out = stage_fn(p_local, inp)
+            # last stage: microbatch (t - n_stages + 1) completes this tick
+            m_done = t - (n_stages - 1)
+            write = jnp.logical_and(stage == n_stages - 1, m_done >= 0)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                out_buf, out.astype(out_buf.dtype), jnp.maximum(m_done, 0), 0)
+            out_buf = jnp.where(write, updated, out_buf)
+            state = jax.lax.ppermute(out, pp_axis, perm)
+            return state, out_buf
+
+        _, out_buf = jax.lax.fori_loop(
+            0, total, tick, (state, out_buf))
+        # only the last stage holds real outputs; psum broadcasts them
+        mask = (stage == n_stages - 1).astype(out_buf.dtype)
+        return jax.lax.psum(out_buf * mask, pp_axis)
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(pp_axis), stage_params)
+    fn = shard_map(
+        body, mesh=jm,
+        in_specs=(spec_params, P()),
+        out_specs=P(),
+    )
+    return fn(stage_params, x)

@@ -1,0 +1,161 @@
+"""Recompute (activation checkpointing).
+
+Analog of /root/reference/python/paddle/distributed/fleet/recompute/
+recompute.py:124 (``RecomputeFunction``: PyLayer that stows inputs + RNG
+state, reruns forward during backward). Two regimes here:
+
+* **traced** (inside jit/TrainStep): ``jax.checkpoint`` — XLA-native
+  rematerialization, the mechanism the whole reference file hand-builds.
+* **eager**: a GradNode that saves inputs + host RNG state; its backward
+  restores the RNG, reruns ``function`` with grad enabled, and routes
+  cotangents with ``autograd.grad`` — same structure as the reference's
+  PyLayer backward.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...core import autograd, random as _random
+from ...core.autograd import GradNode
+from ...core.tensor import Tensor
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def _is_traced(values):
+    return any(isinstance(v, jax.core.Tracer) for v in values)
+
+
+def recompute(function, *args, **kwargs):
+    preserve_rng_state = kwargs.pop("preserve_rng_state", True)
+    kwargs.pop("use_reentrant", None)
+
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    values = [t._value for t in tensor_args]
+
+    if _is_traced(values):
+        # jit path: pure-function remat over the tensor leaves
+        idx = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+
+        def pure(vals):
+            call = list(args)
+            for i, v in zip(idx, vals):
+                call[i] = Tensor._from_value(v)
+            out = function(*call, **kwargs)
+            if isinstance(out, (tuple, list)):
+                return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+            return out._value if isinstance(out, Tensor) else out
+
+        out_vals = jax.checkpoint(pure)(values)
+        if isinstance(out_vals, tuple):
+            return tuple(Tensor._from_value(v) for v in out_vals)
+        return Tensor._from_value(out_vals)
+
+    # Engage whenever grads are on: the block's *parameters* need their
+    # grads even when no tensor input does (reference RecomputeFunction is a
+    # PyLayer and always interposes).
+    if not autograd.is_grad_enabled():
+        return function(*args, **kwargs)
+
+    rng_state = _random.get_rng_state() if preserve_rng_state else None
+    with autograd.no_grad():
+        outputs = function(*args, **kwargs)
+    single = not isinstance(outputs, (tuple, list))
+    out_list = [outputs] if single else list(outputs)
+
+    diff_inputs = [t for t in tensor_args if not t.stop_gradient]
+    edges = [t._grad_edge() for t in diff_inputs]
+    saved_args = args
+
+    def backward_fn(grad_outputs):
+        saved_rng = _random.get_rng_state()
+        if rng_state is not None:
+            _random.set_rng_state(rng_state)
+        try:
+            # rerun with grad enabled on detached stand-ins for the inputs
+            detached = []
+            call = []
+            for a in saved_args:
+                if isinstance(a, Tensor) and not a.stop_gradient:
+                    d = a.detach()
+                    d.stop_gradient = False
+                    detached.append(d)
+                    call.append(d)
+                elif isinstance(a, Tensor):
+                    call.append(a.detach())
+                else:
+                    call.append(a)
+            with autograd.enable_grad():
+                re_out = function(*call, **kwargs)
+            re_list = [re_out] if not isinstance(re_out, (tuple, list)) \
+                else list(re_out)
+            outs, gouts = [], []
+            for o, g in zip(re_list, grad_outputs):
+                if g is not None and isinstance(o, Tensor):
+                    outs.append(o)
+                    gouts.append(Tensor._from_value(g))
+            # One sweep doing both jobs of the reference PyLayer backward:
+            # write .grad on the leaves inside the block (parameters) AND
+            # capture the gradients arriving at the detached inputs.
+            capture = {}
+            in_edges = []
+            for d in detached:
+                node, slot = d._grad_edge()
+                in_edges.append((node, slot))
+                if node is not None:
+                    capture.setdefault((id(node), slot), [])
+            autograd.backward(outs, gouts, capture=capture, write_grads=True)
+            grads = []
+            for node, slot in in_edges:
+                vals = capture.get((id(node), slot)) if node is not None else None
+                if vals:
+                    g = vals[0]
+                    for v in vals[1:]:
+                        g = g + v
+                    grads.append(g)
+                else:
+                    grads.append(None)
+            return tuple(grads)
+        finally:
+            _random.set_rng_state(saved_rng)
+
+    node = GradNode("recompute", backward_fn, edges, len(out_list),
+                    tuple(True for _ in edges))
+    import jax.numpy as jnp
+
+    results = []
+    for i, o in enumerate(out_list):
+        if isinstance(o, Tensor) and jnp.issubdtype(o._value.dtype, jnp.inexact):
+            t = Tensor._from_value(o._value)
+            t.stop_gradient = False
+            t._grad_node = node
+            t._grad_slot = i
+            results.append(t)
+        else:
+            results.append(o)
+    return results[0] if single else tuple(results)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Segmented recompute over a Sequential (reference
+    recompute_sequential): split into ``segments`` chunks, checkpoint each."""
+    segments = (ctx or {}).get("segments", 1)
+    if hasattr(functions, "children"):
+        functions = list(functions.children())
+    functions = list(functions)
+    seg_size = max(len(functions) // max(segments, 1), 1)
+
+    def make_seg(fs):
+        def run(*xs):
+            out = xs[0] if len(xs) == 1 else xs
+            for f in fs:
+                out = f(out)
+            return out
+
+        return run
+
+    out = args[0] if len(args) == 1 else args
+    for s in range(0, len(functions), seg_size):
+        seg = functions[s:s + seg_size]
+        out = recompute(make_seg(seg), out, **kwargs)
+    return out

@@ -1,0 +1,115 @@
+"""Sequence parallelism (Megatron-SP) utilities.
+
+Analog of /root/reference/python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py (ScatterOp:85, GatherOp:97, AllGatherOp:111,
+ReduceScatterOp:127, ColumnSequenceParallelLinear:429,
+RowSequenceParallelLinear:564). The reference swaps the TP all-reduce pair
+for all-gather (entering a TP block) + reduce-scatter (leaving it) along
+the sequence dim. Under GSPMD the same exchange falls out of sharding
+constraints: activations outside TP blocks are Shard(seq → mp); the
+column-parallel matmul forces a gather, the row-parallel output is
+constrained back to sequence-sharded so the Partial reduces via
+reduce-scatter — exactly the Megatron-SP collective schedule, chosen by the
+partitioner.
+
+The shard_map-level primitives (hand-written collectives with custom VJPs)
+live in distributed/comm_ops.py (all_gather/reduce_scatter/all_to_all).
+"""
+from __future__ import annotations
+
+from ...nn.layer_base import Layer
+from ..api import shard_constraint
+from ..placement import Replicate, Shard
+from ..process_mesh import get_mesh
+from .mp_layers import ColumnParallelLinear, RowParallelLinear
+
+__all__ = [
+    "ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+    "scatter", "all_gather", "mark_as_sequence_parallel_parameter",
+    "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+    "register_sequence_parallel_allreduce_hooks",
+]
+
+
+def _seq_constraint(x, seq_dim, mp_axis="mp"):
+    mesh = get_mesh()
+    if mesh is None or mp_axis not in mesh.dim_names:
+        return x
+    pl = [Replicate()] * mesh.ndim
+    pl[mesh.dim_names.index(mp_axis)] = Shard(seq_dim)
+    return shard_constraint(x, mesh, pl)
+
+
+def _replicate_constraint(x):
+    mesh = get_mesh()
+    if mesh is None:
+        return x
+    return shard_constraint(x, mesh, [Replicate()] * mesh.ndim)
+
+
+def scatter(x, seq_dim=0):
+    """Split the sequence dim across mp ranks (reference ScatterOp.forward:
+    local slice; backward: all-gather). GSPMD derives both directions from
+    the constraint."""
+    return _seq_constraint(x, seq_dim)
+
+
+def all_gather(x, seq_dim=0):
+    """Gather sequence shards (GatherOp/AllGatherOp)."""
+    return _replicate_constraint(x)
+
+
+class ScatterOp:
+    @staticmethod
+    def apply(x, seq_dim=0):
+        return scatter(x, seq_dim)
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x, seq_dim=0):
+        return all_gather(x, seq_dim)
+
+
+AllGatherOp = GatherOp
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x, seq_dim=0):
+        return _seq_constraint(x, seq_dim)
+
+
+def mark_as_sequence_parallel_parameter(parameter):
+    parameter.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, *a, **k):
+    """Reference registers grad all-reduce hooks for SP params (norms/biases
+    whose grads are partial over the seq shards). GSPMD emits that reduction
+    from the shardings; kept as a no-op for API parity."""
+    return None
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """ColumnParallelLinear whose input arrives sequence-sharded
+    (sequence_parallel_utils.py:429): the entering all-gather is implicit."""
+
+    def forward(self, x):
+        x = all_gather(x, seq_dim=max(x.ndim - 2, 0))
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """RowParallelLinear that leaves its output sequence-sharded
+    (sequence_parallel_utils.py:564): Partial(mp) → Shard(seq) is a
+    reduce-scatter, not an all-reduce."""
+
+    def forward(self, x):
+        from ...nn import functional as F
+
+        y = F.linear(x, self.weight, None)
+        y = _seq_constraint(y, max(y.ndim - 2, 0))
+        if self.bias is not None:
+            y = y + self.bias
+        return y

@@ -1,0 +1,178 @@
+"""Hybrid-parallel topology: CommunicateTopology + HybridCommunicateGroup.
+
+Analog of /root/reference/python/paddle/distributed/fleet/base/topology.py
+(CommunicateTopology:70, HybridCommunicateGroup:189). Axis order follows the
+reference (topology.py:306): **pp → sep → sharding → mp → dp** cartesian
+product over ranks. TPU-natively the topology IS a ProcessMesh whose axis
+names drive GSPMD shardings; the per-axis "communication groups" the
+reference builds as NCCL communicators are Group handles bound to mesh axes
+(collectives over them compile to ICI/DCN collectives).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..collective import Group
+from ..process_mesh import ProcessMesh
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "sep",
+                                           "model"),
+                 dims=(1, 1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = None
+        self._world = np.arange(int(np.prod(self._dims))).reshape(self._dims)
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(self._world.size)
+
+    def get_rank(self, **kwargs):
+        coords = tuple(kwargs[name] for name in self._parallel_names)
+        return int(self._world[coords])
+
+    def get_coord(self, rank):
+        coords = np.argwhere(self._world == rank)[0]
+        import collections
+
+        Coord = collections.namedtuple("Coord", self._parallel_names)
+        return Coord(*[int(c) for c in coords])
+
+    def get_axis_list(self, axis_name, index):
+        axis = self._parallel_names.index(axis_name)
+        taken = np.take(self._world, index, axis=axis)
+        return taken.flatten().tolist()
+
+    def get_comm_list(self, axis_name):
+        """All rank-groups along one axis (reference get_comm_list)."""
+        axis = self._parallel_names.index(axis_name)
+        other = [i for i in range(self._world.ndim) if i != axis]
+        moved = np.transpose(self._world, other + [axis])
+        return moved.reshape(-1, self._dims[axis]).tolist()
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = self.get_coord(global_rank)._asdict()
+        coord.update(kwargs)
+        return self.get_rank(**coord)
+
+
+class HybridCommunicateGroup:
+    """Per-axis groups + ranks for the current process's device(s).
+
+    In multi-process reference execution each process owns one rank; under a
+    single controller this object describes the whole mesh, with
+    ``global_rank`` defaulting to 0 for rank-dependent queries.
+    """
+
+    def __init__(self, topology: CommunicateTopology | None = None,
+                 dp_degree=1, mp_degree=1, pp_degree=1, sharding_degree=1,
+                 sep_degree=1, global_rank=0):
+        if topology is not None:
+            self._topo = topology
+        else:
+            self._topo = CommunicateTopology(
+                hybrid_group_names=["data", "pipe", "sharding", "sep", "model"],
+                dims=[dp_degree, pp_degree, sharding_degree, sep_degree,
+                      mp_degree],
+            )
+        self.global_rank = global_rank
+        self.nranks = self._topo.world_size()
+        self._dp_degree = self._topo.get_dim("data")
+        self._mp_degree = self._topo.get_dim("model")
+        self._pp_degree = self._topo.get_dim("pipe")
+        self._sharding_degree = self._topo.get_dim("sharding")
+        self._sep_degree = self._topo.get_dim("sep")
+
+        # the mesh: axis order mirrors the topology dims
+        names = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+                 "sep": "sep", "model": "mp"}
+        dims = [self._topo.get_dim(n) for n in self._topo.get_hybrid_group_names()]
+        self.mesh = ProcessMesh(
+            np.arange(int(np.prod(dims))).reshape(dims),
+            [names[n] for n in self._topo.get_hybrid_group_names()],
+        )
+
+        self._groups = {
+            axis: Group(
+                ranks=self._topo.get_axis_list(
+                    axis, 0),
+                mesh=self.mesh,
+                axis=names[axis],
+            )
+            for axis in self._topo.get_hybrid_group_names()
+        }
+
+    # ---- degrees
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    def get_sep_parallel_world_size(self):
+        return self._sep_degree
+
+    # ---- ranks (of self.global_rank within each axis)
+    def _axis_rank(self, axis):
+        return getattr(self._topo.get_coord(self.global_rank), axis)
+
+    def get_data_parallel_rank(self):
+        return self._axis_rank("data")
+
+    def get_model_parallel_rank(self):
+        return self._axis_rank("model")
+
+    def get_stage_id(self):
+        return self._axis_rank("pipe")
+
+    def get_sharding_parallel_rank(self):
+        return self._axis_rank("sharding")
+
+    def get_sep_parallel_rank(self):
+        return self._axis_rank("sep")
+
+    # ---- groups
+    def get_data_parallel_group(self):
+        return self._groups["data"]
+
+    def get_model_parallel_group(self):
+        return self._groups["model"]
+
+    def get_pipe_parallel_group(self):
+        return self._groups["pipe"]
+
+    def get_sharding_parallel_group(self):
+        return self._groups["sharding"]
+
+    def get_sep_parallel_group(self):
+        return self._groups["sep"]
+
+    def get_check_parallel_group(self, *a, **k):
+        return self._groups["model"]
+
+    def get_p2p_groups(self):
+        return None
+
+    def topology(self):
+        return self._topo
+
+    # convenience: the axis names present with degree > 1
+    def active_axes(self):
+        return [n for n, d in zip(self.mesh.dim_names, self.mesh.shape) if d > 1]
