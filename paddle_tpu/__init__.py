@@ -52,11 +52,15 @@ from .ops import __all__ as _ops_all
 
 from . import amp  # noqa: F401
 from . import distributed  # noqa: F401
+from . import distribution  # noqa: F401
+from . import hapi  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import metric  # noqa: F401
+from . import models  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import profiler  # noqa: F401
 from . import vision  # noqa: F401
 
 # paddle-compat aliases

@@ -9,8 +9,10 @@ ProcessGroupNCCL ← HLO collectives over ICI/DCN. What remains host-side is
 this package: mesh/placement metadata, the collective API surface, hybrid-
 parallel layer wrappers, and checkpointing.
 """
+from . import checkpoint  # noqa: F401
 from . import comm_ops  # noqa: F401
 from . import fleet  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .api import (  # noqa: F401
     dtensor_from_fn,
     reshard,

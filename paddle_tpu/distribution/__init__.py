@@ -1,0 +1,395 @@
+"""paddle_tpu.distribution — probability distributions.
+
+Analog of /root/reference/python/paddle/distribution/ (~25 distributions,
+transforms, kl registry). Sampling uses the framework RNG
+(core/random.py); densities are jnp and differentiable.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+from ..core.tensor import Tensor
+
+__all__ = [
+    "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+    "Beta", "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel",
+    "Laplace", "LogNormal", "Multinomial", "Poisson",
+    "kl_divergence", "register_kl",
+]
+
+
+def _v(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jax.Array) else x
+
+
+def _t(v):
+    return Tensor._from_value(v)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _t(jnp.exp(_v(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    @property
+    def mean(self):
+        return _t(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return _t(jnp.broadcast_to(self.scale**2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return _t(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        eps = jax.random.normal(key, tuple(shape) + self.batch_shape)
+        return _t(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        var = self.scale**2
+        return _t(-((_v(value) - self.loc) ** 2) / (2 * var)
+                  - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+
+class LogNormal(Normal):
+    def sample(self, shape=()):
+        return _t(jnp.exp(_v(super().sample(shape))))
+
+    def log_prob(self, value):
+        x = _v(value)
+        return _t(_v(super().log_prob(jnp.log(x))) - jnp.log(x))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _v(low)
+        self.high = _v(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape, self.high.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        u = jax.random.uniform(key, tuple(shape) + self.batch_shape)
+        return _t(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        x = _v(value)
+        inside = (x >= self.low) & (x < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return _t(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return _t(jnp.log(self.high - self.low))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None and probs is None:
+            self.logits = _v(logits)
+        elif probs is not None:
+            self.logits = jnp.log(_v(probs))
+        else:
+            raise ValueError("need logits or probs")
+        super().__init__(self.logits.shape[:-1])
+
+    @property
+    def probs(self):
+        return _t(jax.nn.softmax(self.logits, -1))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _t(jax.random.categorical(
+            key, self.logits, shape=tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        idx = _v(value).astype(jnp.int32)
+        return _t(jnp.take_along_axis(logp, idx[..., None], -1)[..., 0])
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.logits, -1)
+        return _t(-jnp.sum(jnp.exp(logp) * logp, -1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _v(probs)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _t(jax.random.bernoulli(
+            key, self.probs_, tuple(shape) + self.batch_shape
+        ).astype(jnp.float32))
+
+    def log_prob(self, value):
+        x = _v(value)
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return _t(x * jnp.log(p) + (1 - x) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        return _t(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _t(jax.random.exponential(
+            key, tuple(shape) + self.batch_shape) / self.rate)
+
+    def log_prob(self, value):
+        return _t(jnp.log(self.rate) - self.rate * _v(value))
+
+    def entropy(self):
+        return _t(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _v(concentration)
+        self.rate = _v(rate)
+        super().__init__(jnp.broadcast_shapes(
+            self.concentration.shape, self.rate.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _t(jax.random.gamma(
+            key, self.concentration,
+            tuple(shape) + self.batch_shape) / self.rate)
+
+    def log_prob(self, value):
+        x = _v(value)
+        a, b = self.concentration, self.rate
+        return _t(a * jnp.log(b) + (a - 1) * jnp.log(x) - b * x
+                  - jax.lax.lgamma(a))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _v(alpha)
+        self.beta = _v(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape, self.beta.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _t(jax.random.beta(
+            key, self.alpha, self.beta, tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        x = _v(value)
+        a, b = self.alpha, self.beta
+        lbeta = (jax.lax.lgamma(a) + jax.lax.lgamma(b)
+                 - jax.lax.lgamma(a + b))
+        return _t((a - 1) * jnp.log(x) + (b - 1) * jnp.log1p(-x) - lbeta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _v(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _t(jax.random.dirichlet(
+            key, self.concentration, tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        a = self.concentration
+        x = _v(value)
+        lnorm = jnp.sum(jax.lax.lgamma(a), -1) - jax.lax.lgamma(jnp.sum(a, -1))
+        return _t(jnp.sum((a - 1) * jnp.log(x), -1) - lnorm)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _t(self.loc + self.scale * jax.random.laplace(
+            key, tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        return _t(-jnp.abs(_v(value) - self.loc) / self.scale
+                  - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return _t(1 + jnp.log(2 * self.scale))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _t(self.loc + self.scale * jax.random.gumbel(
+            key, tuple(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return _t(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = _v(probs)
+        super().__init__(self.probs_.shape)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        u = jax.random.uniform(key, tuple(shape) + self.batch_shape)
+        return _t(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value):
+        return _t(_v(value) * jnp.log1p(-self.probs_) + jnp.log(self.probs_))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _v(rate)
+        super().__init__(self.rate.shape)
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _t(jax.random.poisson(
+            key, self.rate, tuple(shape) + self.batch_shape
+        ).astype(jnp.float32))
+
+    def log_prob(self, value):
+        x = _v(value)
+        return _t(x * jnp.log(self.rate) - self.rate
+                  - jax.lax.lgamma(x + 1.0))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs_ = _v(probs)
+        super().__init__(self.probs_.shape[:-1], self.probs_.shape[-1:])
+
+    def sample(self, shape=()):
+        cat = Categorical(probs=self.probs_)
+        draws = _v(cat.sample(tuple(shape) + (self.total_count,)))
+        k = self.probs_.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        return _t(jnp.sum(onehot, axis=-2))
+
+    def log_prob(self, value):
+        x = _v(value)
+        logp = jnp.log(self.probs_)
+        coeff = (jax.lax.lgamma(jnp.asarray(self.total_count + 1.0))
+                 - jnp.sum(jax.lax.lgamma(x + 1.0), -1))
+        return _t(coeff + jnp.sum(x * logp, -1))
+
+
+# ------------------------------------------------------------ KL registry
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return deco
+
+
+def kl_divergence(p, q):
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        for (pc, qc), f in _KL_REGISTRY.items():
+            if isinstance(p, pc) and isinstance(q, qc):
+                fn = f
+                break
+    if fn is None:
+        raise NotImplementedError(
+            f"KL({type(p).__name__} || {type(q).__name__}) not registered")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return _t(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    lp = jax.nn.log_softmax(p.logits, -1)
+    lq = jax.nn.log_softmax(q.logits, -1)
+    return _t(jnp.sum(jnp.exp(lp) * (lp - lq), -1))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return _t(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+    b = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+    return _t(a * (jnp.log(a) - jnp.log(b))
+              + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))

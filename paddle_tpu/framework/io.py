@@ -108,11 +108,12 @@ def save(obj, path, protocol=None, **configs):
     offset = 0
     blobs = []
     for arr in tensors:
+        shape = list(arr.shape)  # before ascontiguousarray: it promotes 0-d to 1-d
         arr = np.ascontiguousarray(arr)
         blob = arr.tobytes()
         metas.append({
             "dtype": arr.dtype.name,
-            "shape": list(arr.shape),
+            "shape": shape,
             "offset": offset,
             "nbytes": len(blob),
         })

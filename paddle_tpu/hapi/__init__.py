@@ -4,4 +4,5 @@ Analog of /root/reference/python/paddle/hapi/ (Model.fit/evaluate/predict,
 callbacks, model_summary).
 """
 from . import summary as _summary_mod  # noqa: F401
+from .model import Callback, Model, ModelCheckpoint, ProgBarLogger  # noqa: F401
 from .summary import summary  # noqa: F401

@@ -1,0 +1,292 @@
+"""hapi.Model — the Keras-like trainer.
+
+Analog of /root/reference/python/paddle/hapi/model.py:1472 (``Model`` with
+prepare/fit/evaluate/predict/save/load) and callbacks.py (ProgBarLogger,
+ModelCheckpoint). The dygraph engine below runs eager; pass
+``compiled=True`` to prepare() to train through the whole-step compiled
+path (paddle_tpu.jit.TrainStep) — the TPU-native equivalent of the
+reference's ``Model`` + ``to_static``.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint"]
+
+
+class Callback:
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_train_batch_begin(self, step, logs=None):
+        pass
+
+    def on_train_batch_end(self, step, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+    def on_eval_batch_end(self, step, logs=None):
+        pass
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=10, verbose=2):
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self.t0 = time.time()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and step % self.log_freq == 0:
+            items = " - ".join(
+                f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+                for k, v in (logs or {}).items())
+            print(f"epoch {self.epoch} step {step}: {items}")
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            items = " - ".join(
+                f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+                for k, v in (logs or {}).items())
+            print(f"epoch {epoch} done in {time.time()-self.t0:.1f}s: {items}")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            self.model.save(os.path.join(self.save_dir, str(epoch)))
+
+
+class Model:
+    """Reference hapi/model.py:1472."""
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self._compiled = False
+
+    # ------------------------------------------------ setup
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, compiled=False):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, (list, tuple)):
+            self._metrics = list(metrics)
+        else:
+            self._metrics = [metrics]
+        self._compiled = compiled
+        return self
+
+    # ------------------------------------------------ steps
+
+    def train_batch(self, inputs, labels=None):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        if self._compiled:
+            if self._train_step is None:
+                from ..jit import TrainStep
+
+                labels_holder = {}
+
+                def loss_fn(*outs):
+                    return self._loss(
+                        outs[0] if len(outs) == 1 else outs,
+                        labels_holder["y"])
+
+                self._labels_holder = labels_holder
+                self._train_step = TrainStep(self.network, loss_fn,
+                                             self._optimizer)
+            self._labels_holder["y"] = labels
+            loss = self._train_step(*inputs)
+            return {"loss": float(loss)}
+        out = self.network(*inputs)
+        loss = self._loss(out, labels) if self._loss else out
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        logs = {"loss": float(loss)}
+        for m in self._metrics:
+            m.update(m.compute(out, labels))
+        return logs
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..core import autograd
+
+        with autograd.no_grad():
+            out = self.network(*inputs)
+            logs = {}
+            if self._loss is not None and labels is not None:
+                logs["loss"] = float(self._loss(out, labels))
+        for m in self._metrics:
+            m.update(m.compute(out, labels))
+        return logs
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        from ..core import autograd
+
+        with autograd.no_grad():
+            return self.network(*inputs)
+
+    # ------------------------------------------------ loops
+
+    @staticmethod
+    def _split(batch):
+        if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+            return list(batch[:-1]), batch[-1]
+        return [batch], None
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            shuffle=True, callbacks=None, num_workers=0):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(train_data, Dataset):
+            train_data = DataLoader(train_data, batch_size=batch_size,
+                                    shuffle=shuffle, num_workers=num_workers)
+        cbs = list(callbacks or [])
+        if verbose:
+            cbs.append(ProgBarLogger(log_freq, verbose))
+        if save_dir:
+            cbs.append(ModelCheckpoint(save_freq, save_dir))
+        for cb in cbs:
+            cb.set_model(self)
+        history = []
+        for cb in cbs:
+            cb.on_train_begin()
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            for cb in cbs:
+                cb.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(train_data):
+                ins, lab = self._split(batch)
+                logs = self.train_batch(ins, lab)
+                for m in self._metrics:
+                    logs[_name(m)] = _scalar(m.accumulate())
+                for cb in cbs:
+                    cb.on_train_batch_end(step, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                logs.update(self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0))
+            for cb in cbs:
+                cb.on_epoch_end(epoch, logs)
+            history.append(logs)
+        for cb in cbs:
+            cb.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(eval_data, Dataset):
+            eval_data = DataLoader(eval_data, batch_size=batch_size,
+                                   num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        losses = []
+        for step, batch in enumerate(eval_data):
+            ins, lab = self._split(batch)
+            out = self.eval_batch(ins, lab)
+            if "loss" in out:
+                losses.append(out["loss"])
+        if losses:
+            logs["eval_loss"] = float(np.mean(losses))
+        for m in self._metrics:
+            logs["eval_" + _name(m)] = _scalar(m.accumulate())
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                callbacks=None, verbose=1):
+        from ..io import DataLoader, Dataset
+
+        if isinstance(test_data, Dataset):
+            test_data = DataLoader(test_data, batch_size=batch_size,
+                                   num_workers=num_workers)
+        outputs = []
+        for batch in test_data:
+            ins, _ = self._split(batch)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            import jax.numpy as jnp
+
+            outputs = Tensor(jnp.concatenate(
+                [o._value for o in outputs], axis=0))
+        return outputs
+
+    # ------------------------------------------------ persistence
+
+    def save(self, path, training=True):
+        from ..framework.io import save
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load
+
+        self.network.set_state_dict(load(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary
+
+        return summary(self.network, input_size)
+
+
+def _name(m):
+    n = m.name()
+    return n[0] if isinstance(n, (list, tuple)) else n
+
+
+def _scalar(v):
+    return float(v[0]) if isinstance(v, (list, tuple)) else float(v)

@@ -1,0 +1,171 @@
+"""paddle_tpu.metric — evaluation metrics.
+
+Analog of /root/reference/python/paddle/metric/metrics.py
+(Metric, Accuracy, Precision, Recall, Auc).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def _np(x):
+    return np.asarray(x._value) if isinstance(x, Tensor) else np.asarray(x)
+
+
+class Metric:
+    def __init__(self, name=None):
+        self._name = name or type(self).__name__.lower()
+
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self._name
+
+    def compute(self, pred, label, *args):
+        return pred, label
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference metrics.py Accuracy)."""
+
+    def __init__(self, topk=(1,), name=None):
+        super().__init__(name or "acc")
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self.reset()
+
+    def reset(self):
+        self.total = np.zeros(len(self.topk))
+        self.count = np.zeros(len(self.topk))
+
+    def compute(self, pred, label, *args):
+        pred = _np(pred)
+        label = _np(label)
+        if label.ndim == pred.ndim and label.shape[-1] > 1:  # one-hot
+            label = label.argmax(-1)
+        label = label.reshape(label.shape[0], -1)
+        idx = np.argsort(-pred, axis=-1)[:, : self.maxk]
+        correct = (idx == label[:, :1]).astype(np.float32)
+        return correct
+
+    def update(self, correct, *args):
+        correct = _np(correct)
+        for i, k in enumerate(self.topk):
+            num = correct[:, :k].sum()
+            self.total[i] += num
+            self.count[i] += correct.shape[0]
+        res = self.total / np.maximum(self.count, 1)
+        return res[0] if len(self.topk) == 1 else res
+
+    def accumulate(self):
+        res = (self.total / np.maximum(self.count, 1)).tolist()
+        return res[0] if len(self.topk) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision (reference metrics.py Precision)."""
+
+    def __init__(self, name=None):
+        super().__init__(name or "precision")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def update(self, preds, labels):
+        preds = (_np(preds) > 0.5).astype(np.int64).flatten()
+        labels = _np(labels).astype(np.int64).flatten()
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fp += int(((preds == 1) & (labels == 0)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fp
+        return self.tp / denom if denom else 0.0
+
+
+class Recall(Metric):
+    def __init__(self, name=None):
+        super().__init__(name or "recall")
+        self.reset()
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def update(self, preds, labels):
+        preds = (_np(preds) > 0.5).astype(np.int64).flatten()
+        labels = _np(labels).astype(np.int64).flatten()
+        self.tp += int(((preds == 1) & (labels == 1)).sum())
+        self.fn += int(((preds == 0) & (labels == 1)).sum())
+
+    def accumulate(self):
+        denom = self.tp + self.fn
+        return self.tp / denom if denom else 0.0
+
+
+class Auc(Metric):
+    """ROC AUC via threshold buckets (reference metrics.py Auc)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name=None):
+        super().__init__(name or "auc")
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1)
+        self._stat_neg = np.zeros(self.num_thresholds + 1)
+
+    def update(self, preds, labels):
+        preds = _np(preds)
+        if preds.ndim == 2 and preds.shape[1] == 2:
+            preds = preds[:, 1]
+        preds = preds.flatten()
+        labels = _np(labels).flatten()
+        buckets = np.round(preds * self.num_thresholds).astype(np.int64)
+        buckets = np.clip(buckets, 0, self.num_thresholds)
+        for b, l in zip(buckets, labels):
+            if l:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def accumulate(self):
+        tot_pos = self._stat_pos.sum()
+        tot_neg = self._stat_neg.sum()
+        if tot_pos == 0 or tot_neg == 0:
+            return 0.0
+        # trapezoid over descending thresholds
+        area = 0.0
+        pos = neg = 0.0
+        for b in range(self.num_thresholds, -1, -1):
+            p, n = self._stat_pos[b], self._stat_neg[b]
+            area += n * (pos + p / 2)
+            pos += p
+            neg += n
+        return area / (tot_pos * tot_neg)
+
+
+def accuracy(input, label, k=1):
+    """Functional top-k accuracy (reference paddle.metric.accuracy)."""
+    pred = _np(input)
+    lab = _np(label).reshape(-1, 1)
+    idx = np.argsort(-pred, axis=-1)[:, :k]
+    correct = (idx == lab).any(axis=1).astype(np.float32)
+    return Tensor(np.asarray(correct.mean(), np.float32))

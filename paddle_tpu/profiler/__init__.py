@@ -1,0 +1,188 @@
+"""paddle_tpu.profiler — tracing and profiling.
+
+Analog of /root/reference/python/paddle/profiler/ (Profiler:358 with
+scheduler states, export_chrome_tracing, RecordEvent spans; C++ CUPTI
+tracers in paddle/fluid/platform/profiler/). TPU-natively device timelines
+come from the XLA/XPlane profiler (``jax.profiler``) — the CUPTI
+equivalent — and host-side phases from RecordEvent spans recorded here and
+via ``jax.profiler.TraceAnnotation``.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+
+__all__ = [
+    "Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
+    "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+]
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    CUSTOM_DEVICE = "custom_device"
+    TPU = "tpu"
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+_host_events: list = []
+_active = False
+
+
+class RecordEvent:
+    """Host-side span (reference python/paddle/profiler/utils.py
+    RecordEvent; C++ paddle/fluid/platform/profiler/host_tracer.cc). Also
+    annotates the XLA trace so spans show up in the device timeline."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._t0 = None
+        self._ann = None
+
+    def begin(self):
+        self._t0 = time.perf_counter_ns()
+        try:
+            import jax.profiler as jp
+
+            self._ann = jp.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+        if _active and self._t0 is not None:
+            _host_events.append({
+                "name": self.name, "ph": "X", "pid": os.getpid(), "tid": 0,
+                "ts": self._t0 / 1e3,
+                "dur": (time.perf_counter_ns() - self._t0) / 1e3,
+            })
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Step-state scheduler (reference profiler.py make_scheduler)."""
+    period = closed + ready + record
+
+    def schedule(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = (step - skip_first) % max(period, 1)
+        if repeat and (step - skip_first) // max(period, 1) >= repeat:
+            return ProfilerState.CLOSED
+        if s < closed:
+            return ProfilerState.CLOSED
+        if s < closed + ready:
+            return ProfilerState.READY
+        if s == period - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return schedule
+
+
+class Profiler:
+    """Reference python/paddle/profiler/profiler.py:358. ``start``/``stop``
+    wrap ``jax.profiler.start_trace``/``stop_trace`` (XPlane → TensorBoard/
+    Perfetto) plus the host-event ring for chrome export."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, profile_memory=False, with_flops=False):
+        self.targets = targets or [ProfilerTarget.CPU, ProfilerTarget.TPU]
+        self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self._log_dir = None
+        self._step = 0
+        self._tracing = False
+        self._step_times = []
+        self._last_step_t = None
+
+    def start(self):
+        global _active
+        _active = True
+        _host_events.clear()
+        self._last_step_t = time.perf_counter()
+        if not self.timer_only:
+            try:
+                import jax.profiler as jp
+
+                self._log_dir = os.environ.get(
+                    "PADDLE_PROFILER_LOGDIR", "/tmp/paddle_tpu_profile")
+                jp.start_trace(self._log_dir)
+                self._tracing = True
+            except Exception:
+                self._tracing = False
+        return self
+
+    def stop(self):
+        global _active
+        _active = False
+        if self._tracing:
+            import jax.profiler as jp
+
+            jp.stop_trace()
+            self._tracing = False
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_step_t is not None:
+            self._step_times.append(now - self._last_step_t)
+        self._last_step_t = now
+        self._step += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+
+        arr = np.asarray(self._step_times)
+        return (f"avg step {arr.mean()*1e3:.2f}ms "
+                f"(min {arr.min()*1e3:.2f}, max {arr.max()*1e3:.2f}, "
+                f"n={len(arr)})")
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        print(self.step_info())
+        print(f"host events recorded: {len(_host_events)}")
+
+    def export(self, path, format="json"):
+        export_chrome_tracing(path)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def export_chrome_tracing(path, dir_name=None):
+    """Dump host RecordEvent spans as a chrome://tracing JSON (reference
+    chrometracing_logger.cc analog; device timeline lives in the XPlane
+    dump under the jax.profiler log dir)."""
+    with open(path, "w") as f:
+        json.dump({"traceEvents": list(_host_events)}, f)
+    return path
+
+
+def load_profiler_result(path):
+    with open(path) as f:
+        return json.load(f)

@@ -1,0 +1,210 @@
+"""Aux subsystems: metric, hapi.Model, distribution, profiler,
+distributed checkpoint, NaN/Inf flag.
+
+Mirrors reference test/legacy_test/test_metrics.py, test/distribution/,
+hapi model tests, and auto_parallel checkpoint tests.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed import Replicate, Shard
+
+
+def test_accuracy_metric():
+    from paddle_tpu.metric import Accuracy
+
+    m = Accuracy(topk=(1, 2))
+    pred = paddle.to_tensor(np.array(
+        [[0.1, 0.6, 0.3], [0.7, 0.2, 0.1], [0.3, 0.3, 0.4]], np.float32))
+    label = paddle.to_tensor(np.array([[2], [0], [2]]))
+    m.update(m.compute(pred, label))
+    top1, top2 = m.accumulate()
+    assert abs(top1 - 2 / 3) < 1e-6
+    assert abs(top2 - 1.0) < 1e-6
+
+
+def test_precision_recall_auc():
+    from paddle_tpu.metric import Auc, Precision, Recall
+
+    p, r, a = Precision(), Recall(), Auc()
+    preds = np.array([0.9, 0.8, 0.2, 0.6])
+    labels = np.array([1, 0, 0, 1])
+    for m in (p, r):
+        m.update(preds, labels)
+    a.update(preds, labels)
+    assert abs(p.accumulate() - 2 / 3) < 1e-6
+    assert r.accumulate() == 1.0
+    assert 0.5 < a.accumulate() <= 1.0
+
+
+def test_hapi_model_fit_eval_predict(tmp_path):
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import Dataset
+
+    paddle.seed(0)
+
+    class XorDs(Dataset):
+        def __len__(self):
+            return 64
+
+        def __getitem__(self, i):
+            x = np.array([(i >> 0) & 1, (i >> 1) & 1], np.float32)
+            return x, np.int64(int(x[0]) ^ int(x[1]))
+
+    net = nn.Sequential(nn.Linear(2, 32), nn.Tanh(), nn.Linear(32, 2))
+    model = Model(net)
+    from paddle_tpu.metric import Accuracy
+
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=0.05,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss(),
+        metrics=Accuracy(),
+    )
+    hist = model.fit(XorDs(), batch_size=16, epochs=12, verbose=0)
+    assert hist[-1]["loss"] < hist[0]["loss"]
+    logs = model.evaluate(XorDs(), batch_size=16, verbose=0)
+    assert logs["eval_acc"] > 0.9
+    out = model.predict(XorDs(), batch_size=16, stack_outputs=True)
+    assert out.shape == [64, 2]
+    model.save(str(tmp_path / "ckpt"))
+    model.load(str(tmp_path / "ckpt"))
+
+
+def test_hapi_model_compiled_path():
+    from paddle_tpu.hapi import Model
+
+    paddle.seed(1)
+    net = nn.Linear(8, 1)
+    model = Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.SGD(learning_rate=0.05,
+                                       parameters=net.parameters()),
+        loss=nn.MSELoss(),
+        compiled=True,
+    )
+    x = paddle.to_tensor(np.random.rand(16, 8).astype(np.float32))
+    y = paddle.to_tensor(np.random.rand(16, 1).astype(np.float32))
+    l0 = model.train_batch([x], y)["loss"]
+    for _ in range(5):
+        l1 = model.train_batch([x], y)["loss"]
+    assert l1 < l0
+
+
+def test_distributions():
+    from paddle_tpu.distribution import (
+        Bernoulli,
+        Categorical,
+        Normal,
+        Uniform,
+        kl_divergence,
+    )
+
+    paddle.seed(0)
+    n = Normal(0.0, 1.0)
+    s = n.sample((2000,))
+    assert abs(float(np.asarray(s._value).mean())) < 0.1
+    lp = n.log_prob(paddle.to_tensor(0.0))
+    assert abs(float(lp._value) + 0.9189385) < 1e-4
+
+    u = Uniform(0.0, 2.0)
+    assert abs(float(u.entropy()._value) - np.log(2.0)) < 1e-6
+
+    c = Categorical(logits=np.zeros((3,), np.float32))
+    probs = np.asarray(c.probs._value)
+    np.testing.assert_allclose(probs, np.ones(3) / 3, rtol=1e-6)
+
+    kl = kl_divergence(Normal(0.0, 1.0), Normal(0.0, 1.0))
+    assert abs(float(kl._value)) < 1e-7
+    kl2 = kl_divergence(Bernoulli(np.float32(0.3)), Bernoulli(np.float32(0.3)))
+    assert abs(float(kl2._value)) < 1e-7
+
+
+def test_distribution_sampling_moments():
+    from paddle_tpu.distribution import Beta, Exponential, Gamma, Poisson
+
+    paddle.seed(0)
+    e = Exponential(np.float32(2.0))
+    m = float(np.asarray(e.sample((4000,))._value).mean())
+    assert abs(m - 0.5) < 0.05
+    g = Gamma(np.float32(3.0), np.float32(2.0))
+    m = float(np.asarray(g.sample((4000,))._value).mean())
+    assert abs(m - 1.5) < 0.1
+    b = Beta(np.float32(2.0), np.float32(2.0))
+    m = float(np.asarray(b.sample((4000,))._value).mean())
+    assert abs(m - 0.5) < 0.05
+    p = Poisson(np.float32(4.0))
+    m = float(np.asarray(p.sample((4000,))._value).mean())
+    assert abs(m - 4.0) < 0.2
+
+
+def test_profiler_records(tmp_path):
+    import paddle_tpu.profiler as prof
+
+    with prof.Profiler(timer_only=True) as p:
+        with prof.RecordEvent("forward"):
+            x = paddle.to_tensor(np.random.rand(64, 64).astype(np.float32))
+            (x @ x).sum()
+        p.step()
+        with prof.RecordEvent("backward"):
+            pass
+        p.step()
+    assert "avg step" in p.step_info()
+    out = tmp_path / "trace.json"
+    p.export(str(out))
+    data = prof.load_profiler_result(str(out))
+    names = [e["name"] for e in data["traceEvents"]]
+    assert "forward" in names
+
+
+def test_distributed_checkpoint_roundtrip(tmp_path):
+    sd = {
+        "w": paddle.to_tensor(np.random.rand(16, 8).astype(np.float32)),
+        "b": paddle.to_tensor(np.random.rand(8).astype(np.float32)),
+        "scalar": paddle.to_tensor(np.float32(3.0)),
+    }
+    path = str(tmp_path / "ckpt")
+    dist.save_state_dict(sd, path)
+    assert os.path.exists(os.path.join(path, "metadata.json"))
+
+    target = {
+        "w": paddle.to_tensor(np.zeros((16, 8), np.float32)),
+        "b": paddle.to_tensor(np.zeros(8, np.float32)),
+        "scalar": paddle.to_tensor(np.float32(0.0)),
+    }
+    dist.load_state_dict(target, path)
+    np.testing.assert_allclose(np.asarray(target["w"]._value),
+                               np.asarray(sd["w"]._value))
+    np.testing.assert_allclose(np.asarray(target["scalar"]._value), 3.0)
+
+
+def test_distributed_checkpoint_reshard_on_load(tmp_path):
+    """Save from replicated, load into a sharded tensor (different mesh)."""
+    mesh = dist.ProcessMesh(np.arange(8).reshape(4, 2), ["dp", "mp"])
+    src = {"w": paddle.to_tensor(np.random.rand(16, 8).astype(np.float32))}
+    path = str(tmp_path / "ckpt2")
+    dist.save_state_dict(src, path)
+
+    target_w = dist.shard_tensor(
+        paddle.to_tensor(np.zeros((16, 8), np.float32)), mesh, [Shard(0)])
+    dist.load_state_dict({"w": target_w}, path)
+    np.testing.assert_allclose(np.asarray(target_w._value),
+                               np.asarray(src["w"]._value))
+    # sharding preserved after load
+    assert target_w._value.addressable_shards[0].data.shape == (4, 8)
+    dist.process_mesh._global_mesh = None
+
+
+def test_check_nan_inf_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError):
+            paddle.log(x * 0.0 - 1.0)  # log(-1) = nan
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
