@@ -1,0 +1,2 @@
+"""paddle_tpu.incubate — incubating APIs (reference python/paddle/incubate/)."""
+from . import nn  # noqa: F401

@@ -1,0 +1,13 @@
+"""paddle_tpu.incubate.nn — fused layer surface.
+
+Analog of /root/reference/python/paddle/incubate/nn/.
+"""
+from .fused_transformer import (  # noqa: F401
+    FusedFeedForward,
+    FusedMultiHeadAttention,
+    FusedMultiTransformer,
+    FusedTransformerEncoderLayer,
+)
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]

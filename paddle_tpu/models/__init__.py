@@ -21,3 +21,29 @@ __all__ = [
     "LlamaMLP", "LlamaDecoderLayer", "LlamaPretrainingCriterion",
     "llama_shard_fn", "llama_tiny_config",
 ]
+
+from .bert import (  # noqa: F401
+    BertConfig,
+    BertForPretraining,
+    BertForSequenceClassification,
+    BertModel,
+    BertPretrainingCriterion,
+    bert_base_config,
+    bert_tiny_config,
+)
+from .gpt import (  # noqa: F401
+    GPTConfig,
+    GPTForCausalLM,
+    GPTModel,
+    GPTPretrainingCriterion,
+    gpt_shard_fn,
+    gpt_tiny_config,
+)
+
+__all__ += [
+    "GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
+    "gpt_tiny_config", "gpt_shard_fn",
+    "BertConfig", "BertModel", "BertForPretraining",
+    "BertForSequenceClassification", "BertPretrainingCriterion",
+    "bert_base_config", "bert_tiny_config",
+]

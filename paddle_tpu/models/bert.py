@@ -1,0 +1,156 @@
+"""BERT — BASELINE config 2 (BERT-base with fused attention/feedforward).
+
+Re-implements the architecture of the reference's BERT benchmark path
+(dygraph BERT over incubate fused layers,
+python/paddle/incubate/nn/layer/fused_transformer.py). Encoder blocks are
+paddle_tpu.incubate.nn.FusedTransformerEncoderLayer (post-LN, as BERT).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..incubate.nn.fused_transformer import FusedTransformerEncoderLayer
+from ..nn import Layer, functional as F
+from ..nn import initializer as I
+from ..nn.layers_common import Dropout, Embedding, LayerList, Linear
+from ..nn.layers_norm import LayerNorm
+from ..ops import matmul, reshape, softmax_with_cross_entropy, tanh
+
+__all__ = ["BertConfig", "BertModel", "BertForPretraining",
+           "BertForSequenceClassification", "BertPretrainingCriterion",
+           "bert_base_config", "bert_tiny_config"]
+
+
+class BertConfig:
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02, layer_norm_eps=1e-12,
+                 pad_token_id=0):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+        self.layer_norm_eps = layer_norm_eps
+        self.pad_token_id = pad_token_id
+
+
+def bert_base_config(**overrides):
+    return BertConfig(**overrides)
+
+
+def bert_tiny_config(**overrides):
+    base = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=4, intermediate_size=128,
+                max_position_embeddings=64, hidden_dropout_prob=0.0,
+                attention_probs_dropout_prob=0.0)
+    base.update(overrides)
+    return BertConfig(**base)
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        self.word_embeddings = Embedding(config.vocab_size, config.hidden_size,
+                                         weight_attr=init)
+        self.position_embeddings = Embedding(config.max_position_embeddings,
+                                             config.hidden_size, weight_attr=init)
+        self.token_type_embeddings = Embedding(config.type_vocab_size,
+                                               config.hidden_size, weight_attr=init)
+        self.layer_norm = LayerNorm(config.hidden_size,
+                                    epsilon=config.layer_norm_eps)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        import jax.numpy as jnp
+
+        b, s = input_ids.shape
+        pos = Tensor._from_value(jnp.arange(s)[None, :])
+        x = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is not None:
+            x = x + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertModel(Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = LayerList([
+            FusedTransformerEncoderLayer(
+                config.hidden_size, config.num_attention_heads,
+                config.intermediate_size,
+                dropout_rate=config.hidden_dropout_prob,
+                activation=config.hidden_act,
+                attn_dropout_rate=config.attention_probs_dropout_prob,
+                normalize_before=False)
+            for _ in range(config.num_hidden_layers)
+        ])
+        self.pooler = Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        import jax.numpy as jnp
+
+        mask = None
+        if attention_mask is not None:
+            # (B, S) 1/0 -> additive (B, 1, 1, S)
+            m = attention_mask._value.astype(jnp.float32)
+            mask = Tensor._from_value((1.0 - m)[:, None, None, :] * -1e9)
+        x = self.embeddings(input_ids, token_type_ids)
+        for layer in self.encoder:
+            x = layer(x, src_mask=mask)
+        pooled = tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (reference bert pretraining harness)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.mlm_transform = Linear(config.hidden_size, config.hidden_size)
+        self.mlm_norm = LayerNorm(config.hidden_size,
+                                  epsilon=config.layer_norm_eps)
+        self.nsp_head = Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(seq)))
+        mlm_logits = matmul(h, self.bert.embeddings.word_embeddings.weight,
+                            transpose_y=True)
+        nsp_logits = self.nsp_head(pooled)
+        return mlm_logits, nsp_logits
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, config: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+        self.classifier = Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+class BertPretrainingCriterion(Layer):
+    def forward(self, mlm_logits, nsp_logits, mlm_labels, nsp_labels,
+                masked_positions=None):
+        mlm_loss = softmax_with_cross_entropy(
+            mlm_logits, mlm_labels, ignore_index=-100).mean()
+        nsp_loss = softmax_with_cross_entropy(nsp_logits, nsp_labels).mean()
+        return mlm_loss + nsp_loss

@@ -1,0 +1,176 @@
+"""GPT family (GPT-2/3 architecture) — BASELINE configs 3 and 5.
+
+Re-implements the architecture used by the reference's GPT tests and
+PaddleNLP's gpt modeling (learned positional embeddings, pre-LN blocks,
+GELU MLP), TPU-native on the nn.Layer + cached-op surface. The TP sharding
+plan mirrors models/llama.py's.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn import Layer, functional as F
+from ..nn import initializer as I
+from ..nn.layers_common import Dropout, Embedding, LayerList, Linear
+from ..nn.layers_norm import LayerNorm
+from ..ops import matmul, reshape, scaled_dot_product_attention, softmax_with_cross_entropy
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "GPTPretrainingCriterion",
+           "gpt_tiny_config", "gpt_shard_fn"]
+
+
+class GPTConfig:
+    def __init__(self, vocab_size=50304, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=None, max_position_embeddings=1024,
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 initializer_range=0.02, layer_norm_epsilon=1e-5,
+                 tie_word_embeddings=True):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size or 4 * hidden_size
+        self.max_position_embeddings = max_position_embeddings
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.initializer_range = initializer_range
+        self.layer_norm_epsilon = layer_norm_epsilon
+        self.tie_word_embeddings = tie_word_embeddings
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def gpt_tiny_config(**overrides):
+    base = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                num_attention_heads=4, max_position_embeddings=128,
+                hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0)
+    base.update(overrides)
+    return GPTConfig(**base)
+
+
+class GPTAttention(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h, d = config.num_attention_heads, config.head_dim
+        init = I.Normal(0.0, config.initializer_range)
+        self.qkv_proj = Linear(config.hidden_size, 3 * h * d, weight_attr=init)
+        self.out_proj = Linear(h * d, config.hidden_size, weight_attr=init)
+        self.num_heads = h
+        self.head_dim = d
+        self.dropout_p = config.attention_probs_dropout_prob
+
+    def forward(self, x):
+        b, s, _ = x.shape
+        qkv = reshape(self.qkv_proj(x), [b, s, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.dropout_p if self.training else 0.0,
+            training=self.training)
+        return self.out_proj(reshape(out, [b, s, self.num_heads * self.head_dim]))
+
+
+class GPTBlock(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        init = I.Normal(0.0, config.initializer_range)
+        self.ln_1 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.attn = GPTAttention(config)
+        self.ln_2 = LayerNorm(config.hidden_size, epsilon=config.layer_norm_epsilon)
+        self.fc_in = Linear(config.hidden_size, config.intermediate_size,
+                            weight_attr=init)
+        self.fc_out = Linear(config.intermediate_size, config.hidden_size,
+                             weight_attr=init)
+        self.dropout = Dropout(config.hidden_dropout_prob)
+
+    def forward(self, x):
+        x = x + self.dropout(self.attn(self.ln_1(x)))
+        return x + self.dropout(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
+
+
+class GPTModel(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        init = I.Normal(0.0, config.initializer_range)
+        self.wte = Embedding(config.vocab_size, config.hidden_size,
+                             weight_attr=init)
+        self.wpe = Embedding(config.max_position_embeddings,
+                             config.hidden_size, weight_attr=init)
+        self.drop = Dropout(config.hidden_dropout_prob)
+        self.h = LayerList([GPTBlock(config)
+                            for _ in range(config.num_hidden_layers)])
+        self.ln_f = LayerNorm(config.hidden_size,
+                              epsilon=config.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        b, s = input_ids.shape
+        import jax.numpy as jnp
+
+        pos = Tensor._from_value(jnp.arange(s)[None, :])
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if config.tie_word_embeddings:
+            self.lm_head = None
+        else:
+            self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                                  weight_attr=I.Normal(0.0, config.initializer_range),
+                                  bias_attr=False)
+
+    def forward(self, input_ids):
+        hidden = self.gpt(input_ids)
+        if self.lm_head is None:
+            return matmul(hidden, self.gpt.wte.weight, transpose_y=True)
+        return self.lm_head(hidden)
+
+
+class GPTPretrainingCriterion(Layer):
+    def forward(self, logits, labels):
+        loss = softmax_with_cross_entropy(logits[:, :-1, :], labels[:, 1:])
+        return loss.mean()
+
+
+def gpt_shard_fn(mesh, mp_axis="mp"):
+    """Megatron TP placements for GPT weights (qkv/fc_in column-parallel,
+    out_proj/fc_out row-parallel, embeddings vocab-parallel)."""
+    from ..distributed import Replicate, Shard, shard_tensor
+
+    mp = mesh.dim_names.index(mp_axis) if mp_axis in mesh.dim_names else None
+
+    def placements_for(pname, ndim):
+        pl = [Replicate()] * mesh.ndim
+        if mp is None:
+            return pl
+        is_bias = pname.endswith("bias")
+        if any(k in pname for k in ("qkv_proj", "fc_in")):
+            # column-parallel: weight [in, out] Shard(1); its bias Shard(0)
+            pl[mp] = Shard(0) if is_bias else Shard(1)
+        elif any(k in pname for k in ("out_proj", "fc_out")):
+            # row-parallel: weight Shard(0); bias replicated (post-reduce add)
+            if not is_bias:
+                pl[mp] = Shard(0)
+        elif "wte" in pname:
+            pl[mp] = Shard(0)
+        return pl
+
+    def shard_fn(name, sublayer, mesh_):
+        for pname, p in sublayer._parameters.items():
+            if p is not None:
+                shard_tensor(
+                    p, mesh_,
+                    placements_for(f"{name}.{pname}", len(p.shape)))
+
+    return shard_fn
