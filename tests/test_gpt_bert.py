@@ -93,5 +93,9 @@ def test_fused_layers_standalone():
     y = enc(x)
     assert y.shape == [2, 8, 32]
     y.sum().backward()
-    for p in enc.parameters():
-        assert p.grad is not None
+    # pre_ln is constructed but unused in post-LN mode (reference keeps both
+    # param sets too) - unused params legitimately have no grad
+    for name, p in enc.named_parameters():
+        if "pre_ln" in name:
+            continue
+        assert p.grad is not None, name
