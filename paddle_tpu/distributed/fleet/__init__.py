@@ -131,3 +131,7 @@ init = fleet.init
 distributed_model = fleet.distributed_model
 distributed_optimizer = fleet.distributed_optimizer
 get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
+
+from .ring_attention import RingAttention, ring_attention  # noqa: F401
+
+__all__ += ["ring_attention", "RingAttention"]

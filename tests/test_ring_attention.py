@@ -1,0 +1,74 @@
+"""Ring attention (context parallelism) vs full attention, on the virtual
+8-device mesh. This feature has no reference counterpart (SURVEY.md §5) —
+correctness oracle is the dense computation.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet import ring_attention
+
+
+def _dense(q, k, v, causal):
+    b, s, h, d = q.shape
+    qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    s_ = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        s_ = jnp.where(mask, s_, -jnp.inf)
+    p = jax.nn.softmax(s_, axis=-1)
+    return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vh), 1, 2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("n", [4, 8])
+def test_matches_dense(causal, n):
+    mesh = dist.ProcessMesh(np.arange(n), ["sp"])
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 8 * n, 2, 16), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 8 * n, 2, 16), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 8 * n, 2, 16), jnp.float32)
+    out = ring_attention(q, k, v, mesh, "sp", is_causal=causal)
+    ref = _dense(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_output_stays_sequence_sharded():
+    mesh = dist.ProcessMesh(np.arange(8), ["sp"])
+    q = jnp.ones((1, 64, 2, 16), jnp.float32)
+    out = ring_attention(q, q, q, mesh, "sp")
+    assert out.sharding.spec == jax.sharding.PartitionSpec(
+        None, "sp", None, None)
+
+
+def test_grad_matches_dense():
+    mesh = dist.ProcessMesh(np.arange(4), ["sp"])
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 32, 2, 8), jnp.float32)
+
+    def loss_ring(q, k, v):
+        return (ring_attention(q, k, v, mesh, "sp", is_causal=True) ** 2).sum()
+
+    def loss_dense(q, k, v):
+        return (_dense(q, k, v, True) ** 2).sum()
+
+    g_r = jax.grad(loss_ring, argnums=(0, 1, 2))(q, q, q)
+    g_d = jax.grad(loss_dense, argnums=(0, 1, 2))(q, q, q)
+    for a, b in zip(g_r, g_d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-4, rtol=1e-4)
+
+
+def test_eager_tensor_autograd():
+    mesh = dist.ProcessMesh(np.arange(4), ["sp"])
+    rng = np.random.RandomState(2)
+    q = paddle.to_tensor(rng.randn(1, 32, 2, 8).astype(np.float32),
+                         stop_gradient=False)
+    out = ring_attention(q, q, q, mesh, "sp", is_causal=True)
+    out.sum().backward()
+    assert q.grad is not None
+    assert np.isfinite(np.asarray(q.grad._value)).all()
