@@ -1,0 +1,204 @@
+"""TCPStore — rendezvous key-value store (native-backed).
+
+Python surface of the reference's store API
+(/root/reference/paddle/phi/core/distributed/store/tcp_store.h:121,
+store.h): ``TCPStore(host, port, is_master)`` with set/get/add/wait/
+delete_key and a barrier helper. The data path is the C++ server/client in
+paddle_tpu/native/tcp_store.cpp (built on first use); when no toolchain is
+available a pure-python in-process fallback serves single-host tests.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+import time
+
+__all__ = ["TCPStore", "create_or_get_global_tcp_store"]
+
+_lib = None
+_lib_tried = False
+
+
+def _native():
+    global _lib, _lib_tried
+    if not _lib_tried:
+        _lib_tried = True
+        from ..native import load_library
+
+        lib = load_library("tcp_store")
+        if lib is not None:
+            lib.tcpstore_server_start.restype = ctypes.c_void_p
+            lib.tcpstore_server_start.argtypes = [ctypes.c_int]
+            lib.tcpstore_server_port.restype = ctypes.c_int
+            lib.tcpstore_server_port.argtypes = [ctypes.c_void_p]
+            lib.tcpstore_server_stop.argtypes = [ctypes.c_void_p]
+            lib.tcpstore_client_new.restype = ctypes.c_void_p
+            lib.tcpstore_client_new.argtypes = [ctypes.c_char_p, ctypes.c_int]
+            lib.tcpstore_client_free.argtypes = [ctypes.c_void_p]
+            lib.tcpstore_set.restype = ctypes.c_int
+            lib.tcpstore_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_char_p, ctypes.c_int]
+            lib.tcpstore_get.restype = ctypes.c_int
+            lib.tcpstore_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_char_p, ctypes.c_int]
+            lib.tcpstore_add.restype = ctypes.c_longlong
+            lib.tcpstore_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                         ctypes.c_longlong]
+            lib.tcpstore_check.restype = ctypes.c_int
+            lib.tcpstore_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+            lib.tcpstore_delete.restype = ctypes.c_int
+            lib.tcpstore_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        _lib = lib
+    return _lib
+
+
+class _PyStore:
+    """In-process fallback with TCPStore semantics (single host only)."""
+
+    def __init__(self):
+        self.data = {}
+        self.cv = threading.Condition()
+
+    def set(self, key, value):
+        with self.cv:
+            self.data[key] = bytes(value)
+            self.cv.notify_all()
+
+    def get(self, key, timeout=None):
+        with self.cv:
+            ok = self.cv.wait_for(lambda: key in self.data, timeout)
+            if not ok:
+                raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+            return self.data[key]
+
+    def add(self, key, delta):
+        with self.cv:
+            cur = int.from_bytes(self.data.get(key, b"\0" * 8), "little",
+                                 signed=True)
+            cur += delta
+            self.data[key] = cur.to_bytes(8, "little", signed=True)
+            self.cv.notify_all()
+            return cur
+
+    def check(self, key):
+        with self.cv:
+            return key in self.data
+
+    def delete(self, key):
+        with self.cv:
+            self.data.pop(key, None)
+
+
+_py_stores: dict = {}
+
+
+class TCPStore:
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=900):
+        self.host = host
+        self.is_master = is_master
+        self.timeout = timeout
+        self._server = None
+        self._client = None
+        self._py = None
+        lib = _native()
+        if lib is None:
+            # fallback: one shared dict per (host, port)
+            self._py = _py_stores.setdefault((host, port), _PyStore())
+            self.port = port
+            return
+        self._lib = lib
+        if is_master:
+            self._server = lib.tcpstore_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = lib.tcpstore_server_port(self._server)
+        self.port = port
+        deadline = time.time() + min(timeout, 30)
+        while True:
+            self._client = lib.tcpstore_client_new(host.encode(), port)
+            if self._client:
+                break
+            if time.time() > deadline:
+                raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+            time.sleep(0.05)
+
+    # ------------------------------------------------ API (reference store.h)
+
+    def set(self, key: str, value) -> None:
+        if isinstance(value, str):
+            value = value.encode()
+        if self._py is not None:
+            return self._py.set(key, value)
+        rc = self._lib.tcpstore_set(self._client, key.encode(),
+                                    bytes(value), len(value))
+        if rc != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str) -> bytes:
+        if self._py is not None:
+            return self._py.get(key, self.timeout)
+        buf = ctypes.create_string_buffer(1 << 20)
+        n = self._lib.tcpstore_get(self._client, key.encode(), buf, len(buf))
+        if n < 0:
+            raise RuntimeError("TCPStore.get failed")
+        return buf.raw[:n]
+
+    def add(self, key: str, delta: int) -> int:
+        if self._py is not None:
+            return self._py.add(key, delta)
+        return int(self._lib.tcpstore_add(self._client, key.encode(), delta))
+
+    def check(self, key: str) -> bool:
+        if self._py is not None:
+            return self._py.check(key)
+        return self._lib.tcpstore_check(self._client, key.encode()) == 1
+
+    def wait(self, key: str) -> None:
+        self.get(key)
+
+    def delete_key(self, key: str) -> None:
+        if self._py is not None:
+            return self._py.delete(key)
+        self._lib.tcpstore_delete(self._client, key.encode())
+
+    def barrier(self, prefix: str, world_size: int) -> None:
+        """All ``world_size`` participants block until everyone arrived."""
+        n = self.add(f"{prefix}/count", 1)
+        if n == world_size:
+            self.set(f"{prefix}/done", b"1")
+        self.get(f"{prefix}/done")
+
+    def close(self):
+        if self._py is not None:
+            return
+        if self._client:
+            self._lib.tcpstore_client_free(self._client)
+            self._client = None
+        if self._server:
+            self._lib.tcpstore_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+_global_store = None
+
+
+def create_or_get_global_tcp_store():
+    """Reference pybind create_or_get_global_tcp_store: master decided by
+    PADDLE_TRAINER_ID==0, endpoint from PADDLE_MASTER."""
+    global _global_store
+    if _global_store is None:
+        import os
+
+        endpoint = os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
+        host, _, port = endpoint.rpartition(":")
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        _global_store = TCPStore(host or "127.0.0.1", int(port or 0),
+                                 is_master=(rank == 0))
+    return _global_store

@@ -1,0 +1,57 @@
+"""paddle_tpu.native — C++ runtime components.
+
+The TPU build keeps the *control plane* native, as the reference does
+(SURVEY.md §2.10): TCPStore rendezvous (tcp_store.cpp ←
+paddle/phi/core/distributed/store/tcp_store.h:121). Libraries are built on
+first use with the system toolchain and cached beside the sources; callers
+fall back to pure-python implementations when no compiler is available.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import threading
+
+_here = os.path.dirname(os.path.abspath(__file__))
+_build_lock = threading.Lock()
+
+
+def _lib_path(name: str) -> str:
+    return os.path.join(_here, f"lib{name}.so")
+
+
+def build_library(name: str, sources: list[str] | None = None,
+                  extra_flags: list[str] | None = None) -> str | None:
+    """Compile ``name``.cpp into lib``name``.so (cached). Returns the path,
+    or None if the toolchain is unavailable/compilation fails."""
+    out = _lib_path(name)
+    sources = sources or [os.path.join(_here, f"{name}.cpp")]
+    with _build_lock:
+        if os.path.exists(out) and all(
+            os.path.getmtime(out) >= os.path.getmtime(s) for s in sources
+        ):
+            return out
+        cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
+               *(extra_flags or []), "-o", out, *sources]
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  timeout=120)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            import sys
+
+            print(f"[paddle_tpu.native] build of {name} failed:\n"
+                  f"{proc.stderr[-2000:]}", file=sys.stderr)
+            return None
+        return out
+
+
+def load_library(name: str):
+    """ctypes.CDLL for a native component, building it if needed."""
+    import ctypes
+
+    path = build_library(name)
+    if path is None:
+        return None
+    return ctypes.CDLL(path)

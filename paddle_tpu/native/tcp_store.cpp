@@ -1,0 +1,298 @@
+// TCPStore — native host-side rendezvous/KV store.
+//
+// C++ re-implementation of the reference's TCPStore
+// (/root/reference/paddle/phi/core/distributed/store/tcp_store.h:121 and
+// tcp_store.cc): a coordinator process hosts a key→bytes map over TCP;
+// workers SET/GET/ADD/WAIT keys to exchange endpoints, barrier, and publish
+// state during launch/elastic/checkpoint coordination. This is the control
+// plane that stays native in the TPU build (SURVEY.md §7 item 3) — the data
+// plane (collectives) is XLA's.
+//
+// Wire protocol (little-endian):
+//   request:  u8 op | u32 klen | key bytes | u32 vlen | value bytes
+//   ops: 0=SET 1=GET(blocking) 2=ADD(value=i64 delta) 3=CHECK 4=DELETE
+//   response: u32 vlen | value bytes   (CHECK: 1 byte 0/1)
+//
+// Built as a shared library; driven from Python via ctypes
+// (paddle_tpu/distributed/store.py). No external dependencies.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::map<std::string, std::vector<uint8_t>> data;
+  std::mutex mu;
+  std::condition_variable cv;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  uint8_t* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const uint8_t* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_blob(int fd, std::string* out) {
+  uint32_t len = 0;
+  if (!read_full(fd, &len, 4)) return false;
+  out->resize(len);
+  return len == 0 || read_full(fd, &(*out)[0], len);
+}
+
+bool write_blob(int fd, const void* buf, uint32_t len) {
+  if (!write_full(fd, &len, 4)) return false;
+  return len == 0 || write_full(fd, buf, len);
+}
+
+struct Server {
+  Store store;
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::mutex workers_mu;
+  bool stopping = false;
+
+  void handle(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      uint8_t op;
+      if (!read_full(fd, &op, 1)) break;
+      std::string key, val;
+      if (!read_blob(fd, &key)) break;
+      if (!read_blob(fd, &val)) break;
+      if (op == 0) {  // SET
+        {
+          std::lock_guard<std::mutex> g(store.mu);
+          store.data[key].assign(val.begin(), val.end());
+        }
+        store.cv.notify_all();
+        if (!write_blob(fd, nullptr, 0)) break;
+      } else if (op == 1) {  // GET (blocks until key exists)
+        std::vector<uint8_t> out;
+        {
+          std::unique_lock<std::mutex> g(store.mu);
+          store.cv.wait(g, [&] {
+            return stopping || store.data.count(key) > 0;
+          });
+          if (stopping) break;
+          out = store.data[key];
+        }
+        if (!write_blob(fd, out.data(), static_cast<uint32_t>(out.size())))
+          break;
+      } else if (op == 2) {  // ADD: value is i64 delta; returns new value
+        int64_t delta = 0;
+        if (val.size() == 8) memcpy(&delta, val.data(), 8);
+        int64_t cur = 0;
+        {
+          std::lock_guard<std::mutex> g(store.mu);
+          auto& slot = store.data[key];
+          if (slot.size() == 8) memcpy(&cur, slot.data(), 8);
+          cur += delta;
+          slot.resize(8);
+          memcpy(slot.data(), &cur, 8);
+        }
+        store.cv.notify_all();
+        if (!write_blob(fd, &cur, 8)) break;
+      } else if (op == 3) {  // CHECK
+        uint8_t present;
+        {
+          std::lock_guard<std::mutex> g(store.mu);
+          present = store.data.count(key) ? 1 : 0;
+        }
+        if (!write_blob(fd, &present, 1)) break;
+      } else if (op == 4) {  // DELETE
+        {
+          std::lock_guard<std::mutex> g(store.mu);
+          store.data.erase(key);
+        }
+        if (!write_blob(fd, nullptr, 0)) break;
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  void accept_loop() {
+    for (;;) {
+      int fd = ::accept(listen_fd, nullptr, nullptr);
+      if (fd < 0) break;  // listen_fd closed -> shutdown
+      std::lock_guard<std::mutex> g(workers_mu);
+      workers.emplace_back([this, fd] { handle(fd); });
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----
+
+void* tcpstore_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] { s->accept_loop(); });
+  return s;
+}
+
+int tcpstore_server_port(void* handle) {
+  return static_cast<Server*>(handle)->port;
+}
+
+void tcpstore_server_stop(void* handle) {
+  auto* s = static_cast<Server*>(handle);
+  {
+    std::lock_guard<std::mutex> g(s->store.mu);
+    s->stopping = true;
+  }
+  s->store.cv.notify_all();
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    std::lock_guard<std::mutex> g(s->workers_mu);
+    for (auto& t : s->workers)
+      if (t.joinable()) t.detach();  // blocked handlers exit on close
+  }
+  delete s;
+}
+
+// ---- client ----
+
+void* tcpstore_client_new(const char* host, int port) {
+  auto* c = new Client();
+  c->fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(c->fd);
+    delete c;
+    return nullptr;
+  }
+  if (::connect(c->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+      0) {
+    ::close(c->fd);
+    delete c;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return c;
+}
+
+void tcpstore_client_free(void* handle) {
+  auto* c = static_cast<Client*>(handle);
+  ::close(c->fd);
+  delete c;
+}
+
+static int request(Client* c, uint8_t op, const char* key, const void* val,
+                   uint32_t vlen, std::string* reply) {
+  std::lock_guard<std::mutex> g(c->mu);
+  uint32_t klen = static_cast<uint32_t>(strlen(key));
+  if (!write_full(c->fd, &op, 1)) return -1;
+  if (!write_blob(c->fd, key, klen)) return -1;
+  if (!write_blob(c->fd, val, vlen)) return -1;
+  if (!read_blob(c->fd, reply)) return -1;
+  return 0;
+}
+
+int tcpstore_set(void* handle, const char* key, const void* val, int vlen) {
+  std::string reply;
+  return request(static_cast<Client*>(handle), 0, key, val,
+                 static_cast<uint32_t>(vlen), &reply);
+}
+
+// Blocks until the key exists. Returns value length (truncated to maxlen),
+// or -1 on error.
+int tcpstore_get(void* handle, const char* key, void* buf, int maxlen) {
+  std::string reply;
+  if (request(static_cast<Client*>(handle), 1, key, nullptr, 0, &reply) != 0)
+    return -1;
+  int n = static_cast<int>(reply.size());
+  if (n > maxlen) n = maxlen;
+  memcpy(buf, reply.data(), static_cast<size_t>(n));
+  return static_cast<int>(reply.size());
+}
+
+long long tcpstore_add(void* handle, const char* key, long long delta) {
+  std::string reply;
+  int64_t d = delta;
+  if (request(static_cast<Client*>(handle), 2, key, &d, 8, &reply) != 0)
+    return -1;
+  int64_t out = 0;
+  if (reply.size() == 8) memcpy(&out, reply.data(), 8);
+  return out;
+}
+
+int tcpstore_check(void* handle, const char* key) {
+  std::string reply;
+  if (request(static_cast<Client*>(handle), 3, key, nullptr, 0, &reply) != 0)
+    return -1;
+  return reply.empty() ? 0 : reply[0];
+}
+
+int tcpstore_delete(void* handle, const char* key) {
+  std::string reply;
+  return request(static_cast<Client*>(handle), 4, key, nullptr, 0, &reply);
+}
+
+}  // extern "C"

@@ -1,0 +1,90 @@
+"""Native TCPStore: C++ server/client over loopback, concurrent clients,
+barrier. Mirrors reference test/cpp/phi/core/test_tcp_store semantics.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore, _native
+
+
+def test_native_library_builds():
+    assert _native() is not None, "g++ toolchain expected in this image"
+
+
+def test_set_get_roundtrip():
+    master = TCPStore(is_master=True)
+    client = TCPStore(port=master.port)
+    client.set("hello", b"world")
+    assert master.get("hello") == b"world"
+    assert client.check("hello")
+    assert not client.check("absent")
+    client.delete_key("hello")
+    assert not client.check("hello")
+    client.close()
+    master.close()
+
+
+def test_get_blocks_until_set():
+    master = TCPStore(is_master=True)
+    reader = TCPStore(port=master.port)
+    result = {}
+
+    def read():
+        result["v"] = reader.get("late-key")
+
+    t = threading.Thread(target=read)
+    t.start()
+    t.join(0.2)
+    assert t.is_alive()  # still blocked
+    master.set("late-key", b"now")
+    t.join(5)
+    assert not t.is_alive()
+    assert result["v"] == b"now"
+    reader.close()
+    master.close()
+
+
+def test_add_is_atomic_across_clients():
+    master = TCPStore(is_master=True)
+    clients = [TCPStore(port=master.port) for _ in range(4)]
+
+    def bump(c):
+        for _ in range(50):
+            c.add("counter", 1)
+
+    threads = [threading.Thread(target=bump, args=(c,)) for c in clients]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert master.add("counter", 0) == 200
+    for c in clients:
+        c.close()
+    master.close()
+
+
+def test_barrier():
+    master = TCPStore(is_master=True)
+    workers = [TCPStore(port=master.port) for _ in range(3)]
+    arrived = []
+
+    def work(i, c):
+        c.barrier("b0", 4)
+        arrived.append(i)
+
+    threads = [threading.Thread(target=work, args=(i, c))
+               for i, c in enumerate(workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(0.3)
+    assert all(t.is_alive() for t in threads)  # waiting for 4th
+    master.barrier("b0", 4)
+    for t in threads:
+        t.join(5)
+    assert sorted(arrived) == [0, 1, 2]
+    for c in workers:
+        c.close()
+    master.close()
