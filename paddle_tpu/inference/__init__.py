@@ -1,0 +1,114 @@
+"""paddle_tpu.inference — the deployment predictor.
+
+Analog of /root/reference/paddle/fluid/inference/api/analysis_predictor.h:105
+(``AnalysisPredictor``) + paddle_infer Python surface
+(python/paddle/inference/). The reference's predictor loads a serialized
+program, runs an IR pass pipeline (fusion/TRT), and executes with zero-copy
+IO. TPU-natively the program IS the optimization artifact — a StableHLO
+export compiled by XLA at load — so Config's pass machinery reduces to
+device/precision choices, and zero-copy IO to jax device_put.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor"]
+
+
+class Config:
+    """Reference paddle_infer.Config (api/paddle_api.h): model path +
+    device/precision knobs."""
+
+    def __init__(self, prog_file=None, params_file=None, model_dir=None):
+        # jit.save artifacts share a prefix; accept either convention
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self.model_prefix = prog_file or model_dir
+        self._device = "tpu"
+        self._precision = "float32"
+        self._memory_pool_mb = None
+
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._device = "tpu"  # accelerator of this build
+
+    def enable_tpu(self):
+        self._device = "tpu"
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def enable_memory_optim(self):
+        pass
+
+    def switch_ir_optim(self, flag=True):
+        pass  # XLA owns optimization
+
+    def precision(self, p):
+        self._precision = p
+
+
+class _IOTensor:
+    """Zero-copy-ish handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, store, name):
+        self._store = store
+        self._name = name
+
+    def copy_from_cpu(self, arr):
+        self._store[self._name] = np.asarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._store[self._name])
+
+    def shape(self):
+        return list(np.asarray(self._store[self._name]).shape)
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..jit.serialization import load
+
+        self._layer = load(config.model_prefix)
+        n = self._layer._meta.get("n_inputs", 1)
+        self._input_names = [f"x{i}" for i in range(n)]
+        self._inputs = {}
+        self._outputs = {}
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return _IOTensor(self._inputs, name)
+
+    def get_output_names(self):
+        return list(self._outputs)
+
+    def get_output_handle(self, name):
+        return _IOTensor(self._outputs, name)
+
+    def run(self, inputs=None):
+        """Either positional ndarray list, or pre-staged input handles."""
+        if inputs is None:
+            inputs = [self._inputs[n] for n in self._input_names]
+        outs = self._layer(*[
+            x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+            for x in inputs
+        ])
+        if not isinstance(outs, (tuple, list)):
+            outs = [outs]
+        self._outputs.clear()
+        result = []
+        for i, o in enumerate(outs):
+            arr = np.asarray(o._value if isinstance(o, Tensor) else o)
+            self._outputs[f"out{i}"] = arr
+            result.append(arr)
+        return result
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -438,3 +438,7 @@ def not_to_static(fn):
     """reference-compat marker; tracing follows values so this is advisory."""
     fn.__jit_not_to_static__ = True
     return fn
+
+from .serialization import TranslatedLayer, load, save  # noqa: E402,F401
+
+__all__ += ["save", "load", "TranslatedLayer"]

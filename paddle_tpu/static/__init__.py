@@ -1,0 +1,47 @@
+"""paddle_tpu.static — static-graph compatibility surface.
+
+The reference's static mode (Program/Executor, python/paddle/static/) is
+absorbed by jit tracing on TPU (SURVEY.md §7: PirInterpreter ← XLA). What
+remains meaningful is the declarative bits: ``InputSpec`` (trace
+signatures), and save/load_inference_model (paddle_tpu.jit.save/load over
+StableHLO artifacts).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["InputSpec", "save_inference_model", "load_inference_model"]
+
+
+class InputSpec:
+    """Reference python/paddle/static/input.py InputSpec: shape with None
+    for dynamic dims (exported as symbolic dims), dtype, name."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype!r}, "
+                f"name={self.name!r})")
+
+    def to_aval(self):
+        import jax
+
+        from ..core.dtype import to_jax_dtype
+
+        shape = tuple(1 if d is None or d < 0 else d for d in self.shape)
+        return jax.ShapeDtypeStruct(shape, to_jax_dtype(self.dtype))
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    raise NotImplementedError(
+        "program-based save_inference_model is absorbed by paddle_tpu.jit.save "
+        "(StableHLO export); use jit.save(layer, path, input_spec=[...])")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.load / paddle_tpu.inference.create_predictor")

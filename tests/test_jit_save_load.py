@@ -1,0 +1,88 @@
+"""jit.save/load (StableHLO export) + inference Predictor.
+
+Mirrors reference test/dygraph_to_static jit.save/load tests and
+inference predictor tests (§2.8).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import InputSpec
+
+
+def _mlp():
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(8, 32), nn.GELU(), nn.Linear(32, 4))
+
+
+def test_save_load_roundtrip(tmp_path):
+    model = _mlp()
+    path = str(tmp_path / "model")
+    x = paddle.to_tensor(np.random.rand(2, 8).astype(np.float32))
+    ref = model(x)
+    paddle.jit.save(model, path, input_spec=[InputSpec([2, 8], "float32")])
+
+    loaded = paddle.jit.load(path)
+    out = loaded(x)
+    np.testing.assert_allclose(np.asarray(out._value),
+                               np.asarray(ref._value), rtol=1e-5)
+
+
+def test_loaded_layer_is_inference_only(tmp_path):
+    model = _mlp()
+    path = str(tmp_path / "m2")
+    paddle.jit.save(model, path, input_spec=[InputSpec([1, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    with pytest.raises(RuntimeError):
+        loaded.train()
+
+
+def test_swap_weights_after_load(tmp_path):
+    """The program takes weights as inputs: new checkpoints need no re-export."""
+    model = _mlp()
+    path = str(tmp_path / "m3")
+    paddle.jit.save(model, path, input_spec=[InputSpec([2, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    # zero out weights -> output changes accordingly
+    sd = loaded.state_dict()
+    zeroed = {k: paddle.to_tensor(np.zeros_like(np.asarray(v._value)))
+              for k, v in sd.items()}
+    loaded.set_state_dict(zeroed)
+    x = paddle.to_tensor(np.random.rand(2, 8).astype(np.float32))
+    np.testing.assert_allclose(np.asarray(loaded(x)._value), 0.0, atol=1e-7)
+
+
+def test_resnet_export(tmp_path):
+    from paddle_tpu.vision import models
+
+    model = models.resnet18(num_classes=10)
+    path = str(tmp_path / "resnet")
+    paddle.jit.save(model, path,
+                    input_spec=[InputSpec([1, 3, 32, 32], "float32")])
+    loaded = paddle.jit.load(path)
+    x = paddle.to_tensor(np.random.rand(1, 3, 32, 32).astype(np.float32))
+    model.eval()
+    ref = model(x)
+    np.testing.assert_allclose(np.asarray(loaded(x)._value),
+                               np.asarray(ref._value), rtol=1e-4, atol=1e-5)
+
+
+def test_inference_predictor(tmp_path):
+    from paddle_tpu import inference
+
+    model = _mlp()
+    path = str(tmp_path / "pred")
+    paddle.jit.save(model, path, input_spec=[InputSpec([2, 8], "float32")])
+
+    config = inference.Config(path)
+    predictor = inference.create_predictor(config)
+    x = np.random.rand(2, 8).astype(np.float32)
+
+    names = predictor.get_input_names()
+    predictor.get_input_handle(names[0]).copy_from_cpu(x)
+    outs = predictor.run()
+    assert outs[0].shape == (2, 4)
+    model.eval()
+    ref = model(paddle.to_tensor(x))
+    np.testing.assert_allclose(outs[0], np.asarray(ref._value), rtol=1e-5)
