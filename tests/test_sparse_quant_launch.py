@@ -1,0 +1,129 @@
+"""sparse COO/CSR, quantization PTQ/QAT, and the process launcher."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import sparse
+
+
+def test_coo_create_and_dense_roundtrip():
+    indices = [[0, 1, 2], [1, 2, 0]]
+    values = [1.0, 2.0, 3.0]
+    st = sparse.sparse_coo_tensor(indices, values, shape=[3, 3])
+    assert st.is_sparse_coo() and st.nnz == 3
+    dense = np.asarray(st.to_dense()._value)
+    expect = np.zeros((3, 3), np.float32)
+    expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+    np.testing.assert_allclose(dense, expect)
+    np.testing.assert_allclose(np.asarray(st.values()._value), values)
+    assert st.indices().shape == [2, 3]
+
+
+def test_csr_create_and_views():
+    # matrix [[1,0,2],[0,3,0]]
+    st = sparse.sparse_csr_tensor([0, 2, 3], [0, 2, 1], [1.0, 2.0, 3.0],
+                                  shape=[2, 3])
+    assert st.is_sparse_csr()
+    np.testing.assert_allclose(np.asarray(st.to_dense()._value),
+                               [[1, 0, 2], [0, 3, 0]])
+    np.testing.assert_allclose(np.asarray(st.crows()._value), [0, 2, 3])
+    np.testing.assert_allclose(np.asarray(st.cols()._value), [0, 2, 1])
+
+
+def test_sparse_arithmetic_and_matmul():
+    a = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [1.0, 2.0], [2, 2])
+    b = sparse.sparse_coo_tensor([[0, 1], [1, 0]], [3.0, 4.0], [2, 2])
+    s = sparse.add(a, b)
+    np.testing.assert_allclose(np.asarray(s.to_dense()._value),
+                               [[1, 3], [4, 2]])
+    r = sparse.relu(sparse.sparse_coo_tensor([[0], [0]], [-5.0], [1, 1]))
+    assert float(np.asarray(r.values()._value)[0]) == 0.0
+    x = paddle.to_tensor(np.eye(2, dtype=np.float32))
+    y = sparse.matmul(a, x)
+    np.testing.assert_allclose(np.asarray(y._value), [[1, 0], [0, 2]])
+    m = sparse.masked_matmul(
+        paddle.to_tensor(np.ones((2, 2), np.float32)),
+        paddle.to_tensor(np.ones((2, 2), np.float32)), a)
+    np.testing.assert_allclose(np.asarray(m.values()._value), [2.0, 2.0])
+
+
+def test_quantize_dequantize():
+    from paddle_tpu.quantization import dequantize, quantize
+
+    x = paddle.to_tensor(np.array([0.5, -1.0, 1.0], np.float32))
+    q = quantize(x, scale=1.0)
+    d = dequantize(q, scale=1.0)
+    np.testing.assert_allclose(np.asarray(d._value),
+                               np.asarray(x._value), atol=0.01)
+
+
+def test_qat_fake_quant_training():
+    from paddle_tpu.quantization import (
+        FakeQuanterWithAbsMaxObserver,
+        QAT,
+        QuantConfig,
+    )
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    q = QAT(QuantConfig(activation=FakeQuanterWithAbsMaxObserver(),
+                        weight=FakeQuanterWithAbsMaxObserver()))
+    model = q.quantize(model)
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=model.parameters())
+    x = paddle.to_tensor(np.random.rand(16, 8).astype(np.float32))
+    t = paddle.to_tensor(np.random.rand(16, 1).astype(np.float32))
+    losses = []
+    for _ in range(8):
+        loss = ((model(x) - t) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_ptq_observer_collects_scale():
+    from paddle_tpu.quantization import AbsMaxObserver, PTQ, QuantConfig
+
+    model = nn.Sequential(nn.Linear(4, 4))
+    ptq = PTQ(QuantConfig(activation=AbsMaxObserver(), weight=None))
+    model = ptq.quantize(model)
+    x = paddle.to_tensor(np.array([[0.0, 2.5, -1.0, 0.1]], np.float32))
+    model(x)
+    obs = model._sub_layers["0"].act_q
+    assert abs(obs.scale() - 2.5) < 1e-6
+
+
+def test_launcher_runs_ranked_workers(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent("""
+        import os, sys
+        rank = os.environ["PADDLE_TRAINER_ID"]
+        n = os.environ["PADDLE_TRAINERS_NUM"]
+        master = os.environ["PADDLE_MASTER"]
+        print(f"rank={rank}/{n} master={master}", flush=True)
+    """))
+    from paddle_tpu.distributed.launch import launch
+
+    rc = launch(str(script), nproc_per_node=3, log_dir=str(tmp_path / "logs"))
+    assert rc == 0
+    logs = sorted(os.listdir(tmp_path / "logs"))
+    assert logs == ["worker.0.log", "worker.1.log", "worker.2.log"]
+    content = (tmp_path / "logs" / "worker.2.log").read_text()
+    assert "rank=2/3" in content
+
+
+def test_launcher_propagates_failure(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    from paddle_tpu.distributed.launch import launch
+
+    rc = launch(str(script), nproc_per_node=2)
+    assert rc == 3
