@@ -131,10 +131,12 @@ if SMOKE:
                       max_position_embeddings=256)
     BATCH, SEQ, STEPS = 2, 128, 3
 else:
-    cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
-                      intermediate_size=2816, num_hidden_layers=8,
-                      num_attention_heads=16, max_position_embeddings=1024)
-    BATCH, SEQ, STEPS = 8, 1024, 10
+    # sized for one v5e chip (16G HBM) with AdamW fp32 state: ~440M params
+    # -> 0.9G bf16 + 1.8G master + 3.5G moments + ~4.5G activations
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1536,
+                      intermediate_size=4096, num_hidden_layers=12,
+                      num_attention_heads=12, max_position_embeddings=1536)
+    BATCH, SEQ, STEPS = 4, 1536, 10
 
 log(f"building LLaMA h={cfg.hidden_size} L={cfg.num_hidden_layers} "
     f"batch={BATCH} seq={SEQ}...")
@@ -181,7 +183,10 @@ def one_step(carry, _i=None):
     return (new_p, new_a, new_m, t_step + 1), loss
 
 
-@jax.jit
+import functools  # noqa: E402
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
 def run_steps(p, a, m):
     (p, a, m, _), losses = jax.lax.scan(
         one_step, (p, a, m, jnp.asarray(1, jnp.int32)), None, length=STEPS)

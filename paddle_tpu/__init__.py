@@ -51,6 +51,7 @@ from .ops import *  # noqa: F401,F403
 from .ops import __all__ as _ops_all
 
 from . import amp  # noqa: F401
+from . import autograd  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import quantization  # noqa: F401
@@ -166,5 +167,6 @@ __all__ = (
         "metric",
         "save",
         "load",
+        "autograd",
     ]
 )

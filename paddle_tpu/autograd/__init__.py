@@ -1,0 +1,144 @@
+"""paddle_tpu.autograd — public autograd surface: PyLayer, backward, grad.
+
+Analog of /root/reference/python/paddle/autograd/ (py_layer.py ``PyLayer``
++ backward_mode.py ``backward``) and the C++ PyLayer plumbing
+(paddle/fluid/eager/pylayer/). PyLayer lets model code define custom
+forward/backward pairs — the mechanism the reference's TP/SP/recompute
+layers are built from; here it creates one GradNode whose backward calls
+the user's ``backward`` with a ``PyLayerContext``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core import autograd as _engine
+from ..core.autograd import GradNode
+from ..core.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext", "backward", "grad",
+           "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled"]
+
+backward = _engine.backward
+grad = _engine.grad
+no_grad = _engine.no_grad
+enable_grad = _engine.enable_grad
+is_grad_enabled = _engine.is_grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    return _engine.enable_grad() if mode else _engine.no_grad()
+
+
+class PyLayerContext:
+    """ctx passed to forward/backward (reference py_layer.py
+    PyLayerContext): save_for_backward / saved_tensor + attribute stash."""
+
+    def __init__(self):
+        self._saved = ()
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = saved_tensor
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    def __init__(cls, name, bases, attrs):
+        super().__init__(name, bases, attrs)
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    """Custom differentiable op::
+
+        class Scale(PyLayer):
+            @staticmethod
+            def forward(ctx, x, alpha):
+                ctx.save_for_backward(x)
+                ctx.alpha = alpha
+                return x * alpha
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return grad * ctx.alpha    # one grad per tensor input
+
+    ``backward`` returns one gradient per *tensor* input of forward (None
+    for non-differentiable ones), as in the reference.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs_grad = _engine.is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+
+        if not needs_grad:
+            with _engine.no_grad():
+                return cls.forward(ctx, *args, **kwargs)
+
+        with _engine.no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outputs, (tuple, list))
+        out_list = [outputs] if single else list(outputs)
+
+        edges, needs = [], []
+        for t in tensor_inputs:
+            if not t.stop_gradient:
+                edges.append(t._grad_edge())
+                needs.append(True)
+            else:
+                edges.append(None)
+                needs.append(False)
+        out_shapes = [
+            (o._value.shape, o._value.dtype) if isinstance(o, Tensor) else None
+            for o in out_list
+        ]
+
+        def backward_fn(grad_outputs):
+            gouts = []
+            for g, meta in zip(grad_outputs, out_shapes):
+                if g is None and meta is not None and ctx._materialize_grads:
+                    g = jnp.zeros(meta[0], meta[1])
+                gouts.append(Tensor._from_value(g) if g is not None else None)
+            with _engine.no_grad():
+                grads = cls.backward(ctx, *gouts)
+            if not isinstance(grads, (tuple, list)):
+                grads = (grads,)
+            if len(grads) != len(tensor_inputs):
+                raise RuntimeError(
+                    f"{cls.__name__}.backward returned {len(grads)} grads "
+                    f"for {len(tensor_inputs)} tensor inputs")
+            return tuple(
+                (g._value if isinstance(g, Tensor) else g) if need else None
+                for g, need in zip(grads, needs))
+
+        node = GradNode(cls.__name__, backward_fn, edges, len(out_list),
+                        tuple(needs))
+        results = []
+        for i, o in enumerate(out_list):
+            if isinstance(o, Tensor) and jnp.issubdtype(
+                    o._value.dtype, jnp.inexact):
+                t = Tensor._from_value(o._value)
+                t.stop_gradient = False
+                t._grad_node = node
+                t._grad_slot = i
+                results.append(t)
+            else:
+                results.append(o)
+        return results[0] if single else tuple(results)

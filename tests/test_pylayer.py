@@ -1,0 +1,89 @@
+"""PyLayer custom fwd/bwd (reference python/paddle/autograd/py_layer.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+class Scale(PyLayer):
+    @staticmethod
+    def forward(ctx, x, alpha):
+        ctx.save_for_backward(x)
+        ctx.alpha = alpha
+        return x * alpha
+
+    @staticmethod
+    def backward(ctx, grad):
+        (x,) = ctx.saved_tensor()
+        return grad * ctx.alpha
+
+
+class TwoOut(PyLayer):
+    @staticmethod
+    def forward(ctx, x):
+        return x * 2, x * 3
+
+    @staticmethod
+    def backward(ctx, g1, g2):
+        return g1 * 2 + g2 * 3
+
+
+def test_pylayer_basic():
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32), stop_gradient=False)
+    y = Scale.apply(x, 5.0)
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), 5.0 * np.ones(4))
+
+
+def test_pylayer_multiple_outputs():
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    a, b = TwoOut.apply(x)
+    (a.sum() + b.sum()).backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), 5.0 * np.ones(3))
+
+
+def test_pylayer_partial_use():
+    """Only one output consumed: the other's grad arrives as zeros."""
+    x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+    a, b = TwoOut.apply(x)
+    a.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), 2.0 * np.ones(3))
+
+
+def test_pylayer_no_grad_inputs():
+    x = paddle.to_tensor(np.ones(3, np.float32))  # stop_gradient
+    y = Scale.apply(x, 2.0)
+    assert y.stop_gradient or y._grad_node is None  # plain forward
+
+
+def test_pylayer_composes_with_layers():
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    x = paddle.to_tensor(np.random.rand(2, 4).astype(np.float32))
+    out = Scale.apply(lin(x), 3.0)
+    out.sum().backward()
+    assert lin.weight.grad is not None
+    # grad of weight = 3 * x^T @ ones
+    expect = 3.0 * np.asarray(x._value).T @ np.ones((2, 4), np.float32)
+    np.testing.assert_allclose(np.asarray(lin.weight.grad._value), expect,
+                               rtol=1e-5)
+
+
+def test_pylayer_bad_grad_count():
+    class Bad(PyLayer):
+        @staticmethod
+        def forward(ctx, x, y):
+            return x + y
+
+        @staticmethod
+        def backward(ctx, g):
+            return g  # should be 2 grads
+
+    x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    y = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    out = Bad.apply(x, y)
+    with pytest.raises(RuntimeError, match="grads"):
+        out.sum().backward()
