@@ -51,6 +51,7 @@ from .ops import *  # noqa: F401,F403
 from .ops import __all__ as _ops_all
 
 from . import amp  # noqa: F401
+from . import audio  # noqa: F401
 from . import autograd  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
@@ -58,7 +59,9 @@ from . import quantization  # noqa: F401
 from . import sparse  # noqa: F401
 from . import static  # noqa: F401
 from . import distributed  # noqa: F401
+from . import device  # noqa: F401
 from . import distribution  # noqa: F401
+from . import geometric  # noqa: F401
 from . import hapi  # noqa: F401
 from . import io  # noqa: F401
 from . import jit  # noqa: F401

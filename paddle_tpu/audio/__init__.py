@@ -1,0 +1,181 @@
+"""paddle_tpu.audio — audio feature extraction.
+
+Analog of /root/reference/python/paddle/audio/ (features/layers.py:
+Spectrogram, MelSpectrogram, LogMelSpectrogram, MFCC; functional/: window
+functions, mel scale conversions) built on the FFT op family — which on
+TPU lowers to XLA's FFT.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = [
+    "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
+    "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+    "compute_fbank_matrix", "get_window", "create_dct",
+]
+
+
+def hz_to_mel(freq, htk=False):
+    if htk:
+        return 2595.0 * np.log10(1.0 + np.asarray(freq) / 700.0)
+    f = np.asarray(freq, dtype=np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(f >= min_log_hz,
+                    min_log_mel + np.log(np.maximum(f, 1e-10) / min_log_hz) / logstep,
+                    mels)
+
+
+def mel_to_hz(mel, htk=False):
+    if htk:
+        return 700.0 * (10.0 ** (np.asarray(mel) / 2595.0) - 1.0)
+    m = np.asarray(mel, dtype=np.float64)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    return np.where(m >= min_log_mel,
+                    min_log_hz * np.exp(logstep * (m - min_log_mel)), freqs)
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk), n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return np.linspace(0, sr / 2, n_fft // 2 + 1)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney"):
+    f_max = f_max or sr / 2
+    fftfreqs = fft_frequencies(sr, n_fft)
+    melfreqs = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(melfreqs)
+    ramps = melfreqs[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (melfreqs[2:n_mels + 2] - melfreqs[:n_mels])
+        weights *= enorm[:, None]
+    return weights.astype(np.float32)
+
+
+def get_window(window, win_length, fftbins=True):
+    n = win_length
+    if window == "hann":
+        return np.hanning(n + 1)[:-1] if fftbins else np.hanning(n)
+    if window == "hamming":
+        return np.hamming(n + 1)[:-1] if fftbins else np.hamming(n)
+    if window == "blackman":
+        return np.blackman(n + 1)[:-1] if fftbins else np.blackman(n)
+    if window in ("rect", "boxcar", "ones"):
+        return np.ones(n)
+    raise ValueError(f"unsupported window {window!r}")
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho"):
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[:, None]
+    dct = np.cos(math.pi / n_mels * (n + 0.5) * k)
+    if norm == "ortho":
+        dct[0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    return dct.T.astype(np.float32)  # (n_mels, n_mfcc)
+
+
+def _frame(x, frame_length, hop_length):
+    n = (x.shape[-1] - frame_length) // hop_length + 1
+    idx = (np.arange(frame_length)[None, :]
+           + hop_length * np.arange(n)[:, None])
+    return x[..., idx]  # (..., n_frames, frame_length)
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = get_window(window, self.win_length)
+        if self.win_length < n_fft:  # center-pad window
+            pad = (n_fft - self.win_length) // 2
+            w = np.pad(w, (pad, n_fft - self.win_length - pad))
+        self.register_buffer("window", Tensor(w.astype(np.float32)),
+                             persistable=False)
+
+    def forward(self, x):
+        v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        if self.center:
+            pad = self.n_fft // 2
+            mode = self.pad_mode if self.pad_mode != "reflect" else "reflect"
+            v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)], mode=mode)
+        frames = _frame(v, self.n_fft, self.hop_length)
+        spec = jnp.fft.rfft(frames * self.window._value, axis=-1)
+        mag = jnp.abs(spec) ** self.power
+        return Tensor._from_value(jnp.swapaxes(mag, -1, -2))  # (..., freq, t)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32", **kwargs):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, **kwargs)
+        fb = compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk, norm)
+        self.register_buffer("fbank", Tensor(fb), persistable=False)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+        mel = jnp.einsum("mf,...ft->...mt", self.fbank._value, spec._value)
+        return Tensor._from_value(mel)
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, ref_value=1.0, amin=1e-10, top_db=None,
+                 **kwargs):
+        super().__init__()
+        self.mel = MelSpectrogram(sr=sr, **kwargs)
+        self.amin = amin
+        self.ref_value = ref_value
+        self.top_db = top_db
+
+    def forward(self, x):
+        m = self.mel(x)._value
+        log_spec = 10.0 * jnp.log10(jnp.maximum(m, self.amin))
+        log_spec -= 10.0 * math.log10(max(self.ref_value, self.amin))
+        if self.top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - self.top_db)
+        return Tensor._from_value(log_spec)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_mels=64, **kwargs):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, n_mels=n_mels, **kwargs)
+        self.register_buffer("dct", Tensor(create_dct(n_mfcc, n_mels)),
+                             persistable=False)
+
+    def forward(self, x):
+        lm = self.log_mel(x)._value
+        out = jnp.einsum("mk,...mt->...kt", self.dct._value, lm)
+        return Tensor._from_value(out)

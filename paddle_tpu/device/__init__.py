@@ -1,0 +1,214 @@
+"""paddle_tpu.device — device management surface.
+
+Analog of /root/reference/python/paddle/device/ (set_device, cuda streams/
+events/memory stats, synchronize). TPU-native: streams/events/graphs are
+XLA's concern (async dispatch + compiled programs), so those APIs are
+honest no-ops; memory introspection maps to PJRT ``memory_stats`` — the
+counterpart of paddle.device.cuda.max_memory_allocated over
+paddle/phi/core/memory/stats.h.
+"""
+from __future__ import annotations
+
+from ..core.place import (  # noqa: F401
+    CPUPlace,
+    CustomPlace,
+    Place,
+    TPUPlace,
+    device_count,
+    get_device,
+    set_device,
+)
+
+__all__ = [
+    "set_device", "get_device", "device_count", "synchronize",
+    "get_available_device", "get_available_custom_device",
+    "memory_stats", "max_memory_allocated", "max_memory_reserved",
+    "memory_allocated", "memory_reserved", "empty_cache",
+    "Stream", "Event", "current_stream", "stream_guard",
+    "cuda", "tpu", "is_compiled_with_cuda", "is_compiled_with_rocm",
+]
+
+
+def synchronize(device=None):
+    """Block until pending device work completes."""
+    import jax
+    import jax.numpy as jnp
+
+    jnp.zeros(()).block_until_ready()
+
+
+def get_available_device():
+    import jax
+
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return []
+
+
+def is_compiled_with_cuda():
+    return False
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+# ------------------------------------------------------------ memory stats
+
+def _stats(device_id=0):
+    import jax
+
+    dev = jax.local_devices()[device_id]
+    try:
+        return dev.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_stats(device=None):
+    return _stats(_device_id(device))
+
+
+def _device_id(device):
+    if device is None:
+        return 0
+    if isinstance(device, int):
+        return device
+    if isinstance(device, str) and ":" in device:
+        return int(device.rsplit(":", 1)[1])
+    return 0
+
+
+def memory_allocated(device=None):
+    return int(_stats(_device_id(device)).get("bytes_in_use", 0))
+
+
+def max_memory_allocated(device=None):
+    s = _stats(_device_id(device))
+    return int(s.get("peak_bytes_in_use", s.get("bytes_in_use", 0)))
+
+
+def memory_reserved(device=None):
+    s = _stats(_device_id(device))
+    return int(s.get("bytes_reserved", s.get("bytes_in_use", 0)))
+
+
+def max_memory_reserved(device=None):
+    return max_memory_allocated(device)
+
+
+def empty_cache():
+    """The XLA allocator manages its own pool; kept for API parity."""
+    return None
+
+
+# ------------------------------------------------------------ streams/events
+
+class Stream:
+    """Compute-stream handle (reference device/cuda/streams.py Stream).
+    XLA owns scheduling; the object exists for API parity and ordering is
+    provided by data dependencies."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+_current = Stream()
+
+
+def current_stream(device=None):
+    return _current
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def stream_guard(stream):
+    yield
+
+
+class _DeviceNamespace:
+    """paddle.device.cuda-compatible namespace served by the TPU backend."""
+
+    Stream = Stream
+    Event = Event
+
+    @staticmethod
+    def synchronize(device=None):
+        synchronize(device)
+
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return memory_allocated(device)
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return max_memory_allocated(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return memory_reserved(device)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return max_memory_reserved(device)
+
+    @staticmethod
+    def empty_cache():
+        return empty_cache()
+
+    @staticmethod
+    def current_stream(device=None):
+        return current_stream(device)
+
+    @staticmethod
+    def stream_guard(stream):
+        return stream_guard(stream)
+
+    @staticmethod
+    def get_device_properties(device=None):
+        import jax
+
+        d = jax.local_devices()[_device_id(device)]
+        return type("DeviceProperties", (), {
+            "name": getattr(d, "device_kind", d.platform),
+            "total_memory": _stats(_device_id(device)).get(
+                "bytes_limit", 0),
+        })()
+
+
+cuda = _DeviceNamespace()  # reference-compat alias: paddle.device.cuda.*
+tpu = _DeviceNamespace()

@@ -135,3 +135,7 @@ get_hybrid_communicate_group = fleet.get_hybrid_communicate_group
 from .ring_attention import RingAttention, ring_attention  # noqa: F401
 
 __all__ += ["ring_attention", "RingAttention"]
+
+from .elastic import CommTaskManager, ElasticManager, ElasticStatus, watch  # noqa: F401
+
+__all__ += ["ElasticManager", "ElasticStatus", "CommTaskManager", "watch"]

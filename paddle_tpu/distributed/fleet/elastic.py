@@ -1,0 +1,150 @@
+"""Elastic training manager + comm watchdog.
+
+Analogs of /root/reference/python/paddle/distributed/fleet/elastic/
+manager.py (ElasticManager:125 — host heartbeats over etcd leases, scale
+in/out, fault tolerance :457) and the C++ comm watchdog
+(paddle/phi/core/distributed/comm_task_manager.h:37 — background thread
+tracking in-flight collectives with timeouts + debug dumps).
+
+TPU-native adaptation: the KV substrate is the native TCPStore
+(paddle_tpu/native/tcp_store.cpp) instead of etcd; in-program collectives
+are XLA's (no per-collective task objects), so the watchdog tracks
+*host-side* phases — checkpoint barriers, store waits, step heartbeats —
+the places a TPU job actually wedges.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+__all__ = ["ElasticManager", "ElasticStatus", "CommTaskManager", "watch"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    """Track live hosts by heartbeat keys; report scale events."""
+
+    def __init__(self, store=None, rank=0, world_size=1,
+                 heartbeat_interval=2.0, lease=6.0, prefix="elastic"):
+        from ..store import TCPStore
+
+        self.store = store or TCPStore(is_master=(rank == 0))
+        self.rank = rank
+        self.world_size = world_size
+        self.interval = heartbeat_interval
+        self.lease = lease
+        self.prefix = prefix
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _key(self, rank):
+        return f"{self.prefix}/host/{rank}"
+
+    def start(self):
+        def beat():
+            while not self._stop.is_set():
+                self.store.set(self._key(self.rank),
+                               str(time.time()).encode())
+                self._stop.wait(self.interval)
+
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread:
+            self._thread.join(self.interval + 1)
+
+    def alive_ranks(self):
+        """Ranks whose heartbeat is within the lease (reference
+        _update_hosts)."""
+        now = time.time()
+        alive = []
+        for r in range(self.world_size):
+            key = self._key(r)
+            if not self.store.check(key):
+                continue
+            try:
+                t = float(self.store.get(key).decode())
+            except (ValueError, RuntimeError):
+                continue
+            if now - t <= self.lease:
+                alive.append(r)
+        return alive
+
+    def health_check(self):
+        """COMPLETED if all ranks beat recently; RESTART when some died
+        (reference _update_fault_tolerance)."""
+        alive = self.alive_ranks()
+        if len(alive) == self.world_size:
+            return ElasticStatus.COMPLETED
+        if len(alive) == 0:
+            return ElasticStatus.EXIT
+        return ElasticStatus.RESTART
+
+
+class CommTaskManager:
+    """Watchdog for host-side phases: register a task, it must complete
+    within ``timeout`` or the on_timeout hook fires with a dump."""
+
+    def __init__(self, timeout=1800.0, poll_interval=1.0, on_timeout=None):
+        self.timeout = timeout
+        self.poll = poll_interval
+        self.on_timeout = on_timeout or self._default_dump
+        self._tasks = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._watch, daemon=True)
+        self._thread.start()
+
+    def _default_dump(self, name, started, elapsed):
+        import sys
+
+        print(f"[comm watchdog] task {name!r} exceeded {self.timeout}s "
+              f"(elapsed {elapsed:.1f}s)", file=sys.stderr)
+
+    def _watch(self):
+        while not self._stop.wait(self.poll):
+            now = time.time()
+            with self._lock:
+                for name, started in list(self._tasks.items()):
+                    if now - started > self.timeout:
+                        self.on_timeout(name, started, now - started)
+                        self._tasks.pop(name, None)
+
+    def start_task(self, name):
+        with self._lock:
+            self._tasks[name] = time.time()
+
+    def end_task(self, name):
+        with self._lock:
+            self._tasks.pop(name, None)
+
+    def pending(self):
+        with self._lock:
+            return list(self._tasks)
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(self.poll + 1)
+
+
+import contextlib
+
+
+@contextlib.contextmanager
+def watch(manager: CommTaskManager, name: str):
+    """Scope a watched phase: ``with watch(mgr, "ckpt-barrier"): ...``"""
+    manager.start_task(name)
+    try:
+        yield
+    finally:
+        manager.end_task(name)

@@ -1,0 +1,102 @@
+"""paddle_tpu.geometric — graph learning primitives.
+
+Analog of /root/reference/python/paddle/geometric/ (message passing
+send_u_recv/send_ue_recv, segment ops, sampling). Segment reductions map to
+``jax.ops.segment_*`` (XLA scatter — the role of the reference's CUDA
+segment kernels).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _num_segments(segment_ids, n):
+    if n is not None:
+        return int(n)
+    return int(jnp.max(_v(segment_ids))) + 1
+
+
+def segment_sum(data, segment_ids, num_segments=None):
+    out = jax.ops.segment_sum(_v(data), _v(segment_ids),
+                              _num_segments(segment_ids, num_segments))
+    return Tensor._from_value(out)
+
+
+def segment_mean(data, segment_ids, num_segments=None):
+    n = _num_segments(segment_ids, num_segments)
+    s = jax.ops.segment_sum(_v(data), _v(segment_ids), n)
+    cnt = jax.ops.segment_sum(jnp.ones(_v(data).shape[0]), _v(segment_ids), n)
+    cnt = jnp.maximum(cnt, 1.0)
+    return Tensor._from_value(s / cnt.reshape((-1,) + (1,) * (s.ndim - 1)))
+
+
+def segment_max(data, segment_ids, num_segments=None):
+    out = jax.ops.segment_max(_v(data), _v(segment_ids),
+                              _num_segments(segment_ids, num_segments))
+    return Tensor._from_value(out)
+
+
+def segment_min(data, segment_ids, num_segments=None):
+    out = jax.ops.segment_min(_v(data), _v(segment_ids),
+                              _num_segments(segment_ids, num_segments))
+    return Tensor._from_value(out)
+
+
+_REDUCERS = {"sum": segment_sum, "mean": segment_mean,
+             "max": segment_max, "min": segment_min}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None):
+    """Gather source-node features along edges, reduce at destinations
+    (reference geometric/message_passing/send_recv.py)."""
+    msgs = _v(x)[_v(src_index)]
+    n = out_size or _v(x).shape[0]
+    return _REDUCERS[reduce_op](Tensor._from_value(msgs), dst_index, n)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None):
+    """Node⊕edge message passing."""
+    msgs = _v(x)[_v(src_index)]
+    e = _v(y)
+    if message_op == "add":
+        msgs = msgs + e
+    elif message_op == "mul":
+        msgs = msgs * e
+    elif message_op == "sub":
+        msgs = msgs - e
+    elif message_op == "div":
+        msgs = msgs / e
+    else:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    n = out_size or _v(x).shape[0]
+    return _REDUCERS[reduce_op](Tensor._from_value(msgs), dst_index, n)
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add"):
+    """Per-edge messages from both endpoints."""
+    xs = _v(x)[_v(src_index)]
+    yd = _v(y)[_v(dst_index)]
+    if message_op == "add":
+        out = xs + yd
+    elif message_op == "mul":
+        out = xs * yd
+    elif message_op == "sub":
+        out = xs - yd
+    elif message_op == "div":
+        out = xs / yd
+    else:
+        raise ValueError(f"unsupported message_op {message_op!r}")
+    return Tensor._from_value(out)

@@ -6,3 +6,4 @@ callbacks, model_summary).
 from . import summary as _summary_mod  # noqa: F401
 from .model import Callback, Model, ModelCheckpoint, ProgBarLogger  # noqa: F401
 from .summary import summary  # noqa: F401
+from .dynamic_flops import flops  # noqa: F401

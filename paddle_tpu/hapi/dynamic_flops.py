@@ -1,0 +1,87 @@
+"""FLOPs estimation — analog of
+/root/reference/python/paddle/hapi/dynamic_flops.py (``paddle.flops``):
+hook-based per-layer FLOP counting over one forward pass.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..nn.layer_base import Layer
+
+__all__ = ["flops"]
+
+
+def _numel(shape):
+    return int(np.prod([d for d in shape if d is not None])) if shape else 0
+
+
+def _count(layer, inputs, output):
+    from ..nn.layers_common import Embedding, Linear
+    from ..nn.layers_conv import Conv1D, Conv2D, Conv3D
+    from ..nn.layers_norm import LayerNorm, RMSNorm, _BatchNormBase
+
+    x = inputs[0] if inputs else None
+    out_shape = getattr(output, "shape", None)
+    if isinstance(layer, Linear):
+        batch = _numel(x.shape[:-1]) if x is not None else 1
+        return 2 * batch * layer.in_features * layer.out_features
+    if isinstance(layer, (Conv1D, Conv2D, Conv3D)):
+        if out_shape is None:
+            return 0
+        kernel = _numel(layer.kernel_size) * (layer.in_channels // layer.groups)
+        return 2 * _numel(out_shape) * kernel
+    if isinstance(layer, Embedding):
+        return 0
+    if isinstance(layer, (LayerNorm, RMSNorm, _BatchNormBase)):
+        return 2 * _numel(x.shape) if x is not None else 0
+    return 0
+
+
+def flops(net: Layer, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False):
+    """Total multiply-add FLOPs of one forward pass."""
+    import paddle_tpu as paddle
+
+    total = {"flops": 0}
+    details = []
+    hooks = []
+    custom_ops = custom_ops or {}
+
+    def make_hook(layer):
+        def hook(l, ins, out):
+            fn = custom_ops.get(type(l))
+            n = fn(l, ins, out) if fn else _count(l, ins, out)
+            total["flops"] += n
+            if n and print_detail:
+                details.append((type(l).__name__, n))
+            return None
+
+        return hook
+
+    for _, sub in net.named_sublayers(include_self=True):
+        hooks.append(sub.register_forward_post_hook(make_hook(sub)))
+
+    was_training = net.training
+    net.eval()
+    try:
+        if inputs is None:
+            if input_size is None:
+                raise ValueError("flops() needs input_size or inputs")
+            inputs = [paddle.zeros(shape=list(input_size))]
+        elif not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        from ..core import autograd
+
+        with autograd.no_grad():
+            net(*inputs)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    if print_detail:
+        for name, n in details:
+            print(f"  {name}: {n/1e6:.2f} MFLOPs")
+        print(f"Total FLOPs: {total['flops']/1e9:.4f} GFLOPs")
+    return total["flops"]

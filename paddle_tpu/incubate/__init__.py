@@ -1,2 +1,3 @@
 """paddle_tpu.incubate — incubating APIs (reference python/paddle/incubate/)."""
 from . import nn  # noqa: F401
+from . import asp  # noqa: F401

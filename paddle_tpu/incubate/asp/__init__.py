@@ -1,0 +1,84 @@
+"""paddle_tpu.incubate.asp — 2:4 structured sparsity (Automatic SParsity).
+
+Analog of /root/reference/python/paddle/incubate/asp/ (prune_model,
+decorate, calculate_density, supported_layers): mask Linear/Conv weights to
+n:m patterns and re-apply masks after each optimizer step so training stays
+inside the sparse support.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+
+__all__ = ["calculate_density", "create_mask", "prune_model", "decorate",
+           "check_sparsity"]
+
+_masks: dict[int, jnp.ndarray] = {}
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def create_mask(weight, n=2, m=4):
+    """Keep the n largest-magnitude entries of each group of m along the
+    last axis (reference create_mask, MaskAlgo_MASK_1D)."""
+    arr = np.asarray(weight._value if isinstance(weight, Tensor) else weight)
+    flat = arr.reshape(-1, m) if arr.size % m == 0 else None
+    if flat is None:
+        return np.ones_like(arr)
+    order = np.argsort(-np.abs(flat), axis=1)
+    mask = np.zeros_like(flat)
+    np.put_along_axis(mask, order[:, :n], 1.0, axis=1)
+    return mask.reshape(arr.shape)
+
+
+def check_sparsity(x, n=2, m=4) -> bool:
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    if arr.size % m:
+        return False
+    nz = (arr.reshape(-1, m) != 0).sum(axis=1)
+    return bool((nz <= n).all())
+
+
+def _prunable_params(model):
+    from ...nn.layers_common import Linear
+    from ...nn.layers_conv import Conv2D
+
+    for _, sub in model.named_sublayers(include_self=True):
+        if isinstance(sub, (Linear, Conv2D)):
+            if sub.weight is not None:
+                yield sub.weight
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to all supported layer weights."""
+    for p in _prunable_params(model):
+        mask = jnp.asarray(create_mask(p, n, m), p._value.dtype)
+        p._value = p._value * mask
+        _masks[id(p)] = mask
+    return model
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply masks after each update (reference
+    asp decorate → OptimizerWithSparsityGuarantee)."""
+
+    class _ASPOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+
+        def step(self):
+            self._inner.step()
+            for p in self._inner._parameter_list:
+                mask = _masks.get(id(p))
+                if mask is not None:
+                    p._value = p._value * mask
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+    return _ASPOptimizer(optimizer)

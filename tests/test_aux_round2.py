@@ -1,0 +1,134 @@
+"""Second aux batch: device stats, audio features, geometric, ASP,
+elastic manager, comm watchdog, flops estimator."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_device_surface():
+    from paddle_tpu import device
+
+    assert device.device_count() >= 1
+    device.synchronize()
+    stats = device.memory_stats()
+    assert isinstance(stats, dict)
+    assert device.cuda.device_count() >= 1  # compat namespace
+    s = device.Stream()
+    e = s.record_event()
+    e.synchronize()
+    props = device.cuda.get_device_properties()
+    assert hasattr(props, "name")
+
+
+def test_audio_features():
+    from paddle_tpu import audio
+
+    sr = 16000
+    t = np.linspace(0, 1, sr, dtype=np.float32)
+    wav = paddle.to_tensor(np.sin(2 * np.pi * 440 * t)[None, :])
+
+    spec = audio.Spectrogram(n_fft=512, hop_length=256)(wav)
+    assert spec.shape[1] == 257  # n_fft//2+1 freq bins
+    # 440 Hz -> bin 440/(16000/512) = 14
+    mag = np.asarray(spec._value)[0].mean(axis=1)
+    assert abs(int(mag.argmax()) - 14) <= 1
+
+    mel = audio.MelSpectrogram(sr=sr, n_fft=512, n_mels=40)(wav)
+    assert mel.shape[1] == 40
+    mfcc = audio.MFCC(sr=sr, n_mfcc=13, n_mels=40, n_fft=512)(wav)
+    assert mfcc.shape[1] == 13
+
+    m = audio.hz_to_mel(1000.0)
+    np.testing.assert_allclose(audio.mel_to_hz(m), 1000.0, rtol=1e-6)
+
+
+def test_geometric_segment_and_message_passing():
+    from paddle_tpu import geometric as G
+
+    data = paddle.to_tensor(np.array([[1.0], [2.0], [3.0], [4.0]], np.float32))
+    seg = paddle.to_tensor(np.array([0, 0, 1, 1]))
+    np.testing.assert_allclose(
+        np.asarray(G.segment_sum(data, seg)._value), [[3.0], [7.0]])
+    np.testing.assert_allclose(
+        np.asarray(G.segment_mean(data, seg)._value), [[1.5], [3.5]])
+    np.testing.assert_allclose(
+        np.asarray(G.segment_max(data, seg)._value), [[2.0], [4.0]])
+
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2]))
+    dst = paddle.to_tensor(np.array([1, 2, 1]))
+    out = G.send_u_recv(x, src, dst, "sum")
+    expect = np.zeros((3, 3), np.float32)
+    expect[1] = [1, 0, 1]
+    expect[2] = [0, 1, 0]
+    np.testing.assert_allclose(np.asarray(out._value), expect)
+
+
+def test_asp_two_four_sparsity():
+    from paddle_tpu.incubate import asp
+
+    paddle.seed(0)
+    model = nn.Linear(16, 16)
+    asp.prune_model(model)
+    assert asp.check_sparsity(model.weight)
+    assert abs(asp.calculate_density(model.weight) - 0.5) < 0.01
+
+    opt = asp.decorate(paddle.optimizer.SGD(learning_rate=0.1,
+                                            parameters=model.parameters()))
+    x = paddle.to_tensor(np.random.rand(4, 16).astype(np.float32))
+    model(x).sum().backward()
+    opt.step()
+    assert asp.check_sparsity(model.weight)  # mask survives the update
+
+
+def test_elastic_manager_heartbeats():
+    from paddle_tpu.distributed.fleet import ElasticManager, ElasticStatus
+    from paddle_tpu.distributed.store import TCPStore
+
+    master = TCPStore(is_master=True)
+    m0 = ElasticManager(store=master, rank=0, world_size=2,
+                        heartbeat_interval=0.1, lease=1.0).start()
+    worker_store = TCPStore(port=master.port)
+    m1 = ElasticManager(store=worker_store, rank=1, world_size=2,
+                        heartbeat_interval=0.1, lease=1.0).start()
+    time.sleep(0.3)
+    assert m0.health_check() == ElasticStatus.COMPLETED
+    m1.stop()
+    time.sleep(1.2)
+    assert m0.health_check() == ElasticStatus.RESTART
+    m0.stop()
+    master.close()
+    worker_store.close()
+
+
+def test_comm_watchdog_fires_on_timeout():
+    from paddle_tpu.distributed.fleet import CommTaskManager, watch
+
+    fired = []
+    mgr = CommTaskManager(timeout=0.3, poll_interval=0.05,
+                          on_timeout=lambda n, s, e: fired.append(n))
+    with watch(mgr, "fast-phase"):
+        pass
+    mgr.start_task("stuck-phase")
+    time.sleep(0.6)
+    assert fired == ["stuck-phase"]
+    assert "fast-phase" not in mgr.pending()
+    mgr.shutdown()
+
+
+def test_flops_estimator():
+    from paddle_tpu.hapi import flops
+
+    model = nn.Sequential(nn.Linear(64, 128), nn.ReLU(), nn.Linear(128, 10))
+    n = flops(model, input_size=[1, 64])
+    assert n == 2 * (64 * 128 + 128 * 10)
+
+    from paddle_tpu.vision import models
+
+    r = models.resnet18(num_classes=10)
+    n = flops(r, input_size=[1, 3, 32, 32])
+    assert n > 5e7  # resnet18 @32x32 ~ 0.07 GFLOPs-ish (2x for mul+add)
