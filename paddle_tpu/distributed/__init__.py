@@ -9,9 +9,11 @@ ProcessGroupNCCL ← HLO collectives over ICI/DCN. What remains host-side is
 this package: mesh/placement metadata, the collective API surface, hybrid-
 parallel layer wrappers, and checkpointing.
 """
+from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import comm_ops  # noqa: F401
 from . import fleet  # noqa: F401
+from .auto_parallel import DistModel, Strategy, to_static  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .api import (  # noqa: F401
     dtensor_from_fn,
@@ -67,4 +69,5 @@ __all__ = [
     "all_reduce", "all_gather", "broadcast", "reduce", "scatter",
     "all_to_all", "reduce_scatter", "send", "recv",
     "DataParallel", "ParallelEnv", "comm_ops",
+    "Strategy", "DistModel", "to_static",
 ]

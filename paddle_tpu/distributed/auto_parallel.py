@@ -1,0 +1,121 @@
+"""Semi-auto parallel high level: Strategy + DistModel + dist.to_static.
+
+Analog of /root/reference/python/paddle/distributed/auto_parallel/api.py
+(Strategy:1851, DistModel:2132, to_static:2715): wrap a sharded model +
+loss + optimizer into one compiled distributed training step. The TPU-
+native compiled step is paddle_tpu.jit.TrainStep — fwd+bwd+update in one
+donated XLA program over whatever mesh shardings the parameters carry
+(GSPMD partitions the whole step; the reference reaches the same place via
+Engine._parallel_pir and the pass pipeline).
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+__all__ = ["Strategy", "DistModel", "to_static"]
+
+
+class _Config(dict):
+    def __getattr__(self, k):
+        try:
+            return self[k]
+        except KeyError as e:
+            raise AttributeError(k) from e
+
+    def __setattr__(self, k, v):
+        self[k] = v
+
+
+class Strategy:
+    """reference api.py:1851 — knob tree with sharding/amp/pipeline nodes."""
+
+    def __init__(self, config=None):
+        config = config or {}
+        self.sharding = _Config(enable=False, degree=1, stage=1,
+                                **config.get("sharding", {}))
+        self.amp = _Config(enable=False, dtype="bfloat16", level="O1",
+                           **config.get("amp", {}))
+        self.pipeline = _Config(enable=False, schedule_mode="1F1B",
+                                micro_batch_size=1, accumulate_steps=1,
+                                **config.get("pipeline", {}))
+        self.gradient_merge = _Config(enable=False, k_steps=1,
+                                      **config.get("gradient_merge", {}))
+        self.fused_passes = _Config(enable=False, fused_passes_list=[],
+                                    **config.get("fused_passes", {}))
+
+
+class DistModel:
+    """reference api.py:2132 — train()/eval()/predict() mode switches and a
+    __call__ that runs one compiled step."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None):
+        self.network = layer
+        self._loss = loss
+        self._optimizer = optimizer
+        self._strategy = strategy or Strategy()
+        self._mode = "train"
+        self._train_step = None
+        self._labels_holder = {}
+        if self._strategy.amp.enable and self._strategy.amp.level == "O2":
+            from ..amp import decorate
+
+            decorate(layer, optimizer, level="O2",
+                     dtype=self._strategy.amp.dtype)
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+        return self
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+        return self
+
+    def __call__(self, *args):
+        if self._mode == "predict" or self._loss is None:
+            from ..core import autograd
+
+            with autograd.no_grad():
+                return self.network(*args)
+        *inputs, labels = args
+        if self._mode == "eval":
+            from ..core import autograd
+
+            with autograd.no_grad():
+                out = self.network(*inputs)
+                return self._loss(out, labels)
+        if self._train_step is None:
+            from ..jit import TrainStep
+
+            holder = self._labels_holder
+
+            def loss_fn(*outs):
+                out = outs[0] if len(outs) == 1 else outs
+                return self._loss(out, holder["y"])
+
+            self._train_step = TrainStep(self.network, loss_fn,
+                                         self._optimizer)
+        self._labels_holder["y"] = labels
+        return self._train_step(*inputs)
+
+    def state_dict(self, mode="all"):
+        sd = dict(self.network.state_dict())
+        if mode in ("all", "opt") and self._optimizer is not None:
+            sd.update({f"opt.{k}": v
+                       for k, v in self._optimizer.state_dict().items()})
+        return sd
+
+    def dist_main_program(self, mode=None):
+        return None  # no Program object: the artifact is the XLA executable
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """reference api.py:2715 ``dist.to_static``."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
