@@ -82,6 +82,15 @@ def _make_public(op_name):
 
 globals().update({name: _make_public(name) for name in OPS})
 
+
+def einsum(equation, *operands):
+    """Reference paddle.einsum(equation, *operands) — variadic surface over
+    the registered einsum op (python/paddle/tensor/einsum.py)."""
+    from .registry import apply_op
+
+    return apply_op(OPS["einsum"], equation, list(operands))
+
+
 __all__ = list(OPS)
 
 

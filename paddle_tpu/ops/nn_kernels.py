@@ -479,10 +479,12 @@ def conv2d_transpose(
         padding = [(padding, padding), (padding, padding)]
     if groups != 1:
         raise NotImplementedError("grouped conv_transpose not yet supported")
-    # weight layout: (in, out, kh, kw) — paddle convention
+    # weight layout: (in, out, kh, kw) — paddle convention. With
+    # transpose_kernel=True lax swaps the kernel's I/O axes internally, so
+    # pass HWIO with I=out, O=in.
     out = lax.conv_transpose(
         x,
-        jnp.transpose(weight, (2, 3, 0, 1)),  # HWIO with I=in
+        jnp.transpose(weight, (2, 3, 1, 0)),
         strides=tuple(stride),
         padding=padding if not isinstance(padding, str) else padding.upper(),
         rhs_dilation=tuple(dilation),

@@ -1,0 +1,616 @@
+"""OpTest sweep — the analog of the reference's OpTest harness
+(/root/reference/test/legacy_test/op_test.py:418): every registered op gets
+at least one case; forward is checked against a NumPy oracle where one
+exists; differentiable ops are checked against central finite differences.
+
+The completeness gate (test_every_op_has_a_case) fails whenever a new op
+lands without a case here — enforcing SURVEY.md §4's "≥1 case per op".
+"""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.ops.registry import OPS
+
+rng = np.random.RandomState(1234)
+
+
+def T(arr):
+    return paddle.to_tensor(np.asarray(arr))
+
+
+def P(shape, lo=-1.0, hi=1.0):
+    return (rng.rand(*shape) * (hi - lo) + lo).astype(np.float32)
+
+
+def PP(shape):  # strictly positive
+    return (rng.rand(*shape) * 0.9 + 0.1).astype(np.float32)
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x._value)
+    if isinstance(x, (tuple, list)):
+        return [_np(v) for v in x]
+    return np.asarray(x)
+
+
+def _sigmoid(v):
+    return 1.0 / (1.0 + np.exp(-v))
+
+
+# ---------------------------------------------------------------- case table
+# op -> (args_fn, ref_fn | None, check_grad: bool)
+# args_fn returns (args, kwargs); ref_fn gets the *numpy* args.
+
+A = {}
+
+
+def case(name, args_fn, ref=None, grad=True):
+    A[name] = (args_fn, ref, grad)
+
+
+# ---- smooth unary elementwise: (domain_fn, numpy_ref)
+UNARY = {
+    "abs": (lambda: P((3, 4), 0.2, 1.0), np.abs),
+    "acos": (lambda: P((3, 4), -0.8, 0.8), np.arccos),
+    "acosh": (lambda: P((3, 4), 1.2, 3.0), np.arccosh),
+    "asin": (lambda: P((3, 4), -0.8, 0.8), np.arcsin),
+    "asinh": (lambda: P((3, 4)), np.arcsinh),
+    "atan": (lambda: P((3, 4)), np.arctan),
+    "atanh": (lambda: P((3, 4), -0.8, 0.8), np.arctanh),
+    "cos": (lambda: P((3, 4)), np.cos),
+    "cosh": (lambda: P((3, 4)), np.cosh),
+    "erf": (lambda: P((3, 4)), None),
+    "erfinv": (lambda: P((3, 4), -0.7, 0.7), None),
+    "exp": (lambda: P((3, 4)), np.exp),
+    "expm1": (lambda: P((3, 4)), np.expm1),
+    "log": (lambda: PP((3, 4)), np.log),
+    "log10": (lambda: PP((3, 4)), np.log10),
+    "log1p": (lambda: PP((3, 4)), np.log1p),
+    "log2": (lambda: PP((3, 4)), np.log2),
+    "negative": (lambda: P((3, 4)), np.negative),
+    "reciprocal": (lambda: PP((3, 4)), np.reciprocal),
+    "rsqrt": (lambda: PP((3, 4)), lambda v: 1 / np.sqrt(v)),
+    "sigmoid": (lambda: P((3, 4)), _sigmoid),
+    "sin": (lambda: P((3, 4)), np.sin),
+    "sinh": (lambda: P((3, 4)), np.sinh),
+    "sqrt": (lambda: PP((3, 4)), np.sqrt),
+    "square": (lambda: P((3, 4)), np.square),
+    "tan": (lambda: P((3, 4), -1.0, 1.0), np.tan),
+    "tanh": (lambda: P((3, 4)), np.tanh),
+    "log_sigmoid": (lambda: P((3, 4)), lambda v: np.log(_sigmoid(v))),
+    "softsign": (lambda: P((3, 4)), lambda v: v / (1 + np.abs(v))),
+    "silu": (lambda: P((3, 4)), lambda v: v * _sigmoid(v)),
+    "swish": (lambda: P((3, 4)), lambda v: v * _sigmoid(v)),
+    "mish": (lambda: P((3, 4)), None),
+    "hardswish": (lambda: P((3, 4), 1.0, 2.0), None),
+    "gelu": (lambda: P((3, 4)), None),
+    "relu": (lambda: P((3, 4), 0.1, 1.0), lambda v: np.maximum(v, 0)),
+    "relu6": (lambda: P((3, 4), 0.1, 1.0), lambda v: np.clip(v, 0, 6)),
+    "elu": (lambda: P((3, 4), 0.1, 1.0), None),
+    "celu": (lambda: P((3, 4), 0.1, 1.0), None),
+    "selu": (lambda: P((3, 4), 0.1, 1.0), None),
+    "tanhshrink": (lambda: P((3, 4)), lambda v: v - np.tanh(v)),
+    "frac": (lambda: P((3, 4), 0.1, 0.9), lambda v: v - np.trunc(v)),
+    "logit": (lambda: P((3, 4), 0.2, 0.8), lambda v: np.log(v / (1 - v))),
+}
+for name, (dom, ref) in UNARY.items():
+    case(name, lambda dom=dom: (((T(dom())),), {}),
+         (lambda v, _r=ref: _r(v)) if ref else None)
+
+# ---- non-differentiable unary
+for name, dom, ref in [
+    ("ceil", lambda: P((3, 4)), np.ceil),
+    ("floor", lambda: P((3, 4)), np.floor),
+    ("round", lambda: P((3, 4)), np.round),
+    ("trunc", lambda: P((3, 4)), np.trunc),
+    ("sign", lambda: P((3, 4)), np.sign),
+    ("isfinite", lambda: P((3, 4)), np.isfinite),
+    ("isinf", lambda: P((3, 4)), np.isinf),
+    ("isnan", lambda: P((3, 4)), np.isnan),
+    ("logical_not", lambda: rng.rand(3, 4) > 0.5, np.logical_not),
+    ("bitwise_not", lambda: rng.randint(0, 8, (3, 4)), np.bitwise_not),
+]:
+    case(name, lambda dom=dom: ((T(dom()),), {}),
+         (lambda v, _r=ref: _r(v)) if ref else None, grad=False)
+
+# ---- binary elementwise
+BINARY = {
+    "add": np.add, "subtract": np.subtract, "multiply": np.multiply,
+    "maximum": np.maximum, "minimum": np.minimum,
+    "atan2": np.arctan2,
+}
+for name, ref in BINARY.items():
+    case(name, lambda: ((T(P((3, 4))), T(P((3, 4)))), {}),
+         (lambda x, y, _r=ref: _r(x, y)))
+case("divide", lambda: ((T(P((3, 4))), T(PP((3, 4)))), {}), np.divide)
+case("pow", lambda: ((T(PP((3, 4))), T(P((3, 4), 1.0, 2.0))), {}), np.power)
+case("remainder", lambda: ((T(PP((3, 4))), T(PP((3, 4)))), {}),
+     np.remainder, grad=False)
+case("floor_divide", lambda: ((T(PP((3, 4)) * 10), T(PP((3, 4)) * 3)), {}),
+     np.floor_divide, grad=False)
+for name, ref in [("equal", np.equal), ("not_equal", np.not_equal),
+                  ("greater_than", np.greater), ("greater_equal", np.greater_equal),
+                  ("less_than", np.less), ("less_equal", np.less_equal)]:
+    case(name, lambda: ((T(P((3, 4))), T(P((3, 4)))), {}),
+         (lambda x, y, _r=ref: _r(x, y)), grad=False)
+for name, ref in [("logical_and", np.logical_and), ("logical_or", np.logical_or),
+                  ("logical_xor", np.logical_xor)]:
+    case(name, lambda: ((T(rng.rand(3, 4) > 0.5), T(rng.rand(3, 4) > 0.5)), {}),
+         (lambda x, y, _r=ref: _r(x, y)), grad=False)
+for name, ref in [("bitwise_and", np.bitwise_and), ("bitwise_or", np.bitwise_or),
+                  ("bitwise_xor", np.bitwise_xor)]:
+    case(name, lambda: ((T(rng.randint(0, 8, (3, 4))),
+                         T(rng.randint(0, 8, (3, 4)))), {}),
+         (lambda x, y, _r=ref: _r(x, y)), grad=False)
+
+# ---- reductions
+case("sum", lambda: ((T(P((3, 4))),), {"axis": 1}),
+     lambda v: v.sum(axis=1))
+case("mean", lambda: ((T(P((3, 4))),), {"axis": 0}),
+     lambda v: v.mean(axis=0))
+case("prod", lambda: ((T(PP((3, 3))),), {"axis": 1}),
+     lambda v: v.prod(axis=1))
+case("max", lambda: ((T((lambda: rng.permutation(np.arange(12, dtype=np.float32)).reshape(3, 4) * 0.1)()),), {"axis": 1}), lambda v: v.max(axis=1))
+case("min", lambda: ((T((lambda: rng.permutation(np.arange(12, dtype=np.float32)).reshape(3, 4) * 0.1)()),), {"axis": 1}), lambda v: v.min(axis=1))
+case("amax", lambda: ((T((lambda: rng.permutation(np.arange(12, dtype=np.float32)).reshape(3, 4) * 0.1)()),), {"axis": 1}), lambda v: v.max(axis=1))
+case("amin", lambda: ((T((lambda: rng.permutation(np.arange(12, dtype=np.float32)).reshape(3, 4) * 0.1)()),), {"axis": 1}), lambda v: v.min(axis=1))
+case("var", lambda: ((T(P((3, 4))),), {"axis": 1}),
+     lambda v: v.var(axis=1, ddof=1))
+case("std", lambda: ((T(P((3, 4))),), {"axis": 1}),
+     lambda v: v.std(axis=1, ddof=1))
+case("logsumexp", lambda: ((T(P((3, 4))),), {"axis": 1}),
+     lambda v: np.log(np.exp(v).sum(axis=1)))
+case("median", lambda: ((T(P((3, 5))),), {"axis": 1}),
+     lambda v: np.median(v, axis=1), grad=False)
+case("quantile", lambda: ((T(P((3, 5))),), {"q": 0.5, "axis": 1}),
+     lambda v: np.quantile(v, 0.5, axis=1), grad=False)
+case("nansum", lambda: ((T(P((3, 4))),), {}), np.nansum)
+case("nanmean", lambda: ((T(P((3, 4))),), {}), np.nanmean)
+case("all", lambda: ((T(rng.rand(3, 4) > 0.2),), {}), np.all, grad=False)
+case("any", lambda: ((T(rng.rand(3, 4) > 0.8),), {}), np.any, grad=False)
+case("count_nonzero", lambda: ((T(rng.randint(0, 2, (3, 4))),), {}),
+     np.count_nonzero, grad=False)
+case("cumsum", lambda: ((T(P((3, 4))),), {"axis": 1}),
+     lambda v: v.cumsum(axis=1))
+case("cumprod", lambda: ((T(PP((3, 4))),), {"dim": 1}),
+     lambda v: v.cumprod(axis=1))
+case("cummax", lambda: ((T(P((3, 4))),), {"axis": 1}),
+     lambda v: np.maximum.accumulate(v, axis=1), grad=False)
+
+# ---- matmul family
+case("matmul", lambda: ((T(P((3, 4))), T(P((4, 5)))), {}), np.matmul)
+case("mm", lambda: ((T(P((3, 4))), T(P((4, 5)))), {}), np.matmul)
+case("bmm", lambda: ((T(P((2, 3, 4))), T(P((2, 4, 5)))), {}), np.matmul)
+case("mv", lambda: ((T(P((3, 4))), T(P((4,)))), {}), np.matmul)
+case("dot", lambda: ((T(P((4,))), T(P((4,)))), {}), np.dot)
+case("inner", lambda: ((T(P((3, 4))), T(P((5, 4)))), {}), np.inner)
+case("outer", lambda: ((T(P((3,))), T(P((4,)))), {}), np.outer)
+case("kron", lambda: ((T(P((2, 2))), T(P((2, 3)))), {}), np.kron)
+case("addmm", lambda: ((T(P((3, 5))), T(P((3, 4))), T(P((4, 5)))), {}),
+     lambda i, x, y: i + x @ y)
+case("einsum", lambda: (("ij,jk->ik", T(P((3, 4))), T(P((4, 5)))), {}),
+     None)
+case("linear", lambda: ((T(P((3, 4))), T(P((4, 5))), T(P((5,)))), {}),
+     lambda x, w, b: x @ w + b)
+case("trace", lambda: ((T(P((4, 4))),), {}), np.trace)
+
+# ---- shape / indexing (forward vs numpy; grads via finite diff where cheap)
+case("reshape", lambda: ((T(P((3, 4))),), {"shape": [4, 3]}),
+     lambda v: v.reshape(4, 3))
+case("transpose", lambda: ((T(P((3, 4))),), {"perm": [1, 0]}),
+     lambda v: v.T)
+case("flatten", lambda: ((T(P((2, 3, 4))),), {"start_axis": 1}),
+     lambda v: v.reshape(2, 12))
+case("squeeze", lambda: ((T(P((3, 1, 4))),), {"axis": 1}),
+     lambda v: v.squeeze(1))
+case("unsqueeze", lambda: ((T(P((3, 4))),), {"axis": 0}),
+     lambda v: v[None])
+case("flip", lambda: ((T(P((3, 4))),), {"axis": [0]}),
+     lambda v: np.flip(v, 0))
+case("roll", lambda: ((T(P((3, 4))),), {"shifts": 1, "axis": 0}),
+     lambda v: np.roll(v, 1, 0))
+case("tile", lambda: ((T(P((2, 3))),), {"repeat_times": [2, 2]}),
+     lambda v: np.tile(v, (2, 2)))
+case("expand", lambda: ((T(P((1, 4))),), {"shape": [3, 4]}),
+     lambda v: np.broadcast_to(v, (3, 4)))
+case("expand_as", lambda: ((T(P((1, 4))), T(P((3, 4)))), {}),
+     lambda v, y: np.broadcast_to(v, (3, 4)))
+case("broadcast_to", lambda: ((T(P((1, 4))),), {"shape": [3, 4]}),
+     lambda v: np.broadcast_to(v, (3, 4)))
+case("concat", lambda: (([T(P((2, 3))), T(P((2, 3)))],), {"axis": 0}),
+     None)
+case("stack", lambda: (([T(P((2, 3))), T(P((2, 3)))],), {"axis": 0}), None)
+case("split", lambda: ((T(P((4, 6))),), {"num_or_sections": 2, "axis": 1}),
+     None, grad=False)
+case("chunk", lambda: ((T(P((4, 6))),), {"chunks": 2, "axis": 1}),
+     None, grad=False)
+case("unbind", lambda: ((T(P((3, 4))),), {"axis": 0}), None, grad=False)
+case("slice", lambda: ((T(P((4, 6))),),
+                       {"axes": [0, 1], "starts": [1, 0], "ends": [3, 4]}),
+     lambda v: v[1:3, 0:4])
+case("strided_slice", lambda: ((T(P((6,))),),
+                               {"axes": [0], "starts": [0], "ends": [6],
+                                "strides": [2]}),
+     lambda v: v[0:6:2])
+case("gather", lambda: ((T(P((5, 3))), T(np.array([0, 2]))), {"axis": 0}),
+     lambda v, i: v[[0, 2]])
+case("gather_nd", lambda: ((T(P((3, 4))),
+                            T(np.array([[0, 1], [2, 2]]))), {}),
+     lambda v, i: v[[0, 2], [1, 2]])
+case("index_select", lambda: ((T(P((5, 3))), T(np.array([0, 2]))),
+                              {"axis": 0}),
+     lambda v, i: v[[0, 2]])
+case("take_along_axis", lambda: ((T(P((3, 4))),
+                                  T(np.array([[0], [1], [2]]))), {"axis": 1}),
+     lambda v, i: np.take_along_axis(v, np.array([[0], [1], [2]]), 1))
+case("put_along_axis", lambda: ((T(P((3, 4))), T(np.array([[0], [1], [2]])),
+                                 T(P((3, 1)))), {"axis": 1}), None,
+     grad=False)
+case("index_put", lambda: ((T(P((3, 4))), [T(np.array([0, 1]))],
+                            T(P((2, 4)))), {}), None, grad=False)
+case("scatter", lambda: ((T(P((4, 3))), T(np.array([1, 3])),
+                          T(P((2, 3)))), {}), None, grad=False)
+case("scatter_nd_add", lambda: ((T(P((4,))), T(np.array([[1], [2]])),
+                                 T(P((2,)))), {}), None, grad=False)
+case("masked_fill", lambda: ((T(P((3, 4))), T(rng.rand(3, 4) > 0.5)),
+                             {"value": 0.5}), None)
+case("masked_select", lambda: ((T(P((3, 4))), T(rng.rand(3, 4) > 0.5)), {}),
+     None, grad=False)
+case("where", lambda: ((T(rng.rand(3, 4) > 0.5), T(P((3, 4))),
+                        T(P((3, 4)))), {}),
+     lambda c, x, y: np.where(c, x, y))
+case("nonzero", lambda: ((T(np.array([0.0, 1.0, 0.0, 2.0])),), {}),
+     None, grad=False)
+case("tril", lambda: ((T(P((4, 4))),), {}), np.tril)
+case("triu", lambda: ((T(P((4, 4))),), {}), np.triu)
+case("diag", lambda: ((T(P((4,))),), {}), np.diag)
+case("diagonal", lambda: ((T(P((4, 4))),), {}),
+     lambda v: np.diagonal(v, 0, 0, 1))
+case("pad", lambda: ((T(P((2, 3))),), {"paddings": [1, 1, 0, 0]}), None)
+case("repeat_interleave", lambda: ((T(P((3,))),), {"repeats": 2}),
+     lambda v: np.repeat(v, 2))
+case("meshgrid", lambda: (([T(P((3,))), T(P((4,)))],), {}), None,
+     grad=False)
+case("_getitem", lambda: ((T(P((4, 5))),), {"idx": (slice(1, 3),)}),
+     lambda v: v[1:3])
+case("as_strided", lambda: ((T(P((4, 4))),),
+                            {"shape": [2, 2], "stride": [4, 1],
+                             "offset": 0}), None, grad=False)
+
+# ---- sort / search
+case("sort", lambda: ((T(P((3, 4))),), {"axis": 1}),
+     lambda v: np.sort(v, 1), grad=False)
+case("argsort", lambda: ((T(P((3, 4))),), {"axis": 1}),
+     lambda v: np.argsort(v, 1, kind="stable"), grad=False)
+case("argmax", lambda: ((T(P((3, 4))),), {"axis": 1}),
+     lambda v: v.argmax(1), grad=False)
+case("argmin", lambda: ((T(P((3, 4))),), {"axis": 1}),
+     lambda v: v.argmin(1), grad=False)
+case("topk", lambda: ((T(P((3, 6))),), {"k": 2}), None, grad=False)
+case("searchsorted", lambda: ((T(np.array([1.0, 3.0, 5.0])),
+                               T(np.array([2.0, 4.0]))), {}),
+     lambda s, v: np.searchsorted(s, v), grad=False)
+case("unique", lambda: ((T(np.array([3, 1, 2, 1, 3])),), {}),
+     None, grad=False)
+case("bincount", lambda: ((T(np.array([0, 1, 1, 3])), None), {}),
+     lambda v: np.bincount(v), grad=False)
+case("histogram", lambda: ((T(P((20,), 0.0, 1.0)),),
+                           {"bins": 4, "min": 0.0, "max": 1.0}),
+     None, grad=False)
+case("allclose", lambda: ((T(P((3,))), T(P((3,)))), {}), None, grad=False)
+case("isclose", lambda: ((T(P((3,))), T(P((3,)))), {}), None, grad=False)
+
+# ---- creation (forward-only)
+case("arange", lambda: ((), {"start": 0, "end": 5, "step": 1}),
+     None, grad=False)
+case("linspace", lambda: ((), {"start": 0.0, "stop": 1.0, "num": 5}),
+     None, grad=False)
+case("eye", lambda: ((), {"num_rows": 3}), None, grad=False)
+case("full", lambda: ((), {"shape": [2, 2], "fill_value": 7.0}),
+     None, grad=False)
+case("full_like", lambda: ((T(P((2, 2))),), {"fill_value": 7.0}),
+     None, grad=False)
+case("ones", lambda: ((), {"shape": [2, 3]}), None, grad=False)
+case("ones_like", lambda: ((T(P((2, 3))),), {}), None, grad=False)
+case("zeros", lambda: ((), {"shape": [2, 3]}), None, grad=False)
+case("zeros_like", lambda: ((T(P((2, 3))),), {}), None, grad=False)
+case("assign", lambda: ((T(P((2, 3))),), {}), lambda v: v)
+case("cast", lambda: ((T(P((2, 3))),), {"dtype": "float64"}), None,
+     grad=False)
+case("one_hot", lambda: ((T(np.array([0, 2, 1])),), {"num_classes": 3}),
+     None, grad=False)
+
+# ---- random (statistical smoke only)
+for name, kwargs in [
+    ("uniform", {"shape": [64], "min": 0.0, "max": 1.0}),
+    ("gaussian", {"shape": [64], "mean": 0.0, "std": 1.0}),
+    ("randint", {"low": 0, "high": 5, "shape": [64]}),
+    ("randperm", {"n": 16}),
+]:
+    case(name, lambda kwargs=kwargs: ((), kwargs), None, grad=False)
+case("bernoulli", lambda: ((T(np.full((64,), 0.5, np.float32)),), {}),
+     None, grad=False)
+case("multinomial", lambda: ((T(np.full((4,), 0.25, np.float32)),),
+                             {"num_samples": 2}), None, grad=False)
+case("dropout", lambda: ((T(P((8, 8))),), {"p": 0.5}), None, grad=False)
+case("alpha_dropout", lambda: ((T(P((8, 8))),), {"p": 0.5}), None,
+     grad=False)
+case("gumbel_softmax", lambda: ((T(P((4, 5))),), {}), None, grad=False)
+
+# ---- linalg
+case("cholesky", lambda: ((T(np.eye(3, dtype=np.float32) * 2.0),), {}),
+     lambda v: np.linalg.cholesky(v))
+case("det", lambda: ((T(P((3, 3)) + 2 * np.eye(3, dtype=np.float32)),), {}),
+     np.linalg.det)
+case("slogdet", lambda: ((T(P((3, 3)) + 2 * np.eye(3, dtype=np.float32)),),
+                         {}), None, grad=False)
+case("inverse", lambda: ((T(P((3, 3)) + 2 * np.eye(3, dtype=np.float32)),),
+                         {}), np.linalg.inv)
+case("matrix_power", lambda: ((T(P((3, 3))),), {"n": 2}),
+     lambda v: v @ v)
+case("matrix_norm", lambda: ((T(P((3, 4))),), {}),
+     lambda v: np.linalg.norm(v, "fro"), grad=False)
+case("norm", lambda: ((T(P((3, 4))),), {}),
+     lambda v: np.linalg.norm(v), grad=False)
+case("p_norm", lambda: ((T(P((3, 4))),), {"porder": 2, "axis": 1}),
+     lambda v: np.linalg.norm(v, 2, 1))
+case("l2_normalize", lambda: ((T(P((3, 4))),), {"axis": 1}),
+     lambda v: v / np.linalg.norm(v, 2, 1, keepdims=True))
+case("qr", lambda: ((T(P((4, 3))),), {}), None, grad=False)
+case("svd", lambda: ((T(P((4, 3))),), {}), None, grad=False)
+case("eig", lambda: ((T(P((3, 3))),), {}), None, grad=False)
+case("eigh", lambda: ((T(np.eye(3, dtype=np.float32)),), {}), None,
+     grad=False)
+case("pinv", lambda: ((T(P((4, 3))),), {}), np.linalg.pinv, grad=False)
+case("solve", lambda: ((T(P((3, 3)) + 2 * np.eye(3, dtype=np.float32)),
+                        T(P((3, 2)))), {}),
+     lambda a, b: np.linalg.solve(a, b))
+case("lstsq", lambda: ((T(P((4, 3))), T(P((4, 2)))), {}), None,
+     grad=False)
+case("triangular_solve",
+     lambda: ((T(np.triu(P((3, 3)) + 2 * np.eye(3, dtype=np.float32))),
+               T(P((3, 2)))), {}),
+     lambda a, b: np.linalg.solve(a, b))
+case("cross", lambda: ((T(P((2, 3))), T(P((2, 3)))), {}),
+     lambda x, y: np.cross(x, y))
+case("lerp", lambda: ((T(P((3,))), T(P((3,))), T(PP((3,)))), {}),
+     lambda x, y, w: x + w * (y - x))
+case("nan_to_num", lambda: ((T(np.array([1.0, np.nan, np.inf])),), {}),
+     np.nan_to_num, grad=False)
+case("clip", lambda: ((T(P((3, 4))),), {"min": -0.5, "max": 0.5}),
+     lambda v: np.clip(v, -0.5, 0.5))
+case("scale", lambda: ((T(P((3, 4))),), {"scale": 2.0, "bias": 1.0}),
+     lambda v: 2 * v + 1)
+
+# ---- fft
+case("fft", lambda: ((T(P((8,))),), {}), np.fft.fft, grad=False)
+case("ifft", lambda: ((T(P((8,)).astype(np.complex64)),), {}),
+     np.fft.ifft, grad=False)
+case("rfft", lambda: ((T(P((8,))),), {}), np.fft.rfft, grad=False)
+case("irfft", lambda: ((T(np.fft.rfft(P((8,))).astype(np.complex64)),), {}),
+     None, grad=False)
+case("fft2", lambda: ((T(P((4, 4))),), {}), np.fft.fft2, grad=False)
+case("ifft2", lambda: ((T(P((4, 4)).astype(np.complex64)),), {}),
+     np.fft.ifft2, grad=False)
+case("fftshift", lambda: ((T(P((5,))),), {}), np.fft.fftshift, grad=False)
+case("ifftshift", lambda: ((T(P((5,))),), {}), np.fft.ifftshift,
+     grad=False)
+
+# ---- nn ops
+case("softmax", lambda: ((T(P((3, 4))),), {}),
+     lambda v: np.exp(v) / np.exp(v).sum(-1, keepdims=True))
+case("log_softmax", lambda: ((T(P((3, 4))),), {}),
+     lambda v: v - v.max(-1, keepdims=True)
+     - np.log(np.exp(v - v.max(-1, keepdims=True)).sum(-1, keepdims=True)))
+case("leaky_relu", lambda: ((T(P((3, 4), 0.1, 1.0)),), {}),
+     lambda v: np.where(v > 0, v, 0.01 * v))
+case("hardtanh", lambda: ((T(P((3, 4))),), {}),
+     lambda v: np.clip(v, -1, 1))
+case("hardsigmoid", lambda: ((T(P((3, 4))),), {}), None)
+case("hardshrink", lambda: ((T(P((3, 4), 0.6, 1.0)),), {}), None)
+case("softshrink", lambda: ((T(P((3, 4), 0.6, 1.0)),), {}), None)
+case("softplus", lambda: ((T(P((3, 4))),), {}),
+     lambda v: np.log1p(np.exp(v)))
+case("maxout", lambda: ((T(P((2, 4, 3, 3))),), {"groups": 2}), None)
+case("prelu", lambda: ((T(P((2, 3), 0.2, 1.0)), T(np.array([0.25], np.float32))), {}),
+     None)
+case("glu", lambda: ((T(P((3, 4))),), {}),
+     lambda v: v[:, :2] * _sigmoid(v[:, 2:]))
+case("embedding", lambda: ((T(np.array([[0, 2]])), T(P((5, 3)))), {}),
+     lambda i, w: w[[[0, 2]]])
+case("label_smooth", lambda: ((T(np.eye(3, dtype=np.float32)), None),
+                              {"epsilon": 0.1}), None)
+case("cosine_similarity", lambda: ((T(P((3, 4))), T(P((3, 4)))), {}),
+     lambda x, y: (x * y).sum(-1) /
+     (np.linalg.norm(x, 2, -1) * np.linalg.norm(y, 2, -1)))
+case("layer_norm", lambda: ((T(P((3, 4))), T(PP((4,))), T(P((4,)))), {}),
+     lambda x, w, b: (x - x.mean(-1, keepdims=True)) /
+     np.sqrt(x.var(-1, keepdims=True) + 1e-5) * w + b)
+case("rms_norm", lambda: ((T(P((3, 4))), T(PP((4,))), None), {}),
+     lambda x, w: x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6) * w)
+case("group_norm", lambda: ((T(P((2, 4, 3, 3))), T(PP((4,))),
+                             T(P((4,)))), {"groups": 2}), None)
+case("instance_norm", lambda: ((T(P((2, 3, 4, 4))), None, None), {}), None)
+case("batch_norm", lambda: ((T(P((4, 3))), T(np.zeros(3, np.float32)),
+                             T(np.ones(3, np.float32)),
+                             T(np.ones(3, np.float32)),
+                             T(np.zeros(3, np.float32))),
+                            {"training": False}), None)
+case("local_response_norm", lambda: ((T(P((2, 4, 3, 3))),), {"size": 3}),
+     None)
+case("spectral_norm", lambda: ((T(P((4, 3))), T(P((4,))), T(P((3,)))), {}),
+     None, grad=False)
+
+# ---- conv / pool / vision
+case("conv2d", lambda: ((T(P((1, 2, 5, 5))), T(P((3, 2, 3, 3))), None),
+                        {"padding": 1}), None)
+case("conv1d", lambda: ((T(P((1, 2, 8))), T(P((3, 2, 3))), None),
+                        {"padding": 1}), None)
+case("conv3d", lambda: ((T(P((1, 1, 4, 4, 4))), T(P((2, 1, 3, 3, 3))),
+                         None), {}), None)
+case("conv2d_transpose", lambda: ((T(P((1, 2, 4, 4))),
+                                   T(P((2, 3, 3, 3))), None), {}), None)
+case("max_pool2d", lambda: ((T(P((1, 2, 4, 4))),), {"kernel_size": 2}),
+     None)
+case("avg_pool2d", lambda: ((T(P((1, 2, 4, 4))),), {"kernel_size": 2}),
+     None)
+case("max_pool1d", lambda: ((T(P((1, 2, 6))),), {"kernel_size": 2}), None)
+case("avg_pool1d", lambda: ((T(P((1, 2, 6))),), {"kernel_size": 2}), None)
+case("adaptive_avg_pool2d", lambda: ((T(P((1, 2, 4, 4))),),
+                                     {"output_size": 2}), None)
+case("adaptive_max_pool2d", lambda: ((T(P((1, 2, 4, 4))),),
+                                     {"output_size": 2}), None)
+case("interpolate", lambda: ((T(P((1, 2, 4, 4))),), {"scale_factor": 2}),
+     None)
+case("pixel_shuffle", lambda: ((T(P((1, 4, 2, 2))),),
+                               {"upscale_factor": 2}), None)
+case("unfold", lambda: ((T(P((1, 2, 4, 4))),), {"kernel_sizes": 2}), None)
+
+# ---- losses
+case("mse_loss", lambda: ((T(P((3, 4))), T(P((3, 4)))), {}),
+     lambda a, b: ((a - b) ** 2).mean())
+case("l1_loss", lambda: ((T(P((3, 4))), T(P((3, 4)))), {}),
+     lambda a, b: np.abs(a - b).mean())
+case("smooth_l1_loss", lambda: ((T(P((3, 4))), T(P((3, 4)))), {}), None)
+case("kl_div", lambda: ((T(np.log(PP((3, 4)))), T(PP((3, 4)))), {}), None)
+case("nll_loss", lambda: ((T(np.log(PP((3, 4)))), T(np.array([0, 1, 2])),
+                           None), {}), None)
+case("cross_entropy", lambda: ((T(P((3, 4))), T(np.array([[0], [1], [2]])),
+                                None), {}), None)
+case("softmax_with_cross_entropy",
+     lambda: ((T(P((3, 4))), T(np.array([[0], [1], [2]]))), {}), None)
+case("binary_cross_entropy", lambda: ((T(PP((3,)) * 0.8),
+                                       T((rng.rand(3) > 0.5).astype(np.float32)),
+                                       None), {}), None)
+case("binary_cross_entropy_with_logits",
+     lambda: ((T(P((3,))), T((rng.rand(3) > 0.5).astype(np.float32)),
+               None, None), {}), None)
+case("hinge_embedding_loss",
+     lambda: ((T(P((3,))), T(np.array([1.0, -1.0, 1.0], np.float32))), {}),
+     None)
+
+# ---- attention / rope / misc covered elsewhere but need table entries
+case("scaled_dot_product_attention",
+     lambda: ((T(P((1, 4, 2, 8))), T(P((1, 4, 2, 8))), T(P((1, 4, 2, 8)))),
+              {}), None)
+case("rotary_position_embedding",
+     lambda: ((T(P((1, 4, 2, 8))), T(P((1, 4, 2, 8))),
+               T(P((16, 8))), T(P((16, 8)))), {}), None, grad=False)
+
+# internal composite ops covered by their own dedicated test files
+EXEMPT = {
+    "_gru_scan": "internal RNN kernel (tests/test_nn_layers.py)",
+    "_lstm_scan": "internal RNN kernel (tests/test_nn_layers.py)",
+    "_rnn_scan": "internal RNN kernel (tests/test_nn_layers.py)",
+    "moe_dispatch": "MoE kernel (tests/test_fleet.py)",
+    "moe_combine": "MoE kernel (tests/test_fleet.py)",
+}
+
+
+# ---------------------------------------------------------------- the tests
+
+def test_every_op_has_a_case():
+    missing = [n for n in OPS if n not in A and n not in EXEMPT]
+    assert not missing, f"ops without an OpTest case: {sorted(missing)}"
+
+
+@pytest.mark.parametrize("name", sorted(A))
+def test_op_executes(name):
+    import paddle_tpu.ops as ops
+
+    args_fn, ref, _ = A[name]
+    args, kwargs = args_fn()
+    fn = getattr(ops, name, None)
+    if fn is None:
+        from paddle_tpu.ops.registry import apply_op, get_op
+
+        out = apply_op(get_op(name), *args, **kwargs)
+    else:
+        out = fn(*args, **kwargs)
+    assert out is not None
+    if ref is not None:
+        np_args = [
+            _np(a) for a in args
+            if isinstance(a, Tensor)
+        ]
+        expect = ref(*np_args)
+        got = _np(out[0] if isinstance(out, tuple) else out)
+        np.testing.assert_allclose(got, expect, rtol=2e-4, atol=2e-5,
+                                   err_msg=name)
+
+
+GRAD_OPS = sorted(n for n, (af, r, g) in A.items()
+                  if g and OPS[n].differentiable)
+
+
+@pytest.mark.parametrize("name", GRAD_OPS)
+def test_op_gradient_finite_difference(name):
+    """Central finite differences vs the autograd gradient w.r.t. the first
+    float tensor input (op_test.py check_grad analog)."""
+    import paddle_tpu.ops as ops
+
+    args_fn, _, _ = A[name]
+    args, kwargs = args_fn()
+    fn = getattr(ops, name)
+
+    target_idx = None
+    for i, a in enumerate(args):
+        if isinstance(a, Tensor) and np.issubdtype(
+                np.asarray(a._value).dtype, np.floating):
+            target_idx = i
+            break
+    if target_idx is None:
+        pytest.skip("no float tensor input")
+    base = np.asarray(args[target_idx]._value).astype(np.float64)
+
+    def run_loss(arr):
+        call = list(args)
+        call[target_idx] = T(arr.astype(np.float32))
+        out = fn(*call, **kwargs)
+        outs = out if isinstance(out, tuple) else (out,)
+        total = 0.0
+        for o in outs:
+            if isinstance(o, Tensor) and np.issubdtype(
+                    np.asarray(o._value).dtype, np.floating):
+                total = total + float(np.asarray(o._value).sum())
+        return total
+
+    # autograd gradient
+    call = list(args)
+    t = T(base.astype(np.float32))
+    t.stop_gradient = False
+    call[target_idx] = t
+    out = fn(*call, **kwargs)
+    outs = out if isinstance(out, tuple) else (out,)
+    loss = None
+    for o in outs:
+        if isinstance(o, Tensor) and np.issubdtype(
+                np.asarray(o._value).dtype, np.floating):
+            s = o.sum()
+            loss = s if loss is None else loss + s
+    loss.backward()
+    assert t.grad is not None, f"{name}: no gradient"
+    g = np.asarray(t.grad._value).astype(np.float64)
+
+    # numeric gradient on a sample of elements
+    eps = 1e-3
+    flat = base.flatten()
+    n_sample = min(flat.size, 6)
+    idxs = rng.choice(flat.size, n_sample, replace=False)
+    for i in idxs:
+        plus = flat.copy()
+        minus = flat.copy()
+        plus[i] += eps
+        minus[i] -= eps
+        num = (run_loss(plus.reshape(base.shape))
+               - run_loss(minus.reshape(base.shape))) / (2 * eps)
+        got = g.flatten()[i]
+        denom = max(abs(num), abs(got), 1.0)
+        assert abs(num - got) / denom < 5e-2, (
+            f"{name}: grad mismatch at {i}: numeric {num:.5f} vs "
+            f"autograd {got:.5f}")
