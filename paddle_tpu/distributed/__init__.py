@@ -10,6 +10,7 @@ this package: mesh/placement metadata, the collective API surface, hybrid-
 parallel layer wrappers, and checkpointing.
 """
 from . import auto_parallel  # noqa: F401
+from . import auto_tuner  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import comm_ops  # noqa: F401
 from . import fleet  # noqa: F401

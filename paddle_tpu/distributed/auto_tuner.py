@@ -1,0 +1,174 @@
+"""auto_tuner — search over hybrid-parallel configurations.
+
+Analog of /root/reference/python/paddle/distributed/auto_tuner/ (tuner.py:21
+``AutoTuner``, prune.py's divisibility/memory pruning, the cost-guided
+ordering) and of the auto_parallel static cost model
+(auto_parallel/static/cost/base_cost.py alpha-beta comm model +
+cluster.py peak specs). Candidates are {dp, mp, pp, sharding_stage,
+micro_batch_size, use_recompute}; infeasible points are pruned, the rest
+ranked by an analytical step-time model (compute on MXU peak + TP/DP
+collective bytes over ICI), then measured via a user trial function —
+best-first, like the reference's cost-guided search.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+
+__all__ = ["AutoTuner", "default_candidates"]
+
+# alpha-beta link model (cost/base_cost.py analog), ICI per-link
+_ICI_BW = 4.5e10      # bytes/s effective all-reduce bw per chip (v5e ICI)
+_ICI_ALPHA = 1e-6     # latency per collective
+_DEFAULT_PEAK = 197e12
+_MXU_EFF = 0.5        # achievable fraction of peak (measured ~0.55 on-chip)
+
+
+def default_candidates(num_devices):
+    divisors = [d for d in range(1, num_devices + 1) if num_devices % d == 0]
+    return {
+        "dp_degree": divisors,
+        "mp_degree": divisors,
+        "pp_degree": divisors,
+        "sharding_stage": [0, 1, 2, 3],
+        "micro_batch_size": [1, 2, 4, 8],
+        "use_recompute": [False, True],
+    }
+
+
+class AutoTuner:
+    def __init__(self, tuner_cfg):
+        """tuner_cfg keys (reference tuner_cfg schema): ``num_devices``,
+        ``model_cfg`` {hidden_size, num_layers, vocab_size, seq_length,
+        global_batch_size, param_bytes=2, dtype_bytes=2}, optional
+        ``candidates`` overriding default_candidates, ``hbm_bytes``."""
+        self.cfg = tuner_cfg
+        self.num_devices = int(tuner_cfg["num_devices"])
+        self.model = dict(tuner_cfg.get("model_cfg", {}))
+        self.hbm = float(tuner_cfg.get("hbm_bytes", 16e9))
+        self.peak = float(tuner_cfg.get("peak_flops", _DEFAULT_PEAK))
+        cands = tuner_cfg.get("candidates") or default_candidates(
+            self.num_devices)
+        self.space = self._product(cands)
+        self.space = [c for c in self.space if self.prune(c) is None]
+        self.space.sort(key=self.estimate_cost)
+        self._cursor = 0
+        self.history = []  # (cfg, measured_metric)
+
+    @staticmethod
+    def _product(cands):
+        keys = list(cands)
+        return [dict(zip(keys, vals))
+                for vals in itertools.product(*(cands[k] for k in keys))]
+
+    # ---------------- model size helpers
+
+    def _n_params(self):
+        m = self.model
+        h = m.get("hidden_size", 1024)
+        L = m.get("num_layers", 12)
+        v = m.get("vocab_size", 32000)
+        return 2 * v * h + 12 * L * h * h
+
+    # ---------------- pruning (reference prune.py)
+
+    def prune(self, c):
+        world = c["dp_degree"] * c["mp_degree"] * c["pp_degree"]
+        if world != self.num_devices:
+            return "degree product != num_devices"
+        gbs = self.model.get("global_batch_size", 32)
+        if gbs % (c["dp_degree"] * c["micro_batch_size"]):
+            return "global batch not divisible by dp*micro_batch"
+        L = self.model.get("num_layers", 12)
+        if L % c["pp_degree"]:
+            return "layers not divisible by pp"
+        h = self.model.get("hidden_size", 1024)
+        if h % c["mp_degree"]:
+            return "hidden not divisible by mp"
+        if c["sharding_stage"] > 0 and c["dp_degree"] == 1:
+            return "sharding needs dp>1"
+        if self._memory_bytes(c) > self.hbm:
+            return "exceeds HBM"
+        return None
+
+    def _memory_bytes(self, c):
+        n = self._n_params() / (c["mp_degree"] * c["pp_degree"])
+        pbytes = self.model.get("param_bytes", 2)
+        # params + grads
+        mem = n * pbytes * 2
+        # optimizer state (fp32 master + 2 moments), sharded by stage>=1
+        opt = n * 12
+        if c["sharding_stage"] >= 1:
+            opt /= c["dp_degree"]
+        mem += opt
+        # activations per microbatch (halved by recompute)
+        m = self.model
+        h = m.get("hidden_size", 1024)
+        L = m.get("num_layers", 12) / c["pp_degree"]
+        s = m.get("seq_length", 1024)
+        act = c["micro_batch_size"] * s * h * L * 20 * 2 / c["mp_degree"]
+        if c["use_recompute"]:
+            act /= 8
+        return mem + act
+
+    # ---------------- analytical cost (cost/base_cost.py analog)
+
+    def estimate_cost(self, c):
+        m = self.model
+        gbs = m.get("global_batch_size", 32)
+        s = m.get("seq_length", 1024)
+        tokens = gbs * s
+        flops = 6 * self._n_params() * tokens
+        recompute_factor = 4 / 3 if c["use_recompute"] else 1.0
+        compute = flops * recompute_factor / (
+            self.num_devices * self.peak * _MXU_EFF)
+
+        n_local = self._n_params() / (c["mp_degree"] * c["pp_degree"])
+        pbytes = m.get("param_bytes", 2)
+        comm = 0.0
+        if c["dp_degree"] > 1:  # grad all-reduce (or reduce-scatter+gather)
+            comm += 2 * n_local * pbytes / _ICI_BW + _ICI_ALPHA
+        if c["mp_degree"] > 1:  # per-layer activation all-reduces
+            L = m.get("num_layers", 12)
+            act_bytes = c["micro_batch_size"] * s * m.get("hidden_size", 1024) * 2
+            n_micro = gbs // (c["dp_degree"] * c["micro_batch_size"])
+            comm += 4 * L * n_micro * (act_bytes / _ICI_BW + _ICI_ALPHA)
+        if c["pp_degree"] > 1:  # bubble
+            n_micro = gbs // (c["dp_degree"] * c["micro_batch_size"])
+            bubble = (c["pp_degree"] - 1) / max(n_micro, 1)
+            compute *= 1 + bubble
+        return compute + comm
+
+    # ---------------- search protocol (reference tuner.py)
+
+    def search_once(self):
+        """Next candidate to measure (cost order), or None when exhausted."""
+        if self._cursor >= len(self.space):
+            return None
+        c = self.space[self._cursor]
+        self._cursor += 1
+        return c
+
+    def add_cfg(self, cfg, metric):
+        """Record a measured result (higher metric = better, e.g. tokens/s)."""
+        self.history.append((cfg, metric))
+
+    def get_best_cfg(self):
+        if not self.history:
+            raise RuntimeError("no measured configs; run search_once/add_cfg")
+        return max(self.history, key=lambda kv: kv[1])[0]
+
+    def tune(self, trial_fn, max_trials=None):
+        """Full loop: measure candidates best-estimated-first."""
+        n = 0
+        while True:
+            c = self.search_once()
+            if c is None or (max_trials is not None and n >= max_trials):
+                break
+            try:
+                metric = trial_fn(c)
+            except Exception:
+                metric = float("-inf")
+            self.add_cfg(c, metric)
+            n += 1
+        return self.get_best_cfg()

@@ -1,0 +1,34 @@
+"""paddle_tpu.onnx — model export.
+
+Analog of /root/reference/python/paddle/onnx/export.py, which delegates to
+the external paddle2onnx package. That converter consumes the reference's
+ProgramDesc format, which this framework (deliberately) does not have — the
+portable deployment artifact here is the StableHLO export produced by
+``paddle_tpu.jit.save`` (loadable without Python model code, versioned, and
+runnable by any StableHLO consumer; see jit/serialization.py).
+
+``export`` therefore produces that artifact and says so, rather than
+pretending to emit ONNX protobufs.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=None, **configs):
+    """Export ``layer`` for deployment. Writes the StableHLO artifact pair
+    (``<path>.pdmodel`` + ``.pdiparams``); ONNX protobuf emission would
+    require a StableHLO→ONNX converter, which does not exist in this
+    environment (zero egress, no onnx package baked in)."""
+    import warnings
+
+    from ..jit.serialization import save
+
+    warnings.warn(
+        "paddle_tpu.onnx.export produces a StableHLO artifact "
+        "(the TPU-native portable format), not ONNX protobufs; load it with "
+        "paddle_tpu.jit.load or paddle_tpu.inference.Predictor",
+        stacklevel=2,
+    )
+    save(layer, path, input_spec=input_spec)
+    return path

@@ -1,0 +1,79 @@
+"""paddle_tpu.text — text-domain utilities.
+
+Analog of /root/reference/python/paddle/text/: ``viterbi_decode`` /
+``ViterbiDecoder`` (the CRF decoding op, paddle/phi/kernels/
+viterbi_decode_kernel.h) plus the dataset namespace (the reference's text
+datasets are downloaders; this environment has zero egress, so they raise
+with instructions — see paddle_tpu.vision.datasets for local-file loaders).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag=True):
+    """Batched Viterbi decoding.
+
+    potentials: (B, S, T) emission scores; transition_params: (T, T) or
+    (T+2, T+2) when include_bos_eos_tag (reference semantics: last two tags
+    are BOS/EOS). Returns (scores (B,), paths (B, S)).
+    """
+    e = potentials._value if isinstance(potentials, Tensor) else jnp.asarray(potentials)
+    t = (transition_params._value if isinstance(transition_params, Tensor)
+         else jnp.asarray(transition_params))
+    b, s, n = e.shape
+    if include_bos_eos_tag:
+        # reference layout: transition is (T+2, T+2); rows/cols [n]=BOS [n+1]=EOS
+        full = t
+        trans = full[:n, :n]
+        start = full[n, :n]
+        stop = full[:n, n + 1] if full.shape[0] > n + 1 else jnp.zeros(n)
+    else:
+        trans = t
+        start = jnp.zeros(n)
+        stop = jnp.zeros(n)
+
+    alpha0 = e[:, 0, :] + start[None, :]
+
+    def step(alpha, emit):
+        # alpha (B, T); scores (B, T_prev, T_next)
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)          # (B, T)
+        alpha_new = jnp.max(scores, axis=1) + emit      # (B, T)
+        return alpha_new, best_prev
+
+    emits = jnp.swapaxes(e[:, 1:, :], 0, 1)  # (S-1, B, T)
+    alpha_fin, backptrs = jax.lax.scan(step, alpha0, emits)
+    alpha_fin = alpha_fin + stop[None, :]
+    scores = jnp.max(alpha_fin, axis=1)
+    last = jnp.argmax(alpha_fin, axis=1)  # (B,)
+
+    # backptrs[j][b, t] = best tag at step j given tag t at step j+1;
+    # walking right-to-left yields tags 0..S-2, then append the final tag.
+    def backtrack(tag, ptrs):
+        prev = jnp.take_along_axis(ptrs, tag[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, path_rev = jax.lax.scan(backtrack, last, backptrs, reverse=True)
+    paths = jnp.concatenate([jnp.swapaxes(path_rev, 0, 1),
+                             last[:, None]], axis=1)  # (B, S)
+    return Tensor._from_value(scores), Tensor._from_value(paths)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,91 @@
+"""text.viterbi_decode, distributed.auto_tuner, onnx.export surface."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_viterbi_decode_matches_bruteforce():
+    from paddle_tpu.text import viterbi_decode
+
+    rng = np.random.RandomState(0)
+    B, S, T = 2, 5, 3
+    emis = rng.rand(B, S, T).astype(np.float32)
+    trans = rng.rand(T, T).astype(np.float32)
+    scores, paths = viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        include_bos_eos_tag=False)
+
+    # brute force over all tag sequences
+    import itertools
+
+    for b in range(B):
+        best, best_path = -1e9, None
+        for seq in itertools.product(range(T), repeat=S):
+            sc = emis[b, 0, seq[0]]
+            for i in range(1, S):
+                sc += trans[seq[i - 1], seq[i]] + emis[b, i, seq[i]]
+            if sc > best:
+                best, best_path = sc, seq
+        np.testing.assert_allclose(float(scores._value[b]), best, rtol=1e-5)
+        assert tuple(np.asarray(paths._value)[b].tolist()) == best_path
+
+
+def test_viterbi_decoder_layer():
+    from paddle_tpu.text import ViterbiDecoder
+
+    trans = paddle.to_tensor(np.random.rand(5, 5).astype(np.float32))
+    dec = ViterbiDecoder(trans, include_bos_eos_tag=True)
+    pot = paddle.to_tensor(np.random.rand(2, 4, 3).astype(np.float32))
+    scores, paths = dec(pot)
+    assert scores.shape == [2] and paths.shape == [2, 4]
+
+
+def test_auto_tuner_prunes_and_ranks():
+    from paddle_tpu.distributed.auto_tuner import AutoTuner
+
+    tuner = AutoTuner({
+        "num_devices": 8,
+        "model_cfg": {"hidden_size": 1024, "num_layers": 8,
+                      "vocab_size": 32000, "seq_length": 1024,
+                      "global_batch_size": 32},
+        "hbm_bytes": 16e9,
+    })
+    assert tuner.space, "no feasible configs found"
+    for c in tuner.space:
+        assert c["dp_degree"] * c["mp_degree"] * c["pp_degree"] == 8
+        assert tuner._memory_bytes(c) <= 16e9
+    costs = [tuner.estimate_cost(c) for c in tuner.space]
+    assert costs == sorted(costs)
+
+
+def test_auto_tuner_tune_loop():
+    from paddle_tpu.distributed.auto_tuner import AutoTuner
+
+    tuner = AutoTuner({
+        "num_devices": 4,
+        "model_cfg": {"hidden_size": 512, "num_layers": 4,
+                      "vocab_size": 1000, "seq_length": 256,
+                      "global_batch_size": 16},
+    })
+
+    # pretend dp=4 is the fastest
+    def trial(c):
+        return 100.0 * c["dp_degree"] - 10 * c["pp_degree"]
+
+    best = tuner.tune(trial, max_trials=10)
+    assert best["dp_degree"] == max(
+        c["dp_degree"] for c, _ in tuner.history)
+
+
+def test_onnx_export_produces_stablehlo(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import InputSpec
+
+    model = nn.Linear(4, 2)
+    with pytest.warns(UserWarning, match="StableHLO"):
+        paddle.onnx.export(model, str(tmp_path / "m"),
+                           input_spec=[InputSpec([1, 4], "float32")])
+    loaded = paddle.jit.load(str(tmp_path / "m"))
+    out = loaded(paddle.to_tensor(np.ones((1, 4), np.float32)))
+    assert out.shape == [1, 2]
