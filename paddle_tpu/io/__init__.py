@@ -14,6 +14,7 @@ from .dataset import (  # noqa: F401
     TensorDataset,
     random_split,
 )
+from .token_dataset import TokenFileDataset  # noqa: F401
 from .sampler import (  # noqa: F401
     BatchSampler,
     DistributedBatchSampler,
@@ -28,6 +29,7 @@ __all__ = [
     "DataLoader", "default_collate_fn", "get_worker_info",
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "ChainDataset", "ConcatDataset", "Subset", "random_split",
+    "TokenFileDataset",
     "Sampler", "SequenceSampler", "RandomSampler", "WeightedRandomSampler",
     "SubsetRandomSampler", "BatchSampler", "DistributedBatchSampler",
 ]

@@ -1,0 +1,99 @@
+"""TokenFileDataset — LM training data from a binary token file.
+
+Python surface over the native reader (paddle_tpu/native/token_reader.cpp,
+the DataFeed analog — see that file's header). Samples are (seq_len+1)
+windows: ``input_ids = w[:-1]``-style shifting is left to the criterion
+(models.*PretrainingCriterion shift internally, so the full window is
+returned as both input and label, reference-style).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from .dataset import Dataset
+
+__all__ = ["TokenFileDataset"]
+
+_lib = None
+_lib_tried = False
+
+
+def _native():
+    global _lib, _lib_tried
+    if not _lib_tried:
+        _lib_tried = True
+        from ..native import load_library
+
+        lib = load_library("token_reader")
+        if lib is not None:
+            lib.token_reader_open.restype = ctypes.c_void_p
+            lib.token_reader_open.argtypes = [ctypes.c_char_p]
+            lib.token_reader_len.restype = ctypes.c_longlong
+            lib.token_reader_len.argtypes = [ctypes.c_void_p]
+            lib.token_reader_batch.restype = ctypes.c_int
+            lib.token_reader_batch.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_longlong),
+                ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_int32)]
+            lib.token_reader_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class TokenFileDataset(Dataset):
+    """Random-access (seq_len+1)-token windows over a binary int32 file."""
+
+    def __init__(self, path, seq_len, stride=None, dtype=np.int32):
+        if not os.path.exists(path):
+            raise FileNotFoundError(path)
+        self.path = path
+        self.seq_len = int(seq_len)
+        self.window = self.seq_len + 1
+        self._handle = None
+        lib = _native()
+        if lib is not None:
+            self._handle = lib.token_reader_open(path.encode())
+        if self._handle:
+            self.n_tokens = int(lib.token_reader_len(self._handle))
+            self._mm = None
+        else:  # pure-python fallback: numpy memmap
+            self._mm = np.memmap(path, dtype=np.int32, mode="r")
+            self.n_tokens = int(self._mm.shape[0])
+        self.stride = int(stride) if stride else self.seq_len
+        self.n_samples = max((self.n_tokens - self.window) // self.stride + 1, 0)
+
+    def __len__(self):
+        return self.n_samples
+
+    def __getitem__(self, idx):
+        off = idx * self.stride
+        return self.read_batch(np.asarray([off]))[0]
+
+    def read_batch(self, offsets):
+        """(len(offsets), seq_len+1) int32 — one native call per batch."""
+        offsets = np.asarray(offsets, np.int64)
+        b = len(offsets)
+        out = np.empty((b, self.window), np.int32)
+        lib = _native()
+        if self._handle:
+            rc = lib.token_reader_batch(
+                self._handle,
+                offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+                b, self.window,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+            if rc != 0:
+                raise IndexError("token window out of range")
+        else:
+            for i, off in enumerate(offsets):
+                out[i] = self._mm[off:off + self.window]
+        return out
+
+    def __del__(self):
+        try:
+            if self._handle:
+                _native().token_reader_close(self._handle)
+                self._handle = None
+        except Exception:
+            pass
