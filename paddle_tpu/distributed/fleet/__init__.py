@@ -9,7 +9,7 @@ from __future__ import annotations
 
 from . import mp_layers  # noqa: F401
 from . import sequence_parallel_utils  # noqa: F401
-from .moe import MoELayer, NaiveGate, SwitchGate  # noqa: F401
+from .moe import MoELayer, NaiveGate, StackedExpertsFFN, SwitchGate  # noqa: F401
 from .mp_layers import (  # noqa: F401
     ColumnParallelLinear,
     ParallelCrossEntropy,
@@ -36,7 +36,7 @@ __all__ = [
     "ParallelCrossEntropy", "recompute", "recompute_sequential",
     "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
     "spmd_pipeline", "group_sharded_parallel", "ShardedOptimizer",
-    "MoELayer", "NaiveGate", "SwitchGate",
+    "MoELayer", "NaiveGate", "SwitchGate", "StackedExpertsFFN",
 ]
 
 

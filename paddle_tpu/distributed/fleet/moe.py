@@ -107,12 +107,18 @@ class MoELayer(Layer):
                  top_k=None, **kwargs):
         super().__init__()
         self.d_model = d_model
+        self._stacked = None
         if isinstance(experts, (list, LayerList)):
             self.experts = (experts if isinstance(experts, LayerList)
                             else LayerList(list(experts)))
+            self.num_experts = len(self.experts)
+        elif isinstance(experts, Layer) and hasattr(experts, "num_experts"):
+            self._stacked = experts
+            self.experts = LayerList([experts])
+            self.num_experts = experts.num_experts
         else:
-            raise ValueError("experts must be a list of Layers")
-        self.num_experts = len(self.experts)
+            raise ValueError(
+                "experts must be a list of Layers or a stacked-expert Layer")
         if gate is None or isinstance(gate, dict):
             cfg = gate or {}
             top = cfg.get("top_k", top_k or 2)
@@ -139,15 +145,17 @@ class MoELayer(Layer):
             capacity=capacity, top_k=self.top_k)
         self.aux_loss = aux
 
-        # run each expert on its capacity slice (E small; python loop is
-        # static and unrolls under jit — the ep-sharded vmap path comes with
-        # stacked expert weights)
-        outs = []
-        for e, expert in enumerate(self.experts):
-            outs.append(expert(dispatched[e]))
-        from ...ops import stack
+        if self._stacked is not None:
+            # batched path: all experts in one einsum (ep-shardable)
+            expert_out = self._stacked(dispatched)
+        else:
+            # per-expert loop (E small; static unroll under jit)
+            outs = []
+            for e, expert in enumerate(self.experts):
+                outs.append(expert(dispatched[e]))
+            from ...ops import stack
 
-        expert_out = stack(outs, axis=0)  # (E, C, D)
+            expert_out = stack(outs, axis=0)  # (E, C, D)
         yf = _combine(combine_c, expert_out)
         return reshape(yf, list(orig_shape))
 
@@ -163,3 +171,52 @@ _registry.register_op(
 def _combine(combine_c, expert_out):
     return _registry.apply_op(
         _registry.get_op("moe_combine"), combine_c, expert_out)
+
+
+class StackedExpertsFFN(Layer):
+    """Expert-parallel FFN with *stacked* weights: gate/up/down carry a
+    leading expert dim, shardable Shard(0) over the ``ep`` mesh axis, and
+    all experts run as one batched einsum — the vmap form the reference's
+    fused_moe kernel implements in CUDA. Pair with MoELayer via
+    ``experts=StackedExpertsFFN(...)`` (it is called with the dispatched
+    (E, C, D) tensor directly)."""
+
+    def __init__(self, num_experts, d_model, d_hidden, activation="gelu",
+                 mesh=None, ep_axis="ep"):
+        super().__init__()
+        from ...nn import initializer as I
+
+        self.num_experts = num_experts
+        self.w_in = self.create_parameter(
+            (num_experts, d_model, d_hidden),
+            default_initializer=I.XavierNormal())
+        self.w_out = self.create_parameter(
+            (num_experts, d_hidden, d_model),
+            default_initializer=I.XavierNormal())
+        self.activation = activation
+        if mesh is not None and ep_axis in mesh.dim_names:
+            from ..api import shard_tensor
+            from ..placement import Replicate, Shard
+
+            pl = [Replicate()] * mesh.ndim
+            pl[mesh.dim_names.index(ep_axis)] = Shard(0)
+            shard_tensor(self.w_in, mesh, pl)
+            shard_tensor(self.w_out, mesh, pl)
+
+    def forward(self, dispatched):
+        """(E, C, D) -> (E, C, D), one batched matmul pair over experts."""
+        from ...nn import functional as F
+        from ...ops import registry as _reg
+
+        act = getattr(F, self.activation)
+        h = _reg.apply_op(_reg.get_op("_moe_expert_mm"), dispatched, self.w_in)
+        h = act(h)
+        return _reg.apply_op(_reg.get_op("_moe_expert_mm"), h, self.w_out)
+
+
+def _moe_expert_mm_kernel(x, w):
+    return jnp.einsum("ecd,edh->ech", x, w)
+
+
+_registry.register_op("_moe_expert_mm", _moe_expert_mm_kernel,
+                      inputs=("x", "w"))

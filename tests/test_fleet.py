@@ -322,3 +322,23 @@ def test_fleet_entry():
     model = fleet.distributed_model(nn.Linear(8, 8))
     x = paddle.to_tensor(np.random.rand(8, 8).astype(np.float32))
     assert model(x).shape == [8, 8]
+
+
+def test_moe_stacked_experts_ep_sharded():
+    """Batched stacked-expert path, weights sharded over an ep mesh axis."""
+    from paddle_tpu.distributed.fleet import MoELayer, StackedExpertsFFN
+
+    mesh = dist.ProcessMesh(np.arange(8), ["ep"])
+    paddle.seed(0)
+    d = 16
+    stacked = StackedExpertsFFN(8, d, 32, mesh=mesh)
+    assert stacked.w_in._value.addressable_shards[0].data.shape == (1, 16, 32)
+    moe = MoELayer(d_model=d, experts=stacked, gate={"top_k": 2},
+                   capacity_factor=2.0)
+    x = paddle.to_tensor(np.random.rand(2, 16, d).astype(np.float32))
+    y = moe(x)
+    assert y.shape == [2, 16, d]
+    loss = (y ** 2).mean() + 0.01 * moe.aux_loss
+    loss.backward()
+    assert stacked.w_in.grad is not None
+    assert moe.gate.gate.weight.grad is not None
