@@ -68,3 +68,47 @@ def test_summary_counts_params():
     m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
     info = paddle.summary(m, input_size=(1, 4))
     assert info["total_params"] == 4 * 8 + 8 + 8 * 2 + 2
+
+
+def test_train_save_resume_matches_continuous(tmp_path):
+    """VERDICT r1 item 10 'Done =': train -> save -> restart -> resume gives
+    the same loss curve as training straight through."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.framework.io import load, save
+
+    x = paddle.to_tensor(np.random.RandomState(0).rand(8, 4).astype(np.float32))
+    t = paddle.to_tensor(np.random.RandomState(1).rand(8, 1).astype(np.float32))
+
+    def make():
+        paddle.seed(11)
+        m = nn.Linear(4, 1)
+        o = paddle.optimizer.AdamW(learning_rate=0.05,
+                                   parameters=m.parameters())
+        return m, o
+
+    def step(m, o):
+        loss = ((m(x) - t) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+        return float(loss)
+
+    # continuous run: 6 steps
+    m1, o1 = make()
+    cont = [step(m1, o1) for _ in range(6)]
+
+    # interrupted run: 3 steps, checkpoint, fresh objects, resume 3 steps
+    m2, o2 = make()
+    first = [step(m2, o2) for _ in range(3)]
+    save(m2.state_dict(), str(tmp_path / "m.pdparams"))
+    save(o2.state_dict(), str(tmp_path / "o.pdopt"))
+
+    m3, o3 = make()
+    m3.set_state_dict(load(str(tmp_path / "m.pdparams")))
+    o3.set_state_dict(load(str(tmp_path / "o.pdopt")))
+    resumed = [step(m3, o3) for _ in range(3)]
+
+    np.testing.assert_allclose(first + resumed, cont, rtol=1e-5)
