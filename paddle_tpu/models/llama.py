@@ -62,6 +62,7 @@ class LlamaConfig:
         rms_norm_eps=1e-6,
         rope_theta=10000.0,
         tie_word_embeddings=False,
+        use_recompute=False,
         sequence_parallel=False,
         use_flash_attention=True,
         dtype="float32",
@@ -77,6 +78,7 @@ class LlamaConfig:
         self.rms_norm_eps = rms_norm_eps
         self.rope_theta = rope_theta
         self.tie_word_embeddings = tie_word_embeddings
+        self.use_recompute = use_recompute
         self.sequence_parallel = sequence_parallel
         self.use_flash_attention = use_flash_attention
         self.dtype = dtype
@@ -204,6 +206,13 @@ class LlamaModel(Layer):
             if caches is not None:
                 hidden, c = layer(hidden, attn_mask=attn_mask, cache=caches[i])
                 new_caches.append(c)
+            elif self.config.use_recompute:
+                # activation checkpointing per decoder layer (jax.checkpoint
+                # under trace; reference: recompute_interval semantics)
+                from ..distributed.fleet.recompute import recompute
+
+                hidden = recompute(
+                    lambda h, _l=layer: _l(h, attn_mask=attn_mask), hidden)
             else:
                 hidden = layer(hidden, attn_mask=attn_mask)
         hidden = self.norm(hidden)
