@@ -47,3 +47,7 @@ __all__ += [
     "BertForSequenceClassification", "BertPretrainingCriterion",
     "bert_base_config", "bert_tiny_config",
 ]
+
+from .generation import generate  # noqa: F401
+
+__all__ += ["generate"]

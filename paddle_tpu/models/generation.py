@@ -1,0 +1,81 @@
+"""Text generation — greedy/sampling decode with KV cache.
+
+Analog of the reference's generation path (the fused_multi_transformer /
+masked_multihead_attention decode kernels,
+paddle/phi/kernels/fusion/gpu/fused_multi_transformer_op.cu, plus
+PaddleNLP's generate loop). TPU-natively: prefill is one compiled forward;
+each decode step re-uses the KV cache; sampling is stateless-PRNG.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd, random as _random
+from ..core.tensor import Tensor
+
+__all__ = ["generate"]
+
+
+def _sample(logits, temperature, top_k, top_p, greedy):
+    if greedy:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / max(temperature, 1e-5)
+    if top_k is not None and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    key = _random.next_key()
+    return jax.random.categorical(key, logits, axis=-1)
+
+
+def generate(model, input_ids, max_new_tokens=20, do_sample=False,
+             temperature=1.0, top_k=None, top_p=None, eos_token_id=None):
+    """Decode ``max_new_tokens`` continuations of ``input_ids`` (B, S).
+
+    The model must support ``forward(ids, attn_mask=None, caches=...)``
+    returning (logits, caches) — models.LlamaForCausalLM / GPT-style.
+    Returns (B, S + new) token ids.
+    """
+    ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+    b, s = ids.shape
+    model.eval()
+
+    cfg = model.config
+    kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
+    empty = [
+        (Tensor._from_value(jnp.zeros((b, 0, kv_heads, cfg.head_dim))),
+         Tensor._from_value(jnp.zeros((b, 0, kv_heads, cfg.head_dim))))
+        for _ in range(cfg.num_hidden_layers)
+    ]
+
+    with autograd.no_grad():
+        logits, caches = model(Tensor._from_value(ids), caches=empty)
+        next_tok = _sample(logits._value[:, -1, :], temperature, top_k,
+                           top_p, not do_sample)
+        out = [ids, next_tok[:, None]]
+        finished = jnp.zeros((b,), bool)
+        for step in range(max_new_tokens - 1):
+            cur_len = s + 1 + step
+            # single-token step attends to the whole prefix
+            mask = Tensor._from_value(
+                jnp.ones((b, 1, 1, cur_len), bool))
+            logits, caches = model(
+                Tensor._from_value(next_tok[:, None]),
+                attn_mask=mask, caches=caches)
+            next_tok = _sample(logits._value[:, -1, :], temperature, top_k,
+                               top_p, not do_sample)
+            if eos_token_id is not None:
+                finished = finished | (next_tok == eos_token_id)
+                next_tok = jnp.where(finished, eos_token_id, next_tok)
+            out.append(next_tok[:, None])
+            if eos_token_id is not None and bool(finished.all()):
+                break
+        return Tensor._from_value(jnp.concatenate(out, axis=1))

@@ -133,7 +133,14 @@ class LlamaAttention(Layer):
         q = reshape(self.q_proj(hidden_states), [b, s, h, d])
         k = reshape(self.k_proj(hidden_states), [b, s, kv, d])
         v = reshape(self.v_proj(hidden_states), [b, s, kv, d])
-        q, k = rotary_position_embedding(q, k, self.rope_cos, self.rope_sin)
+        position_ids = None
+        if cache is not None and cache[0].shape[1] > 0:
+            # cached decode: RoPE at absolute positions past the prefix
+            offset = cache[0].shape[1]
+            position_ids = Tensor._from_value(
+                jnp.arange(offset, offset + s))
+        q, k = rotary_position_embedding(q, k, self.rope_cos, self.rope_sin,
+                                         position_ids=position_ids)
         if cache is not None:
             k = concat([cache[0], k], axis=1)
             v = concat([cache[1], v], axis=1)

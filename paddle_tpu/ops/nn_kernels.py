@@ -684,11 +684,19 @@ def rotary_position_embedding(q, k, cos, sin, position_ids=None, use_neox_rotary
             return None
         c = cos.astype(x.dtype)
         s = sin.astype(x.dtype)
-        if c.ndim == 2:
-            c = c[None, :, None, :]
-            s = s[None, :, None, :]
-        c = c[:, : x.shape[1]]
-        s = s[:, : x.shape[1]]
+        if c.ndim != 2:
+            c = c.reshape(-1, c.shape[-1])
+            s = s.reshape(-1, s.shape[-1])
+        if position_ids is not None:
+            # gather absolute positions (cached decode: offset > 0)
+            pid = position_ids
+            c = c[pid][:, :, None, :] if pid.ndim == 2 else c[pid][None, :, None, :]
+            s = sin.astype(x.dtype)
+            s = s.reshape(-1, s.shape[-1])
+            s = s[pid][:, :, None, :] if pid.ndim == 2 else s[pid][None, :, None, :]
+        else:
+            c = c[None, : x.shape[1], None, :]
+            s = s[None, : x.shape[1], None, :]
         if use_neox_rotary_style:
             half = x.shape[-1] // 2
             x1, x2 = x[..., :half], x[..., half:]
