@@ -63,6 +63,16 @@ from . import text  # noqa: F401
 from . import distributed  # noqa: F401
 from . import device  # noqa: F401
 from . import distribution  # noqa: F401
+from . import linalg  # noqa: F401
+from . import signal  # noqa: F401
+
+# `from . import fft` would be skipped: ops* already bound the `fft` op
+# function here, and importlib's fromlist handling sees the existing
+# attribute. Import the submodule explicitly; the namespace wins (its
+# __call__-equivalent lives at paddle.fft.fft, reference layout).
+import importlib as _importlib
+
+fft = _importlib.import_module(".fft", __name__)
 from . import geometric  # noqa: F401
 from . import hapi  # noqa: F401
 from . import io  # noqa: F401

@@ -1,0 +1,55 @@
+"""paddle.fft namespace (reference python/paddle/fft.py)."""
+import jax.numpy as jnp
+
+from .core.tensor import Tensor
+from .ops import (  # noqa: F401
+    fft,
+    fft2,
+    fftshift,
+    ifft,
+    ifft2,
+    ifftshift,
+    irfft,
+    rfft,
+)
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "rfft", "irfft", "fftshift", "ifftshift",
+    "fftn", "ifftn", "rfft2", "irfft2", "fftfreq", "rfftfreq", "hfft", "ihfft",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def fftn(x, s=None, axes=None, norm="backward"):
+    return Tensor._from_value(jnp.fft.fftn(_v(x), s, axes, norm))
+
+
+def ifftn(x, s=None, axes=None, norm="backward"):
+    return Tensor._from_value(jnp.fft.ifftn(_v(x), s, axes, norm))
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return Tensor._from_value(jnp.fft.rfft2(_v(x), s, axes, norm))
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return Tensor._from_value(jnp.fft.irfft2(_v(x), s, axes, norm))
+
+
+def hfft(x, n=None, axis=-1, norm="backward"):
+    return Tensor._from_value(jnp.fft.hfft(_v(x), n, axis, norm))
+
+
+def ihfft(x, n=None, axis=-1, norm="backward"):
+    return Tensor._from_value(jnp.fft.ihfft(_v(x), n, axis, norm))
+
+
+def fftfreq(n, d=1.0, dtype=None):
+    return Tensor._from_value(jnp.fft.fftfreq(n, d))
+
+
+def rfftfreq(n, d=1.0, dtype=None):
+    return Tensor._from_value(jnp.fft.rfftfreq(n, d))

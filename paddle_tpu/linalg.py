@@ -1,0 +1,53 @@
+"""paddle.linalg namespace (reference python/paddle/tensor/linalg.py
+exports under paddle.linalg)."""
+from .ops import (  # noqa: F401
+    cholesky,
+    det,
+    eig,
+    eigh,
+    inverse as inv,
+    lstsq,
+    matmul,
+    matrix_norm,
+    matrix_power,
+    norm,
+    pinv,
+    qr,
+    slogdet,
+    solve,
+    svd,
+    triangular_solve,
+)
+from .ops import cross, dot, inverse, mv, outer  # noqa: F401
+
+__all__ = [
+    "cholesky", "det", "eig", "eigh", "inv", "inverse", "lstsq", "matmul",
+    "matrix_norm", "matrix_power", "norm", "pinv", "qr", "slogdet", "solve",
+    "svd", "triangular_solve", "cross", "dot", "mv", "outer",
+    "multi_dot", "cond", "matrix_rank",
+]
+
+
+def multi_dot(tensors):
+    out = tensors[0]
+    for t in tensors[1:]:
+        out = matmul(out, t)
+    return out
+
+
+def cond(x, p=None):
+    import jax.numpy as jnp
+
+    from .core.tensor import Tensor
+
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor._from_value(jnp.linalg.cond(v, p))
+
+
+def matrix_rank(x, tol=None, hermitian=False):
+    import jax.numpy as jnp
+
+    from .core.tensor import Tensor
+
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor._from_value(jnp.linalg.matrix_rank(v, tol))

@@ -1,0 +1,94 @@
+"""paddle.signal namespace — STFT/ISTFT (reference python/paddle/signal.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core.tensor import Tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def frame(x, frame_length, hop_length, axis=-1):
+    """Slice overlapping frames along ``axis`` (reference signal.frame)."""
+    v = _v(x)
+    assert axis in (-1, v.ndim - 1), "frame supports the last axis"
+    n = (v.shape[-1] - frame_length) // hop_length + 1
+    idx = (np.arange(frame_length)[None, :]
+           + hop_length * np.arange(n)[:, None])
+    return Tensor._from_value(v[..., idx])  # (..., n_frames, frame_length)
+
+
+def overlap_add(x, hop_length, axis=-1):
+    """Inverse of frame: sum overlapping frames (reference signal.overlap_add).
+    x: (..., n_frames, frame_length)."""
+    v = _v(x)
+    *batch, n, fl = v.shape
+    out_len = (n - 1) * hop_length + fl
+    out = jnp.zeros(tuple(batch) + (out_len,), v.dtype)
+    for i in range(n):  # static python loop: n known at trace time
+        out = out.at[..., i * hop_length:i * hop_length + fl].add(v[..., i, :])
+    return Tensor._from_value(out)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True):
+    """Short-time Fourier transform; returns (..., n_fft//2+1, n_frames)
+    complex (reference signal.stft conventions)."""
+    v = _v(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        w = jnp.ones(win_length)
+    else:
+        w = _v(window)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+    if center:
+        v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)],
+                    mode=pad_mode)
+    frames = _v(frame(Tensor._from_value(v), n_fft, hop_length))
+    spec = jnp.fft.rfft(frames * w, axis=-1) if onesided else \
+        jnp.fft.fft(frames * w, axis=-1)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return Tensor._from_value(jnp.swapaxes(spec, -1, -2))
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False):
+    """Inverse STFT with window-envelope normalization (reference
+    signal.istft)."""
+    spec = _v(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        w = jnp.ones(win_length)
+    else:
+        w = _v(window)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (pad, n_fft - win_length - pad))
+    spec = jnp.swapaxes(spec, -1, -2)  # (..., frames, freq)
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+              else jnp.fft.ifft(spec, axis=-1).real)
+    frames = frames * w
+    sig = _v(overlap_add(Tensor._from_value(frames), hop_length))
+    # window envelope for COLA normalization
+    n = frames.shape[-2]
+    env = _v(overlap_add(
+        Tensor._from_value(jnp.broadcast_to(w * w, (n, n_fft))), hop_length))
+    sig = sig / jnp.maximum(env, 1e-10)
+    if center:
+        sig = sig[..., n_fft // 2:-(n_fft // 2) or None]
+    if length is not None:
+        sig = sig[..., :length]
+    return Tensor._from_value(sig)

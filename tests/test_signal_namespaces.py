@@ -1,0 +1,45 @@
+"""linalg/fft/signal namespaces; stft/istft round trip."""
+import numpy as np
+
+import paddle_tpu as paddle
+
+
+def test_linalg_namespace():
+    a = paddle.to_tensor((np.random.rand(3, 3) + 2 * np.eye(3)).astype(np.float32))
+    assert paddle.linalg.inv(a).shape == [3, 3]
+    assert paddle.linalg.multi_dot([a, a, a]).shape == [3, 3]
+    r = paddle.linalg.matrix_rank(a)
+    assert int(r._value) == 3
+
+
+def test_fft_namespace():
+    x = paddle.to_tensor(np.random.rand(8).astype(np.float32))
+    f = paddle.fft.rfft(x)
+    assert f.shape == [5]
+    freqs = paddle.fft.rfftfreq(8, d=0.5)
+    np.testing.assert_allclose(np.asarray(freqs._value),
+                               np.fft.rfftfreq(8, 0.5))
+
+
+def test_frame_overlap_add_inverse():
+    from paddle_tpu.signal import frame, overlap_add
+
+    x = paddle.to_tensor(np.arange(16, dtype=np.float32))
+    fr = frame(x, frame_length=4, hop_length=4)  # non-overlapping
+    assert fr.shape == [4, 4]
+    back = overlap_add(fr, hop_length=4)
+    np.testing.assert_allclose(np.asarray(back._value), np.arange(16))
+
+
+def test_stft_istft_roundtrip():
+    sr = 2048
+    t = np.linspace(0, 1, sr, dtype=np.float32)
+    sig = np.sin(2 * np.pi * 100 * t) + 0.3 * np.sin(2 * np.pi * 300 * t)
+    x = paddle.to_tensor(sig[None, :])
+    win = paddle.to_tensor(np.hanning(256).astype(np.float32))
+    spec = paddle.signal.stft(x, n_fft=256, hop_length=64, window=win)
+    assert spec.shape[1] == 129
+    rec = paddle.signal.istft(spec, n_fft=256, hop_length=64, window=win,
+                              length=sr)
+    err = np.abs(np.asarray(rec._value)[0, 200:-200] - sig[200:-200]).max()
+    assert err < 1e-3, err
