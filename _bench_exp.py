@@ -1,0 +1,52 @@
+# Experiment: does recompute + bigger batch improve tokens/s on-chip?
+import time, sys, functools
+import jax, jax.numpy as jnp, numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion
+from paddle_tpu.jit import _FunctionalModel
+
+def sync(x): return float(jnp.asarray(x).sum())
+
+def measure(batch, seq, recompute, steps=6):
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+                      num_hidden_layers=12, num_attention_heads=12,
+                      max_position_embeddings=seq, use_recompute=recompute)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg); model.to(dtype="bfloat16")
+    crit = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(), multi_precision=True)
+    functional = _FunctionalModel(model)
+    params, buffers = model.raw_state()
+    opt.register_param_names(dict(model.named_parameters()))
+    accs, masters = opt.init_functional_state(params)
+    ids = jnp.asarray(np.random.randint(0, 32000, (batch, seq)).astype(np.int32))
+    rng = jax.random.key_data(jax.random.PRNGKey(0))
+    def loss_of(p):
+        out, _ = functional(p, buffers, (paddle.Tensor._from_value(ids),), {}, rng)
+        return crit(paddle.Tensor._from_value(out._value), paddle.Tensor._from_value(ids))._value
+    def one(carry, _):
+        p,a,m,t = carry
+        loss, grads = jax.value_and_grad(loss_of)(p)
+        p2,a2,m2 = opt.functional_update(p, grads, a, m, jnp.asarray(1e-4, jnp.float32), t)
+        return (p2,a2,m2,t+1), loss
+    @functools.partial(jax.jit, donate_argnums=(0,1,2))
+    def run(p,a,m):
+        (p,a,m,_), losses = jax.lax.scan(one, (p,a,m,jnp.asarray(1,jnp.int32)), None, length=steps)
+        return p,a,m,losses
+    try:
+        params, accs, masters, losses = run(params, accs, masters)
+        sync(losses)
+        t0=time.time()
+        params, accs, masters, losses = run(params, accs, masters)
+        sync(losses)
+        dt=(time.time()-t0-0.05)/steps
+        tps = batch*seq/dt
+        print(f"batch={batch} seq={seq} recompute={recompute}: {dt*1e3:.1f}ms/step {tps:,.0f} tok/s", flush=True)
+        return tps
+    except Exception as e:
+        print(f"batch={batch} seq={seq} recompute={recompute}: FAILED {str(e)[:150]}", flush=True)
+        return 0
+
+measure(4, 1536, False)
+measure(8, 1536, True)
+measure(16, 1536, True)

@@ -135,10 +135,14 @@ class PipelineParallel(Layer):
     result (schedules differ only in peak memory/bubble, not gradients).
     """
 
-    def __init__(self, layers, hcg=None, strategy=None, accumulate_steps=None):
+    def __init__(self, layers, hcg=None, strategy=None, accumulate_steps=None,
+                 schedule_mode="1F1B"):
         super().__init__()
         self._layers = layers
         self._hcg = hcg
+        if schedule_mode not in ("1F1B", "FThenB"):
+            raise ValueError("schedule_mode must be 1F1B or FThenB")
+        self.schedule_mode = schedule_mode
         self.accumulate_steps = accumulate_steps or (
             strategy.pipeline_configs.get("accumulate_steps", 1)
             if strategy is not None and hasattr(strategy, "pipeline_configs")
@@ -148,6 +152,12 @@ class PipelineParallel(Layer):
         return self._layers(*args, **kwargs)
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Micro-batched step. Gradients are identical across schedules; the
+        modes differ in held-activation count, as in the reference:
+        ``1F1B`` backwards each micro-batch as soon as its forward completes
+        (steady-state memory = one micro-batch of activations);
+        ``FThenB`` runs all forwards then all backwards
+        (pipeline_fthenb.py semantics — peak memory, kept for parity)."""
         inputs, labels = data
         n_micro = self.accumulate_steps
         batch = inputs.shape[0]
@@ -156,17 +166,30 @@ class PipelineParallel(Layer):
         mb = batch // n_micro
         total = None
         loss_fn = getattr(self._layers, "_loss_fn", None)
-        for m in range(n_micro):
+
+        def forward_micro(m):
             x = inputs[m * mb:(m + 1) * mb]
             y = labels[m * mb:(m + 1) * mb]
             out = self._layers(x)
             loss = loss_fn(out, y) if loss_fn is not None else out
-            loss = loss / n_micro
+            return loss / n_micro
+
+        def backward_micro(loss):
             if scaler is not None:
                 scaler.scale(loss).backward()
             else:
                 loss.backward()
-            total = loss if total is None else total + loss.detach()
+
+        if self.schedule_mode == "FThenB":
+            losses = [forward_micro(m) for m in range(n_micro)]
+            for loss in losses:
+                backward_micro(loss)
+                total = loss if total is None else total + loss.detach()
+        else:  # 1F1B
+            for m in range(n_micro):
+                loss = forward_micro(m)
+                backward_micro(loss)
+                total = loss if total is None else total + loss.detach()
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()

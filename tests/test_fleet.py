@@ -342,3 +342,21 @@ def test_moe_stacked_experts_ep_sharded():
     loss.backward()
     assert stacked.w_in.grad is not None
     assert moe.gate.gate.weight.grad is not None
+
+
+def test_pipeline_fthenb_matches_1f1b():
+    paddle.seed(0)
+
+    def run(mode):
+        paddle.seed(5)
+        pl = PipelineLayer(layers=[LayerDesc(nn.Linear, 4, 4)], num_stages=1,
+                           loss_fn=lambda o, y: ((o - y) ** 2).mean())
+        model = PipelineParallel(pl, accumulate_steps=4, schedule_mode=mode)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=pl.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0).rand(8, 4).astype(np.float32))
+        y = paddle.to_tensor(np.random.RandomState(1).rand(8, 4).astype(np.float32))
+        model.train_batch((x, y), opt)
+        return np.asarray(pl.run_functions[0][0].weight._value)
+
+    np.testing.assert_allclose(run("1F1B"), run("FThenB"), rtol=1e-6)
