@@ -23,7 +23,8 @@ def measure(batch, seq, recompute, steps=6):
     rng = jax.random.key_data(jax.random.PRNGKey(0))
     def loss_of(p):
         out, _ = functional(p, buffers, (paddle.Tensor._from_value(ids),), {}, rng)
-        return crit(paddle.Tensor._from_value(out._value), paddle.Tensor._from_value(ids))._value
+        out_v = out._value if hasattr(out, '_value') else out
+        return crit(paddle.Tensor._from_value(out_v), paddle.Tensor._from_value(ids))._value
     def one(carry, _):
         p,a,m,t = carry
         loss, grads = jax.value_and_grad(loss_of)(p)

@@ -4,6 +4,13 @@ Analog of /root/reference/python/paddle/hapi/ (Model.fit/evaluate/predict,
 callbacks, model_summary).
 """
 from . import summary as _summary_mod  # noqa: F401
-from .model import Callback, Model, ModelCheckpoint, ProgBarLogger  # noqa: F401
+from .model import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRSchedulerCallback,
+    Model,
+    ModelCheckpoint,
+    ProgBarLogger,
+)
 from .summary import summary  # noqa: F401
 from .dynamic_flops import flops  # noqa: F401

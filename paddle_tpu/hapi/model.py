@@ -16,7 +16,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint"]
+__all__ = ["Model", "Callback", "ProgBarLogger", "ModelCheckpoint", "EarlyStopping", "LRSchedulerCallback"]
 
 
 class Callback:
@@ -212,6 +212,8 @@ class Model:
             for cb in cbs:
                 cb.on_epoch_end(epoch, logs)
             history.append(logs)
+            if any(getattr(cb, "stop_training", False) for cb in cbs):
+                break
         for cb in cbs:
             cb.on_train_end()
         return history
@@ -290,3 +292,73 @@ def _name(m):
 
 def _scalar(v):
     return float(v[0]) if isinstance(v, (list, tuple)) else float(v)
+
+
+class EarlyStopping(Callback):
+    """Stop fit() when a monitored metric stops improving (reference
+    hapi/callbacks.py EarlyStopping)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        if mode == "auto":
+            mode = "max" if "acc" in monitor else "min"
+        self.mode = mode
+        self.wait = 0
+        self.best = None
+        self.stopped_epoch = 0
+        self.stop_training = False
+
+    def _better(self, cur, best):
+        if self.mode == "min":
+            return cur < best - self.min_delta
+        return cur > best + self.min_delta
+
+    def on_eval_end(self, logs=None):
+        self._check(logs or {})
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._check(logs or {}, epoch)
+
+    def _check(self, logs, epoch=0):
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        if self.best is None or self._better(cur, self.best):
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.stopped_epoch = epoch
+                self.stop_training = True
+                if self.verbose:
+                    print(f"early stopping at epoch {epoch} "
+                          f"({self.monitor}={cur:.5f} best={self.best:.5f})")
+
+
+class LRSchedulerCallback(Callback):
+    """Step the optimizer's LR scheduler (reference callbacks.LRScheduler)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        s = self._sched()
+        if self.by_step and s is not None:
+            s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        s = self._sched()
+        if self.by_epoch and s is not None:
+            s.step()

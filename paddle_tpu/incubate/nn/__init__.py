@@ -2,6 +2,7 @@
 
 Analog of /root/reference/python/paddle/incubate/nn/.
 """
+from . import functional  # noqa: F401
 from .fused_transformer import (  # noqa: F401
     FusedFeedForward,
     FusedMultiHeadAttention,

