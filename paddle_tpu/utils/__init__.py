@@ -1,0 +1,91 @@
+"""paddle_tpu.utils — misc utilities.
+
+Analog of /root/reference/python/paddle/utils/ (cpp_extension, deprecated,
+lazy_import, unique_name).
+"""
+from __future__ import annotations
+
+import functools
+import warnings
+
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["cpp_extension", "deprecated", "try_import", "unique_name", "flatten"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (reference utils/deprecated.py)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            msg = f"API {fn.__name__} is deprecated since {since or 'now'}"
+            if update_to:
+                msg += f"; use {update_to} instead"
+            if reason:
+                msg += f" ({reason})"
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"optional dependency {module_name!r} is missing "
+            "(this environment installs nothing at runtime)") from e
+
+
+class _UniqueName:
+    """reference utils/unique_name.py: process-wide name generator."""
+
+    def __init__(self):
+        self._counters = {}
+
+    def generate(self, key="tmp"):
+        n = self._counters.get(key, 0)
+        self._counters[key] = n + 1
+        return f"{key}_{n}"
+
+    def guard(self, new_generator=None):
+        import contextlib
+
+        @contextlib.contextmanager
+        def _guard():
+            saved = dict(self._counters)
+            try:
+                yield
+            finally:
+                self._counters = saved
+
+        return _guard()
+
+
+unique_name = _UniqueName()
+
+
+def flatten(nested):
+    """Flatten nested lists/tuples/dicts to a leaf list (utils/layers_utils)."""
+    out = []
+
+    def walk(x):
+        if isinstance(x, dict):
+            for k in sorted(x):
+                walk(x[k])
+        elif isinstance(x, (list, tuple)):
+            for v in x:
+                walk(v)
+        else:
+            out.append(x)
+
+    walk(nested)
+    return out
