@@ -17,10 +17,11 @@ import yaml
 from ..core.tensor import Tensor
 from . import backward as _backward_rules
 from . import kernels as _k
+from . import kernels_ext as _ext
 from . import nn_kernels as _nn
 from .registry import OPS, apply_op, get_op, register_op
 
-_MODULES = {"k": _k, "nn": _nn}
+_MODULES = {"k": _k, "ext": _ext, "nn": _nn}
 
 
 def _load_yaml_registry():
@@ -83,6 +84,34 @@ def _make_public(op_name):
 globals().update({name: _make_public(name) for name in OPS})
 
 
+def is_complex(x):
+    import jax.numpy as _jnp
+
+    return bool(_jnp.issubdtype(x._value.dtype, _jnp.complexfloating))
+
+
+def is_floating_point(x):
+    import jax.numpy as _jnp
+
+    return bool(_jnp.issubdtype(x._value.dtype, _jnp.floating))
+
+
+def is_integer(x):
+    import jax.numpy as _jnp
+
+    return bool(_jnp.issubdtype(x._value.dtype, _jnp.integer))
+
+
+def is_empty(x):
+    return x.size == 0
+
+
+def broadcast_shape(x_shape, y_shape):
+    import jax.numpy as _jnp
+
+    return list(_jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
 def einsum(equation, *operands):
     """Reference paddle.einsum(equation, *operands) — variadic surface over
     the registered einsum op (python/paddle/tensor/einsum.py)."""
@@ -91,7 +120,7 @@ def einsum(equation, *operands):
     return apply_op(OPS["einsum"], equation, list(operands))
 
 
-__all__ = list(OPS)
+__all__ = list(OPS) + ["is_complex", "is_floating_point", "is_integer", "is_empty", "broadcast_shape"]
 
 
 # -------------------- indexing --------------------

@@ -1,0 +1,682 @@
+"""Extended op kernels — the long tail of the reference's tensor surface.
+
+Analog of the remaining public functions in
+/root/reference/python/paddle/tensor/{math,manipulation,creation,logic,
+search,stat,random,linalg}.py not covered by kernels.py. Same conventions:
+pure functions over jax arrays, registered through ops/yaml/ops.yaml.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+# ------------------------------------------------------------ elementwise
+
+def angle(x):
+    return jnp.angle(x)
+
+
+def conj(x):
+    return jnp.conj(x)
+
+
+def real(x):
+    return jnp.real(x)
+
+
+def imag(x):
+    return jnp.imag(x)
+
+
+def copysign(x, y):
+    return jnp.copysign(x, y)
+
+
+def deg2rad(x):
+    return jnp.deg2rad(x)
+
+
+def rad2deg(x):
+    return jnp.rad2deg(x)
+
+
+def digamma(x):
+    return jax.scipy.special.digamma(x)
+
+
+def lgamma(x):
+    return lax.lgamma(x)
+
+
+def gammaln(x):
+    return jax.scipy.special.gammaln(x)
+
+
+def gammainc(x, y):
+    return jax.scipy.special.gammainc(x, y)
+
+
+def gammaincc(x, y):
+    return jax.scipy.special.gammaincc(x, y)
+
+
+def fmax(x, y):
+    return jnp.fmax(x, y)
+
+
+def fmin(x, y):
+    return jnp.fmin(x, y)
+
+
+def gcd(x, y):
+    return jnp.gcd(x, y)
+
+
+def lcm(x, y):
+    return jnp.lcm(x, y)
+
+
+def heaviside(x, y):
+    return jnp.heaviside(x, y)
+
+
+def hypot(x, y):
+    return jnp.hypot(x, y)
+
+
+def i0(x):
+    return jax.scipy.special.i0(x)
+
+
+def i0e(x):
+    return jax.scipy.special.i0e(x)
+
+
+def i1(x):
+    return jax.scipy.special.i1(x)
+
+
+def i1e(x):
+    return jax.scipy.special.i1e(x)
+
+
+def isneginf(x):
+    return jnp.isneginf(x)
+
+
+def isposinf(x):
+    return jnp.isposinf(x)
+
+
+def isreal(x):
+    return jnp.isreal(x)
+
+
+def isin(x, test_x, assume_unique=False, invert=False):
+    return jnp.isin(x, test_x, assume_unique=assume_unique, invert=invert)
+
+
+def ldexp(x, y):
+    return jnp.ldexp(x, y.astype(jnp.int32))
+
+
+def frexp(x):
+    m, e = jnp.frexp(x)
+    return m, e.astype(jnp.int32)
+
+
+def logaddexp(x, y):
+    return jnp.logaddexp(x, y)
+
+
+def neg(x):
+    return jnp.negative(x)
+
+
+def nextafter(x, y):
+    return jnp.nextafter(x, y)
+
+
+def polar(abs, angle):
+    return abs * jnp.exp(1j * angle.astype(jnp.complex64))
+
+
+def sgn(x):
+    if jnp.issubdtype(x.dtype, jnp.complexfloating):
+        mag = jnp.abs(x)
+        return jnp.where(mag == 0, 0, x / jnp.where(mag == 0, 1, mag))
+    return jnp.sign(x)
+
+
+def signbit(x):
+    return jnp.signbit(x)
+
+
+def sinc(x):
+    return jnp.sinc(x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def square_(x):
+    return jnp.square(x)
+
+
+def complex(real, imag):
+    return lax.complex(real, imag)
+
+
+def as_complex(x):
+    return lax.complex(x[..., 0], x[..., 1])
+
+
+def as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+# ------------------------------------------------------------ reductions
+
+def logcumsumexp(x, axis=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    return lax.cumlogsumexp(x, axis=axis)
+
+
+def cummin(x, axis=None):
+    if axis is None:
+        x = jnp.ravel(x)
+        axis = 0
+    vals = lax.associative_scan(jnp.minimum, x, axis=axis)
+    n = x.shape[axis]
+    eq = x == vals
+    idx = jnp.arange(n).reshape([-1 if i == (axis % x.ndim) else 1
+                                 for i in range(x.ndim)])
+    big = jnp.where(eq, jnp.broadcast_to(idx, x.shape), n)
+    indices = lax.associative_scan(jnp.minimum, big, axis=axis)
+    return vals, indices.astype(jnp.int64)
+
+
+def nanquantile(x, q, axis=None, keepdim=False):
+    return jnp.nanquantile(x, q, axis=axis, keepdims=keepdim)
+
+
+def nanmedian(x, axis=None, keepdim=False):
+    return jnp.nanmedian(x, axis=axis, keepdims=keepdim)
+
+
+def mode(x, axis=-1, keepdim=False):
+    def mode1d(v):
+        vals, counts = jnp.unique(v, return_counts=True,
+                                  size=v.shape[-1], fill_value=v[..., 0])
+        i = jnp.argmax(counts)
+        return vals[i]
+
+    moved = jnp.moveaxis(x, axis, -1)
+    flat = moved.reshape(-1, moved.shape[-1])
+    vals = jax.vmap(mode1d)(flat)
+    # index of the last occurrence (paddle convention)
+    idx = jnp.argmax(
+        (flat == vals[:, None]) * jnp.arange(flat.shape[-1])[None, :], axis=-1)
+    out_shape = moved.shape[:-1]
+    vals = vals.reshape(out_shape)
+    idx = idx.reshape(out_shape)
+    if keepdim:
+        vals = jnp.expand_dims(vals, axis)
+        idx = jnp.expand_dims(idx, axis)
+    return vals, idx.astype(jnp.int64)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    taken = jnp.take(vals, k - 1, axis=axis)
+    taken_i = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        taken = jnp.expand_dims(taken, axis)
+        taken_i = jnp.expand_dims(taken_i, axis)
+    return taken, taken_i.astype(jnp.int64)
+
+
+def dist(x, y, p=2.0):
+    return jnp.linalg.norm(jnp.ravel(x - y), ord=p)
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False):
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1):
+    import jax.scipy.integrate as jsi  # noqa: F401
+
+    n = y.shape[axis]
+    ya = jnp.take(y, jnp.arange(n - 1), axis=axis)
+    yb = jnp.take(y, jnp.arange(1, n), axis=axis)
+    if x is not None:
+        xa = jnp.take(x, jnp.arange(n - 1), axis=-1)
+        xb = jnp.take(x, jnp.arange(1, n), axis=-1)
+        step = (xb - xa)
+        shape = [1] * y.ndim
+        shape[axis] = -1
+        step = step.reshape(shape) if step.ndim == 1 else step
+    else:
+        step = 1.0 if dx is None else dx
+    return jnp.cumsum((ya + yb) * step / 2.0, axis=axis)
+
+
+def corrcoef(x, rowvar=True):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+# ------------------------------------------------------------ manipulation
+
+def add_n(xs):
+    out = xs[0]
+    for v in xs[1:]:
+        out = out + v
+    return out
+
+
+def atleast_1d(x):
+    return jnp.atleast_1d(x)
+
+
+def atleast_2d(x):
+    return jnp.atleast_2d(x)
+
+
+def atleast_3d(x):
+    return jnp.atleast_3d(x)
+
+
+def block_diag(xs):
+    return jax.scipy.linalg.block_diag(*xs)
+
+
+def broadcast_tensors(xs):
+    shape = jnp.broadcast_shapes(*(v.shape for v in xs))
+    return tuple(jnp.broadcast_to(v, shape) for v in xs)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    out = jnp.searchsorted(sorted_sequence, x,
+                           side="right" if right else "left")
+    return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+
+def cdist(x, y, p=2.0):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+    return jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+
+def clone(x):
+    return jnp.array(x)
+
+
+def column_stack(xs):
+    return jnp.column_stack(xs)
+
+
+def row_stack(xs):
+    return jnp.vstack(xs)
+
+
+def hstack(xs):
+    return jnp.hstack(xs)
+
+
+def vstack(xs):
+    return jnp.vstack(xs)
+
+
+def dstack(xs):
+    return jnp.dstack(xs)
+
+
+def hsplit(x, num_or_indices):
+    return tuple(jnp.hsplit(x, num_or_indices))
+
+
+def vsplit(x, num_or_indices):
+    return tuple(jnp.vsplit(x, num_or_indices))
+
+
+def dsplit(x, num_or_indices):
+    return tuple(jnp.dsplit(x, num_or_indices))
+
+
+def tensor_split(x, num_or_indices, axis=0):
+    return tuple(jnp.array_split(x, num_or_indices, axis=axis))
+
+
+def combinations(x, r=2, with_replacement=False):
+    import itertools
+
+    n = x.shape[0]
+    idx = (itertools.combinations_with_replacement(range(n), r)
+           if with_replacement else itertools.combinations(range(n), r))
+    idx = np.asarray(list(idx), np.int32).reshape(-1, r)
+    return x[idx]
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    n = x.shape[-1] + abs(offset)
+    out = jnp.zeros(x.shape[:-1] + (n, n), x.dtype)
+    rows = jnp.arange(x.shape[-1]) + max(-offset, 0)
+    cols = jnp.arange(x.shape[-1]) + max(offset, 0)
+    out = out.at[..., rows, cols].set(x)
+    if (dim1, dim2) != (-2, -1):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+def diagflat(x, offset=0):
+    return jnp.diagflat(x, k=offset)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1):
+    moved = jnp.moveaxis(x, (axis1, axis2), (-2, -1))
+    n = min(moved.shape[-2], moved.shape[-1]) - abs(offset)
+    rows = jnp.arange(n) + max(-offset, 0)
+    cols = jnp.arange(n) + max(offset, 0)
+    moved = moved.at[..., rows, cols].set(y)
+    return jnp.moveaxis(moved, (-2, -1), (axis1, axis2))
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+def equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1):
+    return diagonal_scatter(x, y, offset, dim1, dim2)
+
+
+def index_add(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    vmoved = jnp.moveaxis(value, axis, 0)
+    out = moved.at[index].add(vmoved)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_fill(x, index, axis, value):
+    moved = jnp.moveaxis(x, axis, 0)
+    out = moved.at[index].set(value)
+    return jnp.moveaxis(out, 0, axis)
+
+
+def index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def masked_scatter(x, mask, value):
+    flat_val = jnp.ravel(value)
+    cnt = jnp.cumsum(jnp.ravel(mask)) - 1
+    gathered = flat_val[jnp.clip(cnt, 0, flat_val.shape[0] - 1)]
+    return jnp.where(jnp.ravel(mask), gathered, jnp.ravel(x)).reshape(x.shape)
+
+
+def moveaxis(x, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def renorm(x, p, axis, max_norm):
+    moved = jnp.moveaxis(x, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.linalg.norm(flat, ord=p, axis=1)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    out = flat * scale[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+def select_scatter(x, value, axis, index):
+    return jnp.moveaxis(
+        jnp.moveaxis(x, axis, 0).at[index].set(value), 0, axis)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides):
+    idx = [slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        idx[ax] = slice(st, en, sd)
+    return x.at[tuple(idx)].set(value)
+
+
+def scatter_nd(index, updates, shape):
+    out = jnp.zeros(tuple(shape), updates.dtype)
+    return out.at[tuple(jnp.moveaxis(index, -1, 0))].add(updates)
+
+
+def t(x):
+    if x.ndim < 2:
+        return x
+    assert x.ndim == 2, "paddle.t expects 0/1/2-D"
+    return x.T
+
+
+def take(x, index, mode="raise"):
+    flat = jnp.ravel(x)
+    idx = jnp.ravel(index)
+    if mode == "wrap":
+        idx = idx % flat.shape[0]
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, flat.shape[0] - 1)
+    return flat[idx].reshape(index.shape)
+
+
+def tensordot(x, y, axes=2):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(a) for a in axes)
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def unflatten(x, axis, shape):
+    new_shape = list(x.shape)
+    new_shape[axis:axis + 1] = list(shape)
+    return x.reshape(new_shape)
+
+
+def unstack(x, axis=0, num=None):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    v = jnp.ravel(x) if axis is None else x
+    change = jnp.concatenate(
+        [jnp.ones(1, bool), v[1:] != v[:-1]]) if v.ndim == 1 else None
+    vals = v[change] if change is not None else v
+    outs = [vals]
+    if return_inverse:
+        outs.append(jnp.cumsum(change) - 1)
+    if return_counts:
+        idx = jnp.nonzero(change)[0]
+        counts = jnp.diff(jnp.concatenate([idx, jnp.asarray([v.shape[0]])]))
+        outs.append(counts)
+    return tuple(outs) if len(outs) > 1 else outs[0]
+
+
+def vander(x, n=None, increasing=False):
+    return jnp.vander(x, N=n, increasing=increasing)
+
+
+def crop(x, shape, offsets=None):
+    offsets = offsets or [0] * x.ndim
+    idx = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[idx]
+
+
+def multiplex(inputs, index):
+    stacked = jnp.stack(inputs, axis=0)  # (N, B, ...)
+    idx = jnp.ravel(index).astype(jnp.int32)
+    return stacked[idx, jnp.arange(stacked.shape[1])]
+
+
+def shard_index(x, index_num, nshards, shard_id, ignore_value=-1):
+    size = index_num // nshards
+    lo = shard_id * size
+    hi = lo + size
+    inside = (x >= lo) & (x < hi)
+    return jnp.where(inside, x - lo, ignore_value)
+
+
+def increment(x, value=1.0):
+    return x + value
+
+
+# ------------------------------------------------------------ creation
+
+def logspace(start, stop, num, base=10.0, dtype="float32"):
+    from ..core.dtype import to_jax_dtype
+
+    return jnp.logspace(start, stop, int(num), base=base,
+                        dtype=to_jax_dtype(dtype))
+
+
+def tril_indices(row, col=None, offset=0):
+    col = col if col is not None else row
+    r, c = jnp.tril_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(jnp.int64)
+
+
+def triu_indices(row, col=None, offset=0):
+    col = col if col is not None else row
+    r, c = jnp.triu_indices(row, k=offset, m=col)
+    return jnp.stack([r, c]).astype(jnp.int64)
+
+
+# ------------------------------------------------------------ linalg extras
+
+def cholesky_solve(x, y, upper=False):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+def cholesky_inverse(x, upper=False):
+    n = x.shape[-1]
+    return jax.scipy.linalg.cho_solve((x, not upper), jnp.eye(n, dtype=x.dtype))
+
+
+def eigvals(x):
+    return jnp.linalg.eigvals(x)
+
+
+def eigvalsh(x, UPLO="L"):
+    return jnp.linalg.eigvalsh(x, UPLO=UPLO)
+
+
+def matrix_exp(x):
+    return jax.scipy.linalg.expm(x)
+
+
+def lu(x, pivot=True):
+    lu_mat, piv = jax.scipy.linalg.lu_factor(x)
+    return lu_mat, (piv + 1).astype(jnp.int32)  # paddle returns 1-based pivots
+
+
+def multi_dot(xs):
+    out = xs[0]
+    for v in xs[1:]:
+        out = out @ v
+    return out
+
+
+# ------------------------------------------------------------ random
+
+def normal(mean=0.0, std=1.0, shape=None, *, rng_key=None):
+    from ..core.random import next_key
+
+    key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+           else next_key())
+    return mean + std * jax.random.normal(key, tuple(shape or ()))
+
+
+def standard_normal(shape, dtype="float32", *, rng_key=None):
+    from ..core.dtype import to_jax_dtype
+    from ..core.random import next_key
+
+    key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+           else next_key())
+    return jax.random.normal(key, tuple(shape), to_jax_dtype(dtype))
+
+
+def standard_gamma(alpha, *, rng_key=None):
+    from ..core.random import next_key
+
+    key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+           else next_key())
+    return jax.random.gamma(key, alpha)
+
+
+def poisson(x, *, rng_key=None):
+    from ..core.random import next_key
+
+    key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+           else next_key())
+    return jax.random.poisson(key, x).astype(jnp.float32)
+
+
+def binomial(count, prob, *, rng_key=None):
+    from ..core.random import next_key
+
+    key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+           else next_key())
+    return jax.random.binomial(key, count, prob).astype(jnp.int64)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, *, rng_key=None):
+    return jnp.exp(normal(mean, std, shape, rng_key=rng_key))
+
+
+def randint_like(x, low=0, high=None, dtype=None, *, rng_key=None):
+    from ..core.random import next_key
+
+    key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+           else next_key())
+    if high is None:
+        low, high = 0, low
+    return jax.random.randint(key, x.shape, int(low), int(high),
+                              dtype=jnp.int64)
+
+
+# ------------------------------------------------------------ predicates
+
+def is_complex(x):
+    return bool(jnp.issubdtype(x.dtype, jnp.complexfloating))
+
+
+def is_floating_point(x):
+    return bool(jnp.issubdtype(x.dtype, jnp.floating))
+
+
+def is_integer(x):
+    return bool(jnp.issubdtype(x.dtype, jnp.integer))
+
+
+def is_empty(x):
+    return x.size == 0
+
+
+def rank(x):
+    return jnp.asarray(x.ndim)

@@ -121,13 +121,18 @@ for name, dom, ref in [
 # ---- binary elementwise
 BINARY = {
     "add": np.add, "subtract": np.subtract, "multiply": np.multiply,
-    "maximum": np.maximum, "minimum": np.minimum,
     "atan2": np.arctan2,
 }
 for name, ref in BINARY.items():
     case(name, lambda: ((T(P((3, 4))), T(P((3, 4)))), {}),
          (lambda x, y, _r=ref: _r(x, y)))
 case("divide", lambda: ((T(P((3, 4))), T(PP((3, 4)))), {}), np.divide)
+# tie-free operands: finite differences flip the selected branch when
+# |x - y| < 2*eps
+case("maximum", lambda: ((T(P((3, 4), 0.0, 1.0)), T(P((3, 4), 1.1, 2.0))),
+                         {}), np.maximum)
+case("minimum", lambda: ((T(P((3, 4), 0.0, 1.0)), T(P((3, 4), 1.1, 2.0))),
+                         {}), np.minimum)
 case("pow", lambda: ((T(PP((3, 4))), T(P((3, 4), 1.0, 2.0))), {}), np.power)
 case("remainder", lambda: ((T(PP((3, 4))), T(PP((3, 4)))), {}),
      np.remainder, grad=False)
@@ -502,13 +507,204 @@ case("rotary_position_embedding",
      lambda: ((T(P((1, 4, 2, 8))), T(P((1, 4, 2, 8))),
                T(P((16, 8))), T(P((16, 8)))), {}), None, grad=False)
 
+# ---- extended surface (kernels_ext.py)
+case("angle", lambda: ((T(P((3,)).astype(np.complex64)),), {}), np.angle,
+     grad=False)
+case("conj", lambda: ((T(P((3,)).astype(np.complex64)),), {}), np.conj,
+     grad=False)
+case("real", lambda: ((T(P((3,)).astype(np.complex64)),), {}), np.real,
+     grad=False)
+case("imag", lambda: ((T(P((3,)).astype(np.complex64)),), {}), np.imag,
+     grad=False)
+case("copysign", lambda: ((T(P((3,))), T(P((3,)))), {}), np.copysign,
+     grad=False)
+case("deg2rad", lambda: ((T(P((3,)) * 180),), {}), np.deg2rad)
+case("rad2deg", lambda: ((T(P((3,))),), {}), np.rad2deg)
+case("digamma", lambda: ((T(PP((3,)) + 1),), {}), None)
+case("lgamma", lambda: ((T(PP((3,)) + 1),), {}), None)
+case("gammaln", lambda: ((T(PP((3,)) + 1),), {}), None)
+case("gammainc", lambda: ((T(PP((3,))), T(PP((3,)))), {}), None, grad=False)
+case("gammaincc", lambda: ((T(PP((3,))), T(PP((3,)))), {}), None, grad=False)
+case("fmax", lambda: ((T(P((3,))), T(P((3,)))), {}), np.fmax)
+case("fmin", lambda: ((T(P((3,))), T(P((3,)))), {}), np.fmin)
+case("gcd", lambda: ((T(np.array([4, 6])), T(np.array([6, 9]))), {}),
+     np.gcd, grad=False)
+case("lcm", lambda: ((T(np.array([4, 6])), T(np.array([6, 9]))), {}),
+     np.lcm, grad=False)
+case("heaviside", lambda: ((T(P((3,), 0.2, 1.0)), T(P((3,)))), {}),
+     np.heaviside)
+case("hypot", lambda: ((T(PP((3,))), T(PP((3,)))), {}), np.hypot)
+case("i0", lambda: ((T(P((3,))),), {}), None)
+case("i0e", lambda: ((T(P((3,))),), {}), None, grad=False)
+case("i1", lambda: ((T(P((3,))),), {}), None, grad=False)
+case("i1e", lambda: ((T(P((3,))),), {}), None, grad=False)
+case("isneginf", lambda: ((T(np.array([1.0, -np.inf])),), {}), np.isneginf,
+     grad=False)
+case("isposinf", lambda: ((T(np.array([1.0, np.inf])),), {}), np.isposinf,
+     grad=False)
+case("isreal", lambda: ((T(P((3,))),), {}), np.isreal, grad=False)
+case("isin", lambda: ((T(np.array([1, 2, 3])), T(np.array([2]))), {}),
+     None, grad=False)
+case("ldexp", lambda: ((T(P((3,))), T(np.array([1.0, 2.0, 3.0]))), {}),
+     lambda x, y: np.ldexp(x, y.astype(np.int32)), grad=False)
+case("frexp", lambda: ((T(PP((3,))),), {}), None, grad=False)
+case("logaddexp", lambda: ((T(P((3,))), T(P((3,)))), {}), np.logaddexp)
+case("neg", lambda: ((T(P((3,))),), {}), np.negative)
+case("nextafter", lambda: ((T(P((3,))), T(P((3,)))), {}), np.nextafter,
+     grad=False)
+case("polar", lambda: ((T(PP((3,))), T(P((3,)))), {}),
+     lambda a, t: a * np.exp(1j * t).astype(np.complex64), grad=False)
+case("sgn", lambda: ((T(P((3,))),), {}), np.sign, grad=False)
+case("signbit", lambda: ((T(P((3,))),), {}), np.signbit, grad=False)
+case("sinc", lambda: ((T(P((3,))),), {}), np.sinc)
+case("stanh", lambda: ((T(P((3,))),), {}),
+     lambda v: 1.7159 * np.tanh(0.67 * v))
+case("complex", lambda: ((T(P((3,))), T(P((3,)))), {}),
+     lambda r, i: r + 1j * i, grad=False)
+case("as_complex", lambda: ((T(P((3, 2))),), {}),
+     lambda v: v[..., 0] + 1j * v[..., 1], grad=False)
+case("as_real", lambda: ((T(P((3,)).astype(np.complex64)),), {}),
+     lambda v: np.stack([v.real, v.imag], -1), grad=False)
+case("logcumsumexp", lambda: ((T(P((5,))),), {}),
+     lambda v: np.log(np.cumsum(np.exp(v))))
+case("cummin", lambda: ((T(P((5,))),), {}), None, grad=False)
+case("nanquantile", lambda: ((T(P((5,))),), {"q": 0.5}),
+     lambda v: np.nanquantile(v, 0.5), grad=False)
+case("nanmedian", lambda: ((T(P((5,))),), {}), np.nanmedian, grad=False)
+case("mode", lambda: ((T(np.array([1.0, 2.0, 2.0, 3.0])),), {}), None,
+     grad=False)
+case("kthvalue", lambda: ((T(P((5,))),), {"k": 2}), None, grad=False)
+case("dist", lambda: ((T(P((3,))), T(P((3,)))), {}),
+     lambda x, y: np.linalg.norm(x - y))
+case("vector_norm", lambda: ((T(P((3, 4))),), {"axis": 1}),
+     lambda v: np.linalg.norm(v, 2, 1))
+case("trapezoid", lambda: ((T(P((5,))), None), {}),
+     lambda y: np.trapezoid(y) if hasattr(np, "trapezoid") else np.trapz(y))
+case("cumulative_trapezoid", lambda: ((T(P((5,))), None), {}), None)
+case("corrcoef", lambda: ((T(P((3, 6))),), {}), np.corrcoef, grad=False)
+case("cov", lambda: ((T(P((3, 6))),), {}), lambda v: np.cov(v, ddof=1))
+case("add_n", lambda: (([T(P((3,))), T(P((3,))), T(P((3,)))],), {}), None)
+case("atleast_1d", lambda: ((T(np.float32(3.0)),), {}), np.atleast_1d)
+case("atleast_2d", lambda: ((T(P((3,))),), {}), np.atleast_2d)
+case("atleast_3d", lambda: ((T(P((3,))),), {}), np.atleast_3d)
+case("block_diag", lambda: (([T(P((2, 2))), T(P((3, 3)))],), {}), None)
+case("broadcast_tensors", lambda: (([T(P((1, 4))), T(P((3, 1)))],), {}),
+     None, grad=False)
+case("bucketize", lambda: ((T(np.array([0.5, 2.5])),
+                            T(np.array([1.0, 2.0, 3.0]))), {}),
+     None, grad=False)
+case("cdist", lambda: ((T(P((3, 4))), T(P((5, 4)))), {}), None)
+case("clone", lambda: ((T(P((3,))),), {}), lambda v: v)
+case("column_stack", lambda: (([T(P((3,))), T(P((3,)))],), {}),
+     None)
+case("row_stack", lambda: (([T(P((2, 3))), T(P((2, 3)))],), {}), None)
+case("hstack", lambda: (([T(P((3,))), T(P((3,)))],), {}), None)
+case("vstack", lambda: (([T(P((2, 3))), T(P((2, 3)))],), {}), None)
+case("dstack", lambda: (([T(P((2, 3))), T(P((2, 3)))],), {}), None)
+case("hsplit", lambda: ((T(P((4, 4))),), {"num_or_indices": 2}), None,
+     grad=False)
+case("vsplit", lambda: ((T(P((4, 4))),), {"num_or_indices": 2}), None,
+     grad=False)
+case("dsplit", lambda: ((T(P((2, 2, 4))),), {"num_or_indices": 2}), None,
+     grad=False)
+case("tensor_split", lambda: ((T(P((5, 2))),), {"num_or_indices": 2}),
+     None, grad=False)
+case("combinations", lambda: ((T(P((4,))),), {"r": 2}), None, grad=False)
+case("diag_embed", lambda: ((T(P((2, 3))),), {}), None)
+case("diagflat", lambda: ((T(P((3,))),), {}), np.diagflat)
+case("diagonal_scatter", lambda: ((T(P((3, 3))), T(P((3,)))), {}), None)
+case("diff", lambda: ((T(P((5,))),), {}), np.diff)
+case("equal_all", lambda: ((T(P((3,))), T(P((3,)))), {}), None, grad=False)
+case("fill_diagonal_tensor", lambda: ((T(P((3, 3))), T(P((3,)))), {}),
+     None)
+case("index_add", lambda: ((T(P((4, 3))), T(np.array([0, 2]))),
+                           {"axis": 0, "value": T(P((2, 3)))}), None,
+     grad=False)
+case("index_fill", lambda: ((T(P((4, 3))), T(np.array([0, 2]))),
+                            {"axis": 0, "value": 0.0}), None, grad=False)
+case("index_sample", lambda: ((T(P((3, 5))),
+                               T(np.array([[0, 1], [2, 3], [4, 0]]))), {}),
+     lambda v, i: np.take_along_axis(v, np.array([[0, 1], [2, 3], [4, 0]]), 1))
+case("masked_scatter", lambda: ((T(P((4,))), T(np.array([True, False, True, False])),
+                                 T(P((4,)))), {}), None, grad=False)
+case("moveaxis", lambda: ((T(P((2, 3, 4))),),
+                          {"source": 0, "destination": 2}),
+     lambda v: np.moveaxis(v, 0, 2))
+case("renorm", lambda: ((T(P((3, 4))),), {"p": 2.0, "axis": 0,
+                                          "max_norm": 1.0}), None)
+case("rot90", lambda: ((T(P((3, 4))),), {}), lambda v: np.rot90(v))
+case("select_scatter", lambda: ((T(P((3, 4))), T(P((4,)))),
+                                {"axis": 0, "index": 1}), None)
+case("slice_scatter", lambda: ((T(P((4, 4))), T(P((2, 4)))),
+                               {"axes": [0], "starts": [0], "ends": [2],
+                                "strides": [1]}), None)
+case("scatter_nd", lambda: ((T(np.array([[1], [3]])), T(P((2,)))),
+                            {"shape": [5]}), None, grad=False)
+case("t", lambda: ((T(P((3, 4))),), {}), lambda v: v.T)
+case("take", lambda: ((T(P((3, 4))), T(np.array([0, 5, 11]))), {}),
+     lambda v, i: v.flatten()[[0, 5, 11]], grad=False)
+case("tensordot", lambda: ((T(P((3, 4))), T(P((4, 5)))), {"axes": 1}),
+     lambda x, y: np.tensordot(x, y, 1))
+case("unflatten", lambda: ((T(P((6,))),), {"axis": 0, "shape": [2, 3]}),
+     lambda v: v.reshape(2, 3))
+case("unstack", lambda: ((T(P((3, 4))),), {}), None, grad=False)
+case("unique_consecutive", lambda: ((T(np.array([1, 1, 2, 3, 3])),), {}),
+     None, grad=False)
+case("vander", lambda: ((T(P((3,))),), {}), np.vander, grad=False)
+case("crop", lambda: ((T(P((4, 4))),), {"shape": [2, 2],
+                                        "offsets": [1, 1]}),
+     lambda v: v[1:3, 1:3])
+case("multiplex", lambda: (([T(P((3, 2))), T(P((3, 2)))],
+                            T(np.array([[0], [1], [0]]))), {}), None,
+     grad=False)
+case("shard_index", lambda: ((T(np.array([0, 5, 9])),),
+                             {"index_num": 10, "nshards": 2, "shard_id": 0}),
+     None, grad=False)
+case("increment", lambda: ((T(P((3,))),), {}), lambda v: v + 1)
+case("logspace", lambda: ((), {"start": 0, "stop": 2, "num": 3}), None,
+     grad=False)
+case("tril_indices", lambda: ((), {"row": 3}), None, grad=False)
+case("triu_indices", lambda: ((), {"row": 3}), None, grad=False)
+case("cholesky_solve",
+     lambda: ((T(P((3, 1))),
+               T(np.linalg.cholesky((lambda a: a @ a.T + 3 * np.eye(3))(
+                   P((3, 3)))).astype(np.float32))), {}), None, grad=False)
+case("cholesky_inverse",
+     lambda: ((T(np.linalg.cholesky((lambda a: a @ a.T + 3 * np.eye(3))(
+         P((3, 3)))).astype(np.float32)),), {}), None, grad=False)
+case("eigvals", lambda: ((T(P((3, 3))),), {}), None, grad=False)
+case("eigvalsh", lambda: ((T(np.eye(3, dtype=np.float32) * 2),), {}),
+     lambda v: np.linalg.eigvalsh(v), grad=False)
+case("matrix_exp", lambda: ((T(P((3, 3)) * 0.1),), {}), None, grad=False)
+case("lu", lambda: ((T(P((3, 3)) + 2 * np.eye(3, dtype=np.float32)),), {}),
+     None, grad=False)
+case("multi_dot", lambda: (([T(P((2, 3))), T(P((3, 4))), T(P((4, 2)))],),
+                           {}), None)
+for name, kwargs in [
+    ("normal", {"mean": 0.0, "std": 1.0, "shape": [32]}),
+    ("standard_normal", {"shape": [32]}),
+    ("log_normal", {"shape": [16]}),
+]:
+    case(name, lambda kwargs=kwargs: ((), kwargs), None, grad=False)
+case("standard_gamma", lambda: ((T(PP((16,)) * 3),), {}), None, grad=False)
+case("poisson", lambda: ((T(PP((16,)) * 4),), {}), None, grad=False)
+case("binomial", lambda: ((T(np.full((8,), 10.0, np.float32)),
+                           T(np.full((8,), 0.5, np.float32))), {}), None,
+     grad=False)
+case("randint_like", lambda: ((T(P((8,))),), {"low": 0, "high": 5}), None,
+     grad=False)
+case("rank", lambda: ((T(P((2, 3))),), {}), None, grad=False)
+
 # internal composite ops covered by their own dedicated test files
+
+# (exemptions)
 EXEMPT = {
     "_gru_scan": "internal RNN kernel (tests/test_nn_layers.py)",
     "_lstm_scan": "internal RNN kernel (tests/test_nn_layers.py)",
     "_rnn_scan": "internal RNN kernel (tests/test_nn_layers.py)",
     "moe_dispatch": "MoE kernel (tests/test_fleet.py)",
     "moe_combine": "MoE kernel (tests/test_fleet.py)",
+    "_moe_expert_mm": "MoE kernel (tests/test_fleet.py)",
 }
 
 
