@@ -22,10 +22,25 @@ __all__ = ["seed", "get_rng_state", "set_rng_state", "next_key", "RNGStatesTrack
 
 
 class _RNG(threading.local):
+    """Root key is materialized lazily: creating a jax PRNG key initializes
+    the XLA backend, which must NOT happen at import time — multi-controller
+    processes have to call jax.distributed.initialize first
+    (distributed/collective.py init_parallel_env)."""
+
     def __init__(self):
         self.root_seed = 0
-        self.key = jax.random.key(0)
+        self._key = None
         self.counter = 0
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.key(self.root_seed)
+        return self._key
+
+    @key.setter
+    def key(self, value):
+        self._key = value
 
 
 _rng = _RNG()

@@ -103,11 +103,19 @@ def init_parallel_env():
         "JAX_COORDINATOR_ADDRESS"
     )
     nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
-    if coord and nprocs > 1 and jax.process_count() == 1:
+    if coord and nprocs > 1:
+        # NOTE: must run before anything touches the XLA backend (even
+        # jax.process_count() would initialize it) — core/random keys are
+        # lazy for exactly this reason.
         rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-        jax.distributed.initialize(
-            coordinator_address=coord, num_processes=nprocs, process_id=rank
-        )
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord, num_processes=nprocs,
+                process_id=rank,
+            )
+        except RuntimeError as e:
+            if "already" not in str(e):  # double-init is fine; else re-raise
+                raise
     _default_group = Group(ranks=list(range(len(jax.devices()))), gid=0)
     _groups[0] = _default_group
     return _default_group

@@ -44,6 +44,10 @@ class Pod:
         for rank in range(self.nprocs):
             env = dict(os.environ)
             env.update(self.base_env)
+            # workers run with sys.path[0] = script dir; keep the launcher's
+            # cwd importable (the reference launcher inherits it via cwd)
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in (os.getcwd(), env.get("PYTHONPATH", "")) if p)
             env.update({
                 "PADDLE_TRAINER_ID": str(rank),
                 "PADDLE_TRAINERS_NUM": str(self.nprocs),
