@@ -20,6 +20,7 @@ from .layers_attention import (  # noqa: F401
     TransformerEncoderLayer,
 )
 from .layers_common import *  # noqa: F401,F403
+from .layers_extra import *  # noqa: F401,F403
 from .layers_conv import *  # noqa: F401,F403
 from .layers_norm import *  # noqa: F401,F403
 from .layers_rnn import (  # noqa: F401

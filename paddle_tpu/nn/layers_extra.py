@@ -1,0 +1,773 @@
+"""Additional nn layers — the reference surface beyond the core set.
+
+Analogs of the remaining classes in /root/reference/python/paddle/nn/layer/
+(pooling.py, common.py, loss.py, rnn.py, vision.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import functional as F
+from . import initializer as I
+from .layer_base import Layer
+
+__all__ = [
+    "AdaptiveAvgPool1D", "AdaptiveAvgPool3D", "AdaptiveMaxPool1D",
+    "AdaptiveMaxPool3D", "AvgPool3D", "MaxPool3D",
+    "Bilinear", "ChannelShuffle", "Conv1DTranspose", "Conv3DTranspose",
+    "Fold", "InstanceNorm1D", "InstanceNorm3D", "LPPool1D", "LPPool2D",
+    "PairwiseDistance", "PixelUnshuffle", "RNN", "BiRNN", "RReLU", "Silu",
+    "Softmax2D", "ThresholdedReLU", "Unflatten", "ZeroPad1D", "ZeroPad2D",
+    "ZeroPad3D", "ParameterDict", "FeatureAlphaDropout",
+    "CosineEmbeddingLoss", "CTCLoss", "GaussianNLLLoss",
+    "MultiLabelSoftMarginLoss", "MultiMarginLoss", "PoissonNLLLoss",
+    "SoftMarginLoss", "TripletMarginLoss", "TripletMarginWithDistanceLoss",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _t(v):
+    return Tensor._from_value(v)
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+
+def _dispatch(fn, *tensors, **attrs):
+    """Run a pure jnp function over Tensor/array inputs with eager-tape
+    integration: under trace or no-grad it just runs; otherwise jax.vjp
+    captures the backward (same pattern as the registry's rule-less path)."""
+    import jax as _jax
+
+    from ..core import autograd as _ag
+    from ..core.autograd import GradNode as _GN
+
+    vals = [(_v(x) if x is not None else None) for x in tensors]
+    tensor_objs = [x for x in tensors if isinstance(x, Tensor)]
+    tracing = any(isinstance(v, _jax.core.Tracer) for v in vals if v is not None)
+    needs = (_ag.is_grad_enabled() and not tracing
+             and any(not t.stop_gradient for t in tensor_objs))
+    if not needs:
+        out = fn(*vals, **attrs)
+        if isinstance(out, tuple):
+            return tuple(_t(o) for o in out)
+        return _t(out)
+
+    diff_idx = [i for i, x in enumerate(tensors)
+                if isinstance(x, Tensor) and not x.stop_gradient]
+
+    def pure(diff_vals):
+        call = list(vals)
+        for i, v in zip(diff_idx, diff_vals):
+            call[i] = v
+        out = fn(*call, **attrs)
+        return out if isinstance(out, tuple) else (out,)
+
+    primals = [vals[i] for i in diff_idx]
+    outs, vjp_fn = _jax.vjp(pure, primals)
+    edges = [tensors[i]._grad_edge() for i in diff_idx]
+    shapes = [(o.shape, o.dtype) for o in outs]
+
+    def backward_fn(grad_outputs, _vjp=vjp_fn, _shapes=shapes):
+        gouts = tuple(
+            g if g is not None else jnp.zeros(s, d)
+            for g, (s, d) in zip(grad_outputs, _shapes))
+        (grads,) = _vjp(gouts)
+        return tuple(grads)
+
+    node = _GN("nn_extra", backward_fn, edges, len(outs),
+               tuple(True for _ in edges))
+    results = []
+    for i, o in enumerate(outs):
+        r = _t(o)
+        if jnp.issubdtype(o.dtype, jnp.inexact):
+            r.stop_gradient = False
+            r._grad_node = node
+            r._grad_slot = i
+        results.append(r)
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+# ------------------------------------------------------------ pooling
+
+def _adaptive_pool(x, output_size, nd, op):
+    v = _v(x)
+    if isinstance(output_size, int):
+        output_size = (output_size,) * nd
+    spatial = v.shape[-nd:]
+    out = v
+    for i, (s, o) in enumerate(zip(spatial, output_size)):
+        axis = v.ndim - nd + i
+        assert s % o == 0, f"adaptive pool needs divisible sizes {s}%{o}"
+        new_shape = out.shape[:axis] + (o, s // o) + out.shape[axis + 1:]
+        out = op(out.reshape(new_shape), axis=axis + 1)
+    return out
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return _dispatch(lambda v: _adaptive_pool(v, self.output_size, 1,
+                                                  jnp.mean), x)
+
+
+class AdaptiveMaxPool1D(AdaptiveAvgPool1D):
+    def forward(self, x):
+        return _dispatch(lambda v: _adaptive_pool(v, self.output_size, 1,
+                                                  jnp.max), x)
+
+
+class AdaptiveAvgPool3D(AdaptiveAvgPool1D):
+    def forward(self, x):
+        return _dispatch(lambda v: _adaptive_pool(v, self.output_size, 3,
+                                                  jnp.mean), x)
+
+
+class AdaptiveMaxPool3D(AdaptiveAvgPool1D):
+    def forward(self, x):
+        return _dispatch(lambda v: _adaptive_pool(v, self.output_size, 3,
+                                                  jnp.max), x)
+
+
+def _pool3d(x, kernel, stride, padding, op, init):
+    from jax import lax
+
+    if isinstance(kernel, int):
+        kernel = (kernel,) * 3
+    stride = stride or kernel
+    if isinstance(stride, int):
+        stride = (stride,) * 3
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * 3
+    dims = (1, 1) + tuple(kernel)
+    strides = (1, 1) + tuple(stride)
+    pads = [(0, 0), (0, 0)] + list(padding)
+    return lax.reduce_window(x, init, op, dims, strides, pads)
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = kernel_size, stride, padding
+
+    def forward(self, x):
+        from jax import lax
+
+        return _dispatch(
+            lambda v: _pool3d(v, self.kernel_size, self.stride, self.padding,
+                              lax.max, -jnp.inf), x)
+
+
+class AvgPool3D(MaxPool3D):
+    def forward(self, x):
+        from jax import lax
+
+        def avg(v):
+            s = _pool3d(v, self.kernel_size, self.stride, self.padding,
+                        lax.add, 0.0)
+            cnt = _pool3d(jnp.ones_like(v), self.kernel_size, self.stride,
+                          self.padding, lax.add, 0.0)
+            return s / cnt
+
+        return _dispatch(avg, x)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0, **kw):
+        super().__init__()
+        self.p = float(norm_type)
+        self.kernel_size = kernel_size
+        self.stride = stride or kernel_size
+        self.padding = padding
+
+    def _pool(self, v, nd):
+        from jax import lax
+
+        k = self.kernel_size
+        k = (k,) * nd if isinstance(k, int) else tuple(k)
+        s = self.stride
+        s = (s,) * nd if isinstance(s, int) else tuple(s)
+        pad = self.padding
+        pad = [(pad, pad)] * nd if isinstance(pad, int) else list(pad)
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+        pads = [(0, 0), (0, 0)] + pad
+        out = lax.reduce_window(jnp.abs(v) ** self.p, 0.0, lax.add, dims,
+                                strides, pads)
+        return out ** (1.0 / self.p)
+
+    def forward(self, x):
+        return _dispatch(lambda v: self._pool(v, 1), x)
+
+
+class LPPool2D(LPPool1D):
+    def forward(self, x):
+        return _dispatch(lambda v: self._pool(v, 2), x)
+
+
+# ------------------------------------------------------------ conv transpose
+
+class Conv1DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__()
+        import math
+
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,)
+        self.stride, self.padding, self.dilation = stride, padding, dilation
+        fan_in = (in_channels // groups) * kernel_size[0]
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups) + tuple(kernel_size),
+            attr=weight_attr,
+            default_initializer=I.Uniform(-1 / math.sqrt(fan_in),
+                                          1 / math.sqrt(fan_in)))
+        self.bias = self.create_parameter((out_channels,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        from jax import lax
+
+        stride = (self.stride,) if isinstance(self.stride, int) else tuple(self.stride)
+        k = self.weight.shape[2]
+        p = self.padding if isinstance(self.padding, int) else self.padding[0]
+
+        def fn(v, w, b):
+            out = lax.conv_transpose(
+                v, jnp.transpose(w, (2, 1, 0)),
+                strides=stride, padding=[(k - 1 - p, k - 1 - p)],
+                dimension_numbers=("NCH", "HIO", "NCH"),
+                transpose_kernel=True)
+            if b is not None:
+                out = out + b.reshape(1, -1, 1)
+            return out
+
+        return _dispatch(fn, x, self.weight, self.bias)
+
+
+class Conv3DTranspose(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__()
+        import math
+
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * 3
+        self.stride, self.padding = stride, padding
+        fan_in = (in_channels // groups) * int(np.prod(kernel_size))
+        self.weight = self.create_parameter(
+            (in_channels, out_channels // groups) + tuple(kernel_size),
+            attr=weight_attr,
+            default_initializer=I.Uniform(-1 / math.sqrt(fan_in),
+                                          1 / math.sqrt(fan_in)))
+        self.bias = self.create_parameter((out_channels,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        from jax import lax
+
+        st = (self.stride,) * 3 if isinstance(self.stride, int) else tuple(self.stride)
+        ks = self.weight.shape[2:5]
+        p = self.padding if isinstance(self.padding, int) else self.padding[0]
+        pad = [(k - 1 - p, k - 1 - p) for k in ks]
+
+        def fn(v, w, b):
+            out = lax.conv_transpose(
+                v, jnp.transpose(w, (2, 3, 4, 1, 0)),
+                strides=st, padding=pad,
+                dimension_numbers=("NCDHW", "DHWIO", "NCDHW"),
+                transpose_kernel=True)
+            if b is not None:
+                out = out + b.reshape(1, -1, 1, 1, 1)
+            return out
+
+        return _dispatch(fn, x, self.weight, self.bias)
+
+
+# ------------------------------------------------------------ misc layers
+
+class Bilinear(Layer):
+    """out[b, o] = x1[b, i] W[o, i, j] x2[b, j] + bias (common.py Bilinear)."""
+
+    def __init__(self, in1_features, in2_features, out_features,
+                 weight_attr=None, bias_attr=None, name=None):
+        super().__init__()
+        self.weight = self.create_parameter(
+            (out_features, in1_features, in2_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter((out_features,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x1, x2):
+        def fn(a, b, w, bias):
+            out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+            return out + bias if bias is not None else out
+
+        return _dispatch(fn, x1, x2, self.weight, self.bias)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW"):
+        super().__init__()
+        self.groups = groups
+
+    def forward(self, x):
+        def fn(v):
+            n, c, h, w = v.shape
+            g = self.groups
+            return v.reshape(n, g, c // g, h, w).swapaxes(1, 2).reshape(
+                n, c, h, w)
+
+        return _dispatch(fn, x)
+
+
+class PixelUnshuffle(Layer):
+    def __init__(self, downscale_factor, data_format="NCHW"):
+        super().__init__()
+        self.r = downscale_factor
+
+    def forward(self, x):
+        def fn(v):
+            n, c, h, w = v.shape
+            r = self.r
+            v = v.reshape(n, c, h // r, r, w // r, r)
+            return v.transpose(0, 1, 3, 5, 2, 4).reshape(
+                n, c * r * r, h // r, w // r)
+
+        return _dispatch(fn, x)
+
+
+class Fold(Layer):
+    """Inverse of unfold (common.py Fold): accumulate patches back."""
+
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1):
+        super().__init__()
+        as2 = lambda v: (v, v) if isinstance(v, int) else tuple(v)
+        self.output_sizes = as2(output_sizes)
+        self.kernel_sizes = as2(kernel_sizes)
+        self.strides = as2(strides)
+        self.paddings = as2(paddings)
+
+    def forward(self, x):
+        return _dispatch(self._fold, x)
+
+    def _fold(self, v):
+        n, ckk, L = v.shape
+        kh, kw = self.kernel_sizes
+        c = ckk // (kh * kw)
+        oh, ow = self.output_sizes
+        sh, sw = self.strides
+        ph, pw = self.paddings
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), v.dtype)
+        nh = (oh + 2 * ph - kh) // sh + 1
+        nw = (ow + 2 * pw - kw) // sw + 1
+        patches = v.reshape(n, c, kh, kw, nh, nw)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :, i:i + nh * sh:sh, j:j + nw * sw:sw].add(
+                    patches[:, :, i, j])
+        out = out[:, :, ph:ph + oh, pw:pw + ow]
+        return out
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self.epsilon = epsilon
+        self.weight = self.create_parameter(
+            (num_features,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter((num_features,), attr=bias_attr,
+                                          is_bias=True)
+        self._axes = (2,)
+
+    def forward(self, x):
+        def fn(v, w, b):
+            mean = v.mean(axis=self._axes, keepdims=True)
+            var = v.var(axis=self._axes, keepdims=True)
+            out = (v - mean) / jnp.sqrt(var + self.epsilon)
+            shape = (1, -1) + (1,) * len(self._axes)
+            return out * w.reshape(shape) + b.reshape(shape)
+
+        return _dispatch(fn, x, self.weight, self.bias)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, *args, **kwargs):
+        kwargs.pop("data_format", None)
+        super().__init__(*args, **kwargs)
+        self._axes = (2, 3, 4)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return _dispatch(
+            lambda a, b: jnp.linalg.norm(a - b + self.epsilon, ord=self.p,
+                                         axis=-1, keepdims=self.keepdim),
+            x, y)
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU (train: slope~U[lower,upper]; eval: mean)."""
+
+    def __init__(self, lower=1. / 8, upper=1. / 3, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        v = _v(x)
+        if self.training:
+            from ..core.random import next_key
+
+            slope = jax.random.uniform(next_key(), v.shape,
+                                       minval=self.lower, maxval=self.upper)
+        else:
+            slope = (self.lower + self.upper) / 2
+        return _dispatch(lambda u: jnp.where(u >= 0, u, u * slope), x)
+
+
+class Silu(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class Softmax2D(Layer):
+    def forward(self, x):
+        return _dispatch(lambda v: jax.nn.softmax(v, axis=-3), x)
+
+
+class ThresholdedReLU(Layer):
+    def __init__(self, threshold=1.0, name=None):
+        super().__init__()
+        self.threshold = threshold
+
+    def forward(self, x):
+        return _dispatch(
+            lambda v: jnp.where(v > self.threshold, v, 0.0), x)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from ..ops import unflatten
+
+        return unflatten(x, axis=self.axis, shape=self.shape)
+
+
+class _ZeroPadN(Layer):
+    def __init__(self, padding, nd, data_format=None, name=None):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * (2 * nd)
+        self.padding = list(padding)
+        self.nd = nd
+
+    def forward(self, x):
+        def fn(v):
+            pads = [(0, 0)] * (v.ndim - self.nd)
+            p = self.padding
+            for i in range(self.nd):
+                pads.append((p[2 * i], p[2 * i + 1]))
+            return jnp.pad(v, pads)
+
+        return _dispatch(fn, x)
+
+
+class ZeroPad1D(_ZeroPadN):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__(padding, 1)
+
+
+class ZeroPad2D(_ZeroPadN):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, 2)
+
+
+class ZeroPad3D(_ZeroPadN):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, 3)
+
+
+class ParameterDict(Layer):
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters:
+            for k, p in (parameters.items()
+                         if isinstance(parameters, dict) else parameters):
+                self.add_parameter(k, p)
+
+    def __getitem__(self, key):
+        return self._parameters[key]
+
+    def __setitem__(self, key, parameter):
+        self.add_parameter(key, parameter)
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters)
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def items(self):
+        return self._parameters.items()
+
+    def values(self):
+        return self._parameters.values()
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        from ..ops import alpha_dropout
+
+        return alpha_dropout(x, p=self.p, training=self.training)
+
+
+# ------------------------------------------------------------ RNN wrappers
+
+class RNN(Layer):
+    """Run a cell over time (rnn.py RNN): cell(input_t, state) -> (out, state)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None):
+        v = _v(inputs)
+        if not self.time_major:
+            v = jnp.swapaxes(v, 0, 1)  # (T, B, F)
+        if self.is_reverse:
+            v = v[::-1]
+        T = v.shape[0]
+        state = initial_states
+        outs = []
+        for t in range(T):
+            out, state = self.cell(_t(v[t]), state)
+            outs.append(_v(out))
+        seq = jnp.stack(outs, axis=0)
+        if self.is_reverse:
+            seq = seq[::-1]
+        if not self.time_major:
+            seq = jnp.swapaxes(seq, 0, 1)
+        return _t(seq), state
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None):
+        s_fw, s_bw = (initial_states if initial_states is not None
+                      else (None, None))
+        out_f, st_f = self.fw(inputs, s_fw)
+        out_b, st_b = self.bw(inputs, s_bw)
+        return _t(jnp.concatenate([_v(out_f), _v(out_b)], axis=-1)), (st_f, st_b)
+
+
+# ------------------------------------------------------------ losses
+
+class CosineEmbeddingLoss(Layer):
+    def __init__(self, margin=0.0, reduction="mean", name=None):
+        super().__init__()
+        self.margin, self.reduction = margin, reduction
+
+    def forward(self, input1, input2, label):
+        def fn(x1, x2, y):
+            cos = (x1 * x2).sum(-1) / (
+                jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1)
+                + 1e-12)
+            loss = jnp.where(y == 1, 1 - cos,
+                             jnp.maximum(0.0, cos - self.margin))
+            return _reduce(loss, self.reduction)
+
+        return _dispatch(fn, input1, input2, label)
+
+
+class CTCLoss(Layer):
+    """Connectionist temporal classification (loss.py CTCLoss) via optax's
+    reference ctc_loss (blank id 0, matching warpctc's convention)."""
+
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        import optax
+
+        lp = _v(log_probs)  # (T, B, C) paddle layout
+        lp = jnp.swapaxes(lp, 0, 1)  # (B, T, C)
+        labels_v = _v(labels)
+        B, T, C = lp.shape
+        L = labels_v.shape[1]
+        t_idx = jnp.arange(T)[None, :]
+        logit_pad = (t_idx >= _v(input_lengths)[:, None]).astype(jnp.float32)
+        l_idx = jnp.arange(L)[None, :]
+        label_pad = (l_idx >= _v(label_lengths)[:, None]).astype(jnp.float32)
+        def fn(lp_):
+            loss = optax.ctc_loss(lp_, logit_pad, labels_v, label_pad,
+                                  blank_id=self.blank)
+            if norm_by_times:
+                loss = loss / _v(input_lengths).astype(loss.dtype)
+            return _reduce(loss, self.reduction)
+
+        return _dispatch(fn, _t(lp) if not isinstance(log_probs, Tensor)
+                         else log_probs.transpose([1, 0, 2]))
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean", name=None):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        def fn(mu, y, var):
+            var = jnp.maximum(var, self.epsilon)
+            loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+            if self.full:
+                loss = loss + 0.5 * np.log(2 * np.pi)
+            return _reduce(loss, self.reduction)
+
+        return _dispatch(fn, input, label, variance)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        def fn(x, y):
+            loss = -(y * jax.nn.log_sigmoid(x)
+                     + (1 - y) * jax.nn.log_sigmoid(-x))
+            if self.weight is not None:
+                loss = loss * _v(self.weight)
+            return _reduce(loss.mean(-1), self.reduction)
+
+        return _dispatch(fn, input, label)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.p, self.margin, self.reduction = p, margin, reduction
+
+    def forward(self, input, label):
+        def fn(x, yv):
+            y = yv.astype(jnp.int32).reshape(-1)
+            correct = jnp.take_along_axis(x, y[:, None], axis=1)
+            margins = jnp.maximum(0.0, self.margin - correct + x) ** self.p
+            margins = margins.at[jnp.arange(x.shape[0]), y].set(0.0)
+            return _reduce(margins.sum(-1) / x.shape[1], self.reduction)
+
+        return _dispatch(fn, input, label)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.log_input, self.full = log_input, full
+        self.epsilon, self.reduction = epsilon, reduction
+
+    def forward(self, input, label):
+        def fn(x, y):
+            if self.log_input:
+                loss = jnp.exp(x) - y * x
+            else:
+                loss = x - y * jnp.log(x + self.epsilon)
+            if self.full:
+                stirling = y * jnp.log(y + 1e-12) - y + 0.5 * jnp.log(
+                    2 * np.pi * jnp.maximum(y, 1.0))
+                loss = loss + jnp.where(y > 1, stirling, 0.0)
+            return _reduce(loss, self.reduction)
+
+        return _dispatch(fn, input, label)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return _dispatch(
+            lambda x, y: _reduce(jnp.log1p(jnp.exp(-y * x)), self.reduction),
+            input, label)
+
+
+class TripletMarginLoss(Layer):
+    def __init__(self, margin=1.0, p=2.0, epsilon=1e-6, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.margin, self.p, self.epsilon = margin, p, epsilon
+        self.swap, self.reduction = swap, reduction
+
+    def forward(self, input, positive, negative):
+        def fn(a, pos, neg):
+            dp = jnp.linalg.norm(a - pos + self.epsilon, ord=self.p, axis=-1)
+            dn = jnp.linalg.norm(a - neg + self.epsilon, ord=self.p, axis=-1)
+            if self.swap:
+                dpn = jnp.linalg.norm(pos - neg + self.epsilon, ord=self.p,
+                                      axis=-1)
+                dn = jnp.minimum(dn, dpn)
+            return _reduce(jnp.maximum(0.0, dp - dn + self.margin),
+                           self.reduction)
+
+        return _dispatch(fn, input, positive, negative)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.dist = distance_function or (
+            lambda x, y: _t(jnp.linalg.norm(_v(x) - _v(y), axis=-1)))
+        self.margin, self.swap, self.reduction = margin, swap, reduction
+
+    def forward(self, input, positive, negative):
+        dp = _v(self.dist(input, positive))
+        dn = _v(self.dist(input, negative))
+        if self.swap:
+            dn = jnp.minimum(dn, _v(self.dist(positive, negative)))
+        return _t(_reduce(jnp.maximum(0.0, dp - dn + self.margin),
+                          self.reduction))

@@ -475,18 +475,31 @@ def conv2d_transpose(
         stride = (stride, stride)
     if isinstance(dilation, int):
         dilation = (dilation, dilation)
-    if isinstance(padding, int):
-        padding = [(padding, padding), (padding, padding)]
     if groups != 1:
         raise NotImplementedError("grouped conv_transpose not yet supported")
+    if isinstance(padding, int):
+        padding = (padding, padding)
     # weight layout: (in, out, kh, kw) — paddle convention. With
     # transpose_kernel=True lax swaps the kernel's I/O axes internally, so
-    # pass HWIO with I=out, O=in.
+    # pass HWIO with I=out, O=in. lax explicit padding is in FORWARD conv
+    # coordinates: paddle padding p maps to (k-1)*d - p per side, giving
+    # out = (in-1)*s - 2p + d*(k-1) + 1 (+ output_padding).
+    kh, kw = weight.shape[2], weight.shape[3]
+    if isinstance(padding, str):
+        lax_pad = padding.upper()
+    else:
+        ph, pw = padding
+        opad = ((output_padding, output_padding)
+                if isinstance(output_padding, int) else tuple(output_padding))
+        lax_pad = [
+            ((kh - 1) * dilation[0] - ph, (kh - 1) * dilation[0] - ph + opad[0]),
+            ((kw - 1) * dilation[1] - pw, (kw - 1) * dilation[1] - pw + opad[1]),
+        ]
     out = lax.conv_transpose(
         x,
         jnp.transpose(weight, (2, 3, 1, 0)),
         strides=tuple(stride),
-        padding=padding if not isinstance(padding, str) else padding.upper(),
+        padding=lax_pad,
         rhs_dilation=tuple(dilation),
         dimension_numbers=("NCHW", "HWIO", "NCHW"),
         transpose_kernel=True,

@@ -1,0 +1,171 @@
+"""Extended nn layer surface (nn/layers_extra.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def T(a):
+    return paddle.to_tensor(np.asarray(a, np.float32))
+
+
+def test_adaptive_and_3d_pools():
+    x = T(np.random.rand(2, 3, 8))
+    assert nn.AdaptiveAvgPool1D(2)(x).shape == [2, 3, 2]
+    assert nn.AdaptiveMaxPool1D(4)(x).shape == [2, 3, 4]
+    x3 = T(np.random.rand(1, 2, 4, 4, 4))
+    assert nn.AdaptiveAvgPool3D(2)(x3).shape == [1, 2, 2, 2, 2]
+    assert nn.MaxPool3D(2, 2)(x3).shape == [1, 2, 2, 2, 2]
+    avg = nn.AvgPool3D(2, 2)(x3)
+    np.testing.assert_allclose(
+        float(np.asarray(avg._value)[0, 0, 0, 0, 0]),
+        np.asarray(x3._value)[0, 0, :2, :2, :2].mean(), rtol=1e-6)
+    lp = nn.LPPool2D(2, 2, 2)(T(np.random.rand(1, 2, 4, 4)))
+    assert lp.shape == [1, 2, 2, 2]
+
+
+def test_conv_transpose_1d_3d():
+    y = nn.Conv1DTranspose(2, 3, 3)(T(np.random.rand(1, 2, 8)))
+    assert y.shape == [1, 3, 10]
+    y3 = nn.Conv3DTranspose(2, 3, 3)(T(np.random.rand(1, 2, 4, 4, 4)))
+    assert y3.shape == [1, 3, 6, 6, 6]
+
+
+def test_bilinear_and_pairwise():
+    b = nn.Bilinear(4, 5, 3)
+    out = b(T(np.random.rand(2, 4)), T(np.random.rand(2, 5)))
+    assert out.shape == [2, 3]
+    out.sum().backward()
+    assert b.weight.grad is not None
+    d = nn.PairwiseDistance()(T(np.ones((2, 3))), T(np.zeros((2, 3))))
+    np.testing.assert_allclose(np.asarray(d._value), np.sqrt(3) * np.ones(2),
+                               rtol=1e-4)
+
+
+def test_shuffle_unshuffle_fold():
+    x = T(np.random.rand(1, 4, 4, 4))
+    cs = nn.ChannelShuffle(2)(x)
+    assert cs.shape == [1, 4, 4, 4]
+    pu = nn.PixelUnshuffle(2)(x)
+    assert pu.shape == [1, 16, 2, 2]
+    # fold(unfold(x)) with stride=kernel reconstructs x
+    from paddle_tpu.ops import unfold
+
+    u = unfold(x, kernel_sizes=2, strides=2)
+    f = nn.Fold((4, 4), 2, strides=2)(u)
+    np.testing.assert_allclose(np.asarray(f._value), np.asarray(x._value),
+                               rtol=1e-6)
+
+
+def test_pads_and_activations():
+    x = T(np.random.rand(1, 2, 4))
+    assert nn.ZeroPad1D(1)(x).shape == [1, 2, 6]
+    assert nn.ZeroPad2D(1)(T(np.random.rand(1, 2, 4, 4))).shape == [1, 2, 6, 6]
+    assert nn.Silu()(x).shape == [1, 2, 4]
+    tr = nn.ThresholdedReLU(0.5)(T(np.array([0.3, 0.7])))
+    np.testing.assert_allclose(np.asarray(tr._value), [0.0, 0.7])
+    r = nn.RReLU().eval()(T(np.array([-1.0, 1.0])))
+    np.testing.assert_allclose(np.asarray(r._value),
+                               [-(1 / 8 + 1 / 3) / 2, 1.0], rtol=1e-6)
+    sm = nn.Softmax2D()(T(np.random.rand(1, 3, 2, 2)))
+    np.testing.assert_allclose(np.asarray(sm._value).sum(1),
+                               np.ones((1, 2, 2)), rtol=1e-6)
+    assert nn.Unflatten(1, [2, 2])(T(np.random.rand(3, 4))).shape == [3, 2, 2]
+
+
+def test_instance_norms():
+    y = nn.InstanceNorm1D(3)(T(np.random.rand(2, 3, 8)))
+    np.testing.assert_allclose(np.asarray(y._value).mean(-1),
+                               np.zeros((2, 3)), atol=1e-5)
+    y3 = nn.InstanceNorm3D(2)(T(np.random.rand(1, 2, 3, 3, 3)))
+    assert y3.shape == [1, 2, 3, 3, 3]
+
+
+def test_parameter_dict():
+    pd = nn.ParameterDict({"a": paddle.Parameter(np.ones(3, np.float32))})
+    assert len(pd) == 1 and "a" in list(pd.keys())
+    assert pd["a"].shape == [3]
+
+
+def test_rnn_wrappers():
+    paddle.seed(0)
+    cell = nn.SimpleRNNCell(4, 8)
+    out, state = nn.RNN(cell)(T(np.random.rand(2, 5, 4)))
+    assert out.shape == [2, 5, 8]
+    bi = nn.BiRNN(nn.SimpleRNNCell(4, 8), nn.SimpleRNNCell(4, 8))
+    out, _ = bi(T(np.random.rand(2, 5, 4)))
+    assert out.shape == [2, 5, 16]
+
+
+def test_new_losses():
+    y1 = nn.CosineEmbeddingLoss()(T(np.random.rand(4, 8)),
+                                  T(np.random.rand(4, 8)),
+                                  paddle.to_tensor(np.array([1, -1, 1, -1])))
+    assert np.isfinite(float(y1._value))
+    g = nn.GaussianNLLLoss()(T(np.zeros(5)), T(np.ones(5)),
+                             T(np.ones(5)))
+    np.testing.assert_allclose(float(g._value), 0.5, rtol=1e-5)
+    for loss_cls in (nn.MultiLabelSoftMarginLoss, nn.SoftMarginLoss):
+        l = loss_cls()(T(np.random.rand(3, 4)),
+                       T((np.random.rand(3, 4) > 0.5).astype(np.float32) * 2 - 1))
+        assert np.isfinite(float(l._value))
+    mm = nn.MultiMarginLoss()(T(np.random.rand(3, 5)),
+                              paddle.to_tensor(np.array([0, 2, 4])))
+    assert np.isfinite(float(mm._value))
+    p = nn.PoissonNLLLoss()(T(np.random.rand(4)), T(np.random.rand(4)))
+    assert np.isfinite(float(p._value))
+    t = nn.TripletMarginLoss()(T(np.random.rand(3, 8)),
+                               T(np.random.rand(3, 8)),
+                               T(np.random.rand(3, 8)))
+    assert np.isfinite(float(t._value))
+    t2 = nn.TripletMarginWithDistanceLoss(swap=True)(
+        T(np.random.rand(3, 8)), T(np.random.rand(3, 8)),
+        T(np.random.rand(3, 8)))
+    assert np.isfinite(float(t2._value))
+
+
+def test_ctc_loss():
+    paddle.seed(0)
+    T_, B, C = 12, 2, 5
+    logits = T(np.random.randn(T_, B, C))
+    import jax.nn as jnn
+    import jax.numpy as jnp
+
+    log_probs = paddle.to_tensor(
+        np.asarray(jnn.log_softmax(jnp.asarray(np.asarray(logits._value)), -1)))
+    labels = paddle.to_tensor(np.array([[1, 2, 3], [2, 4, 0]]))
+    in_len = paddle.to_tensor(np.array([12, 10]))
+    lab_len = paddle.to_tensor(np.array([3, 2]))
+    loss = nn.CTCLoss()(log_probs, labels, in_len, lab_len)
+    v = float(loss._value)
+    assert np.isfinite(v) and v > 0
+
+
+def test_extra_layers_backprop():
+    """All parametric extra layers must produce gradients (they dispatch
+    through the tape, not raw jnp)."""
+    paddle.seed(0)
+    cases = [
+        (nn.Bilinear(4, 5, 3),
+         lambda l: l(T(np.random.rand(2, 4)), T(np.random.rand(2, 5)))),
+        (nn.Conv1DTranspose(2, 3, 3),
+         lambda l: l(T(np.random.rand(1, 2, 8)))),
+        (nn.Conv3DTranspose(2, 3, 3),
+         lambda l: l(T(np.random.rand(1, 2, 4, 4, 4)))),
+        (nn.InstanceNorm1D(3), lambda l: l(T(np.random.rand(2, 3, 8)))),
+    ]
+    for layer, run in cases:
+        out = run(layer)
+        out.sum().backward()
+        for name, p in layer.named_parameters():
+            assert p.grad is not None, f"{type(layer).__name__}.{name}"
+
+
+def test_extra_losses_backprop():
+    x = T(np.random.rand(3, 8))
+    x.stop_gradient = False
+    loss = nn.TripletMarginLoss()(x, T(np.random.rand(3, 8)),
+                                  T(np.random.rand(3, 8)))
+    loss.backward()
+    assert x.grad is not None
