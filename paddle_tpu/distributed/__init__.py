@@ -17,7 +17,9 @@ from . import fleet  # noqa: F401
 from .auto_parallel import DistModel, Strategy, to_static  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .api import (  # noqa: F401
+    ShardDataloader,
     dtensor_from_fn,
+    shard_dataloader,
     reshard,
     shard_constraint,
     shard_layer,
@@ -65,6 +67,7 @@ __all__ = [
     "Placement", "Shard", "Replicate", "Partial",
     "shard_tensor", "reshard", "shard_constraint", "dtensor_from_fn",
     "shard_layer", "shard_optimizer", "unshard_dtensor",
+    "shard_dataloader", "ShardDataloader",
     "Group", "ReduceOp", "new_group", "get_rank", "get_world_size",
     "init_parallel_env", "is_initialized", "barrier",
     "all_reduce", "all_gather", "broadcast", "reduce", "scatter",

@@ -229,3 +229,42 @@ def unshard_dtensor(x: Tensor) -> Tensor:
     out = shard_tensor(x, mesh, [Replicate() for _ in range(mesh.ndim)])
     out._placements_hint = None
     return out
+
+
+class ShardDataloader:
+    """Wrap a DataLoader so every yielded batch is sharded over the mesh
+    (reference api.py ShardDataloader / shard_dataloader): batch dim over
+    ``shard_dims`` (default "dp"), other axes replicated."""
+
+    def __init__(self, dataloader, meshes, shard_dims="dp",
+                 input_keys=None):
+        self._loader = dataloader
+        self._mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+        self._dims = shard_dims
+
+    def _shard(self, item):
+        from ..core.tensor import Tensor
+
+        if isinstance(item, (list, tuple)):
+            return type(item)(self._shard(v) for v in item)
+        if isinstance(item, dict):
+            return {k: self._shard(v) for k, v in item.items()}
+        if isinstance(item, Tensor):
+            pl = [Replicate()] * self._mesh.ndim
+            if self._dims in self._mesh.dim_names:
+                ax = self._mesh.dim_names.index(self._dims)
+                if item.ndim > 0 and item.shape[0] % self._mesh.shape[ax] == 0:
+                    pl[ax] = Shard(0)
+            return shard_tensor(item, self._mesh, pl)
+        return item
+
+    def __iter__(self):
+        for batch in self._loader:
+            yield self._shard(batch)
+
+    def __len__(self):
+        return len(self._loader)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims="dp", input_keys=None):
+    return ShardDataloader(dataloader, meshes, shard_dims, input_keys)

@@ -254,8 +254,13 @@ def apply_op(op: OpDef, *args, **kwargs):
             out_shapes = [(v.shape, v.dtype) for v in outs_flat]
 
             def backward_fn(grad_outputs, _vjp=vjp_fn, _shapes=out_shapes):
+                # Coerce cotangent dtypes to the primal output dtypes: under
+                # AMP, gray-op promotion (bf16 + f32 residual → f32) sends
+                # f32 grads to bf16 producers — the cast the reference's
+                # generated cast grad-nodes perform explicitly.
                 gouts = tuple(
-                    g if g is not None else _zero_cotangent(s, d)
+                    (g.astype(d) if g.dtype != d else g)
+                    if g is not None else _zero_cotangent(s, d)
                     for g, (s, d) in zip(grad_outputs, _shapes)
                 )
                 grads = _vjp(gouts)
