@@ -1,0 +1,53 @@
+import time, sys, functools
+import jax, jax.numpy as jnp, numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion
+from paddle_tpu.jit import _FunctionalModel
+
+def sync(x): return float(jnp.asarray(x).sum())
+
+def measure(h, L, inter, heads, batch, seq, steps=6):
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=h, intermediate_size=inter,
+                      num_hidden_layers=L, num_attention_heads=heads,
+                      max_position_embeddings=seq)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg); model.to(dtype="bfloat16")
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    crit = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(), multi_precision=True)
+    functional = _FunctionalModel(model)
+    params, buffers = model.raw_state()
+    opt.register_param_names(dict(model.named_parameters()))
+    accs, masters = opt.init_functional_state(params)
+    ids = jnp.asarray(np.random.randint(0, 32000, (batch, seq)).astype(np.int32))
+    rng = jax.random.key_data(jax.random.PRNGKey(0))
+    def loss_of(p):
+        out, _ = functional(p, buffers, (paddle.Tensor._from_value(ids),), {}, rng)
+        ov = out._value if hasattr(out, '_value') else out
+        return crit(paddle.Tensor._from_value(ov), paddle.Tensor._from_value(ids))._value
+    def one(carry, _):
+        p,a,m,t = carry
+        loss, grads = jax.value_and_grad(loss_of)(p)
+        p2,a2,m2 = opt.functional_update(p, grads, a, m, jnp.asarray(1e-4, jnp.float32), t)
+        return (p2,a2,m2,t+1), loss
+    @functools.partial(jax.jit, donate_argnums=(0,1,2))
+    def run(p,a,m):
+        (p,a,m,_), losses = jax.lax.scan(one, (p,a,m,jnp.asarray(1,jnp.int32)), None, length=steps)
+        return p,a,m,losses
+    try:
+        params, accs, masters, losses = run(params, accs, masters)
+        sync(losses)
+        t0=time.time()
+        params, accs, masters, losses = run(params, accs, masters)
+        sync(losses)
+        dt=(time.time()-t0-0.05)/steps
+        tps = batch*seq/dt
+        fpt = 6*n_params + 12*L*h*seq
+        mfu = tps*fpt/240e12
+        print(f"h={h} L={L} b={batch} s={seq} ({n_params/1e6:.0f}M): {dt*1e3:.1f}ms {tps:,.0f} tok/s MFU~{mfu*100:.1f}%", flush=True)
+    except Exception as e:
+        print(f"h={h} L={L} b={batch} s={seq}: FAILED {str(e)[:120]}", flush=True)
+
+measure(2048, 12, 5504, 16, 2, 1536)
+measure(2048, 10, 5504, 16, 4, 1536)
+measure(1536, 12, 4096, 12, 6, 1536)

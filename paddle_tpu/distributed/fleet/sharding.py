@@ -41,18 +41,27 @@ def _shard0_placements(mesh, axis_idx, shape, degree):
 
 class ShardedOptimizer:
     """Optimizer wrapper that keeps accumulators (and optionally masters)
-    sharded over the sharding axis — ZeRO-1 memory footprint."""
+    sharded over the sharding axis — ZeRO-1 memory footprint. With
+    ``offload=True`` the sharded state additionally lives in host memory
+    between steps (GroupShardedOptimizerStage2's offload mode backed by the
+    async_load copy engine; here jax's pinned-host transfer)."""
 
-    def __init__(self, optimizer, mesh: ProcessMesh, axis="dp"):
+    def __init__(self, optimizer, mesh: ProcessMesh, axis="dp",
+                 offload=False):
         self._inner = optimizer
         self._mesh = mesh
         self._axis_idx = _axis_index(mesh, axis)
         self._degree = (mesh.get_dim_size(axis)
                         if self._axis_idx is not None else 1)
+        self._offload = offload
+        self._cpu = jax.devices("cpu")[0] if offload else None
 
     def _shard_state(self):
         for store in (self._inner._accumulators, self._inner._master_weights):
             for key, v in list(store.items()):
+                if self._offload:
+                    store[key] = jax.device_put(v, self._cpu)
+                    continue
                 pl = _shard0_placements(
                     self._mesh, self._axis_idx, v.shape, self._degree)
                 sharding = to_named_sharding(self._mesh, pl)
@@ -87,5 +96,6 @@ def group_sharded_parallel(model, optimizer, level="os", scaler=None,
             pl = _shard0_placements(mesh, axis_idx, p.shape, degree)
             shard_tensor(p, mesh, pl)
 
-    sharded_opt = ShardedOptimizer(optimizer, mesh, axis=axis)
+    sharded_opt = ShardedOptimizer(optimizer, mesh, axis=axis,
+                                   offload=offload)
     return model, sharded_opt, scaler

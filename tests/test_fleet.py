@@ -360,3 +360,22 @@ def test_pipeline_fthenb_matches_1f1b():
         return np.asarray(pl.run_functions[0][0].weight._value)
 
     np.testing.assert_allclose(run("1F1B"), run("FThenB"), rtol=1e-6)
+
+
+def test_group_sharded_offload():
+    mesh = dist.ProcessMesh(np.arange(8), ["dp"])
+    dist.set_mesh(mesh)
+    model = nn.Linear(16, 16)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=model.parameters())
+    model, opt, _ = group_sharded_parallel(model, opt, level="os",
+                                           offload=True)
+    model(paddle.to_tensor(np.random.rand(8, 16).astype(np.float32))
+          ).sum().backward()
+    opt.step()
+    for v in opt._accumulators.values():
+        assert v.sharding.device_set.pop().platform == "cpu"
+    # next step still works with host-resident state
+    model(paddle.to_tensor(np.random.rand(8, 16).astype(np.float32))
+          ).sum().backward()
+    opt.step()
