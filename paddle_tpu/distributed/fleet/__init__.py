@@ -22,6 +22,7 @@ from .pipeline import (  # noqa: F401
     PipelineParallel,
     SharedLayerDesc,
     spmd_pipeline,
+    spmd_pipeline_vpp,
 )
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .sharding import ShardedOptimizer, group_sharded_parallel  # noqa: F401
@@ -35,7 +36,7 @@ __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "recompute", "recompute_sequential",
     "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
-    "spmd_pipeline", "group_sharded_parallel", "ShardedOptimizer",
+    "spmd_pipeline", "spmd_pipeline_vpp", "group_sharded_parallel", "ShardedOptimizer",
     "MoELayer", "NaiveGate", "SwitchGate", "StackedExpertsFFN",
 ]
 

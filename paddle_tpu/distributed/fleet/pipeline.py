@@ -31,7 +31,7 @@ from ...nn.layer_base import Layer
 from ...nn.layers_common import LayerList
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
-           "PipelineParallel", "spmd_pipeline"]
+           "PipelineParallel", "spmd_pipeline", "spmd_pipeline_vpp"]
 
 
 class LayerDesc:
@@ -273,3 +273,87 @@ def spmd_pipeline(stage_fn, stage_params, x, n_microbatches, mesh,
         out_specs=P(),
     )
     return fn(stage_params, x)
+
+
+def spmd_pipeline_vpp(stage_fn, stage_params, x, n_microbatches, mesh,
+                      vpp=2, pp_axis="pp"):
+    """Interleaved virtual-pipeline schedule (VPP), compiled.
+
+    The reference's PipelineParallelWithInterleave
+    (meta_parallel/pipeline_parallel.py:1174): each device hosts ``vpp``
+    non-adjacent model chunks (device d owns virtual stages d, d+n, ...),
+    shrinking the bubble from (n-1)/m to (n-1)/(m*vpp). Here the whole
+    schedule is ONE shard_map program: per tick every device runs its
+    (up to vpp) active chunks and activations ring-advance with ppermute;
+    at the wrap device the in-flight buffer shifts chunk slot.
+
+    stage_params: pytree with leading dim n_stages*vpp (virtual-stage
+    order); x: (n_microbatches, mb, ...). Differentiable.
+    """
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    jm = mesh.jax_mesh()
+    n = mesh.get_dim_size(pp_axis)
+    n_virtual = n * vpp
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # group chunks by owner device: global slot d*vpp + k = virtual stage
+    # k*n + d, so shard_map's contiguous Shard(0) gives device d its chunks
+    # in execution order k = 0..vpp-1.
+    order = jnp.asarray([k * n + d for d in range(n) for k in range(vpp)])
+    grouped = jax.tree_util.tree_map(
+        lambda v: jnp.take(v, order, axis=0), stage_params)
+
+    def body(params, xs):
+        # params leaves: (vpp, ...) local chunks; xs replicated
+        stage = jax.lax.axis_index(pp_axis)
+        mb_shape = xs.shape[1:]
+        states = jax.lax.pcast(
+            jnp.zeros((vpp,) + mb_shape, xs.dtype), (pp_axis,), to="varying")
+        out_buf = jax.lax.pcast(jnp.zeros_like(xs), (pp_axis,), to="varying")
+        total = n_microbatches + n_virtual - 1
+
+        def tick(t, carry):
+            states, out_buf = carry
+            # device 0 slot 0 ingests microbatch t
+            feed = jax.lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, n_microbatches - 1), 0, keepdims=False)
+            states = jnp.where(
+                jnp.logical_and(stage == 0, t < n_microbatches),
+                states.at[0].set(feed), states)
+
+            # compute every local chunk (inactive chunks run on zeros and
+            # their outputs are masked out downstream)
+            def run_chunk(k, outs):
+                p_k = jax.tree_util.tree_map(lambda v: v[k], params)
+                return outs.at[k].set(
+                    stage_fn(p_k, states[k]).astype(xs.dtype))
+
+            outs = jax.lax.fori_loop(
+                0, vpp, run_chunk,
+                jax.lax.pcast(jnp.zeros((vpp,) + mb_shape, xs.dtype),
+                              (pp_axis,), to="varying"))
+
+            # last virtual stage (device n-1, slot vpp-1) completes
+            # microbatch m = t - (n_virtual - 1)
+            m_done = t - (n_virtual - 1)
+            write = jnp.logical_and(stage == n - 1, m_done >= 0)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                out_buf, outs[vpp - 1], jnp.maximum(m_done, 0), 0)
+            out_buf = jnp.where(write, updated, out_buf)
+
+            # ring-advance: each chunk output feeds the next virtual stage.
+            # Arriving at device 0 (wrap), data shifts up one chunk slot.
+            moved = jax.lax.ppermute(outs, pp_axis, perm)
+            shifted = jnp.roll(moved, 1, axis=0)  # slot k -> k+1 (wrap drop)
+            states = jnp.where(stage == 0, shifted, moved)
+            return states, out_buf
+
+        _, out_buf = jax.lax.fori_loop(0, total, tick, (states, out_buf))
+        mask = (stage == n - 1).astype(out_buf.dtype)
+        return jax.lax.psum(out_buf * mask, pp_axis)
+
+    spec_params = jax.tree_util.tree_map(lambda _: P(pp_axis), grouped)
+    fn = shard_map(body, mesh=jm, in_specs=(spec_params, P()), out_specs=P())
+    return fn(grouped, x)
