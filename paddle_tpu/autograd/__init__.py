@@ -142,3 +142,84 @@ class PyLayer(metaclass=PyLayerMeta):
             else:
                 results.append(o)
         return results[0] if single else tuple(results)
+
+
+# ------------------------------------------------------------ higher-order
+# Functional transforms (reference python/paddle/autograd/autograd.py
+# jacobian/hessian + incubate.autograd.{jvp,vjp}): computed by functionalizing
+# the Tensor computation and handing it to jax's exact transforms.
+
+def _functionalize(func):
+    import jax
+
+    def pure(*vals):
+        ts = [Tensor._from_value(v) for v in vals]
+        for t in ts:
+            t.stop_gradient = False
+        out = func(*ts)
+        return out._value if isinstance(out, Tensor) else out
+
+    return pure
+
+
+def jacobian(func, xs, create_graph=False):
+    """J[i][j] = d func(xs)[i] / d xs[j] (reference autograd.jacobian)."""
+    import jax
+
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    vals = [x._value for x in xs_list]
+    jac = jax.jacobian(_functionalize(func), argnums=tuple(range(len(vals))))(
+        *vals)
+    out = tuple(Tensor._from_value(j) for j in jac)
+    return out[0] if single else out
+
+
+def hessian(func, xs, create_graph=False):
+    """Hessian of a scalar-output func (reference autograd.hessian)."""
+    import jax
+
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    vals = [x._value for x in xs_list]
+    hes = jax.hessian(_functionalize(func), argnums=tuple(range(len(vals))))(
+        *vals)
+    if single:
+        return Tensor._from_value(hes[0][0])
+    return tuple(tuple(Tensor._from_value(h) for h in row) for row in hes)
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode: (func(xs), J @ v) (reference incubate.autograd.jvp)."""
+    import jax
+
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    vals = tuple(x._value for x in xs_list)
+    if v is None:
+        tangents = tuple(jax.numpy.ones_like(val) for val in vals)
+    else:
+        v_list = [v] if isinstance(v, Tensor) else list(v)
+        tangents = tuple(t._value for t in v_list)
+    out, tangent_out = jax.jvp(_functionalize(func), vals, tangents)
+    return Tensor._from_value(out), Tensor._from_value(tangent_out)
+
+
+def vjp(func, xs, v=None):
+    """Reverse-mode: (func(xs), v @ J) (reference incubate.autograd.vjp)."""
+    import jax
+
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    vals = tuple(x._value for x in xs_list)
+    out, vjp_fn = jax.vjp(_functionalize(func), *vals)
+    if v is None:
+        cot = jax.numpy.ones_like(out)
+    else:
+        cot = v._value if isinstance(v, Tensor) else v
+    grads = vjp_fn(cot)
+    grads_t = tuple(Tensor._from_value(g) for g in grads)
+    return Tensor._from_value(out), (grads_t[0] if single else grads_t)
+
+
+__all__ += ["jacobian", "hessian", "jvp", "vjp"]

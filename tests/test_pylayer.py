@@ -87,3 +87,21 @@ def test_pylayer_bad_grad_count():
     out = Bad.apply(x, y)
     with pytest.raises(RuntimeError, match="grads"):
         out.sum().backward()
+
+
+def test_functional_jacobian_hessian():
+    from paddle_tpu.autograd import hessian, jacobian, jvp, vjp
+
+    def f(x):
+        return (x ** 3).sum()
+
+    x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    j = jacobian(f, x)
+    np.testing.assert_allclose(np.asarray(j._value), [3.0, 12.0])
+    h = hessian(f, x)
+    np.testing.assert_allclose(np.asarray(h._value),
+                               np.diag([6.0, 12.0]), atol=1e-5)
+    out, tangent = jvp(f, x, paddle.to_tensor(np.array([1.0, 0.0], np.float32)))
+    np.testing.assert_allclose(float(tangent._value), 3.0)
+    out, grad = vjp(f, x)
+    np.testing.assert_allclose(np.asarray(grad._value), [3.0, 12.0])
