@@ -5,6 +5,7 @@ amp_lists.py). bf16 is the TPU-native low dtype (no loss scaling needed);
 fp16 + GradScaler are provided for reference parity.
 """
 from . import amp_lists  # noqa: F401
+from . import debugging  # noqa: F401
 from .auto_cast import (  # noqa: F401
     amp_decorate,
     amp_guard,

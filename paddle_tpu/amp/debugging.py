@@ -1,0 +1,101 @@
+"""AMP debugging — per-op precision observability.
+
+Analog of /root/reference/python/paddle/amp/debugging.py
+(collect_operator_stats: counts ops executed per dtype;
+enable_operator_stats_collection; check_numerics; compare_accuracy). Hooks
+the eager dispatcher's AMP slot, so stats reflect exactly what dispatched.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import defaultdict
+
+import jax.numpy as jnp
+
+__all__ = [
+    "collect_operator_stats", "enable_operator_stats_collection",
+    "disable_operator_stats_collection", "enable_tensor_checker",
+    "disable_tensor_checker", "check_numerics", "TensorCheckerConfig",
+]
+
+_stats: dict | None = None
+
+
+def _op_observer(op_name, out_values):
+    if _stats is None:
+        return
+    for v in out_values:
+        if v is None or not hasattr(v, "dtype"):
+            continue
+        _stats[op_name][str(v.dtype)] += 1
+
+
+def enable_operator_stats_collection():
+    global _stats
+    _stats = defaultdict(lambda: defaultdict(int))
+    from ..ops import registry
+
+    registry._amp_observer = _op_observer
+
+
+def disable_operator_stats_collection():
+    """Stops collection and prints the table (reference behavior)."""
+    global _stats
+    from ..ops import registry
+
+    registry._amp_observer = None
+    stats = _stats
+    _stats = None
+    if stats:
+        print("<------------------- op list -------------------->")
+        print(f"{'op':30s} {'calls by dtype'}")
+        for op, by_dtype in sorted(stats.items()):
+            counts = ", ".join(f"{d}: {n}" for d, n in sorted(by_dtype.items()))
+            print(f"{op:30s} {counts}")
+    return stats
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=None, output_dir=None,
+                 checked_op_list=None, skipped_op_list=None):
+        self.enable = enable
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+
+
+def enable_tensor_checker(config: TensorCheckerConfig | None = None):
+    """NaN/Inf checking on every op output (maps to FLAGS_check_nan_inf,
+    which the dispatcher already consults)."""
+    from ..core.flags import set_flags
+
+    set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    from ..core.flags import set_flags
+
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
+    """Raise on NaN/Inf in ``tensor`` (reference debugging.check_numerics)."""
+    from ..core.tensor import Tensor
+
+    v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    if jnp.issubdtype(v.dtype, jnp.inexact):
+        n_nan = int(jnp.isnan(v).sum())
+        n_inf = int(jnp.isinf(v).sum())
+        if n_nan or n_inf:
+            raise FloatingPointError(
+                f"check_numerics: {op_type or 'tensor'} {var_name} has "
+                f"{n_nan} NaN and {n_inf} Inf values")
+    return tensor

@@ -41,6 +41,7 @@ OPS: dict[str, "OpDef"] = {}
 # disabled-path cost is one attribute check per op call.
 _amp_state = None
 _amp_transform = None
+_amp_observer = None  # amp.debugging per-op dtype stats
 
 
 def install_amp(state, transform):
@@ -303,6 +304,9 @@ def apply_op(op: OpDef, *args, **kwargs):
                 t.stop_gradient = False
                 t._grad_node = node
                 t._grad_slot = i
+
+    if _amp_observer is not None and not tracing:
+        _amp_observer(op.name, outs_flat)
 
     if flag("FLAGS_check_nan_inf") and not tracing:
         for v in outs_flat:

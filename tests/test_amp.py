@@ -127,3 +127,29 @@ def test_bf16_training_matches_fp32_trajectory():
     lbf = run(True)
     assert lbf[-1] < lbf[0]
     np.testing.assert_allclose(lbf[-1], l32[-1], rtol=0.2)
+
+
+def test_operator_stats_collection(capsys):
+    from paddle_tpu.amp import debugging
+
+    x = paddle.to_tensor(np.random.rand(4, 8).astype(np.float32))
+    w = paddle.to_tensor(np.random.rand(8, 4).astype(np.float32))
+    with debugging.collect_operator_stats():
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            y = paddle.matmul(x, w)
+            z = paddle.nn.functional.softmax(y)
+    out = capsys.readouterr().out
+    assert "matmul" in out and "bfloat16" in out
+    assert "softmax" in out and "float32" in out
+
+
+def test_check_numerics():
+    from paddle_tpu.amp.debugging import check_numerics
+
+    ok = paddle.to_tensor(np.ones(3, np.float32))
+    check_numerics(ok, "identity", "x")
+    import pytest as _pytest
+
+    bad = paddle.to_tensor(np.array([1.0, np.nan], np.float32))
+    with _pytest.raises(FloatingPointError, match="NaN"):
+        check_numerics(bad, "op", "y")
