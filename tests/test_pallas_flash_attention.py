@@ -90,3 +90,26 @@ def test_grad_through_public_op():
     out.sum().backward()
     assert q.grad is not None
     assert np.isfinite(np.asarray(q.grad._value)).all()
+
+
+@pytest.mark.parametrize("sq,sk", [(256, 256), (512, 256), (256, 512),
+                                   (384, 256)])
+def test_mixed_block_sizes(sq, sk):
+    """seqs hitting different preferred block sizes (256 vs 128) must stay
+    exact, including the causal bounds."""
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(1, sq, 2, 32), jnp.float32)
+    k = jnp.asarray(rng.randn(1, sk, 2, 32), jnp.float32)
+    v = jnp.asarray(rng.randn(1, sk, 2, 32), jnp.float32)
+    causal = sq <= sk  # causal cross shapes only valid when sk >= sq
+    out = fa.flash_attention(q, k, v, is_causal=causal)
+
+    qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(32)
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -jnp.inf)
+    p = jax.nn.softmax(logits.astype(jnp.float32), -1)
+    ref = jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vh), 1, 2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
