@@ -1,0 +1,48 @@
+import time, functools
+import jax, jax.numpy as jnp, numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM, LlamaPretrainingCriterion
+from paddle_tpu.jit import _FunctionalModel
+
+def sync(x): return float(jnp.asarray(x).sum())
+
+def measure(batch, steps=6):
+    cfg = LlamaConfig(vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+                      num_hidden_layers=12, num_attention_heads=12,
+                      max_position_embeddings=1536)
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg); model.to(dtype="bfloat16")
+    n = sum(int(np.prod(p.shape)) for p in model.parameters())
+    crit = LlamaPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(), multi_precision=True)
+    f = _FunctionalModel(model)
+    params, buffers = model.raw_state()
+    opt.register_param_names(dict(model.named_parameters()))
+    accs, masters = opt.init_functional_state(params)
+    ids = jnp.asarray(np.random.randint(0, 32000, (batch, 1536)).astype(np.int32))
+    rng = jax.random.key_data(jax.random.PRNGKey(0))
+    def loss_of(p):
+        out, _ = f(p, buffers, (paddle.Tensor._from_value(ids),), {}, rng)
+        ov = out._value if hasattr(out, '_value') else out
+        return crit(paddle.Tensor._from_value(ov), paddle.Tensor._from_value(ids))._value
+    def one(c, _):
+        p,a,m,t = c
+        loss, grads = jax.value_and_grad(loss_of)(p)
+        p2,a2,m2 = opt.functional_update(p, grads, a, m, jnp.asarray(1e-4, jnp.float32), t)
+        return (p2,a2,m2,t+1), loss
+    @functools.partial(jax.jit, donate_argnums=(0,1,2))
+    def run(p,a,m):
+        (p,a,m,_), ls = jax.lax.scan(one, (p,a,m,jnp.asarray(1,jnp.int32)), None, length=steps)
+        return p,a,m,ls
+    try:
+        params, accs, masters, ls = run(params, accs, masters); sync(ls)
+        t0=time.time(); params, accs, masters, ls = run(params, accs, masters); sync(ls)
+        dt=(time.time()-t0-0.05)/steps
+        tps = batch*1536/dt
+        mfu = tps*(6*n+12*12*1536*1536)/226e12
+        print(f"b={batch}: {dt*1e3:.1f}ms {tps:,.0f} tok/s MFU~{mfu*100:.1f}%", flush=True)
+    except Exception as e:
+        print(f"b={batch}: FAIL {str(e)[:100]}", flush=True)
+
+for b in [6, 8]:
+    measure(b)

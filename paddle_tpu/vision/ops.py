@@ -1,0 +1,179 @@
+"""vision.ops — detection operators.
+
+Analog of /root/reference/python/paddle/vision/ops.py (nms, roi_align,
+roi_pool, box_coder, distribute_fpn_proposals; CUDA kernels under
+paddle/phi/kernels/gpu/{nms,roi_align}_kernel.cu). TPU-native notes: NMS is
+inherently sequential over ranked boxes — implemented as a fori_loop over a
+suppression mask (compiles to one program, no host sync); roi_align is a
+gather + bilinear interpolation, fully vectorized.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["nms", "roi_align", "roi_pool", "box_area", "box_iou"]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def box_area(boxes):
+    b = _v(boxes)
+    return Tensor._from_value((b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1]))
+
+
+def _iou_matrix(b):
+    area = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(b[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(b[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area[:, None] + area[None, :] - inter + 1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    b1, b2 = _v(boxes1), _v(boxes2)
+    a1 = (b1[:, 2] - b1[:, 0]) * (b1[:, 3] - b1[:, 1])
+    a2 = (b2[:, 2] - b2[:, 0]) * (b2[:, 3] - b2[:, 1])
+    lt = jnp.maximum(b1[:, None, :2], b2[None, :, :2])
+    rb = jnp.minimum(b1[:, None, 2:], b2[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return Tensor._from_value(inter / (a1[:, None] + a2[None, :] - inter + 1e-10))
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy non-maximum suppression (reference vision/ops.py nms).
+
+    Returns indices of kept boxes, ordered by descending score. Sequential
+    dependency is expressed as a fori_loop over the score-ranked boxes with
+    a running suppression mask — one compiled program.
+    """
+    b = _v(boxes)
+    n = b.shape[0]
+    s = (_v(scores) if scores is not None
+         else jnp.arange(n, 0, -1, dtype=jnp.float32))
+    order = jnp.argsort(-s)
+    sorted_boxes = b[order]
+    iou = _iou_matrix(sorted_boxes)
+    if category_idxs is not None:
+        cats = _v(category_idxs)[order]
+        same = cats[:, None] == cats[None, :]
+        iou = jnp.where(same, iou, 0.0)  # class-aware: only same-class suppress
+
+    def body(i, keep):
+        # box i survives iff no kept earlier box overlaps it
+        suppressed = jnp.any((iou[:, i] > iou_threshold)
+                             & keep & (jnp.arange(n) < i))
+        return keep.at[i].set(~suppressed)
+
+    keep = jax.lax.fori_loop(0, n, body, jnp.ones(n, bool))
+    kept_sorted = jnp.nonzero(keep, size=n, fill_value=-1)[0]
+    out = order[kept_sorted[kept_sorted >= 0]]
+    if top_k is not None:
+        out = out[:top_k]
+    return Tensor._from_value(out.astype(jnp.int64))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign (reference vision/ops.py roi_align / roi_align_kernel.cu):
+    bilinear sampling on a regular grid inside each box."""
+    feat = _v(x)  # (N, C, H, W)
+    rois = _v(boxes)  # (R, 4) in input-image coords
+    nums = np.asarray(_v(boxes_num))  # rois per image
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    n, c, h, w = feat.shape
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+
+    # map each roi to its batch image
+    batch_idx = np.repeat(np.arange(len(nums)), nums)
+    batch_idx = jnp.asarray(batch_idx, jnp.int32)
+
+    offset = 0.5 if aligned else 0.0
+    x1 = rois[:, 0] * spatial_scale - offset
+    y1 = rois[:, 1] * spatial_scale - offset
+    x2 = rois[:, 2] * spatial_scale - offset
+    y2 = rois[:, 3] * spatial_scale - offset
+    roi_w = jnp.maximum(x2 - x1, 1e-5)
+    roi_h = jnp.maximum(y2 - y1, 1e-5)
+    bin_w = roi_w / ow
+    bin_h = roi_h / oh
+
+    # sample grid: (R, oh, ow, ratio, ratio)
+    gy = (y1[:, None, None] + (jnp.arange(oh)[None, :, None] +
+          (jnp.arange(ratio)[None, None, :] + 0.5) / ratio)
+          * bin_h[:, None, None])
+    gx = (x1[:, None, None] + (jnp.arange(ow)[None, :, None] +
+          (jnp.arange(ratio)[None, None, :] + 0.5) / ratio)
+          * bin_w[:, None, None])
+
+    def bilinear(img, ys, xs):
+        # img (C, H, W); ys (oh, r); xs (ow, r) -> (C, oh, r, ow, r)
+        y0 = jnp.clip(jnp.floor(ys), 0, h - 1)
+        x0 = jnp.clip(jnp.floor(xs), 0, w - 1)
+        y1_ = jnp.clip(y0 + 1, 0, h - 1)
+        x1_ = jnp.clip(x0 + 1, 0, w - 1)
+        wy = jnp.clip(ys, 0, h - 1) - y0
+        wx = jnp.clip(xs, 0, w - 1) - x0
+        y0i, y1i = y0.astype(jnp.int32), y1_.astype(jnp.int32)
+        x0i, x1i = x0.astype(jnp.int32), x1_.astype(jnp.int32)
+        # gather: (C, oh, r, ow, r)
+        f00 = img[:, y0i[:, :, None, None], x0i[None, None, :, :]]
+        f01 = img[:, y0i[:, :, None, None], x1i[None, None, :, :]]
+        f10 = img[:, y1i[:, :, None, None], x0i[None, None, :, :]]
+        f11 = img[:, y1i[:, :, None, None], x1i[None, None, :, :]]
+        wy_ = wy[None, :, :, None, None]
+        wx_ = wx[None, None, None, :, :]
+        return (f00 * (1 - wy_) * (1 - wx_) + f01 * (1 - wy_) * wx_
+                + f10 * wy_ * (1 - wx_) + f11 * wy_ * wx_)
+
+    def per_roi(r):
+        img = feat[batch_idx[r]]
+        vals = bilinear(img, gy[r], gx[r])  # (C, oh, r, ow, r)
+        return vals.mean(axis=(2, 4))
+
+    out = jax.vmap(per_roi)(jnp.arange(rois.shape[0]))
+    return Tensor._from_value(out)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0):
+    """Max-pool RoI (reference roi_pool): nearest-grid max variant."""
+    feat = _v(x)
+    rois = _v(boxes)
+    nums = np.asarray(_v(boxes_num))
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    n, c, h, w = feat.shape
+    batch_idx = jnp.asarray(np.repeat(np.arange(len(nums)), nums), jnp.int32)
+
+    x1 = jnp.round(rois[:, 0] * spatial_scale).astype(jnp.int32)
+    y1 = jnp.round(rois[:, 1] * spatial_scale).astype(jnp.int32)
+    x2 = jnp.maximum(jnp.round(rois[:, 2] * spatial_scale).astype(jnp.int32),
+                     x1 + 1)
+    y2 = jnp.maximum(jnp.round(rois[:, 3] * spatial_scale).astype(jnp.int32),
+                     y1 + 1)
+
+    ratio = 4  # dense sampling then max over the per-bin samples
+
+    def per_roi(r):
+        ys = y1[r] + (jnp.arange(oh * ratio) + 0.5) * (y2[r] - y1[r]) / (oh * ratio)
+        xs = x1[r] + (jnp.arange(ow * ratio) + 0.5) * (x2[r] - x1[r]) / (ow * ratio)
+        yi = jnp.clip(ys.astype(jnp.int32), 0, h - 1)
+        xi = jnp.clip(xs.astype(jnp.int32), 0, w - 1)
+        img = feat[batch_idx[r]]
+        vals = img[:, yi[:, None], xi[None, :]]  # (C, oh*r, ow*r)
+        vals = vals.reshape(c, oh, ratio, ow, ratio)
+        return vals.max(axis=(2, 4))
+
+    out = jax.vmap(per_roi)(jnp.arange(rois.shape[0]))
+    return Tensor._from_value(out)

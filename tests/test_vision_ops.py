@@ -1,0 +1,62 @@
+"""vision.ops: nms, roi_align, roi_pool, box utilities."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+def test_box_iou_and_area():
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 2, 2], [1, 1, 3, 3], [10, 10, 12, 12]], np.float32))
+    area = np.asarray(vops.box_area(boxes)._value)
+    np.testing.assert_allclose(area, [4, 4, 4])
+    iou = np.asarray(vops.box_iou(boxes, boxes)._value)
+    np.testing.assert_allclose(np.diag(iou), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(iou[0, 1], 1 / 7, rtol=1e-5)
+    assert iou[0, 2] == 0.0
+
+
+def test_nms_suppresses_overlaps():
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10],      # best
+        [1, 1, 11, 11],      # big overlap with 0 -> suppressed
+        [20, 20, 30, 30],    # separate -> kept
+        [21, 21, 31, 31],    # overlaps 2 -> suppressed
+    ], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7, 0.6], np.float32))
+    keep = np.asarray(vops.nms(boxes, 0.5, scores)._value)
+    np.testing.assert_array_equal(keep, [0, 2])
+
+
+def test_nms_class_aware():
+    boxes = paddle.to_tensor(np.array([
+        [0, 0, 10, 10], [1, 1, 11, 11]], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8], np.float32))
+    cats = paddle.to_tensor(np.array([0, 1]))
+    keep = np.asarray(vops.nms(boxes, 0.5, scores, category_idxs=cats,
+                               categories=[0, 1])._value)
+    np.testing.assert_array_equal(sorted(keep), [0, 1])  # different classes
+
+
+def test_roi_align_identity_box():
+    # averaging over a full-image box of a constant channel = the constant
+    feat = np.zeros((1, 2, 8, 8), np.float32)
+    feat[0, 0] = 1.0
+    feat[0, 1] = np.arange(64, dtype=np.float32).reshape(8, 8)
+    x = paddle.to_tensor(feat)
+    boxes = paddle.to_tensor(np.array([[0, 0, 8, 8]], np.float32))
+    out = vops.roi_align(x, boxes, paddle.to_tensor(np.array([1])), 4,
+                         aligned=False)
+    assert out.shape == [1, 2, 4, 4]
+    np.testing.assert_allclose(np.asarray(out._value)[0, 0], 1.0, rtol=1e-5)
+
+
+def test_roi_pool_shape():
+    x = paddle.to_tensor(np.random.rand(2, 3, 16, 16).astype(np.float32))
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 8, 8], [4, 4, 12, 12], [0, 0, 16, 16]], np.float32))
+    nums = paddle.to_tensor(np.array([2, 1]))
+    out = vops.roi_pool(x, boxes, nums, 2)
+    assert out.shape == [3, 3, 2, 2]
+    # max over a full-image constant-ish region >= mean
+    assert np.isfinite(np.asarray(out._value)).all()
