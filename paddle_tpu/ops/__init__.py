@@ -197,6 +197,11 @@ _TENSOR_METHODS = [
     "mv", "outer", "inner", "cross", "norm", "inverse", "det", "cholesky", "trace",
     "diagonal", "kron", "tril", "triu", "where", "split", "chunk", "cast",
     "softmax", "sigmoid",
+    "t", "real", "imag", "conj", "take", "unique_consecutive",
+    "put_along_axis", "mode", "kthvalue", "rank", "moveaxis", "diff",
+    "nanmedian", "logcumsumexp", "frac", "lerp", "heaviside", "hypot",
+    "fmax", "fmin", "lgamma", "digamma", "deg2rad", "rad2deg", "vander",
+    "unflatten", "take_along_axis",
 ]
 
 _this = globals()
@@ -262,3 +267,18 @@ Tensor.__hash__ = lambda self: id(self)
 Tensor.__and__ = _binop("logical_and")
 Tensor.__or__ = _binop("logical_or")
 Tensor.__invert__ = lambda self: apply_op(OPS["logical_not"], self)
+
+
+# -------------------- Tensor misc aliases --------------------
+
+Tensor.ndimension = lambda self: self.ndim
+Tensor.mT = property(lambda self: apply_op(
+    OPS["transpose"], self, perm=list(range(self.ndim - 2))
+    + [self.ndim - 1, self.ndim - 2]))
+Tensor.is_contiguous = lambda self: True  # jax arrays have no exposed strides
+Tensor.contiguous = lambda self: self
+Tensor.masked_fill_ = lambda self, mask, value: self.set_value(
+    apply_op(OPS["masked_fill"], self, mask, value=value)._value) or self
+Tensor.flatten_ = lambda self, start_axis=0, stop_axis=-1: self.set_value(
+    apply_op(OPS["flatten"], self, start_axis=start_axis,
+             stop_axis=stop_axis)._value) or self
