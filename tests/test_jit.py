@@ -148,3 +148,33 @@ def test_scan():
 
     carry, ys = jit.scan(body, paddle.to_tensor(0.0), paddle.arange(5).astype("float32"))
     assert float(carry) == 10.0
+
+
+def test_train_step_bf16_master_weights():
+    """Compiled whole-step path with O2 bf16 params + fp32 master weights
+    (the bench.py configuration, on CPU shapes)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.GELU(), nn.Linear(32, 1))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters(),
+                                 multi_precision=True)
+    model, opt = paddle.amp.decorate(model, opt, level="O2", dtype="bfloat16")
+    x = paddle.to_tensor(np.random.rand(8, 16).astype(np.float32))
+    t = paddle.to_tensor(np.random.rand(8, 1).astype(np.float32))
+    step = paddle.jit.TrainStep(
+        model, lambda o: ((o.astype("float32") - t) ** 2).mean(), opt)
+    losses = [float(step(x)) for _ in range(10)]
+    assert losses[-1] < losses[0] * 0.7, losses
+    # params stayed bf16; masters exist in fp32
+    import jax.numpy as jnp
+
+    for p in model.parameters():
+        assert p._value.dtype == jnp.bfloat16
+    assert step._masters, "expected fp32 master weights in the step state"
+    for v in step._masters.values():
+        assert v.dtype == jnp.float32
