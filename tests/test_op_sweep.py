@@ -711,7 +711,14 @@ EXEMPT = {
 # ---------------------------------------------------------------- the tests
 
 def test_every_op_has_a_case():
-    missing = [n for n in OPS if n not in A and n not in EXEMPT]
+    # user-registered custom ops (utils.cpp_extension in other test files)
+    # are outside the built-in registry contract
+    missing = [
+        n for n, op in OPS.items()
+        if n not in A and n not in EXEMPT
+        and (op.kernel.__module__ or "").startswith(("paddle_tpu.ops",
+                                                     "paddle_tpu.distributed"))
+    ]
     assert not missing, f"ops without an OpTest case: {sorted(missing)}"
 
 
