@@ -50,11 +50,11 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
 
     cfg = model.config
     kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
-    empty = [
-        (Tensor._from_value(jnp.zeros((b, 0, kv_heads, cfg.head_dim))),
-         Tensor._from_value(jnp.zeros((b, 0, kv_heads, cfg.head_dim))))
-        for _ in range(cfg.num_hidden_layers)
-    ]
+    max_len = s + max_new_tokens
+    from .llama import StaticCache
+
+    empty = [StaticCache(b, max_len, kv_heads, cfg.head_dim)
+             for _ in range(cfg.num_hidden_layers)]
 
     with autograd.no_grad():
         logits, caches = model(Tensor._from_value(ids), caches=empty)
@@ -63,13 +63,11 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
         out = [ids, next_tok[:, None]]
         finished = jnp.zeros((b,), bool)
         for step in range(max_new_tokens - 1):
-            cur_len = s + 1 + step
-            # single-token step attends to the whole prefix
-            mask = Tensor._from_value(
-                jnp.ones((b, 1, 1, cur_len), bool))
+            # static cache: every decode step has identical shapes -> the
+            # per-op executable cache serves each op from one compiled
+            # program (masked_multihead_attention decode-loop behavior)
             logits, caches = model(
-                Tensor._from_value(next_tok[:, None]),
-                attn_mask=mask, caches=caches)
+                Tensor._from_value(next_tok[:, None]), caches=caches)
             next_tok = _sample(logits._value[:, -1, :], temperature, top_k,
                                top_p, not do_sample)
             if eos_token_id is not None:

@@ -39,6 +39,7 @@ from ..ops import (
 )
 
 __all__ = [
+    "StaticCache",
     "LlamaConfig", "LlamaAttention", "LlamaMLP", "LlamaDecoderLayer",
     "LlamaModel", "LlamaForCausalLM", "LlamaPretrainingCriterion",
     "llama_shard_fn", "llama_tiny_config",
@@ -99,6 +100,32 @@ def llama_tiny_config(**overrides):
     return LlamaConfig(**base)
 
 
+class StaticCache:
+    """Pre-allocated KV cache slot for one attention layer — the analog of
+    the reference's decode kernels' cache layout
+    (paddle/phi/kernels/fusion/gpu/masked_multihead_attention: fixed-size
+    cache + valid-length mask; block_multi_head_attention pages it). Fixed
+    shapes keep every decode step at ONE compiled program."""
+
+    __slots__ = ("k", "v", "length")
+
+    def __init__(self, batch, max_len, kv_heads, head_dim, dtype=jnp.float32):
+        self.k = jnp.zeros((batch, max_len, kv_heads, head_dim), dtype)
+        self.v = jnp.zeros((batch, max_len, kv_heads, head_dim), dtype)
+        self.length = 0  # concrete python int: static under per-step jit
+
+    def update(self, k_new, v_new):
+        """Write new keys/values at [length, length+s); returns views plus
+        the attention mask over valid positions."""
+        s = k_new.shape[1]
+        self.k = jax.lax.dynamic_update_slice_in_dim(
+            self.k, k_new.astype(self.k.dtype), self.length, axis=1)
+        self.v = jax.lax.dynamic_update_slice_in_dim(
+            self.v, v_new.astype(self.v.dtype), self.length, axis=1)
+        self.length += s
+        return self.k, self.v
+
+
 def _rope_tables(head_dim, max_pos, theta, dtype=jnp.float32):
     inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
     t = np.arange(max_pos, dtype=np.float64)
@@ -134,6 +161,27 @@ class LlamaAttention(Layer):
         k = reshape(self.k_proj(hidden_states), [b, s, kv, d])
         v = reshape(self.v_proj(hidden_states), [b, s, kv, d])
         position_ids = None
+        if isinstance(cache, StaticCache):
+            # fixed-shape decode (masked_multihead_attention semantics):
+            # write into the pre-allocated buffers, attend over the full
+            # cache with a valid-length mask — shapes never change.
+            offset = cache.length
+            if offset > 0:
+                position_ids = Tensor._from_value(
+                    jnp.arange(offset, offset + s))
+            q, k = rotary_position_embedding(
+                q, k, self.rope_cos, self.rope_sin,
+                position_ids=position_ids)
+            k_all, v_all = cache.update(k._value, v._value)
+            max_len = k_all.shape[1]
+            rows = jnp.arange(s)[:, None] + offset
+            cols = jnp.arange(max_len)[None, :]
+            mask = (cols <= rows)[None, None, :, :]  # causal over valid cells
+            out = scaled_dot_product_attention(
+                q, Tensor._from_value(k_all), Tensor._from_value(v_all),
+                attn_mask=Tensor._from_value(mask))
+            out = self.o_proj(reshape(out, [b, s, h * d]))
+            return out, cache
         if cache is not None and cache[0].shape[1] > 0:
             # cached decode: RoPE at absolute positions past the prefix
             offset = cache[0].shape[1]
