@@ -100,3 +100,67 @@ def send_uv(x, y, src_index, dst_index, message_op="add"):
     else:
         raise ValueError(f"unsupported message_op {message_op!r}")
     return Tensor._from_value(out)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None):
+    """Uniform neighbor sampling over a CSC graph (reference
+    geometric/sampling/neighbors.py over graph_sample_neighbors kernels).
+
+    row: (E,) CSC row indices; colptr: (N+1,) offsets; input_nodes: (B,)
+    nodes to sample for. Returns (out_neighbors, out_count[, out_eids]).
+    Host-side numpy (graph sampling is an input-pipeline stage, like the
+    reference's CPU kernel path).
+    """
+    import numpy as np
+
+    row_np = np.asarray(_v(row))
+    colptr_np = np.asarray(_v(colptr))
+    nodes = np.asarray(_v(input_nodes))
+    eids_np = np.asarray(_v(eids)) if eids is not None else None
+    rng = np.random.default_rng()
+
+    out_n, out_c, out_e = [], [], []
+    for n in nodes:
+        lo, hi = int(colptr_np[n]), int(colptr_np[n + 1])
+        deg = hi - lo
+        if sample_size < 0 or deg <= sample_size:
+            idx = np.arange(lo, hi)
+        else:
+            idx = lo + rng.choice(deg, sample_size, replace=False)
+        out_n.append(row_np[idx])
+        out_c.append(len(idx))
+        if eids_np is not None:
+            out_e.append(eids_np[idx])
+    neighbors = Tensor(np.concatenate(out_n) if out_n else
+                       np.zeros(0, row_np.dtype))
+    counts = Tensor(np.asarray(out_c, np.int32))
+    if return_eids:
+        return neighbors, counts, Tensor(
+            np.concatenate(out_e) if out_e else np.zeros(0, np.int64))
+    return neighbors, counts
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None):
+    """Compact global node ids to local ids (reference
+    geometric/reindex.py): x = unique seed nodes, neighbors = sampled
+    neighbor ids. Returns (reindexed_src, reindexed_dst, out_nodes)."""
+    import numpy as np
+
+    seeds = np.asarray(_v(x))
+    nbrs = np.asarray(_v(neighbors))
+    cnts = np.asarray(_v(count))
+
+    out_nodes = list(seeds)
+    mapping = {int(n): i for i, n in enumerate(seeds)}
+    for n in nbrs:
+        if int(n) not in mapping:
+            mapping[int(n)] = len(out_nodes)
+            out_nodes.append(n)
+    reindexed_src = np.asarray([mapping[int(n)] for n in nbrs], np.int64)
+    dst = np.repeat(np.arange(len(seeds)), cnts)
+    return (Tensor(reindexed_src), Tensor(dst.astype(np.int64)),
+            Tensor(np.asarray(out_nodes, np.int64)))
+
+
+__all__ += ["sample_neighbors", "reindex_graph"]

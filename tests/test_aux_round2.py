@@ -132,3 +132,23 @@ def test_flops_estimator():
     r = models.resnet18(num_classes=10)
     n = flops(r, input_size=[1, 3, 32, 32])
     assert n > 5e7  # resnet18 @32x32 ~ 0.07 GFLOPs-ish (2x for mul+add)
+
+
+def test_geometric_sampling_and_reindex():
+    from paddle_tpu import geometric as G
+
+    # graph: 0->{1,2,3}, 1->{2}, 2->{}, 3->{0,1} (CSC: in-neighbors per node)
+    row = paddle.to_tensor(np.array([3, 0, 0, 1, 0, 3]))
+    colptr = paddle.to_tensor(np.array([0, 1, 3, 5, 6]))
+    nodes = paddle.to_tensor(np.array([1, 2]))
+    nbrs, cnt = G.sample_neighbors(row, colptr, nodes)
+    np.testing.assert_array_equal(np.asarray(cnt._value), [2, 2])
+    np.testing.assert_array_equal(np.asarray(nbrs._value), [0, 0, 1, 0])
+    nbrs2, cnt2 = G.sample_neighbors(row, colptr, nodes, sample_size=1)
+    assert np.asarray(cnt2._value).tolist() == [1, 1]
+
+    src, dst, out_nodes = G.reindex_graph(nodes, nbrs, cnt)
+    # seeds [1,2] -> local 0,1; neighbor 0 gets local id 2; 1 is a seed
+    np.testing.assert_array_equal(np.asarray(out_nodes._value), [1, 2, 0])
+    np.testing.assert_array_equal(np.asarray(src._value), [2, 2, 0, 2])
+    np.testing.assert_array_equal(np.asarray(dst._value), [0, 0, 1, 1])
