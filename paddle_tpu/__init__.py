@@ -89,6 +89,9 @@ from . import vision  # noqa: F401
 from .ops import cast as as_type  # noqa: F401
 
 
+from .nn import LazyGuard  # noqa: F401
+
+
 def rand(shape, dtype="float32"):
     from .ops import uniform
 

@@ -37,6 +37,7 @@ class Tensor:
         "name",
         "persistable",
         "_placements_hint",
+        "_lazy_init",
         "__weakref__",
     )
 
@@ -60,6 +61,7 @@ class Tensor:
         self.name = name or f"tensor_{Tensor._next_id()}"
         self.persistable = False
         self._placements_hint = None
+        self._lazy_init = None
 
     @classmethod
     def _next_id(cls):
@@ -78,6 +80,7 @@ class Tensor:
         t.name = name or f"tensor_{cls._next_id()}"
         t.persistable = False
         t._placements_hint = None
+        t._lazy_init = None
         return t
 
     # ---------------- autograd plumbing ----------------

@@ -79,6 +79,21 @@ def shard_tensor(data, mesh: ProcessMesh = None, placements=None,
     placements = _normalize_placements(
         placements if placements is not None else [], mesh
     )
+    if isinstance(data, Tensor) and getattr(data, "_lazy_init", None):
+        # LazyGuard parameter: materialize straight into the sharding —
+        # jit with out_shardings allocates only the local shard per device
+        init, shape, dtype = data._lazy_init
+        placements = _normalize_placements(placements or [], mesh)
+        sharding = to_named_sharding(mesh, placements)
+
+        def produce():
+            out = init(shape, dtype=dtype)
+            return out._value if isinstance(out, Tensor) else out
+
+        data._value = jax.jit(produce, out_shardings=sharding)()
+        data._lazy_init = None
+        data._placements_hint = (mesh, placements)
+        return data
     if isinstance(data, Tensor):
         t = data
         value = t._value

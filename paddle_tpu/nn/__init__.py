@@ -10,7 +10,7 @@ from .clip import (  # noqa: F401
     ClipGradByNorm,
     ClipGradByValue,
 )
-from .layer_base import Layer, ParamAttr  # noqa: F401
+from .layer_base import Layer, LazyGuard, ParamAttr  # noqa: F401
 from .layers_attention import (  # noqa: F401
     MultiHeadAttention,
     Transformer,

@@ -23,7 +23,30 @@ from ..core.dtype import convert_dtype, to_jax_dtype
 from ..core.tensor import Parameter, Tensor
 from . import initializer as I
 
-__all__ = ["Layer", "ParamAttr"]
+__all__ = ["Layer", "ParamAttr", "LazyGuard"]
+
+_lazy_mode = False
+
+
+class LazyGuard:
+    """Defer parameter materialization (reference python/paddle/nn/
+    initializer/lazy_init.py ``LazyGuard``, used by the semi-auto LLaMA
+    harness to build 10B+ models without host OOM): inside the guard,
+    ``create_parameter`` records (initializer, shape, dtype) instead of
+    allocating. ``dist.shard_tensor``/``shard_layer`` then materialize each
+    parameter directly INTO its sharding via ``jax.jit`` with
+    ``out_shardings`` — every device allocates only its own shard;
+    ``Layer.lazy_materialize()`` materializes unsharded."""
+
+    def __enter__(self):
+        global _lazy_mode
+        self._saved = _lazy_mode
+        _lazy_mode = True
+        return self
+
+    def __exit__(self, *exc):
+        global _lazy_mode
+        _lazy_mode = self._saved
 
 
 class ParamAttr:
@@ -102,10 +125,15 @@ class Layer:
         init = attr.initializer or default_initializer
         if init is None:
             init = I.Constant(0.0) if is_bias else I.XavierNormal()
-        value = init(tuple(shape), dtype=dtype)
-        if isinstance(value, Tensor):
-            value = value._value
-        p = Parameter(value, name=attr.name, trainable=attr.trainable)
+        if _lazy_mode:
+            p = Parameter(jnp.zeros((), to_jax_dtype(dtype)), name=attr.name,
+                          trainable=attr.trainable)
+            p._lazy_init = (init, tuple(shape), dtype)
+        else:
+            value = init(tuple(shape), dtype=dtype)
+            if isinstance(value, Tensor):
+                value = value._value
+            p = Parameter(value, name=attr.name, trainable=attr.trainable)
         p.optimize_attr["learning_rate"] = attr.learning_rate
         p.regularizer = attr.regularizer
         p.need_clip = getattr(attr, "need_clip", True)
@@ -415,6 +443,17 @@ class Layer:
         return outputs
 
     # ------------------------------------------------ misc
+
+    def lazy_materialize(self):
+        """Materialize parameters deferred under LazyGuard (unsharded)."""
+        for _, p in self.named_parameters():
+            lazy = getattr(p, "_lazy_init", None)
+            if lazy is not None:
+                init, shape, dtype = lazy
+                value = init(shape, dtype=dtype)
+                p._value = value._value if isinstance(value, Tensor) else value
+                p._lazy_init = None
+        return self
 
     def clear_gradients(self):
         for p in self.parameters():

@@ -258,3 +258,41 @@ def test_spectral_norm_grad_flows():
     out.sum().backward()
     assert w.grad is not None
     assert np.isfinite(np.asarray(w.grad._value)).all()
+
+
+def test_lazy_guard_sharded_materialization():
+    """LazyGuard defers allocation; shard_tensor materializes each param
+    directly into its sharding (semi_auto_llama LazyGuard flow)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.nn as nn
+
+    with paddle.LazyGuard():
+        layer = nn.Linear(16, 32)
+    assert layer.weight._lazy_init is not None
+    assert layer.weight._value.shape == ()  # nothing allocated yet
+
+    mesh = dist.ProcessMesh(np.arange(8), ["mp"])
+    dist.shard_tensor(layer.weight, mesh, [dist.Shard(1)])
+    dist.shard_tensor(layer.bias, mesh, [dist.Shard(0)])
+    assert layer.weight.shape == [16, 32]
+    assert layer.weight._value.addressable_shards[0].data.shape == (16, 4)
+    x = paddle.to_tensor(np.random.rand(4, 16).astype(np.float32))
+    assert layer(x).shape == [4, 32]
+
+
+def test_lazy_guard_unsharded_materialize():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    with paddle.LazyGuard():
+        layer = nn.Linear(4, 4)
+    layer.lazy_materialize()
+    assert layer.weight.shape == [4, 4]
+    y = layer(paddle.to_tensor(np.ones((2, 4), np.float32)))
+    assert y.shape == [2, 4]
