@@ -94,16 +94,14 @@ class DistModel:
         if self._train_step is None:
             from ..jit import TrainStep
 
-            holder = self._labels_holder
-
-            def loss_fn(*outs):
-                out = outs[0] if len(outs) == 1 else outs
-                return self._loss(out, holder["y"])
+            def loss_fn(*outs_and_labels):
+                *outs, lab = outs_and_labels
+                out = outs[0] if len(outs) == 1 else tuple(outs)
+                return self._loss(out, lab)
 
             self._train_step = TrainStep(self.network, loss_fn,
                                          self._optimizer)
-        self._labels_holder["y"] = labels
-        return self._train_step(*inputs)
+        return self._train_step(*inputs, labels=labels)
 
     def state_dict(self, mode="all"):
         sd = dict(self.network.state_dict())

@@ -122,18 +122,14 @@ class Model:
             if self._train_step is None:
                 from ..jit import TrainStep
 
-                labels_holder = {}
-
-                def loss_fn(*outs):
+                def loss_fn(*outs_and_labels):
+                    *outs, lab = outs_and_labels
                     return self._loss(
-                        outs[0] if len(outs) == 1 else outs,
-                        labels_holder["y"])
+                        outs[0] if len(outs) == 1 else tuple(outs), lab)
 
-                self._labels_holder = labels_holder
                 self._train_step = TrainStep(self.network, loss_fn,
                                              self._optimizer)
-            self._labels_holder["y"] = labels
-            loss = self._train_step(*inputs)
+            loss = self._train_step(*inputs, labels=labels)
             return {"loss": float(loss)}
         out = self.network(*inputs)
         loss = self._loss(out, labels) if self._loss else out

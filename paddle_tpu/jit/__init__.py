@@ -312,7 +312,8 @@ class TrainStep:
         grad_clip = optimizer._grad_clip
         clip_attrs = self._clip_attrs
 
-        def one_step(params, buffers, accs, masters, lr, t, rng_key, args, kwargs):
+        def one_step(params, buffers, accs, masters, lr, t, rng_key, args,
+                     kwargs, labels):
             p_train = {k: v for k, v in params.items() if k in trainable}
             p_frozen = {k: v for k, v in params.items() if k not in trainable}
 
@@ -325,7 +326,15 @@ class TrainStep:
                     if isinstance(out, tuple)
                     else Tensor._from_value(out)
                 )
-                loss = loss_fn(*out_t) if isinstance(out_t, tuple) else loss_fn(out_t)
+                outs = out_t if isinstance(out_t, tuple) else (out_t,)
+                if labels is not None:
+                    # labels ride as traced operands — closure-captured
+                    # labels would be baked into the executable as constants
+                    lab = jax.tree_util.tree_map(
+                        Tensor._from_value, labels)
+                    loss = loss_fn(*outs, lab)
+                else:
+                    loss = loss_fn(*outs)
                 loss_val = loss._value if isinstance(loss, Tensor) else loss
                 return loss_val, new_bufs
 
@@ -349,7 +358,7 @@ class TrainStep:
 
         return jax.jit(one_step, donate_argnums=(0, 2, 3))
 
-    def __call__(self, *args, **kwargs):
+    def __call__(self, *args, labels=None, **kwargs):
         if self._compiled is None:
             self._compiled = self._build()
         model, optimizer = self.model, self.optimizer
@@ -363,6 +372,7 @@ class TrainStep:
         loss, new_params, new_buffers, self._accs, self._masters = self._compiled(
             params, buffers, self._accs, self._masters, lr, t, rng_key,
             _as_array_tree(args), _as_array_tree(kwargs),
+            _as_array_tree(labels),
         )
         model.load_raw_state(new_params, new_buffers)
         return Tensor._from_value(loss)

@@ -178,3 +178,29 @@ def test_train_step_bf16_master_weights():
     assert step._masters, "expected fp32 master weights in the step state"
     for v in step._masters.values():
         assert v.dtype == jnp.float32
+
+
+def test_train_step_labels_are_not_baked():
+    """Regression: labels passed per-call must NOT be compile-time constants
+    (a closure-captured label tensor would train on batch-1 labels forever)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    model = nn.Linear(2, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.2,
+                               parameters=model.parameters())
+    step = paddle.jit.TrainStep(
+        model, lambda out, lab: ((out - lab) ** 2).mean(), opt)
+    x = paddle.to_tensor(np.ones((4, 2), np.float32))
+    y_a = paddle.to_tensor(np.zeros((4, 1), np.float32))
+    y_b = paddle.to_tensor(np.full((4, 1), 10.0, np.float32))
+    step(x, labels=y_a)  # compile with labels A
+    # now train toward labels B only: output must move UP toward 10
+    before = float(model(x).mean())
+    for _ in range(20):
+        step(x, labels=y_b)
+    after = float(model(x).mean())
+    assert after > before + 1.0, (before, after)
