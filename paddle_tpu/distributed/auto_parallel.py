@@ -56,7 +56,6 @@ class DistModel:
         self._strategy = strategy or Strategy()
         self._mode = "train"
         self._train_step = None
-        self._labels_holder = {}
         if self._strategy.amp.enable and self._strategy.amp.level == "O2":
             from ..amp import decorate
 
@@ -101,6 +100,8 @@ class DistModel:
 
             self._train_step = TrainStep(self.network, loss_fn,
                                          self._optimizer)
+        if labels is None:
+            raise ValueError("DistModel training call needs (inputs, labels)")
         return self._train_step(*inputs, labels=labels)
 
     def state_dict(self, mode="all"):

@@ -129,6 +129,10 @@ class Model:
 
                 self._train_step = TrainStep(self.network, loss_fn,
                                              self._optimizer)
+            if labels is None:
+                raise ValueError(
+                    "compiled train_batch requires labels (the loss was "
+                    "configured in prepare())")
             loss = self._train_step(*inputs, labels=labels)
             return {"loss": float(loss)}
         out = self.network(*inputs)

@@ -283,7 +283,8 @@ class TrainStep:
 
         step = TrainStep(model, loss_fn, optimizer)
         for x, y in loader:
-            loss = step(x, y)      # model/optimizer state updated in place
+            # labels ride as traced operands; loss_fn receives (*outputs, y)
+            loss = step(x, labels=y)   # state updated in place
     """
 
     def __init__(self, model, loss_fn, optimizer):
