@@ -31,6 +31,7 @@ class GradScaler:
         self._good_steps = 0
         self._bad_steps = 0
         self._found_inf = False
+        self._unscaled_opts: set = set()  # ids of optimizers already unscaled
 
     def is_enable(self):
         return self._enable
@@ -50,9 +51,15 @@ class GradScaler:
         return loss * self._scale
 
     def unscale_(self, optimizer):
-        """Divide accumulated grads by the scale; record non-finite."""
+        """Divide accumulated grads by the scale; record non-finite.
+        Idempotent per optimizer per step (the reference tracks an UNSCALED
+        state so the unscale_ -> clip -> step() recipe doesn't divide
+        twice)."""
         if not self._enable:
             return
+        if id(optimizer) in self._unscaled_opts:
+            return
+        self._unscaled_opts.add(id(optimizer))
         inv = 1.0 / self._scale
         found = False
         for p in optimizer._parameter_list:
@@ -73,6 +80,7 @@ class GradScaler:
         self.unscale_(optimizer)
         if not self._found_inf:
             optimizer.step()
+        self._unscaled_opts.discard(id(optimizer))
 
     def update(self):
         if not (self._enable and self._use_dynamic):

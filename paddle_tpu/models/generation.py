@@ -53,15 +53,23 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     max_len = s + max_new_tokens
     from .llama import StaticCache
 
-    empty = [StaticCache(b, max_len, kv_heads, cfg.head_dim)
+    # cache in the model's compute dtype (bf16 models keep a bf16 KV cache)
+    try:
+        cache_dtype = next(iter(model.parameters()))._value.dtype
+    except StopIteration:
+        cache_dtype = jnp.float32
+    empty = [StaticCache(b, max_len, kv_heads, cfg.head_dim,
+                         dtype=cache_dtype)
              for _ in range(cfg.num_hidden_layers)]
 
     with autograd.no_grad():
         logits, caches = model(Tensor._from_value(ids), caches=empty)
         next_tok = _sample(logits._value[:, -1, :], temperature, top_k,
                            top_p, not do_sample)
-        out = [ids, next_tok[:, None]]
         finished = jnp.zeros((b,), bool)
+        if eos_token_id is not None:
+            finished = finished | (next_tok == eos_token_id)
+        out = [ids, next_tok[:, None]]
         for step in range(max_new_tokens - 1):
             # static cache: every decode step has identical shapes -> the
             # per-op executable cache serves each op from one compiled

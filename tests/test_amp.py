@@ -153,3 +153,24 @@ def test_check_numerics():
     bad = paddle.to_tensor(np.array([1.0, np.nan], np.float32))
     with _pytest.raises(FloatingPointError, match="NaN"):
         check_numerics(bad, "op", "y")
+
+
+def test_unscale_then_step_divides_once():
+    """Review regression: unscale_ -> clip -> step() must not unscale twice."""
+    p = paddle.Parameter(jnp.ones(4, jnp.float32))
+    scaler = paddle.amp.GradScaler(init_loss_scaling=8.0)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[p])
+    (p * 2).sum().backward()  # true grad = 2; scaled backward would be 16
+    scaler.scale(paddle.to_tensor(np.float32(0.0)))  # (scale used on loss)
+    # emulate scaled grads as scale(loss).backward() would produce
+    p._grad._value = p._grad._value * 8.0
+    scaler.unscale_(opt)
+    np.testing.assert_allclose(np.asarray(p.grad._value), 2.0 * np.ones(4))
+    scaler.step(opt)  # must NOT divide again
+    np.testing.assert_allclose(np.asarray(p._value), 1.0 - 0.1 * 2.0)
+    # next step: unscale works again
+    opt.clear_grad()
+    (p * 2).sum().backward()
+    p._grad._value = p._grad._value * 8.0
+    scaler.unscale_(opt)
+    np.testing.assert_allclose(np.asarray(p.grad._value), 2.0 * np.ones(4))
