@@ -1,0 +1,224 @@
+"""Minimal RPC — remote function execution between ranks.
+
+Analog of /root/reference/python/paddle/distributed/rpc/ (init_rpc,
+rpc_sync, rpc_async, shutdown over brpc services,
+paddle/fluid/distributed/rpc/). TPU-native transport: the native TCPStore
+(tcp_store.cpp) carries length-framed request/response blobs; each worker
+runs a dispatcher thread serving calls addressed to its name. Payloads are
+serialized with the framework's safe container format (framework/io.py) —
+function identity travels as ``module:qualname`` and is resolved by import,
+never unpickled code.
+"""
+from __future__ import annotations
+
+import importlib
+import json
+import threading
+import time
+import uuid
+
+import numpy as np
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown", "get_worker_info"]
+
+_state = None
+
+
+class WorkerInfo:
+    def __init__(self, name, rank, ip=None, port=None):
+        self.name = name
+        self.rank = rank
+        self.ip = ip
+        self.port = port
+
+
+class _RpcState:
+    def __init__(self, name, rank, world_size, store, serve_store):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store          # caller-side connection
+        self.serve_store = serve_store  # dispatcher's OWN connection:
+        # a blocking GET holds the per-connection mutex, so server and
+        # client must not share one socket (deadlock otherwise)
+        self.seq = 0
+        self.stop = threading.Event()
+        self.thread = None
+
+
+def _encode(obj) -> bytes:
+    """JSON head + tensor payloads via the io container."""
+    import base64
+    import io as _pyio
+    import tempfile
+
+    from ..framework.io import save
+
+    tensors = []
+
+    def walk(o):
+        from ..core.tensor import Tensor
+
+        if isinstance(o, Tensor):
+            tensors.append(np.asarray(o._value))
+            return {"@rpc_t": len(tensors) - 1}
+        if isinstance(o, np.ndarray):
+            tensors.append(o)
+            return {"@rpc_t": len(tensors) - 1}
+        if isinstance(o, dict):
+            return {k: walk(v) for k, v in o.items()}
+        if isinstance(o, (list, tuple)):
+            return {"@rpc_l": [walk(v) for v in o],
+                    "@rpc_tuple": isinstance(o, tuple)}
+        if isinstance(o, np.integer):
+            return int(o)
+        if isinstance(o, np.floating):
+            return float(o)
+        return o
+
+    tree = walk(obj)
+    blob = b""
+    if tensors:
+        with tempfile.NamedTemporaryFile(suffix=".bin") as f:
+            save({"t": tensors}, f.name)
+            blob = open(f.name, "rb").read()
+    head = json.dumps(tree).encode()
+    return (len(head).to_bytes(8, "little") + head + blob)
+
+
+def _decode(data: bytes):
+    import tempfile
+
+    from ..framework.io import load
+
+    hlen = int.from_bytes(data[:8], "little")
+    tree = json.loads(data[8:8 + hlen].decode())
+    blob = data[8 + hlen:]
+    tensors = []
+    if blob:
+        with tempfile.NamedTemporaryFile(suffix=".bin") as f:
+            open(f.name, "wb").write(blob)
+            tensors = load(f.name, return_numpy=True)["t"]
+
+    def walk(o):
+        if isinstance(o, dict):
+            if "@rpc_t" in o:
+                return tensors[o["@rpc_t"]]
+            if "@rpc_l" in o:
+                vals = [walk(v) for v in o["@rpc_l"]]
+                return tuple(vals) if o.get("@rpc_tuple") else vals
+            return {k: walk(v) for k, v in o.items()}
+        return o
+
+    return walk(tree)
+
+
+def _fn_ref(fn) -> str:
+    return f"{fn.__module__}:{fn.__qualname__}"
+
+
+def _resolve(ref: str):
+    mod, _, qual = ref.partition(":")
+    obj = importlib.import_module(mod)
+    for part in qual.split("."):
+        obj = getattr(obj, part)
+    return obj
+
+
+def _serve(state: _RpcState):
+    store = state.serve_store
+    inbox = f"rpc/inbox/{state.name}"
+    while not state.stop.is_set():
+        n = store.add(inbox, 0)  # current queue length
+        served = store.add(f"{inbox}/served", 0)
+        if served >= n:
+            time.sleep(0.01)
+            continue
+        key = f"{inbox}/{served}"
+        try:
+            req = _decode(store.get(key))
+        except Exception:
+            time.sleep(0.01)
+            continue
+        store.add(f"{inbox}/served", 1)
+        try:
+            fn = _resolve(req["fn"])
+            result = fn(*req.get("args", ()), **dict(req.get("kwargs", {})))
+            payload = {"ok": True, "result": result}
+        except Exception as e:  # error travels as text
+            payload = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        store.set(f"rpc/reply/{req['id']}", _encode(payload))
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Join the RPC group (reference rpc/init_rpc). Single-host multi-thread
+    or multi-process via the shared TCPStore endpoint."""
+    global _state
+    from .store import TCPStore
+
+    if master_endpoint:
+        host, _, port = master_endpoint.rpartition(":")
+        store = TCPStore(host or "127.0.0.1", int(port),
+                         is_master=(rank in (0, None)))
+        serve_store = TCPStore(host or "127.0.0.1", store.port)
+    else:
+        store = TCPStore(is_master=(rank in (0, None)))
+        serve_store = TCPStore(port=store.port)
+    _state = _RpcState(name, rank or 0, world_size or 1, store, serve_store)
+    _state.store.set(f"rpc/worker/{name}", str(rank or 0))
+    _state.thread = threading.Thread(target=_serve, args=(_state,),
+                                     daemon=True)
+    _state.thread.start()
+    return _state.store
+
+
+def get_worker_info(name=None):
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    if name is None:
+        return WorkerInfo(_state.name, _state.rank)
+    rank = int(_state.store.get(f"rpc/worker/{name}").decode())
+    return WorkerInfo(name, rank)
+
+
+class _Future:
+    def __init__(self, req_id, store):
+        self._id = req_id
+        self._store = store
+        self._done = None
+
+    def wait(self, timeout=None):
+        if self._done is None:
+            payload = _decode(self._store.get(f"rpc/reply/{self._id}"))
+            if not payload["ok"]:
+                raise RuntimeError(f"rpc remote error: {payload['error']}")
+            self._done = payload["result"]
+        return self._done
+
+
+def rpc_async(to, fn, args=(), kwargs=None, timeout=None):
+    """Submit fn for execution on worker ``to`` (reference rpc_async)."""
+    if _state is None:
+        raise RuntimeError("call init_rpc first")
+    req_id = uuid.uuid4().hex
+    req = {"id": req_id, "fn": _fn_ref(fn), "args": tuple(args),
+           "kwargs": dict(kwargs or {})}
+    inbox = f"rpc/inbox/{to}"
+    slot = _state.store.add(inbox, 1) - 1
+    _state.store.set(f"{inbox}/{slot}", _encode(req))
+    return _Future(req_id, _state.store)
+
+
+def rpc_sync(to, fn, args=(), kwargs=None, timeout=None):
+    return rpc_async(to, fn, args, kwargs).wait(timeout)
+
+
+def shutdown():
+    global _state
+    if _state is not None:
+        _state.stop.set()
+        if _state.thread:
+            _state.thread.join(1)
+        _state.serve_store.close()
+        _state.store.close()
+        _state = None

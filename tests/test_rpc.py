@@ -1,0 +1,44 @@
+"""Minimal RPC over the native TCPStore (reference paddle.distributed.rpc)."""
+import operator
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import rpc
+
+
+@pytest.fixture
+def rpc_env():
+    rpc.init_rpc("worker0", rank=0, world_size=1)
+    yield
+    rpc.shutdown()
+
+
+def test_rpc_sync_scalar(rpc_env):
+    assert rpc.rpc_sync("worker0", operator.add, args=(3, 4)) == 7
+
+
+def test_rpc_tensor_payload(rpc_env):
+    x = np.arange(6, dtype=np.float32).reshape(2, 3)
+    out = rpc.rpc_sync("worker0", np.sum, args=(x,))
+    assert out == 15.0
+    y = rpc.rpc_sync("worker0", np.transpose, args=(x,))
+    np.testing.assert_array_equal(y, x.T)
+
+
+def test_rpc_async_futures(rpc_env):
+    futs = [rpc.rpc_async("worker0", operator.mul, args=(i, i))
+            for i in range(5)]
+    assert [f.wait() for f in futs] == [0, 1, 4, 9, 16]
+
+
+def test_rpc_remote_error(rpc_env):
+    with pytest.raises(RuntimeError, match="rpc remote error"):
+        rpc.rpc_sync("worker0", operator.truediv, args=(1, 0))
+
+
+def test_worker_info(rpc_env):
+    info = rpc.get_worker_info()
+    assert info.name == "worker0" and info.rank == 0
+    assert rpc.get_worker_info("worker0").rank == 0
