@@ -1,0 +1,33 @@
+"""Export a trained model to a StableHLO artifact and serve it.
+
+Run: python examples/export_and_serve.py [--cpu]
+"""
+import sys
+import tempfile
+
+if "--cpu" in sys.argv:
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import inference
+from paddle_tpu.static import InputSpec
+
+paddle.seed(0)
+model = nn.Sequential(nn.Linear(16, 64), nn.GELU(), nn.Linear(64, 4))
+path = tempfile.mkdtemp() + "/model"
+paddle.jit.save(model, path, input_spec=[InputSpec([1, 16], "float32")])
+print("exported StableHLO artifact:", path + ".pdmodel")
+
+predictor = inference.create_predictor(inference.Config(path))
+x = np.random.rand(1, 16).astype(np.float32)
+predictor.get_input_handle(predictor.get_input_names()[0]).copy_from_cpu(x)
+(out,) = predictor.run()
+print("served output:", out)

@@ -1,0 +1,43 @@
+"""Train a small LLaMA on one chip through the whole-step compiled path.
+
+Run: python examples/train_llama_single_chip.py [--cpu]
+"""
+import sys
+
+if "--cpu" in sys.argv:
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (
+    LlamaConfig,
+    LlamaForCausalLM,
+    LlamaPretrainingCriterion,
+)
+
+paddle.seed(0)
+cfg = LlamaConfig(vocab_size=2048, hidden_size=256, intermediate_size=704,
+                  num_hidden_layers=4, num_attention_heads=8,
+                  max_position_embeddings=256)
+model = LlamaForCausalLM(cfg)
+crit = LlamaPretrainingCriterion()
+opt = paddle.optimizer.AdamW(
+    learning_rate=paddle.optimizer.lr.CosineAnnealingDecay(3e-4, T_max=100),
+    parameters=model.parameters(), weight_decay=0.1)
+
+# one XLA program: forward + backward + AdamW, buffers donated
+step = paddle.jit.TrainStep(model, lambda logits, ids: crit(logits, ids), opt)
+
+rng = np.random.RandomState(0)
+for it in range(20):
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (8, 256)))
+    loss = step(ids, labels=ids)
+    if it % 5 == 0:
+        print(f"step {it}: loss {float(loss):.4f}")
+print("done")

@@ -1,0 +1,35 @@
+"""ResNet-18 classification with the high-level Model API (BASELINE cfg 1).
+
+Run: python examples/train_resnet_cifar.py [--cpu]
+(pass a real CIFAR archive to vision.datasets.Cifar10 via data_file=...;
+FakeData keeps this example self-contained in a zero-egress environment)
+"""
+import sys
+
+if "--cpu" in sys.argv:
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.hapi import Model
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.vision import datasets, models
+
+paddle.seed(0)
+net = models.resnet18(num_classes=10)
+model = Model(net)
+model.prepare(
+    optimizer=paddle.optimizer.Momentum(
+        learning_rate=0.01, parameters=net.parameters(), weight_decay=5e-4),
+    loss=nn.CrossEntropyLoss(),
+    metrics=Accuracy(),
+)
+train = datasets.FakeData(num_samples=256, image_shape=(3, 32, 32),
+                          num_classes=10)
+model.fit(train, batch_size=64, epochs=2, verbose=2)
+print(model.evaluate(train, batch_size=64, verbose=0))

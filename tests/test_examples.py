@@ -1,0 +1,21 @@
+"""The examples/ scripts run end-to-end (CPU mode)."""
+import subprocess
+import sys
+
+import pytest
+
+
+@pytest.mark.parametrize("script", [
+    "examples/train_llama_distributed.py",
+    "examples/export_and_serve.py",
+])
+def test_example_runs(script):
+    import os
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.getcwd() + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([sys.executable, script, "--cpu"],
+                          capture_output=True, text=True, timeout=300,
+                          env=env)
+    assert proc.returncode == 0, proc.stderr[-1500:]
+    assert "done" in proc.stdout or "served output" in proc.stdout
