@@ -85,6 +85,12 @@ def shard_tensor(data, mesh: ProcessMesh = None, placements=None,
         init, shape, dtype = data._lazy_init
         placements = _normalize_placements(placements or [], mesh)
         sharding = to_named_sharding(mesh, placements)
+        # materialize the RNG root key OUTSIDE the trace: initializers draw
+        # from the global stream, and a key first created inside jit would
+        # escape as a leaked tracer
+        from ..core import random as _random
+
+        _ = _random._rng.key
 
         def produce():
             out = init(shape, dtype=dtype)
