@@ -106,11 +106,14 @@ def mm_chain(x, b):
     return jax.lax.fori_loop(0, iters, lambda i, x: x @ b, x)
 
 sync_fetch(mm_chain(a, b))  # compile + warm
-a2 = a + 0.01  # fresh input: defeat call memoization
-t = time.time()
-sync_fetch(mm_chain(a2, b))
-dt = max(time.time() - t - RTT, 1e-9) / iters
-matmul_tflops = 2 * N**3 / dt / 1e12
+best_dt = None
+for rep in range(1 if SMOKE else 3):  # best-of-3: RTT jitter is additive
+    a2 = a + 0.01 * (rep + 1)  # fresh input: defeat call memoization
+    t = time.time()
+    sync_fetch(mm_chain(a2, b))
+    dt = max(time.time() - t - RTT, 1e-9) / iters
+    best_dt = dt if best_dt is None else min(best_dt, dt)
+matmul_tflops = 2 * N**3 / best_dt / 1e12
 log(f"matmul: {matmul_tflops:.1f} TFLOP/s"
     + (f" ({100*matmul_tflops*1e12/peak:.0f}% of {peak/1e12:.0f}T nominal)" if peak else ""))
 # MFU denominator: at least the demonstrated matmul rate — if the chip beats
