@@ -214,6 +214,66 @@ mfu = tokens_per_sec * flops_per_token / peak
 log(f"step={dt*1e3:.1f}ms  tokens/s={tokens_per_sec:,.0f}  "
     f"MFU={100*mfu:.1f}% (loss={float(loss):.3f})")
 
+# ------------------------------------------------------------ (c) resnet
+# BASELINE config 1: resnet training throughput (img/s) on synthetic
+# CIFAR-shaped data, same device-side multi-step methodology.
+from paddle_tpu.vision import models as _vmodels  # noqa: E402
+import paddle_tpu.nn as _nn  # noqa: E402
+
+if SMOKE:
+    RN_BATCH, RN_STEPS = 8, 2
+else:
+    RN_BATCH, RN_STEPS = 256, 10
+log(f"resnet18 bench: batch={RN_BATCH} @3x32x32...")
+paddle.seed(0)
+rn = _vmodels.resnet18(num_classes=10)
+rn_opt = paddle.optimizer.Momentum(learning_rate=0.1,
+                                   parameters=rn.parameters())
+rn_crit = _nn.CrossEntropyLoss()
+rn_f = _FunctionalModel(rn)
+rn_params, rn_buffers = rn.raw_state()
+rn_opt.register_param_names(dict(rn.named_parameters()))
+rn_accs, rn_masters = rn_opt.init_functional_state(rn_params)
+rn_x = jnp.asarray(np.random.rand(RN_BATCH, 3, 32, 32).astype(np.float32))
+rn_y = jnp.asarray(np.random.randint(0, 10, (RN_BATCH, 1)))
+
+
+def rn_loss_of(p, bufs):
+    out, new_bufs = rn_f(p, bufs, (paddle.Tensor._from_value(rn_x),), {}, rng)
+    ov = out._value if hasattr(out, "_value") else out
+    loss = rn_crit(paddle.Tensor._from_value(ov),
+                   paddle.Tensor._from_value(rn_y))
+    return loss._value, new_bufs
+
+
+def rn_step(carry, _):
+    p, bufs, a, m, t_s = carry
+    (loss, new_bufs), grads = jax.value_and_grad(
+        rn_loss_of, has_aux=True)(p, bufs)
+    p2, a2, m2 = rn_opt.functional_update(
+        p, grads, a, m, jnp.asarray(0.1, jnp.float32), t_s)
+    return (p2, new_bufs, a2, m2, t_s + 1), loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def rn_run(p, bufs, a, m):
+    (p, bufs, a, m, _), losses = jax.lax.scan(
+        rn_step, (p, bufs, a, m, jnp.asarray(1, jnp.int32)), None,
+        length=RN_STEPS)
+    return p, bufs, a, m, losses
+
+
+rn_params, rn_buffers, rn_accs, rn_masters, rn_losses = rn_run(
+    rn_params, rn_buffers, rn_accs, rn_masters)
+sync_fetch(rn_losses)
+t = time.time()
+rn_params, rn_buffers, rn_accs, rn_masters, rn_losses = rn_run(
+    rn_params, rn_buffers, rn_accs, rn_masters)
+sync_fetch(rn_losses)
+rn_dt = max(time.time() - t - RTT, 1e-9) / RN_STEPS
+resnet_img_s = RN_BATCH / rn_dt
+log(f"resnet18: {rn_dt*1e3:.1f}ms/step {resnet_img_s:,.0f} img/s")
+
 result = {
     "metric": "llama_train_mfu",
     "value": round(100 * mfu, 2),
@@ -222,6 +282,7 @@ result = {
     "tokens_per_sec": round(tokens_per_sec, 1),
     "step_ms": round(dt * 1e3, 2),
     "matmul_tflops": round(matmul_tflops, 1),
+    "resnet18_img_per_sec": round(resnet_img_s, 1),
     "n_params_m": round(n_params / 1e6, 1),
     "device": kind,
     "platform": platform,

@@ -157,6 +157,17 @@ class Ctx:
 
 def apply_op(op: OpDef, *args, **kwargs):
     """Dispatch one eager op call. Returns Tensor or tuple of Tensors."""
+    from ..profiler import _active as _prof_active
+
+    if _prof_active:
+        from ..profiler import RecordEvent
+
+        with RecordEvent(f"op::{op.name}"):
+            return _apply_op_impl(op, args, kwargs)
+    return _apply_op_impl(op, args, kwargs)
+
+
+def _apply_op_impl(op: OpDef, args, kwargs):
     bound = op.sig.bind(*args, **kwargs)
     bound.apply_defaults()
     arguments = bound.arguments
