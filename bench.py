@@ -223,7 +223,7 @@ import paddle_tpu.nn as _nn  # noqa: E402
 if SMOKE:
     RN_BATCH, RN_STEPS = 8, 2
 else:
-    RN_BATCH, RN_STEPS = 256, 10
+    RN_BATCH, RN_STEPS = 256, 100  # small model: enough steps to clear the sync RTT
 log(f"resnet18 bench: batch={RN_BATCH} @3x32x32...")
 paddle.seed(0)
 rn = _vmodels.resnet18(num_classes=10)
@@ -282,6 +282,9 @@ result = {
     "tokens_per_sec": round(tokens_per_sec, 1),
     "step_ms": round(dt * 1e3, 2),
     "matmul_tflops": round(matmul_tflops, 1),
+    "mfu_vs_nominal_peak_pct": round(
+        100 * tokens_per_sec * flops_per_token
+        / (chip_peak(kind) or peak), 2),
     "resnet18_img_per_sec": round(resnet_img_s, 1),
     "n_params_m": round(n_params / 1e6, 1),
     "device": kind,
