@@ -81,12 +81,16 @@ def sync_fetch(x) -> float:
 
 
 def measure_rtt() -> float:
+    # MIN of several samples: sync latency noise is strictly additive, and
+    # an inflated RTT would over-subtract from every measurement below
     z = jnp.zeros(())
     sync_fetch(z)
-    t = time.time()
-    for _ in range(3):
-        sync_fetch(z + 1.0)
-    return (time.time() - t) / 3
+    samples = []
+    for i in range(5):
+        t = time.time()
+        sync_fetch(z + float(i + 1))
+        samples.append(time.time() - t)
+    return min(samples)
 
 
 RTT = measure_rtt()
@@ -266,6 +270,7 @@ def rn_run(p, bufs, a, m):
 rn_params, rn_buffers, rn_accs, rn_masters, rn_losses = rn_run(
     rn_params, rn_buffers, rn_accs, rn_masters)
 sync_fetch(rn_losses)
+RTT = measure_rtt()  # re-measure at steady state for the small-model timing
 t = time.time()
 rn_params, rn_buffers, rn_accs, rn_masters, rn_losses = rn_run(
     rn_params, rn_buffers, rn_accs, rn_masters)
