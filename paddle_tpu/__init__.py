@@ -89,7 +89,16 @@ from . import vision  # noqa: F401
 from .ops import cast as as_type  # noqa: F401
 
 
+from .hapi import Model  # noqa: F401
+from .hapi import model as callbacks  # noqa: F401  (paddle.callbacks.*)
 from .nn import LazyGuard  # noqa: F401
+
+
+def flops(net, input_size=None, inputs=None, custom_ops=None,
+          print_detail=False):
+    from .hapi import flops as _flops
+
+    return _flops(net, input_size, inputs, custom_ops, print_detail)
 
 
 def rand(shape, dtype="float32"):
