@@ -61,6 +61,7 @@ from . import onnx  # noqa: F401
 from . import static  # noqa: F401
 from . import text  # noqa: F401
 from . import utils  # noqa: F401
+from . import version  # noqa: F401
 from . import distributed  # noqa: F401
 from . import device  # noqa: F401
 from . import distribution  # noqa: F401

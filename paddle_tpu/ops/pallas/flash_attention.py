@@ -45,7 +45,10 @@ NEG_INF = -1e30
 
 
 def _block_for(seq: int) -> int:
-    return PREFERRED_BLOCK if seq % PREFERRED_BLOCK == 0 else BLOCK_Q
+    from ...core.flags import flag
+
+    preferred = int(flag("FLAGS_flash_attention_block_size") or PREFERRED_BLOCK)
+    return preferred if seq % preferred == 0 else BLOCK_Q
 
 
 def _interpret():

@@ -1,0 +1,28 @@
+"""Version metadata (reference python/paddle/version.py, generated at build).
+"""
+import subprocess
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+cuda_version = "False"   # reference-compat field: no CUDA in this build
+cudnn_version = "False"
+tpu = "True"
+with_pip = "OFF"
+
+try:
+    commit = subprocess.run(
+        ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+        text=True, timeout=5).stdout.strip() or "unknown"
+except Exception:
+    commit = "unknown"
+
+
+def show():
+    print(f"paddle_tpu {full_version} (commit {commit}, tpu native)")
+
+
+def cuda():
+    return False
