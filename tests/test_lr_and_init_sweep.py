@@ -1,0 +1,135 @@
+"""Sweep every LR scheduler and initializer (analog of the reference's
+test/legacy_test/test_lr_scheduler.py and test_initializer.py coverage).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.nn import initializer as I
+from paddle_tpu.optimizer import lr as L
+
+# scheduler -> (ctor, property checked over 12 steps)
+SCHEDULERS = {
+    "NoamDecay": (lambda: L.NoamDecay(d_model=64, warmup_steps=4,
+                                      learning_rate=1.0), "warmup_peak"),
+    "PiecewiseDecay": (lambda: L.PiecewiseDecay(
+        boundaries=[3, 6], values=[1.0, 0.5, 0.1]), "nonincreasing"),
+    "NaturalExpDecay": (lambda: L.NaturalExpDecay(1.0, gamma=0.1),
+                        "nonincreasing"),
+    "InverseTimeDecay": (lambda: L.InverseTimeDecay(1.0, gamma=0.5),
+                         "nonincreasing"),
+    "PolynomialDecay": (lambda: L.PolynomialDecay(1.0, decay_steps=10,
+                                                  end_lr=0.1),
+                        "nonincreasing"),
+    "LinearWarmup": (lambda: L.LinearWarmup(0.5, warmup_steps=5,
+                                            start_lr=0.0, end_lr=0.5),
+                     "warmup_peak"),
+    "ExponentialDecay": (lambda: L.ExponentialDecay(1.0, gamma=0.9),
+                         "nonincreasing"),
+    "MultiStepDecay": (lambda: L.MultiStepDecay(1.0, milestones=[4, 8],
+                                                gamma=0.1), "nonincreasing"),
+    "StepDecay": (lambda: L.StepDecay(1.0, step_size=4, gamma=0.5),
+                  "nonincreasing"),
+    "LambdaDecay": (lambda: L.LambdaDecay(1.0, lr_lambda=lambda e: 0.9 ** e),
+                    "nonincreasing"),
+    "MultiplicativeDecay": (lambda: L.MultiplicativeDecay(
+        1.0, lr_lambda=lambda e: 0.9), "nonincreasing"),
+    "CosineAnnealingDecay": (lambda: L.CosineAnnealingDecay(1.0, T_max=12),
+                             "nonincreasing"),
+    "CosineAnnealingWarmRestarts": (
+        lambda: L.CosineAnnealingWarmRestarts(1.0, T_0=4), "positive"),
+    "LinearLR": (lambda: L.LinearLR(1.0, total_steps=10,
+                                    start_factor=0.1), "nondecreasing"),
+    "OneCycleLR": (lambda: L.OneCycleLR(max_learning_rate=1.0,
+                                        total_steps=12), "positive"),
+    "CyclicLR": (lambda: L.CyclicLR(base_learning_rate=0.1,
+                                    max_learning_rate=1.0,
+                                    step_size_up=3), "positive"),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SCHEDULERS))
+def test_scheduler(name):
+    ctor, prop = SCHEDULERS[name]
+    sched = ctor()
+    values = []
+    for _ in range(12):
+        values.append(float(sched()))
+        sched.step()
+    assert all(np.isfinite(v) for v in values), values
+    assert all(v >= 0 for v in values), values
+    if prop == "nonincreasing":
+        assert all(a >= b - 1e-9 for a, b in zip(values, values[1:])), values
+        assert values[-1] < values[0]
+    elif prop == "nondecreasing":
+        assert all(b >= a - 1e-9 for a, b in zip(values, values[1:])), values
+    elif prop == "warmup_peak":
+        assert values[0] < max(values)  # rises then falls/holds
+    elif prop == "positive":
+        assert max(values) > 0
+
+
+def test_reduce_on_plateau():
+    sched = L.ReduceOnPlateau(learning_rate=1.0, factor=0.5, patience=2)
+    for loss in [1.0, 1.0, 1.0, 1.0, 1.0]:
+        sched.step(paddle.to_tensor(np.float32(loss)))
+    assert float(sched()) < 1.0  # plateaued -> reduced
+
+
+def test_scheduler_state_dict_roundtrip():
+    s1 = L.CosineAnnealingDecay(1.0, T_max=10)
+    for _ in range(4):
+        s1.step()
+    state = s1.state_dict()
+    s2 = L.CosineAnnealingDecay(1.0, T_max=10)
+    s2.set_state_dict(state)
+    assert float(s1()) == float(s2())
+
+
+INITS = {
+    "Constant": (lambda: I.Constant(3.0),
+                 lambda a: np.allclose(a, 3.0)),
+    "Normal": (lambda: I.Normal(0.0, 0.02),
+               lambda a: abs(a.std() - 0.02) < 0.005),
+    "TruncatedNormal": (lambda: I.TruncatedNormal(0.0, 1.0),
+                        lambda a: np.abs(a).max() <= 2.0 + 1e-5),
+    "Uniform": (lambda: I.Uniform(-0.5, 0.5),
+                lambda a: a.min() >= -0.5 and a.max() <= 0.5),
+    "XavierNormal": (lambda: I.XavierNormal(),
+                     lambda a: abs(a.std() - np.sqrt(2 / (64 + 64))) < 0.01),
+    "XavierUniform": (lambda: I.XavierUniform(),
+                      lambda a: np.abs(a).max() <= np.sqrt(6 / 128) + 1e-5),
+    "KaimingNormal": (lambda: I.KaimingNormal(),
+                      lambda a: a.std() > 0),
+    "KaimingUniform": (lambda: I.KaimingUniform(),
+                       lambda a: a.std() > 0),
+}
+
+
+@pytest.mark.parametrize("name", sorted(INITS))
+def test_initializer(name):
+    paddle.seed(0)
+    ctor, check = INITS[name]
+    arr = ctor()((64, 64), dtype="float32")
+    a = np.asarray(arr._value if hasattr(arr, "_value") else arr)
+    assert a.shape == (64, 64)
+    assert np.isfinite(a).all()
+    assert check(a), f"{name} property failed"
+
+
+def test_orthogonal_initializer():
+    paddle.seed(0)
+    arr = I.Orthogonal()((32, 32), dtype="float32")
+    a = np.asarray(arr._value if hasattr(arr, "_value") else arr)
+    np.testing.assert_allclose(a @ a.T, np.eye(32), atol=1e-4)
+
+
+def test_assign_and_dirac():
+    src = np.random.rand(4, 4).astype(np.float32)
+    arr = I.Assign(src)((4, 4), dtype="float32")
+    a = np.asarray(arr._value if hasattr(arr, "_value") else arr)
+    np.testing.assert_allclose(a, src)
+    d = I.Dirac()((4, 4, 3, 3), dtype="float32")
+    dv = np.asarray(d._value if hasattr(d, "_value") else d)
+    # identity conv: center tap = 1 on matching channels
+    assert dv[0, 0, 1, 1] == 1.0 and dv[0, 1, 1, 1] == 0.0
