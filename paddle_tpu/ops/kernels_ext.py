@@ -680,3 +680,93 @@ def is_empty(x):
 
 def rank(x):
     return jnp.asarray(x.ndim)
+
+
+# ---------------------------------------------------------- second batch
+
+def cartesian_prod(xs):
+    grids = jnp.meshgrid(*xs, indexing="ij")
+    return jnp.stack([g.ravel() for g in grids], axis=-1)
+
+
+def fill_constant(shape, dtype, value):
+    from ..core.dtype import to_jax_dtype
+
+    return jnp.full(tuple(shape), value, to_jax_dtype(dtype))
+
+
+def polygamma(x, n=1):
+    return jax.scipy.special.polygamma(n, x)
+
+
+def multigammaln(x, p):
+    return jax.scipy.special.multigammaln(x, p)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None):
+    h, edges = jnp.histogramdd(x, bins=bins, range=ranges, density=density,
+                               weights=weights)
+    return (h,) + tuple(edges)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True):
+    n = lu_data.shape[-2]
+    L = jnp.tril(lu_data, -1) + jnp.eye(n, dtype=lu_data.dtype)
+    U = jnp.triu(lu_data)
+    # pivots (1-based, from ext.lu) -> permutation matrix
+    piv = lu_pivots.astype(jnp.int32) - 1
+    perm = jnp.arange(n)
+    def swap(i, p):
+        a, b = p[i], p[piv[i]]
+        p = p.at[i].set(b)
+        return p.at[piv[i]].set(a)
+    perm = jax.lax.fori_loop(0, piv.shape[-1], swap, perm)
+    P = jnp.eye(n, dtype=lu_data.dtype)[perm]
+    return P, L, U
+
+
+def householder_product(x, tau):
+    return jax.lax.linalg.householder_product(x, tau)
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, *, rng_key=None):
+    """Randomized truncated SVD (reference linalg.svd_lowrank; Halko et al.)."""
+    from ..core.random import next_key
+
+    key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+           else next_key())
+    m, n = x.shape[-2], x.shape[-1]
+    q = min(q, m, n)
+    omega = jax.random.normal(key, x.shape[:-2] + (n, q), x.dtype)
+    y = x @ omega
+    for _ in range(niter):
+        y = x @ (jnp.swapaxes(x, -1, -2) @ y)
+    Q, _ = jnp.linalg.qr(y)
+    b = jnp.swapaxes(Q, -1, -2) @ x
+    u_b, s, v = jnp.linalg.svd(b, full_matrices=False)
+    return Q @ u_b, s, jnp.swapaxes(v, -1, -2)
+
+
+def pca_lowrank(x, q=6, center=True, niter=2, *, rng_key=None):
+    if center:
+        x = x - x.mean(axis=-2, keepdims=True)
+    return svd_lowrank(x, q=q, niter=niter, rng_key=rng_key)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, *, rng_key=None):
+    """Nucleus sampling over logits (reference top_p_sampling kernel)."""
+    from ..core.random import next_key
+
+    key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+           else next_key())
+    p = ps if np.isscalar(ps) else jnp.asarray(ps).reshape(-1)[0]
+    sorted_logits = jnp.sort(x, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cum < p, axis=-1, keepdims=True)
+    cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+    masked = jnp.where(x < cutoff, -1e30, x)
+    ids = jax.random.categorical(key, masked, axis=-1)
+    probs_out = jnp.take_along_axis(
+        jax.nn.softmax(masked, -1), ids[..., None], axis=-1)
+    return probs_out, ids[..., None].astype(jnp.int64)

@@ -697,6 +697,25 @@ case("rank", lambda: ((T(P((2, 3))),), {}), None, grad=False)
 
 # internal composite ops covered by their own dedicated test files
 
+case("cartesian_prod", lambda: (([T(P((2,))), T(P((3,)))],), {}), None,
+     grad=False)
+case("fill_constant", lambda: ((), {"shape": [2, 2], "dtype": "float32",
+                                    "value": 5.0}), None, grad=False)
+case("polygamma", lambda: ((T(PP((3,)) + 1),), {}), None)
+case("multigammaln", lambda: ((T(PP((3,)) + 3),), {"p": 2}), None)
+case("histogramdd", lambda: ((T(P((10, 2))),), {"bins": 3}), None,
+     grad=False)
+case("lu_unpack", lambda: (tuple(
+    __import__("paddle_tpu").lu(T(P((3, 3)) + 2 * np.eye(3, dtype=np.float32)))
+), {}), None, grad=False)
+case("householder_product",
+     lambda: ((T(np.linalg.qr(P((4, 3)))[0][:, :3]), T(P((3,)))), {}),
+     None, grad=False)
+case("svd_lowrank", lambda: ((T(P((6, 5))),), {"q": 3}), None, grad=False)
+case("pca_lowrank", lambda: ((T(P((6, 5))),), {"q": 3}), None, grad=False)
+case("top_p_sampling", lambda: ((T(P((2, 8))),), {"ps": 0.9}), None,
+     grad=False)
+
 # (exemptions)
 EXEMPT = {
     "_gru_scan": "internal RNN kernel (tests/test_nn_layers.py)",
