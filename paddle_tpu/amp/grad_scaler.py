@@ -30,7 +30,8 @@ class GradScaler:
         self._use_dynamic = use_dynamic_loss_scaling
         self._good_steps = 0
         self._bad_steps = 0
-        self._found_inf = False
+        self._found_inf = False  # any-optimizer flag, read by update()
+        self._inf_by_opt: dict = {}  # per-optimizer, read by step()
         self._unscaled_opts: set = set()  # ids of optimizers already unscaled
 
     def is_enable(self):
@@ -70,7 +71,8 @@ class GradScaler:
             if not bool(jnp.all(jnp.isfinite(gv))):
                 found = True
             g._value = gv
-        self._found_inf = found
+        self._inf_by_opt[id(optimizer)] = found
+        self._found_inf = self._found_inf or found
 
     def step(self, optimizer):
         """unscale + skip-on-inf + optimizer.step (reference GradScaler.step)."""
@@ -78,9 +80,10 @@ class GradScaler:
             optimizer.step()
             return
         self.unscale_(optimizer)
-        if not self._found_inf:
+        if not self._inf_by_opt.get(id(optimizer), False):
             optimizer.step()
         self._unscaled_opts.discard(id(optimizer))
+        self._inf_by_opt.pop(id(optimizer), None)
 
     def update(self):
         if not (self._enable and self._use_dynamic):

@@ -189,7 +189,15 @@ class _Future:
 
     def wait(self, timeout=None):
         if self._done is None:
-            payload = _decode(self._store.get(f"rpc/reply/{self._id}"))
+            key = f"rpc/reply/{self._id}"
+            if timeout is not None:
+                deadline = time.time() + timeout
+                while not self._store.check(key):
+                    if time.time() > deadline:
+                        raise TimeoutError(
+                            f"rpc reply not received within {timeout}s")
+                    time.sleep(0.01)
+            payload = _decode(self._store.get(key))
             if not payload["ok"]:
                 raise RuntimeError(f"rpc remote error: {payload['error']}")
             self._done = payload["result"]
@@ -210,7 +218,7 @@ def rpc_async(to, fn, args=(), kwargs=None, timeout=None):
 
 
 def rpc_sync(to, fn, args=(), kwargs=None, timeout=None):
-    return rpc_async(to, fn, args, kwargs).wait(timeout)
+    return rpc_async(to, fn, args, kwargs).wait(timeout=timeout)
 
 
 def shutdown():

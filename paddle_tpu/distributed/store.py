@@ -142,6 +142,14 @@ class TCPStore:
         n = self._lib.tcpstore_get(self._client, key.encode(), buf, len(buf))
         if n < 0:
             raise RuntimeError("TCPStore.get failed")
+        if n > len(buf):
+            # value larger than the first buffer: GET is idempotent (the
+            # server keeps the key), so re-request with the exact size
+            buf = ctypes.create_string_buffer(n)
+            n = self._lib.tcpstore_get(self._client, key.encode(), buf,
+                                       len(buf))
+            if n < 0:
+                raise RuntimeError("TCPStore.get failed")
         return buf.raw[:n]
 
     def add(self, key: str, delta: int) -> int:

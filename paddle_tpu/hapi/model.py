@@ -225,6 +225,10 @@ class Model:
         if isinstance(eval_data, Dataset):
             eval_data = DataLoader(eval_data, batch_size=batch_size,
                                    num_workers=num_workers)
+        cbs = list(callbacks or [])
+        for cb in cbs:
+            cb.set_model(self)
+            cb.on_eval_begin()
         for m in self._metrics:
             m.reset()
         logs = {}
@@ -234,10 +238,14 @@ class Model:
             out = self.eval_batch(ins, lab)
             if "loss" in out:
                 losses.append(out["loss"])
+            for cb in cbs:
+                cb.on_eval_batch_end(step, out)
         if losses:
             logs["eval_loss"] = float(np.mean(losses))
         for m in self._metrics:
             logs["eval_" + _name(m)] = _scalar(m.accumulate())
+        for cb in cbs:
+            cb.on_eval_end(logs)
         return logs
 
     def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,

@@ -46,6 +46,7 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     """
     ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
     b, s = ids.shape
+    was_training = getattr(model, "training", False)
     model.eval()
 
     cfg = model.config
@@ -84,4 +85,6 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
             out.append(next_tok[:, None])
             if eos_token_id is not None and bool(finished.all()):
                 break
+        if was_training:
+            model.train()
         return Tensor._from_value(jnp.concatenate(out, axis=1))

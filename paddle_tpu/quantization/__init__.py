@@ -121,12 +121,22 @@ class _QuantedLayer(Layer):
         self.w_q = w_q
 
     def forward(self, x):
+        from ..nn import functional as F
+        from ..nn.layers_common import Linear
+        from ..nn.layers_conv import Conv2D
+
         if self.act_q is not None:
             x = self.act_q(x)
         if self.w_q is not None and hasattr(self.inner, "weight"):
-            w = self.inner.weight
-            orig = w._value
-            self.w_q(Tensor._from_value(orig))
+            w_qdq = self.w_q(self.inner.weight)  # STE: qdq error in fwd/bwd
+            if isinstance(self.inner, Linear):
+                return F.linear(x, w_qdq, self.inner.bias)
+            if isinstance(self.inner, Conv2D):
+                return F.conv2d(x, w_qdq, self.inner.bias,
+                                stride=self.inner.stride,
+                                padding=self.inner.padding,
+                                dilation=self.inner.dilation,
+                                groups=self.inner.groups)
         return self.inner(x)
 
 

@@ -127,3 +127,28 @@ def test_launcher_propagates_failure(tmp_path):
 
     rc = launch(str(script), nproc_per_node=2)
     assert rc == 3
+
+
+def test_qat_weight_qdq_actually_applied():
+    """Review regression: the fake-quantized weight must reach the matmul."""
+    from paddle_tpu.quantization import (
+        FakeQuanterWithAbsMaxObserver,
+        QAT,
+        QuantConfig,
+    )
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(4, 4, bias_attr=False))
+    # coarse 2-bit quantization so the qdq error is large and observable
+    q = QAT(QuantConfig(activation=None,
+                        weight=FakeQuanterWithAbsMaxObserver(quant_bits=2)))
+    qmodel = q.quantize(model)
+    x = paddle.to_tensor(np.eye(4, dtype=np.float32))
+    out = np.asarray(qmodel(x)._value)
+    w = np.asarray(model._sub_layers["0"].inner.weight._value)
+    # output equals the QDQ'd weight, not the raw weight
+    assert not np.allclose(out, w, atol=1e-6)
+    scale = model._sub_layers["0"].w_q._scale
+    qmax = 2 ** (2 - 1) - 1
+    expect = np.clip(np.round(w / scale * qmax), -qmax, qmax) / qmax * scale
+    np.testing.assert_allclose(out, expect, atol=1e-6)
