@@ -76,6 +76,7 @@ from ..ops import (  # noqa: F401
 from ..ops import l2_normalize as normalize  # noqa: F401
 from ..ops import rotary_position_embedding  # noqa: F401
 from ..ops import tanh  # noqa: F401
+from ..ops import affine_grid, grid_sample  # noqa: F401
 
 
 def relu_(x):

@@ -876,3 +876,66 @@ def label_smooth(label, prior_dist=None, epsilon=0.1):
     if prior_dist is not None:
         return (1 - epsilon) * label + epsilon * prior_dist
     return (1 - epsilon) * label + epsilon / n
+
+
+def affine_grid(theta, out_shape, align_corners=True):
+    """Affine sampling grid (reference paddle.nn.functional.affine_grid /
+    paddle/phi/kernels/gpu/affine_grid_kernel.cu): theta (N, 2, 3),
+    out_shape [N, C, H, W] -> grid (N, H, W, 2) of normalized (x, y)."""
+    n, _, h, w = [int(v) for v in out_shape]
+    if align_corners:
+        xs = jnp.linspace(-1.0, 1.0, w)
+        ys = jnp.linspace(-1.0, 1.0, h)
+    else:
+        xs = (jnp.arange(w) + 0.5) * 2.0 / w - 1.0
+        ys = (jnp.arange(h) + 0.5) * 2.0 / h - 1.0
+    gx, gy = jnp.meshgrid(xs, ys)  # (H, W)
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # (H, W, 3)
+    # grid = base @ theta^T  per batch
+    return jnp.einsum("hwk,njk->nhwj", base, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True):
+    """Sample input at normalized grid points (reference
+    nn.functional.grid_sample / grid_sample_kernel.cu): x (N, C, H, W),
+    grid (N, Ho, Wo, 2) with (x, y) in [-1, 1]."""
+    n, c, h, w = x.shape
+    gx = grid[..., 0]
+    gy = grid[..., 1]
+    if align_corners:
+        fx = (gx + 1.0) * (w - 1) / 2.0
+        fy = (gy + 1.0) * (h - 1) / 2.0
+    else:
+        fx = ((gx + 1.0) * w - 1.0) / 2.0
+        fy = ((gy + 1.0) * h - 1.0) / 2.0
+
+    def gather(ix, iy):
+        # out-of-range handling
+        if padding_mode == "border":
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+            valid = jnp.ones_like(ix, dtype=x.dtype)
+        else:  # zeros
+            valid = ((ix >= 0) & (ix <= w - 1) & (iy >= 0)
+                     & (iy <= h - 1)).astype(x.dtype)
+            ixc = jnp.clip(ix, 0, w - 1)
+            iyc = jnp.clip(iy, 0, h - 1)
+        # x (N,C,H,W); per-batch gather at (iyc, ixc): (N, Ho, Wo) indices
+        out = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(x, iyc, ixc)
+        return out * valid[:, None, :, :]
+
+    if mode == "nearest":
+        return gather(jnp.round(fx).astype(jnp.int32),
+                      jnp.round(fy).astype(jnp.int32))
+    x0 = jnp.floor(fx).astype(jnp.int32)
+    y0 = jnp.floor(fy).astype(jnp.int32)
+    x1 = x0 + 1
+    y1 = y0 + 1
+    wx = (fx - x0).astype(x.dtype)[:, None, :, :]
+    wy = (fy - y0).astype(x.dtype)[:, None, :, :]
+    return (gather(x0, y0) * (1 - wx) * (1 - wy)
+            + gather(x1, y0) * wx * (1 - wy)
+            + gather(x0, y1) * (1 - wx) * wy
+            + gather(x1, y1) * wx * wy)

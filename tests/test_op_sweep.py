@@ -716,6 +716,13 @@ case("pca_lowrank", lambda: ((T(P((6, 5))),), {"q": 3}), None, grad=False)
 case("top_p_sampling", lambda: ((T(P((2, 8))),), {"ps": 0.9}), None,
      grad=False)
 
+case("affine_grid", lambda: ((T(np.tile(np.array([[1, 0, 0], [0, 1, 0]],
+                                                 np.float32), (2, 1, 1))),),
+                             {"out_shape": [2, 3, 4, 4]}), None)
+case("grid_sample", lambda: ((T(P((1, 2, 4, 4))),
+                              T(np.zeros((1, 2, 2, 2), np.float32))), {}),
+     None)
+
 # (exemptions)
 EXEMPT = {
     "_gru_scan": "internal RNN kernel (tests/test_nn_layers.py)",
