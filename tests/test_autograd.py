@@ -175,3 +175,49 @@ def test_inplace_mutation_cannot_stale_gradients():
     w.sum().backward()
     # grad = 2 * z_original = 6, NOT 2 * 103
     np.testing.assert_allclose(np.asarray(z.grad._value), 6.0 * np.ones(3))
+
+
+def test_cached_backward_distinguishes_call_patterns():
+    """Regression: pow(x_t, y_t) and x_t ** scalar share value structure but
+    must compile distinct backward executables (cache-key collision made one
+    pattern reuse the other's executable)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    x = paddle.to_tensor(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+    # pattern A: tensor ** python scalar (exponent coerced to raw array)
+    (x ** 2.0).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value), [4.0, 6.0],
+                               rtol=1e-6)
+    x.clear_grad()
+    # pattern B: pow(tensor, tensor) — same shapes, same attrs
+    y = paddle.to_tensor(np.array([3.0, 2.0], np.float32), stop_gradient=False)
+    paddle.pow(x, y).sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               [3 * 4.0, 2 * 3.0], rtol=1e-6)  # y*x^(y-1)
+    np.testing.assert_allclose(np.asarray(y.grad._value),
+                               [8 * np.log(2), 9 * np.log(3)], rtol=1e-5)
+
+
+def test_cached_backward_rng_key_not_baked():
+    """Regression: dropout's rng_key (a raw array input) must ride into the
+    cached backward as an argument — a baked first-call key would make every
+    later backward replay the first mask."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    paddle.seed(123)
+    x = paddle.to_tensor(np.ones((64,), np.float32), stop_gradient=False)
+    masks = []
+    for _ in range(3):
+        y = paddle.dropout(x, p=0.5)
+        y.sum().backward()
+        # grad == mask/keep_prob: must match THIS call's forward mask
+        fwd_mask = (np.asarray(y._value) != 0).astype(np.float32) / 0.5
+        np.testing.assert_allclose(np.asarray(x.grad._value), fwd_mask,
+                                   rtol=1e-6)
+        masks.append(fwd_mask.tobytes())
+        x.clear_grad()
+    assert len(set(masks)) > 1  # different draws across calls
