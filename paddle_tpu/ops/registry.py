@@ -133,6 +133,17 @@ def _is_tracer(v):
     return isinstance(v, jax.core.Tracer)
 
 
+def _scatter(vals, specs, values):
+    """Place ``values`` into kernel-positional ``vals`` slots addressed by
+    specs of the form ('arg'|'list_item', pos, sub)."""
+    for (kind, pos, sub), v in zip(specs, values):
+        if kind == "arg":
+            vals[pos] = v
+        else:
+            vals[pos][sub] = v
+    return vals
+
+
 class Ctx:
     """Context passed to explicit backward rules: saved forward values.
 
@@ -232,13 +243,9 @@ def _apply_op_impl(op: OpDef, args, kwargs):
         # Rare rule-less path that can't go through the executable caches
         # (nojit / stateful RNG): per-call jax.vjp, residuals kept.
         def fwd(*tensor_vals):
-            vals = [list(v) if isinstance(v, list) else v for v in in_vals]
-            for spec, tv in zip(in_specs, tensor_vals):
-                kind, pos, sub = spec
-                if kind == "arg":
-                    vals[pos] = tv
-                else:
-                    vals[pos][sub] = tv
+            vals = _scatter(
+                [list(v) if isinstance(v, list) else v for v in in_vals],
+                in_specs, tensor_vals)
             out = op.kernel(**dict(zip(op.input_names, vals)), **attrs)
             return out if isinstance(out, (tuple, list)) else (out,)
 
@@ -284,6 +291,13 @@ def _apply_op_impl(op: OpDef, args, kwargs):
                            for v in in_vals]
             static_lists = [list(v) if isinstance(v, list) else None
                             for v in in_vals]
+            # tensor positions are always overwritten by the specs scatter:
+            # null them so the cached closure never pins those device arrays
+            for kind, pos, sub in in_specs:
+                if kind == "arg":
+                    static_vals[pos] = None
+                else:
+                    static_lists[pos][sub] = None
             dyn_other_specs = []
             dyn_other_vals = []
             for pos, v in enumerate(in_vals):
@@ -315,18 +329,8 @@ def _apply_op_impl(op: OpDef, args, kwargs):
                     def fwd(*tv):
                         vals = [list(l) if l is not None else sv
                                 for sv, l in zip(static_vals, static_lists)]
-                        for spec, v in zip(o_specs, other_vals):
-                            kind, pos, sub = spec
-                            if kind == "arg":
-                                vals[pos] = v
-                            else:
-                                vals[pos][sub] = v
-                        for spec, v in zip(specs, tv):
-                            kind, pos, sub = spec
-                            if kind == "arg":
-                                vals[pos] = v
-                            else:
-                                vals[pos][sub] = v
+                        _scatter(vals, o_specs, other_vals)
+                        _scatter(vals, specs, tv)
                         out = kernel(**dict(zip(names, vals)), **attrs)
                         return out if isinstance(out, (tuple, list)) else (out,)
 
