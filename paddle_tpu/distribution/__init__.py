@@ -19,7 +19,10 @@ __all__ = [
     "Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
     "Beta", "Dirichlet", "Exponential", "Gamma", "Geometric", "Gumbel",
     "Laplace", "LogNormal", "Multinomial", "Poisson",
-    "kl_divergence", "register_kl",
+    "Binomial", "Cauchy", "Chi2", "ContinuousBernoulli",
+    "ExponentialFamily", "Independent", "LKJCholesky",
+    "MultivariateNormal", "StudentT", "TransformedDistribution",
+    "kl_divergence", "register_kl", "transform",
 ]
 
 
@@ -393,3 +396,141 @@ def _kl_bernoulli(p, q):
     b = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
     return _t(a * (jnp.log(a) - jnp.log(b))
               + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = p.rate / q.rate
+    return _t(jnp.log(r) + q.rate / p.rate - 1.0)
+
+
+def _digamma(x):
+    return jax.lax.digamma(x)
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma(p, q):
+    a1, b1, a2, b2 = (jnp.asarray(p.concentration, jnp.float32),
+                      jnp.asarray(p.rate, jnp.float32),
+                      jnp.asarray(q.concentration, jnp.float32),
+                      jnp.asarray(q.rate, jnp.float32))
+    a1, b1, a2, b2 = jnp.broadcast_arrays(a1, b1, a2, b2)
+    return _t((a1 - a2) * _digamma(a1) - jax.lax.lgamma(a1)
+              + jax.lax.lgamma(a2) + a2 * (jnp.log(b1) - jnp.log(b2))
+              + a1 * (b2 - b1) / b1)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta(p, q):
+    a1, b1 = jnp.broadcast_arrays(jnp.asarray(p.alpha, jnp.float32),
+                                  jnp.asarray(p.beta, jnp.float32))
+    a2, b2 = jnp.broadcast_arrays(jnp.asarray(q.alpha, jnp.float32),
+                                  jnp.asarray(q.beta, jnp.float32))
+    lbeta = lambda a, b: (jax.lax.lgamma(a) + jax.lax.lgamma(b)
+                          - jax.lax.lgamma(a + b))
+    s1 = a1 + b1
+    return _t(lbeta(a2, b2) - lbeta(a1, b1)
+              + (a1 - a2) * _digamma(a1) + (b1 - b2) * _digamma(b1)
+              + (a2 - a1 + b2 - b1) * _digamma(s1))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet(p, q):
+    a = jnp.asarray(p.concentration, jnp.float32)
+    b = jnp.asarray(q.concentration, jnp.float32)
+    s = jnp.sum(a, -1)
+    return _t(jax.lax.lgamma(s) - jnp.sum(jax.lax.lgamma(a), -1)
+              - jax.lax.lgamma(jnp.sum(b, -1))
+              + jnp.sum(jax.lax.lgamma(b), -1)
+              + jnp.sum((a - b) * (_digamma(a) - _digamma(s)[..., None]), -1))
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace(p, q):
+    d = jnp.abs(p.loc - q.loc)
+    r = p.scale / q.scale
+    return _t(-jnp.log(r) + d / q.scale
+              + r * jnp.exp(-d / p.scale) - 1.0)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric(p, q):
+    a = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+    b = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+    return _t((1 - a) / a * (jnp.log1p(-a) - jnp.log1p(-b))
+              + jnp.log(a) - jnp.log(b))
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson(p, q):
+    return _t(p.rate * (jnp.log(p.rate) - jnp.log(q.rate))
+              + q.rate - p.rate)
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal(p, q):
+    return _kl_normal(p, q)
+
+
+# second-tranche distributions + their KL pairs live in extra.py/transform.py
+from . import transform  # noqa: E402
+from .extra import (  # noqa: E402
+    Binomial, Cauchy, Chi2, ContinuousBernoulli, ExponentialFamily,
+    Independent, LKJCholesky, MultivariateNormal, StudentT,
+    TransformedDistribution,
+)
+
+
+@register_kl(Binomial, Binomial)
+def _kl_binomial(p, q):
+    # validate only when counts are concrete; under jit tracing the caller
+    # owns the invariant (a host-side equality check would break tracing)
+    if not any(isinstance(c, jax.core.Tracer)
+               for c in (p.total_count, q.total_count)):
+        if not bool(np.all(np.asarray(p.total_count)
+                           == np.asarray(q.total_count))):
+            raise NotImplementedError(
+                "KL(Binomial||Binomial) requires equal total_count")
+    a = jnp.clip(p.probs_, 1e-7, 1 - 1e-7)
+    b = jnp.clip(q.probs_, 1e-7, 1 - 1e-7)
+    per_trial = (a * (jnp.log(a) - jnp.log(b))
+                 + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+    return _t(p.total_count * per_trial)
+
+
+@register_kl(Cauchy, Cauchy)
+def _kl_cauchy(p, q):
+    # closed form (Chyzak & Nielsen 2019)
+    num = (p.scale + q.scale) ** 2 + (p.loc - q.loc) ** 2
+    den = 4 * p.scale * q.scale
+    return _t(jnp.log(num / den))
+
+
+@register_kl(MultivariateNormal, MultivariateNormal)
+def _kl_mvn(p, q):
+    k = p.event_shape[0]
+    diff = q.loc - p.loc
+    batch = jnp.broadcast_shapes(p.scale_tril.shape[:-2],
+                                 q.scale_tril.shape[:-2], diff.shape[:-1])
+    Lp = jnp.broadcast_to(p.scale_tril, batch + (k, k))
+    Lq = jnp.broadcast_to(q.scale_tril, batch + (k, k))
+    diff = jnp.broadcast_to(diff, batch + (k,))
+    m = jax.scipy.linalg.solve_triangular(Lq, Lp, lower=True)
+    tr = jnp.sum(m * m, (-2, -1))
+    md = jax.scipy.linalg.solve_triangular(
+        Lq, diff[..., None], lower=True)[..., 0]
+    maha = jnp.sum(md * md, -1)
+    hld = (jnp.sum(jnp.log(jnp.diagonal(Lq, axis1=-2, axis2=-1)), -1)
+           - jnp.sum(jnp.log(jnp.diagonal(Lp, axis1=-2, axis2=-1)), -1))
+    return _t(0.5 * (tr + maha - k) + hld)
+
+
+@register_kl(Independent, Independent)
+def _kl_independent(p, q):
+    if p.rank != q.rank:
+        raise NotImplementedError("Independent KL needs matching ranks")
+    inner = kl_divergence(p.base, q.base)
+    v = inner._value if isinstance(inner, Tensor) else jnp.asarray(inner)
+    if p.rank:
+        v = jnp.sum(v, axis=tuple(range(-p.rank, 0)))
+    return _t(v)

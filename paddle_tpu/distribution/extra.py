@@ -1,0 +1,387 @@
+"""Second tranche of distributions.
+
+Analogs of /root/reference/python/paddle/distribution/{binomial,cauchy,
+chi2,continuous_bernoulli,exponential_family,independent,lkj_cholesky,
+multivariate_normal,student_t,transformed_distribution}.py — built on the
+jnp/jax.random primitives rather than paddle kernels.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from . import Distribution, Gamma, _t, _v, register_kl
+from .transform import Transform
+
+__all__ = [
+    "Binomial", "Cauchy", "Chi2", "ContinuousBernoulli",
+    "ExponentialFamily", "Independent", "LKJCholesky",
+    "MultivariateNormal", "StudentT", "TransformedDistribution",
+]
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _v(total_count)
+        self.probs_ = _v(probs)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.total_count), self.probs_.shape))
+
+    @property
+    def mean(self):
+        return _t(jnp.broadcast_to(self.total_count * self.probs_,
+                                   self.batch_shape))
+
+    @property
+    def variance(self):
+        return _t(jnp.broadcast_to(
+            self.total_count * self.probs_ * (1 - self.probs_),
+            self.batch_shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        n = jnp.broadcast_to(self.total_count, self.batch_shape)
+        p = jnp.broadcast_to(self.probs_, self.batch_shape)
+        out = jax.random.binomial(key, n, p, tuple(shape) + self.batch_shape)
+        return _t(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        k = _v(value)
+        n, p = self.total_count, jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        comb = (jax.lax.lgamma(n + 1.0) - jax.lax.lgamma(k + 1.0)
+                - jax.lax.lgamma(n - k + 1.0))
+        return _t(comb + k * jnp.log(p) + (n - k) * jnp.log1p(-p))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        return _t(self.loc + self.scale * jax.random.cauchy(
+            key, tuple(shape) + self.batch_shape))
+
+    rsample = sample
+
+    def log_prob(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return _t(-math.log(math.pi) - jnp.log(self.scale) - jnp.log1p(z * z))
+
+    def entropy(self):
+        return _t(jnp.broadcast_to(
+            math.log(4 * math.pi) + jnp.log(self.scale), self.batch_shape))
+
+    def cdf(self, value):
+        z = (_v(value) - self.loc) / self.scale
+        return _t(jnp.arctan(z) / math.pi + 0.5)
+
+
+class Chi2(Gamma):
+    def __init__(self, df, name=None):
+        df = _v(df)
+        self.df = df
+        super().__init__(df / 2.0, jnp.asarray(0.5, df.dtype))
+
+
+class ContinuousBernoulli(Distribution):
+    """CB(λ): density C(λ) λ^x (1-λ)^{1-x} on [0, 1]."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs_ = _v(probs)
+        self._lims = lims
+        super().__init__(self.probs_.shape)
+
+    def _log_const(self):
+        lam = jnp.clip(self.probs_, 1e-6, 1 - 1e-6)
+        lo, hi = self._lims
+        near_half = (lam > lo) & (lam < hi)
+        safe = jnp.where(near_half, 0.25, lam)
+        out = jnp.log(2.0 * jnp.abs(jnp.arctanh(1 - 2 * safe))
+                      / jnp.abs(1 - 2 * safe))
+        # Taylor expansion around 1/2: log C ≈ log 2 + 4(λ-1/2)^2/3
+        taylor = math.log(2.0) + 4.0 / 3.0 * (lam - 0.5) ** 2
+        return jnp.where(near_half, taylor, out)
+
+    def log_prob(self, value):
+        x = _v(value)
+        lam = jnp.clip(self.probs_, 1e-6, 1 - 1e-6)
+        return _t(self._log_const() + x * jnp.log(lam)
+                  + (1 - x) * jnp.log1p(-lam))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        u = jax.random.uniform(key, tuple(shape) + self.batch_shape)
+        lam = jnp.clip(self.probs_, 1e-6, 1 - 1e-6)
+        lo, hi = self._lims
+        near_half = (lam > lo) & (lam < hi)
+        safe = jnp.where(near_half, 0.25, lam)
+        icdf = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                / (jnp.log(safe) - jnp.log1p(-safe)))
+        return _t(jnp.where(near_half, u, icdf))
+
+    rsample = sample
+
+    @property
+    def mean(self):
+        lam = jnp.clip(self.probs_, 1e-6, 1 - 1e-6)
+        lo, hi = self._lims
+        near_half = (lam > lo) & (lam < hi)
+        safe = jnp.where(near_half, 0.25, lam)
+        out = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        return _t(jnp.where(near_half, 0.5, out))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _v(df)
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+        super().__init__(jnp.broadcast_shapes(
+            self.df.shape, self.loc.shape, self.scale.shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        t = jax.random.t(key, self.df, tuple(shape) + self.batch_shape)
+        return _t(self.loc + self.scale * t)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        df = self.df
+        z = (_v(value) - self.loc) / self.scale
+        lnorm = (jax.lax.lgamma((df + 1) / 2) - jax.lax.lgamma(df / 2)
+                 - 0.5 * jnp.log(df * math.pi) - jnp.log(self.scale))
+        return _t(lnorm - (df + 1) / 2 * jnp.log1p(z * z / df))
+
+    @property
+    def mean(self):
+        return _t(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+    @property
+    def variance(self):
+        v = self.scale ** 2 * self.df / (self.df - 2)
+        return _t(jnp.where(self.df > 2, v, jnp.nan))
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _v(loc)
+        if scale_tril is not None:
+            self.scale_tril = _v(scale_tril)
+        elif covariance_matrix is not None:
+            self.scale_tril = jnp.linalg.cholesky(_v(covariance_matrix))
+        elif precision_matrix is not None:
+            prec = _v(precision_matrix)
+            self.scale_tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        else:
+            raise ValueError(
+                "need covariance_matrix, precision_matrix or scale_tril")
+        k = self.loc.shape[-1]
+        batch = jnp.broadcast_shapes(
+            self.loc.shape[:-1], self.scale_tril.shape[:-2])
+        super().__init__(batch, (k,))
+
+    @property
+    def covariance_matrix(self):
+        L = self.scale_tril
+        return _t(L @ jnp.swapaxes(L, -1, -2))
+
+    @property
+    def mean(self):
+        return _t(jnp.broadcast_to(self.loc, self.batch_shape + self.event_shape))
+
+    @property
+    def variance(self):
+        return _t(jnp.broadcast_to(jnp.sum(self.scale_tril ** 2, -1),
+                                   self.batch_shape + self.event_shape))
+
+    def sample(self, shape=()):
+        key = _random.next_key()
+        eps = jax.random.normal(
+            key, tuple(shape) + self.batch_shape + self.event_shape)
+        return _t(self.loc + jnp.einsum("...ij,...j->...i", self.scale_tril, eps))
+
+    rsample = sample
+
+    def _half_log_det(self):
+        return jnp.sum(jnp.log(jnp.diagonal(self.scale_tril, axis1=-2,
+                                            axis2=-1)), -1)
+
+    def log_prob(self, value):
+        k = self.event_shape[0]
+        diff = _v(value) - self.loc
+        L = jnp.broadcast_to(self.scale_tril, diff.shape[:-1] + (k, k))
+        m = jax.scipy.linalg.solve_triangular(
+            L, diff[..., None], lower=True)[..., 0]
+        return _t(-0.5 * jnp.sum(m * m, -1) - self._half_log_det()
+                  - 0.5 * k * math.log(2 * math.pi))
+
+    def entropy(self):
+        k = self.event_shape[0]
+        return _t(jnp.broadcast_to(
+            0.5 * k * (1 + math.log(2 * math.pi)) + self._half_log_det(),
+            self.batch_shape))
+
+
+class Independent(Distribution):
+    """Reinterpret trailing batch dims of `base` as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1, name=None):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        if self.rank > len(base.batch_shape):
+            raise ValueError("reinterpreted rank exceeds base batch rank")
+        split = len(base.batch_shape) - self.rank
+        super().__init__(base.batch_shape[:split],
+                         base.batch_shape[split:] + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def _sum_event(self, x):
+        return jnp.sum(_v(x), axis=tuple(range(-self.rank, 0))) \
+            if self.rank else _v(x)
+
+    def log_prob(self, value):
+        return _t(self._sum_event(self.base.log_prob(value)))
+
+    def entropy(self):
+        return _t(self._sum_event(self.base.entropy()))
+
+
+class TransformedDistribution(Distribution):
+    """y = T(x), x ~ base; log p(y) = log p(x) - log|det J_T(x)|."""
+
+    def __init__(self, base, transforms, name=None):
+        from .transform import ChainTransform
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.base = base
+        self.transform = (transforms[0] if len(transforms) == 1
+                          else ChainTransform(transforms))
+        # shape metadata follows the transform: probe the forward map and
+        # split batch/event by the output event rank
+        in_shape = tuple(base.batch_shape) + tuple(base.event_shape)
+        out = jax.eval_shape(self.transform._forward,
+                             jax.ShapeDtypeStruct(in_shape, jnp.float32))
+        event_rank = max(len(base.event_shape),
+                         self.transform._codomain_event_rank)
+        split = len(out.shape) - event_rank
+        super().__init__(out.shape[:split], out.shape[split:])
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self.transform.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self.transform.forward(x)
+
+    def log_prob(self, value):
+        y = _v(value)
+        x = self.transform._inverse(y)
+        base_lp = _v(self.base.log_prob(_t(x)))
+        ldj = self.transform._forward_log_det_jacobian(x)
+        base_rank = len(self.base.event_shape)
+        d = self.transform._domain_event_rank
+        if d > base_rank:
+            # transform promotes batch dims to event dims: reduce base_lp
+            base_lp = jnp.sum(base_lp, axis=tuple(range(-(d - base_rank), 0)))
+        elif d < base_rank:
+            # elementwise transform under a multivariate base: reduce ldj
+            ldj = jnp.sum(ldj, axis=tuple(range(-(base_rank - d), 0)))
+        return _t(base_lp - ldj)
+
+
+class ExponentialFamily(Distribution):
+    """Natural-parameter family: log p(x|θ) = ⟨t(x), θ⟩ - A(θ) + h(x).
+
+    Subclasses provide `_natural_parameters` (tuple of arrays) and
+    `_log_normalizer(*theta)`; KL between two members of the same family
+    follows from the Bregman divergence of A (computed with jax.grad),
+    mirroring the reference's exponential_family.py entropy/KL route.
+    """
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *theta):
+        raise NotImplementedError
+
+
+@register_kl(ExponentialFamily, ExponentialFamily)
+def _kl_expfamily(p, q):
+    if type(p) is not type(q):
+        raise NotImplementedError(
+            "generic exponential-family KL needs matching families")
+    tp = tuple(jnp.asarray(t, jnp.float32) for t in p._natural_parameters)
+    tq = tuple(jnp.asarray(t, jnp.float32) for t in q._natural_parameters)
+    # KL(p||q) = A(θq) - A(θp) - ⟨∇A(θp), θq - θp⟩, elementwise over batch
+    # (grad of the summed log-normalizer is the elementwise derivative).
+    grads = jax.grad(lambda *th: jnp.sum(p._log_normalizer(*th)),
+                     argnums=tuple(range(len(tp))))(*tp)
+    a_p = p._log_normalizer(*tp)
+    a_q = q._log_normalizer(*tq)
+    inner = sum(g * (b - a) for g, a, b in zip(grads, tp, tq))
+    return _t(a_q - a_p - inner)
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over Cholesky factors of correlation matrices.
+
+    Sampling uses the onion construction; the density over L is
+    ∝ Π_{k=2..n} L_kk^{n-k+2η-2} with the normalizer derived from the
+    per-row hemisphere integrals:
+    log c = Σ_{k=2..n} [ ((k-1)/2)·log π − lgamma((k-1)/2)
+                         + lbeta((k-1)/2, η + (n-k)/2) ].
+    """
+
+    def __init__(self, dim, concentration=1.0, name=None):
+        self.dim = int(dim)
+        self.concentration = _v(concentration)
+        super().__init__(self.concentration.shape, (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        n = self.dim
+        eta = jnp.broadcast_to(self.concentration,
+                               tuple(shape) + self.batch_shape)
+        lead = eta.shape
+        rows = [jnp.zeros(lead + (n,)).at[..., 0].set(1.0)]
+        for i in range(1, n):
+            kb, ku = _random.next_key(), _random.next_key()
+            beta_b = eta + (n - 1 - i) / 2.0
+            y = jax.random.beta(kb, i / 2.0, beta_b, lead)
+            u = jax.random.normal(ku, lead + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            row = jnp.sqrt(y)[..., None] * u
+            diag = jnp.sqrt(1.0 - y)
+            pad = jnp.zeros(lead + (n - i - 1,))
+            rows.append(jnp.concatenate([row, diag[..., None], pad], -1))
+        return _t(jnp.stack(rows, -2))
+
+    def log_prob(self, value):
+        L = _v(value)
+        n = self.dim
+        eta = self.concentration
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        k = jnp.arange(2, n + 1, dtype=diag.dtype)
+        expo = n - k + 2 * eta[..., None] - 2
+        unnorm = jnp.sum(expo * jnp.log(diag), -1)
+        km1 = (k - 1) / 2.0
+        b = eta[..., None] + (n - k) / 2.0
+        # per-row normalizer (the lgamma(km1) of the hemisphere surface
+        # measure cancels against the one inside log B(km1, b))
+        log_c = jnp.sum(km1 * math.log(math.pi) + jax.lax.lgamma(b)
+                        - jax.lax.lgamma(km1 + b), -1)
+        return _t(unnorm - log_c)

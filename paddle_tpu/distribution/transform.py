@@ -1,0 +1,312 @@
+"""Bijective transforms for TransformedDistribution.
+
+Analog of /root/reference/python/paddle/distribution/transform.py (14
+transform classes: Abs/Affine/Chain/Exp/Independent/Power/Reshape/
+Sigmoid/Softmax/Stack/StickBreaking/Tanh over a Transform base). Each
+transform is a deterministic jnp map with forward, inverse, and log-det
+Jacobian; everything is traceable/differentiable.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+# share the Tensor box/unbox helpers with the sibling distribution modules
+# (the package __init__ defines them before importing this module)
+from . import _t, _v  # noqa: E402
+
+
+class Transform:
+    """Base class: y = f(x) with tractable inverse and log|det J|."""
+
+    #: number of event dims the transform consumes (0 = elementwise)
+    _domain_event_rank = 0
+    _codomain_event_rank = 0
+    bijective = True
+
+    def forward(self, x):
+        return _t(self._forward(_v(x)))
+
+    def inverse(self, y):
+        return _t(self._inverse(_v(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return _t(self._forward_log_det_jacobian(_v(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        y = _v(y)
+        return _t(-self._forward_log_det_jacobian(self._inverse(y)))
+
+    def __call__(self, x):
+        return self.forward(x)
+
+    # hooks ------------------------------------------------------------
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class AbsTransform(Transform):
+    """y = |x| — not bijective; inverse returns the positive branch."""
+
+    bijective = False
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = _v(loc)
+        self.scale = _v(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    """y = x ** power  (x > 0)."""
+
+    def __init__(self, power):
+        self.power = _v(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2 (log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (not bijective on R^n)."""
+
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+    bijective = False
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, -1)
+
+    def _inverse(self, y):
+        x = jnp.log(y)
+        return x - x.max(-1, keepdims=True)
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError("SoftmaxTransform is not bijective")
+
+
+class StickBreakingTransform(Transform):
+    """R^{n} -> interior of the n+1 simplex via stick breaking."""
+
+    _domain_event_rank = 1
+    _codomain_event_rank = 1
+
+    def _forward(self, x):
+        n = x.shape[-1]
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=x.dtype))
+        z = jax.nn.sigmoid(x - offset)
+        cum = jnp.cumprod(1 - z, -1)
+        pad = jnp.ones_like(cum[..., :1])
+        lead = jnp.concatenate([pad, cum[..., :-1]], -1)
+        head = z * lead
+        last = cum[..., -1:]
+        return jnp.concatenate([head, last], -1)
+
+    def _inverse(self, y):
+        n = y.shape[-1] - 1
+        cum = jnp.cumsum(y[..., :-1], -1)
+        rem = 1 - cum
+        pad = jnp.ones_like(rem[..., :1])
+        lead = jnp.concatenate([pad, rem[..., :-1]], -1)
+        z = y[..., :-1] / lead
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=y.dtype))
+        return jnp.log(z) - jnp.log1p(-z) + offset
+
+    def _forward_log_det_jacobian(self, x):
+        n = x.shape[-1]
+        offset = jnp.log(jnp.arange(n, 0, -1, dtype=x.dtype))
+        xs = x - offset
+        z = jax.nn.sigmoid(xs)
+        cum = jnp.cumprod(1 - z, -1)
+        pad = jnp.ones_like(cum[..., :1])
+        lead = jnp.concatenate([pad, cum[..., :-1]], -1)
+        # dy_k/dz_k = lead_k; dz/dx = sigmoid'(xs)
+        return jnp.sum(jnp.log(lead) - jax.nn.softplus(-xs)
+                       - jax.nn.softplus(xs), -1)
+
+
+class ChainTransform(Transform):
+    """Composition t_k ∘ … ∘ t_1 (applied left to right)."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        # propagate event ranks through rank-changing members: the chain's
+        # domain rank is found walking backward from the last member, the
+        # codomain rank by replaying forward
+        r = 0
+        for t in reversed(self.transforms):
+            r = max(t._domain_event_rank,
+                    r - t._codomain_event_rank + t._domain_event_rank)
+        self._domain_event_rank = r
+        for t in self.transforms:
+            r = max(r - t._domain_event_rank + t._codomain_event_rank,
+                    t._codomain_event_rank)
+        self._codomain_event_rank = r
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = None
+        r = self._domain_event_rank
+        for t in self.transforms:
+            ldj = t._forward_log_det_jacobian(x)
+            # reduce ldj over dims that are event dims at this point in the
+            # chain but batch dims to this member
+            extra = r - t._domain_event_rank
+            if extra > 0:
+                ldj = jnp.sum(ldj, axis=tuple(range(-extra, 0)))
+            total = ldj if total is None else total + ldj
+            x = t._forward(x)
+            r = r - t._domain_event_rank + t._codomain_event_rank
+        return total
+
+
+class IndependentTransform(Transform):
+    """Reinterpret trailing batch dims of a base transform as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._domain_event_rank = base._domain_event_rank + self.rank
+        self._codomain_event_rank = base._codomain_event_rank + self.rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self.base._forward_log_det_jacobian(x)
+        return jnp.sum(ldj, axis=tuple(range(-self.rank, 0)))
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if math.prod(self.in_event_shape) != math.prod(self.out_event_shape):
+            raise ValueError("event sizes must match")
+        self._domain_event_rank = len(self.in_event_shape)
+        self._codomain_event_rank = len(self.out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+
+class StackTransform(Transform):
+    """Apply transforms[i] to slice i along `axis`."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _split(self, x):
+        return [jnp.squeeze(s, self.axis)
+                for s in jnp.split(x, len(self.transforms), self.axis)]
+
+    def _forward(self, x):
+        parts = [t._forward(s) for t, s in zip(self.transforms, self._split(x))]
+        return jnp.stack(parts, self.axis)
+
+    def _inverse(self, y):
+        parts = [t._inverse(s) for t, s in zip(self.transforms, self._split(y))]
+        return jnp.stack(parts, self.axis)
+
+    def _forward_log_det_jacobian(self, x):
+        parts = [t._forward_log_det_jacobian(s)
+                 for t, s in zip(self.transforms, self._split(x))]
+        return jnp.stack(parts, self.axis)

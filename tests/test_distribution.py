@@ -1,0 +1,287 @@
+"""Distribution module: densities/KL vs torch.distributions oracles,
+transform bijectivity, TransformedDistribution consistency.
+
+Mirrors the reference's test/distribution/ strategy (scipy oracles there;
+torch-cpu here). Ref: /root/reference/python/paddle/distribution/.
+"""
+import numpy as np
+import pytest
+import torch
+import torch.distributions as td
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+from paddle_tpu.distribution import transform as T
+
+
+def _np(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(1234)
+
+
+# ---------------------------------------------------------------- log_prob
+
+
+@pytest.mark.parametrize("ours,theirs,value", [
+    (lambda: D.Cauchy(0.5, 2.0), lambda: td.Cauchy(0.5, 2.0), 1.3),
+    (lambda: D.StudentT(np.float32(5.0), 0.5, 2.0),
+     lambda: td.StudentT(5.0, 0.5, 2.0), 1.3),
+    (lambda: D.Chi2(np.float32(3.0)), lambda: td.Chi2(3.0), 2.1),
+    (lambda: D.Binomial(10.0, 0.3),
+     lambda: td.Binomial(10, 0.3), 4.0),
+    (lambda: D.ContinuousBernoulli(np.float32(0.3)),
+     lambda: td.ContinuousBernoulli(torch.tensor(0.3)), 0.7),
+    (lambda: D.ContinuousBernoulli(np.float32(0.5)),
+     lambda: td.ContinuousBernoulli(torch.tensor(0.5)), 0.7),
+])
+def test_log_prob_matches_torch(ours, theirs, value):
+    got = _np(ours().log_prob(np.float32(value)))
+    want = theirs().log_prob(torch.tensor(float(value))).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_mvn_log_prob_entropy():
+    loc = np.array([0.3, -0.2, 1.0], np.float32)
+    A = np.array([[1.0, 0.2, 0.0], [0.2, 1.5, 0.3], [0.0, 0.3, 2.0]],
+                 np.float32)
+    ours = D.MultivariateNormal(loc, covariance_matrix=A)
+    theirs = td.MultivariateNormal(torch.tensor(loc),
+                                   covariance_matrix=torch.tensor(A))
+    x = np.array([0.1, 0.0, 0.5], np.float32)
+    np.testing.assert_allclose(_np(ours.log_prob(x)),
+                               theirs.log_prob(torch.tensor(x)).numpy(),
+                               rtol=1e-4)
+    np.testing.assert_allclose(_np(ours.entropy()),
+                               theirs.entropy().numpy(), rtol=1e-4)
+
+
+def test_independent_log_prob():
+    base = D.Normal(np.zeros((4, 3), np.float32),
+                    np.ones((4, 3), np.float32))
+    ind = D.Independent(base, 1)
+    assert ind.batch_shape == (4,)
+    assert ind.event_shape == (3,)
+    x = np.random.RandomState(0).randn(4, 3).astype(np.float32)
+    got = _np(ind.log_prob(x))
+    want = td.Independent(td.Normal(torch.zeros(4, 3), torch.ones(4, 3)),
+                          1).log_prob(torch.tensor(x)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_lkj_cholesky_log_prob_and_sample():
+    d = D.LKJCholesky(4, np.float32(1.5))
+    L = _np(d.sample((64,)))
+    # every sample is a valid correlation cholesky: rows unit norm,
+    # positive diagonal, lower triangular
+    corr_diag = np.einsum("...ij,...ij->...i", L, L)
+    np.testing.assert_allclose(corr_diag, np.ones_like(corr_diag), atol=1e-5)
+    assert (np.diagonal(L, axis1=-2, axis2=-1) > 0).all()
+    assert np.allclose(np.triu(L, 1), 0, atol=1e-6)
+    want = td.LKJCholesky(4, 1.5).log_prob(torch.tensor(L)).numpy()
+    got = _np(d.log_prob(L))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+# ---------------------------------------------------------------- sampling
+
+
+@pytest.mark.parametrize("dist,mean,var", [
+    (lambda: D.StudentT(np.float32(7.0), 1.0, 0.5), 1.0,
+     0.25 * 7 / 5),
+    (lambda: D.Binomial(20.0, 0.25), 5.0, 3.75),
+    (lambda: D.Chi2(np.float32(4.0)), 4.0, 8.0),
+])
+def test_sample_moments(dist, mean, var):
+    s = _np(dist().sample((20000,)))
+    assert abs(s.mean() - mean) < 0.15 * max(1.0, abs(mean))
+    assert abs(s.var() - var) < 0.25 * max(1.0, var)
+
+
+def test_mvn_sample_cov():
+    A = np.array([[1.0, 0.4], [0.4, 0.8]], np.float32)
+    d = D.MultivariateNormal(np.zeros(2, np.float32), covariance_matrix=A)
+    s = _np(d.sample((30000,)))
+    np.testing.assert_allclose(np.cov(s.T), A, atol=0.05)
+
+
+# ---------------------------------------------------------------- KL
+
+
+@pytest.mark.parametrize("ours,theirs", [
+    (lambda: (D.Gamma(2.0, 1.5), D.Gamma(3.0, 0.5)),
+     lambda: (td.Gamma(2.0, 1.5), td.Gamma(3.0, 0.5))),
+    (lambda: (D.Beta(2.0, 3.0), D.Beta(4.0, 1.5)),
+     lambda: (td.Beta(2.0, 3.0), td.Beta(4.0, 1.5))),
+    (lambda: (D.Exponential(2.0), D.Exponential(0.7)),
+     lambda: (td.Exponential(2.0), td.Exponential(0.7))),
+    (lambda: (D.Laplace(0.0, 1.0), D.Laplace(0.5, 2.0)),
+     lambda: (td.Laplace(0.0, 1.0), td.Laplace(0.5, 2.0))),
+    (lambda: (D.Poisson(3.0), D.Poisson(5.0)),
+     lambda: (td.Poisson(3.0), td.Poisson(5.0))),
+    (lambda: (D.Geometric(0.3), D.Geometric(0.6)),
+     lambda: (td.Geometric(0.3), td.Geometric(0.6))),
+    (lambda: (D.Dirichlet(np.array([1.0, 2.0, 3.0], np.float32)),
+              D.Dirichlet(np.array([2.0, 1.0, 1.5], np.float32))),
+     lambda: (td.Dirichlet(torch.tensor([1.0, 2.0, 3.0])),
+              td.Dirichlet(torch.tensor([2.0, 1.0, 1.5])))),
+    (lambda: (D.Binomial(10.0, 0.3), D.Binomial(10.0, 0.6)),
+     lambda: (td.Binomial(10, 0.3), td.Binomial(10, 0.6))),
+])
+def test_kl_matches_torch(ours, theirs):
+    p, q = ours()
+    tp, tq = theirs()
+    got = float(np.sum(_np(D.kl_divergence(p, q))))
+    want = float(td.kl_divergence(tp, tq).sum())
+    np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-5)
+
+
+def test_kl_mvn():
+    A = np.array([[1.0, 0.2], [0.2, 1.5]], np.float32)
+    B = np.array([[2.0, -0.1], [-0.1, 0.9]], np.float32)
+    p = D.MultivariateNormal(np.zeros(2, np.float32), covariance_matrix=A)
+    q = D.MultivariateNormal(np.array([0.5, -0.5], np.float32),
+                             covariance_matrix=B)
+    tp = td.MultivariateNormal(torch.zeros(2), torch.tensor(A))
+    tq = td.MultivariateNormal(torch.tensor([0.5, -0.5]), torch.tensor(B))
+    np.testing.assert_allclose(float(_np(D.kl_divergence(p, q))),
+                               float(td.kl_divergence(tp, tq)), rtol=1e-4)
+
+
+def test_kl_cauchy_via_samples():
+    p = D.Cauchy(0.0, 1.0)
+    q = D.Cauchy(1.0, 2.0)
+    kl = float(_np(D.kl_divergence(p, q)))
+    s = _np(p.sample((200000,)))
+    mc = float(np.mean(_np(p.log_prob(s)) - _np(q.log_prob(s))))
+    assert abs(kl - mc) < 0.05
+
+
+def test_kl_expfamily_generic():
+    class _ExpFam(D.ExponentialFamily):
+        # Exponential(rate) as an exponential family: θ = -rate, A = -log(-θ)
+        def __init__(self, rate):
+            self.rate = np.float32(rate)
+            super().__init__(())
+
+        @property
+        def _natural_parameters(self):
+            return (np.float32(-self.rate),)
+
+        def _log_normalizer(self, theta):
+            import jax.numpy as jnp
+            return -jnp.log(-theta)
+
+    got = float(_np(D.kl_divergence(_ExpFam(2.0), _ExpFam(0.7))))
+    want = float(td.kl_divergence(td.Exponential(2.0), td.Exponential(0.7)))
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+# ---------------------------------------------------------------- transforms
+
+
+@pytest.mark.parametrize("t,x", [
+    (T.AffineTransform(1.5, -2.0), 0.7),
+    (T.ExpTransform(), 0.7),
+    (T.SigmoidTransform(), 0.7),
+    (T.TanhTransform(), 0.7),
+    (T.PowerTransform(np.float32(2.0)), 0.7),
+])
+def test_transform_roundtrip_and_ldj(t, x):
+    import jax
+    x = np.float32(x)
+    y = _np(t.forward(x))
+    back = _np(t.inverse(y))
+    np.testing.assert_allclose(back, x, rtol=1e-5, atol=1e-6)
+    # log|det J| vs autodiff derivative
+    want = np.log(abs(float(jax.grad(lambda v: t._forward(v))(x))))
+    np.testing.assert_allclose(_np(t.forward_log_det_jacobian(x)), want,
+                               rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(_np(t.inverse_log_det_jacobian(y)), -want,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_stickbreaking_transform():
+    import jax
+    import jax.numpy as jnp
+    t = T.StickBreakingTransform()
+    x = np.array([0.3, -0.2, 0.5], np.float32)
+    y = _np(t.forward(x))
+    assert y.shape == (4,)
+    np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-5)
+    np.testing.assert_allclose(_np(t.inverse(y)), x, rtol=1e-4, atol=1e-5)
+    J = jax.jacobian(lambda v: t._forward(v)[:-1])(jnp.asarray(x))
+    want = np.linalg.slogdet(np.asarray(J))[1]
+    np.testing.assert_allclose(_np(t.forward_log_det_jacobian(x)), want,
+                               rtol=1e-4)
+
+
+def test_chain_and_reshape_and_stack():
+    chain = T.ChainTransform([T.AffineTransform(0.0, 2.0), T.ExpTransform()])
+    x = np.float32(0.3)
+    y = _np(chain.forward(x))
+    np.testing.assert_allclose(y, np.exp(0.6), rtol=1e-5)
+    np.testing.assert_allclose(_np(chain.inverse(y)), x, rtol=1e-5)
+    r = T.ReshapeTransform((2, 3), (6,))
+    xr = np.arange(6, dtype=np.float32).reshape(2, 3)
+    assert _np(r.forward(xr)).shape == (6,)
+    assert _np(r.inverse(_np(r.forward(xr)))).shape == (2, 3)
+    s = T.StackTransform([T.ExpTransform(), T.AffineTransform(0.0, 3.0)], 0)
+    xs = np.array([0.5, 0.5], np.float32)
+    ys = _np(s.forward(xs))
+    np.testing.assert_allclose(ys, [np.exp(0.5), 1.5], rtol=1e-5)
+
+
+def test_transformed_distribution_is_lognormal():
+    base = D.Normal(0.2, 1.3)
+    tdist = D.TransformedDistribution(base, T.ExpTransform())
+    ln = D.LogNormal(0.2, 1.3)
+    x = np.float32(0.9)
+    np.testing.assert_allclose(_np(tdist.log_prob(x)), _np(ln.log_prob(x)),
+                               rtol=1e-5)
+    s = _np(tdist.sample((20000,)))
+    assert abs(np.log(s).mean() - 0.2) < 0.05
+
+
+def test_transformed_distribution_promoted_event_dims():
+    # StickBreaking promotes the base's batch dim to an event dim: log_prob
+    # must reduce the base log_prob over it and return a scalar
+    base = D.Normal(np.zeros(3, np.float32), np.ones(3, np.float32))
+    tdist = D.TransformedDistribution(base, T.StickBreakingTransform())
+    assert tdist.batch_shape == ()
+    assert tuple(tdist.event_shape) == (4,)
+    y = _np(tdist.sample())
+    lp = _np(tdist.log_prob(y))
+    assert lp.shape == ()
+    want = td.TransformedDistribution(
+        td.Normal(torch.zeros(3), torch.ones(3)),
+        td.StickBreakingTransform()).log_prob(torch.tensor(y)).numpy()
+    np.testing.assert_allclose(lp, want, rtol=1e-4)
+
+
+def test_chain_rank_changing_transform():
+    base = D.Independent(
+        D.Normal(np.zeros(4, np.float32), np.ones(4, np.float32)), 1)
+    tdist = D.TransformedDistribution(
+        base, [T.ReshapeTransform((4,), (2, 2)), T.ExpTransform()])
+    assert tdist.batch_shape == ()
+    assert tuple(tdist.event_shape) == (2, 2)
+    y = _np(tdist.sample())
+    assert y.shape == (2, 2)
+    lp = _np(tdist.log_prob(y))
+    assert lp.shape == ()
+    # log p(y) = sum normal.log_prob(log y) - sum log y
+    x = np.log(y).reshape(4)
+    want = (sum(-(v ** 2) / 2 - 0.5 * np.log(2 * np.pi) for v in x)
+            - np.log(y).sum())
+    np.testing.assert_allclose(lp, want, rtol=1e-4)
+
+
+def test_independent_transform():
+    it = T.IndependentTransform(T.ExpTransform(), 1)
+    x = np.array([0.1, 0.2, 0.3], np.float32)
+    ldj = _np(it.forward_log_det_jacobian(x))
+    np.testing.assert_allclose(ldj, x.sum(), rtol=1e-5)
