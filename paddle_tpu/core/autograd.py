@@ -72,7 +72,8 @@ class GradNode:
     get an ``AccumulationNode``.
     """
 
-    __slots__ = ("name", "backward_fn", "edges", "num_outputs", "input_needs_grad", "__weakref__")
+    __slots__ = ("name", "backward_fn", "edges", "num_outputs",
+                 "input_needs_grad", "pure_bwd", "in_tensors", "__weakref__")
 
     def __init__(self, name, backward_fn, edges, num_outputs, input_needs_grad):
         self.name = name
@@ -80,6 +81,13 @@ class GradNode:
         self.edges = edges
         self.num_outputs = num_outputs
         self.input_needs_grad = input_needs_grad
+        # create_graph (double-backward) support: ``pure_bwd(primal_vals,
+        # grad_out_vals) -> grads`` is a pure re-differentiable function of
+        # the op's tensor inputs and output cotangents; ``in_tensors`` are
+        # the forward input Tensors (for wiring second-order edges). None on
+        # paths that can't support it (stateful RNG / nojit vjp fallback).
+        self.pure_bwd = None
+        self.in_tensors = None
 
     def __repr__(self):
         return f"<GradNode {self.name}>"
@@ -126,8 +134,102 @@ def _add(a, b):
     return a + b
 
 
+def _zero_ct(shape, dtype):
+    if jnp.issubdtype(dtype, jnp.inexact):
+        return jnp.zeros(shape, dtype)
+    import numpy as _np
+
+    return _np.zeros(shape, jax.dtypes.float0)
+
+
+def _tape_apply(name, fn, in_tensors):
+    """Apply pure ``fn(*vals)`` to Tensors, recording a re-differentiable
+    GradNode — the primitive the create_graph sweep runs every node through
+    (so gradients themselves carry grad nodes, like the reference's
+    double-grad ops from backward.yaml)."""
+    from .tensor import Tensor
+
+    vals = [t._value for t in in_tensors]
+    outs, vjp_fn = jax.vjp(fn, *vals)
+    out_list = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+    edges, needs = [], []
+    for t in in_tensors:
+        if not t.stop_gradient:
+            edges.append(t._grad_edge())
+            needs.append(True)
+        else:
+            edges.append(None)
+            needs.append(False)
+    out_tensors = [None if v is None else Tensor._from_value(v)
+                   for v in out_list]
+    if any(needs) and is_grad_enabled():
+        shapes = [None if v is None else (v.shape, v.dtype) for v in out_list]
+        needs_t = tuple(needs)
+
+        def _coerce(gouts, _shapes=shapes):
+            out = []
+            for g, s in zip(gouts, _shapes):
+                if s is None:
+                    out.append(None)
+                elif g is None:
+                    out.append(_zero_ct(*s))
+                elif g.dtype != s[1]:
+                    out.append(g.astype(s[1]))
+                else:
+                    out.append(g)
+            return tuple(out)
+
+        def backward_fn(grad_outputs, _vjp=vjp_fn):
+            grads = _vjp(_coerce(grad_outputs))
+            return tuple(g if need else None
+                         for g, need in zip(grads, needs_t))
+
+        node = GradNode(name, backward_fn, edges, len(out_list), needs_t)
+        node.in_tensors = list(in_tensors)
+
+        def pure_bwd(primals, gouts, _fn=fn):
+            grads = jax.vjp(_fn, *primals)[1](_coerce(gouts))
+            return tuple(g if need else None
+                         for g, need in zip(grads, needs_t))
+
+        node.pure_bwd = pure_bwd
+        for i, t in enumerate(out_tensors):
+            if t is not None and jnp.issubdtype(t._value.dtype, jnp.inexact):
+                t.stop_gradient = False
+                t._grad_node = node
+                t._grad_slot = i
+    return out_tensors
+
+
+def _fire_node_create_graph(node, gouts):
+    """Run one GradNode under create_graph: its backward becomes a recorded,
+    re-differentiable application over (forward inputs, output cotangents)."""
+    if node.pure_bwd is None or node.in_tensors is None:
+        raise RuntimeError(
+            f"create_graph through node '{node.name}' is not supported: it "
+            "has no re-differentiable backward (custom nodes like PyLayer/"
+            "to_static programs, or ops on the stateful-RNG/nojit vjp path); "
+            "use the functional transforms in paddle.autograd "
+            "(jacobian/hessian/jvp/vjp) instead")
+    present = [i for i, g in enumerate(gouts) if g is not None]
+    n_in = len(node.in_tensors)
+    num = node.num_outputs
+    pure = node.pure_bwd
+
+    def fn(*vals):
+        primals = list(vals[:n_in])
+        gs = vals[n_in:]
+        full = [None] * num
+        for j, i in enumerate(present):
+            full[i] = gs[j]
+        return pure(primals, full)
+
+    ins = list(node.in_tensors) + [gouts[i] for i in present]
+    return _tape_apply(f"{node.name}_grad", fn, ins)
+
+
 def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
-             write_grads=True):
+             write_grads=True, create_graph=False):
     """Run the backward sweep from ``tensors`` (typically a scalar loss).
 
     ``capture``: optional dict mapping ``(id(node), slot)`` → list; when that
@@ -145,7 +247,11 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
     elif isinstance(grad_tensors, Tensor):
         grad_tensors = [grad_tensors]
 
-    # Seed gradients.
+    retain_graph = retain_graph or create_graph
+
+    # Seed gradients. In create_graph mode every buffered gradient is a
+    # Tensor (so accumulation itself records onto the tape); otherwise raw
+    # jax arrays.
     ready: dict[tuple[int, int], jax.Array] = {}  # (id(node), slot) -> grad
     node_by_id: dict[int, object] = {}
     roots = []
@@ -162,6 +268,9 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
             seed = jnp.ones_like(t._value)
         else:
             seed = g._value if isinstance(g, Tensor) else jnp.asarray(g)
+        if create_graph:
+            seed = (g if isinstance(g, Tensor)
+                    else Tensor._from_value(seed, stop_gradient=True))
         key = (id(node), slot)
         ready[key] = _add(ready.get(key), seed)
         node_by_id[id(node)] = node
@@ -201,6 +310,18 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
     # wait until their consumers run.
     processed: set[int] = set()
 
+    # create_graph: the sweep's own computations (node backwards, grad
+    # accumulation via Tensor.__add__) must record onto the tape.
+    sweep_ctx = enable_grad() if create_graph else contextlib.nullcontext()
+    with sweep_ctx:
+        _run_sweep(queue, processed, buffers, indeg, capture, write_grads,
+                   retain_graph, create_graph)
+
+
+def _run_sweep(queue, processed, buffers, indeg, capture, write_grads,
+               retain_graph, create_graph):
+    from .tensor import Tensor
+
     while queue:
         node = queue.popleft()
         if id(node) in processed:
@@ -211,13 +332,23 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
         if isinstance(node, AccumulationNode):
             g = slot_grads.get(0)
             if g is not None:
-                g = node.run_hooks(g)
+                if create_graph and node.hooks:
+                    # hooks see the detached value; a replacement re-enters
+                    # graph-free (hook+create_graph composition is out of
+                    # scope, as in the reference's eager hooks)
+                    new = node.run_hooks(g._value)
+                    if new is not g._value:
+                        g = Tensor._from_value(new, stop_gradient=True)
+                elif not create_graph:
+                    g = node.run_hooks(g)
                 if capture is not None:
                     sink = capture.get((id(node), 0))
                     if sink is not None:
                         sink.append(g)
                 if write_grads:
-                    node.write(g)
+                    t = node.tensor_ref()
+                    if t is not None:
+                        t._accumulate_grad(g)
             continue
 
         if capture is not None:
@@ -238,13 +369,16 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
                 if indeg[id(nxt)] <= 0:
                     queue.append(nxt)
             if not retain_graph:
-                node.backward_fn = _dead_backward
+                _release_node(node)
             continue
 
         grad_outputs = tuple(
             slot_grads.get(i) for i in range(node.num_outputs)
         )
-        grads_in = node.backward_fn(grad_outputs)
+        if create_graph:
+            grads_in = _fire_node_create_graph(node, grad_outputs)
+        else:
+            grads_in = node.backward_fn(grad_outputs)
         if not isinstance(grads_in, (tuple, list)):
             grads_in = (grads_in,)
         if len(grads_in) != len(node.edges):
@@ -267,7 +401,16 @@ def backward(tensors, grad_tensors=None, retain_graph=False, capture=None,
             if indeg[id(nxt)] <= 0:
                 queue.append(nxt)
         if not retain_graph:
-            node.backward_fn = _dead_backward
+            _release_node(node)
+
+
+def _release_node(node):
+    """Drop everything a spent node pins: the backward closure's residuals
+    and the create_graph fields (in_tensors would otherwise keep the whole
+    forward activation chain alive through any retained output tensor)."""
+    node.backward_fn = _dead_backward
+    node.pure_bwd = None
+    node.in_tensors = None
 
 
 def _dead_backward(*_):
@@ -277,13 +420,21 @@ def _dead_backward(*_):
     )
 
 
-def grad(outputs, inputs, grad_outputs=None, retain_graph=False, allow_unused=False):
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, allow_unused=False):
     """``paddle.grad`` analog: gradients of outputs w.r.t. inputs (leaf OR
     intermediate) without touching ``.grad`` of any leaf (reference:
     general_grad.h). An intermediate tensor's gradient is observed at the
-    ``(producer_node, slot)`` edge where its consumers deposited grads."""
+    ``(producer_node, slot)`` edge where its consumers deposited grads.
+
+    ``create_graph=True`` runs the sweep through re-differentiable node
+    applications so the returned gradients carry grad nodes — calling
+    ``grad``/``backward`` on them yields higher-order derivatives (the
+    reference's double-grad path from backward.yaml's *_double_grad ops)."""
     from .tensor import Tensor
 
+    if retain_graph is None:
+        retain_graph = create_graph
     if isinstance(outputs, Tensor):
         outputs = [outputs]
     if isinstance(inputs, Tensor):
@@ -298,7 +449,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, allow_unused=Fa
             capture.setdefault((id(node), slot), [])
 
     backward(outputs, grad_outputs, retain_graph=retain_graph,
-             capture=capture, write_grads=False)
+             capture=capture, write_grads=False, create_graph=create_graph)
 
     results = []
     for i, (t, (node, slot)) in enumerate(zip(inputs, edges)):
@@ -307,7 +458,10 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=False, allow_unused=Fa
             g = vals[0]
             for v in vals[1:]:
                 g = _add(g, v)
-            results.append(Tensor._from_value(g, stop_gradient=True))
+            if isinstance(g, Tensor):
+                results.append(g)
+            else:
+                results.append(Tensor._from_value(g, stop_gradient=True))
         elif allow_unused:
             results.append(None)
         else:

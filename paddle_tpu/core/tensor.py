@@ -96,8 +96,16 @@ class Tensor:
         return None, 0
 
     def _accumulate_grad(self, value):
-        if self._grad is None:
+        if isinstance(value, Tensor):
+            # create_graph mode: keep the grad's graph so it can be
+            # differentiated again (reference: grad var with grad node)
+            self._grad = value if self._grad is None else self._grad + value
+        elif self._grad is None:
             self._grad = Tensor._from_value(value, stop_gradient=True, name=self.name + "@GRAD")
+        elif self._grad._grad_node is not None:
+            # existing grad carries a graph (earlier create_graph backward):
+            # rebuild via a recorded add so value and graph stay in sync
+            self._grad = self._grad + Tensor._from_value(value, stop_gradient=True)
         else:
             self._grad._value = self._grad._value + value
 
@@ -118,9 +126,9 @@ class Tensor:
     def is_leaf(self) -> bool:
         return self._grad_node is None
 
-    def backward(self, grad_tensor=None, retain_graph=False):
+    def backward(self, grad_tensor=None, retain_graph=False, create_graph=False):
         autograd.backward([self], [grad_tensor] if grad_tensor is not None else None,
-                          retain_graph=retain_graph)
+                          retain_graph=retain_graph, create_graph=create_graph)
 
     def clear_grad(self):
         self._grad = None

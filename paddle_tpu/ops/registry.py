@@ -27,7 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import autograd
-from ..core.autograd import GradNode
+from ..core.autograd import GradNode, _zero_ct as _zero_cotangent
 from ..core.flags import flag
 from ..core.tensor import Tensor
 
@@ -341,17 +341,20 @@ def _apply_op_impl(op: OpDef, args, kwargs):
                 op._jit_cache[key] = bwd_exec
             saved_primals = [t._value for t in in_tensors]
 
-            def backward_fn(grad_outputs, _bwd=bwd_exec,
-                            _primals=saved_primals,
-                            _others=dyn_other_vals, _shapes=out_shapes):
+            def pure_bwd(primal_vals, grad_outputs, _bwd=bwd_exec,
+                         _others=dyn_other_vals, _shapes=out_shapes):
                 gouts = [
                     (g.astype(d) if g.dtype != d else g)
                     if g is not None else _zero_cotangent(s, d)
                     for g, (s, d) in zip(grad_outputs, _shapes)
                 ]
-                grads = _bwd(_primals, _others, gouts)
+                grads = _bwd(list(primal_vals), _others, gouts)
                 return tuple(g if need else None
                              for g, need in zip(grads, needs))
+
+            def backward_fn(grad_outputs, _pure=pure_bwd,
+                            _primals=saved_primals):
+                return _pure(_primals, grad_outputs)
 
         elif vjp_fn is not None:
             out_shapes = [(v.shape, v.dtype) for v in outs_flat]
@@ -380,9 +383,7 @@ def _apply_op_impl(op: OpDef, args, kwargs):
             needs_decl = tuple(needs_decl)
             specs = tuple(in_specs)
 
-            def backward_fn(grad_outputs, _rule=rule):
-                ctx = Ctx(saved_in, attrs, saved_out, needs_decl)
-                decl = _rule(ctx, *grad_outputs)
+            def _flatten_decl(decl):
                 if not isinstance(decl, (tuple, list)):
                     decl = (decl,)
                 flat = []
@@ -397,7 +398,27 @@ def _apply_op_impl(op: OpDef, args, kwargs):
                     flat.append(g if need else None)
                 return tuple(flat)
 
+            def backward_fn(grad_outputs, _rule=rule):
+                ctx = Ctx(saved_in, attrs, saved_out, needs_decl)
+                return _flatten_decl(_rule(ctx, *grad_outputs))
+
+            def pure_bwd(primal_vals, grad_outputs, _rule=rule,
+                         _kernel=op.kernel, _names=op.input_names):
+                # create_graph route: recompute the forward from the primal
+                # arguments so saved outputs used by the rule (e.g. tanh's y)
+                # stay differentiable w.r.t. the inputs
+                vals = [list(v) if isinstance(v, list) else v
+                        for v in saved_in]
+                _scatter(vals, specs, primal_vals)
+                out = _kernel(**dict(zip(_names, vals)), **attrs)
+                outs2 = list(out) if isinstance(out, (tuple, list)) else [out]
+                ctx = Ctx(vals, attrs, outs2, needs_decl)
+                return _flatten_decl(_rule(ctx, *grad_outputs))
+
         node = GradNode(op.name, backward_fn, edges, len(outs_flat), tuple(needs))
+        node.in_tensors = list(in_tensors)
+        if use_cached_vjp or (vjp_fn is None and op.backward is not None):
+            node.pure_bwd = pure_bwd
         for i, t in enumerate(out_tensors):
             # Integer/bool outputs (indices from topk/argsort/...) carry no
             # gradient: keep them stop_gradient=True so jax.vjp never sees a
@@ -424,11 +445,3 @@ def _apply_op_impl(op: OpDef, args, kwargs):
     return tuple(out_tensors)
 
 
-def _zero_cotangent(shape, dtype):
-    """Zero cotangent matching jax.vjp's expectation: dense zeros for inexact
-    primal outputs, float0 for integer/bool outputs."""
-    if jnp.issubdtype(dtype, jnp.inexact):
-        return jnp.zeros(shape, dtype)
-    import numpy as _np
-
-    return _np.zeros(shape, jax.dtypes.float0)
