@@ -17,6 +17,8 @@ from .mp_layers import (  # noqa: F401
     VocabParallelEmbedding,
 )
 from .pipeline import (  # noqa: F401
+    ZeroBubblePipelineParallel,
+    zero_bubble_schedule,
     LayerDesc,
     PipelineLayer,
     PipelineParallel,
@@ -36,7 +38,8 @@ __all__ = [
     "ColumnParallelLinear", "RowParallelLinear", "VocabParallelEmbedding",
     "ParallelCrossEntropy", "recompute", "recompute_sequential",
     "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
-    "spmd_pipeline", "spmd_pipeline_vpp", "group_sharded_parallel", "ShardedOptimizer",
+    "spmd_pipeline", "spmd_pipeline_vpp", "ZeroBubblePipelineParallel",
+    "zero_bubble_schedule", "group_sharded_parallel", "ShardedOptimizer",
     "MoELayer", "NaiveGate", "SwitchGate", "StackedExpertsFFN",
 ]
 

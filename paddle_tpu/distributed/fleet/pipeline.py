@@ -31,7 +31,8 @@ from ...nn.layer_base import Layer
 from ...nn.layers_common import LayerList
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
-           "PipelineParallel", "spmd_pipeline", "spmd_pipeline_vpp"]
+           "PipelineParallel", "ZeroBubblePipelineParallel",
+           "zero_bubble_schedule", "spmd_pipeline", "spmd_pipeline_vpp"]
 
 
 class LayerDesc:
@@ -109,15 +110,13 @@ class PipelineLayer(Layer):
     def forward_stage(self, x, stage_id):
         from .recompute import recompute
 
-        for i, (layer, tag) in enumerate(self.stage_layers(stage_id)):
-            fn = layer if tag is None or tag == "fn" else \
-                (lambda v, l=layer, f=tag: f(l, v))
+        for i, entry in enumerate(self.stage_layers(stage_id)):
             if (self._recompute_interval > 0
                     and i % self._recompute_interval == 0
                     and isinstance(x, Tensor) and not x.stop_gradient):
-                x = recompute(fn, x)
+                x = recompute(lambda v, e=entry: _apply_entry(e, v), x)
             else:
-                x = fn(x)
+                x = _apply_entry(entry, x)
         return x
 
     def forward(self, x):
@@ -207,6 +206,239 @@ class PipelineParallel(Layer):
         if compute_loss and loss_fn is not None:
             return loss_fn(out, labels)
         return out
+
+
+# ---------------------------------------------------------- zero bubble (H1)
+
+def zero_bubble_schedule(n_stages, n_micro):
+    """Build a ZBH1 schedule table: per stage, a list of per-tick ops
+    ``('F'|'B'|'W', microbatch)`` or ``None`` (idle).
+
+    The reference implements this as a static-graph pass
+    (distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py,
+    ZBH1 at :62) that splits ``matmul_grad`` into separate dX/dW jobs so
+    weight-gradient work fills the 1F1B bubble. Here the schedule is built
+    by event-driven simulation with the same priorities: activation-grad
+    (B) first — it unblocks upstream stages — then forward under the 1F1B
+    in-flight cap, and deferred weight-grad (W) only in otherwise-idle
+    slots. Memory stays at the 1F1B level (in-flight ≤ n_stages - s).
+    """
+    done_F, done_B = set(), set()
+    next_F = [0] * n_stages
+    next_B = [0] * n_stages
+    next_W = [0] * n_stages
+    sched = [[] for _ in range(n_stages)]
+    while not all(w == n_micro for w in next_W):
+        decisions = []
+        for s in range(n_stages):
+            op = None
+            m = next_B[s]
+            b_ready = (m < n_micro and (s, m) in done_F
+                       and (s == n_stages - 1 or (s + 1, m) in done_B))
+            f = next_F[s]
+            f_ready = (f < n_micro
+                       and (s == 0 or (s - 1, f) in done_F)
+                       and (f - next_B[s]) < (n_stages - s))
+            if b_ready:
+                op = ("B", m)
+            elif f_ready:
+                op = ("F", f)
+            elif next_W[s] < next_B[s]:
+                op = ("W", next_W[s])
+            decisions.append(op)
+        # commit synchronously: this tick's readiness was judged on prior
+        # ticks' completions, as on real lock-step hardware
+        for s, op in enumerate(decisions):
+            sched[s].append(op)
+            if op is None:
+                continue
+            kind, m = op
+            if kind == "F":
+                done_F.add((s, m))
+                next_F[s] += 1
+            elif kind == "B":
+                done_B.add((s, m))
+                next_B[s] += 1
+            else:
+                next_W[s] += 1
+    return sched
+
+
+def _apply_entry(entry, x):
+    """Run one PipelineLayer entry — the single definition of the
+    (layer, tag) dispatch rule shared by forward_stage and _StageModule."""
+    layer, tag = entry
+    if tag is None or tag == "fn":
+        return layer(x)
+    return tag(layer, x)
+
+
+class _StageModule(Layer):
+    """One pipeline stage's run_functions as a standalone Layer (so it can
+    be functionalized for per-stage vjp)."""
+
+    def __init__(self, entries):
+        super().__init__()
+        self.entries = entries
+        self.stage_layers = LayerList(
+            [l for l, tag in entries if isinstance(l, Layer)])
+
+    def forward(self, x):
+        for entry in self.entries:
+            x = _apply_entry(entry, x)
+        return x
+
+
+class ZeroBubblePipelineParallel(PipelineParallel):
+    """Host-driven ZBH1 trainer: backward split into activation-grad (B)
+    and weight-grad (W) phases, W deferred into bubble slots.
+
+    TPU-native adaptation of pipeline_zero_bubble.py's ZBH1: each stage is
+    a functionalized sub-Layer; B runs ``jax.vjp`` w.r.t. the stage input
+    only (unblocking the upstream stage immediately), while W re-linearizes
+    w.r.t. the parameters in its scheduled bubble slot (recompute-in-bubble
+    — the W work, including its forward recompute, occupies time that 1F1B
+    would have idled away; memory stays at 1F1B level because no dW
+    residuals are held). Gradients are numerically identical to GPipe/1F1B.
+    """
+
+    def __init__(self, layers, hcg=None, strategy=None, accumulate_steps=None):
+        super().__init__(layers, hcg=hcg, strategy=strategy,
+                         accumulate_steps=accumulate_steps,
+                         schedule_mode="1F1B")
+        self.schedule_mode = "ZBH1"
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("ZeroBubblePipelineParallel requires a "
+                            "PipelineLayer model")
+        if getattr(layers, "_recompute_interval", 0):
+            import warnings
+
+            warnings.warn(
+                "ZBH1 ignores PipelineLayer.recompute_interval: its W phase "
+                "already re-linearizes each stage in the bubble slot")
+        self._stages = [
+            _StageModule(layers.stage_layers(s))
+            for s in range(layers.get_num_stages())
+        ]
+        self.last_schedule = None  # (for inspection/tests)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        from ...core import random as _random
+        from ...jit import _FunctionalModel
+
+        inputs, labels = data
+        n_micro = self.accumulate_steps
+        n_stages = len(self._stages)
+        batch = inputs.shape[0]
+        assert batch % n_micro == 0, (
+            f"batch {batch} not divisible by accumulate_steps {n_micro}")
+        mb = batch // n_micro
+        loss_fn = getattr(self._layers, "_loss_fn", None)
+        scale = (float(scaler._scale) if scaler is not None
+                 and getattr(scaler, "_enable", True) else 1.0)
+
+        fms = [_FunctionalModel(s) for s in self._stages]
+        states = [s.raw_state() for s in self._stages]
+
+        def run_stage(s, params, buffers, x, key, target=None):
+            out, new_buffers = fms[s](params, buffers, (x,), {}, key)
+            if target is not None:
+                loss = loss_fn(Tensor._from_value(out),
+                               Tensor._from_value(target))
+                out = (loss._value if isinstance(loss, Tensor) else loss) \
+                    * (scale / n_micro)
+            return out, new_buffers
+
+        sched = zero_bubble_schedule(n_stages, n_micro)
+        self.last_schedule = sched
+        ticks = len(sched[0])
+
+        act_in = [dict() for _ in range(n_stages)]   # (s, m) stage inputs
+        pull_x = [dict() for _ in range(n_stages)]   # B-phase vjp closures
+        keys = [dict() for _ in range(n_stages)]     # per-(s,m) rng keys
+        buf_in = [dict() for _ in range(n_stages)]   # buffers seen by F(s,m)
+        gin = [dict() for _ in range(n_stages)]      # incoming output grads
+        gy_saved = [dict() for _ in range(n_stages)]  # cotangents held for W
+        grad_acc = [None] * n_stages                 # per-stage param grads
+        total_loss = None
+
+        iv, lv = inputs._value, labels._value
+        for m in range(n_micro):
+            act_in[0][m] = iv[m * mb:(m + 1) * mb]
+
+        for t in range(ticks):
+            for s in range(n_stages):
+                op = sched[s][t]
+                if op is None:
+                    continue
+                kind, m = op
+                params = states[s][0]
+                if kind == "F":
+                    key = jax.random.key_data(_random.next_key())
+                    keys[s][m] = key
+                    x = act_in[s][m]
+                    last = s == n_stages - 1
+                    tgt = lv[m * mb:(m + 1) * mb] if last else None
+                    buffers = states[s][1]
+                    buf_in[s][m] = buffers
+                    # B differentiates w.r.t. the activation ONLY — the
+                    # parameter cotangent is deliberately not produced here
+                    out, px, new_buffers = jax.vjp(
+                        lambda a: run_stage(s, params, buffers, a, key, tgt),
+                        x, has_aux=True)
+                    pull_x[s][m] = px
+                    # forward-updated buffers (BN running stats) advance
+                    # micro-to-micro, like the sequential trainer
+                    states[s] = (params, new_buffers)
+                    if last:
+                        loss_m = out / scale
+                        total_loss = (loss_m if total_loss is None
+                                      else total_loss + loss_m)
+                        gin[s][m] = jnp.ones_like(out)
+                    else:
+                        act_in[s + 1][m] = out
+                elif kind == "B":
+                    gy = gin[s].pop(m)
+                    (gx,) = pull_x[s].pop(m)(gy)
+                    gy_saved[s][m] = gy
+                    if s > 0:
+                        gin[s - 1][m] = gx
+                else:  # W: re-linearize w.r.t. params in the bubble slot
+                    x = act_in[s].pop(m)
+                    key = keys[s].pop(m)
+                    buffers = buf_in[s].pop(m)  # as seen by this F: exact
+                    last = s == n_stages - 1
+                    tgt = lv[m * mb:(m + 1) * mb] if last else None
+                    _, pw, _unused = jax.vjp(
+                        lambda p: run_stage(s, p, buffers, x, key, tgt),
+                        params, has_aux=True)
+                    (gw,) = pw(gy_saved[s].pop(m))
+                    if grad_acc[s] is None:
+                        grad_acc[s] = gw
+                    else:
+                        grad_acc[s] = jax.tree_util.tree_map(
+                            jnp.add, grad_acc[s], gw)
+
+        # write accumulated grads + forward-updated buffers (BN running
+        # stats) back into the live layers
+        for s, stage in enumerate(self._stages):
+            stage.load_raw_state({}, states[s][1])
+            if grad_acc[s] is None:
+                continue
+            index = {k: p for k, p in stage.named_parameters()}
+            for k, g in grad_acc[s].items():
+                if k in index and not index[k].stop_gradient:
+                    index[k]._accumulate_grad(g)
+
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor._from_value(total_loss, stop_gradient=True)
 
 
 # ------------------------------------------------------------ compiled route

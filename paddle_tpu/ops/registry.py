@@ -416,9 +416,11 @@ def _apply_op_impl(op: OpDef, args, kwargs):
                 return _flatten_decl(_rule(ctx, *grad_outputs))
 
         node = GradNode(op.name, backward_fn, edges, len(outs_flat), tuple(needs))
-        node.in_tensors = list(in_tensors)
         if use_cached_vjp or (vjp_fn is None and op.backward is not None):
+            # create_graph support; only set alongside pure_bwd so the
+            # vjp-fallback path doesn't pin input Tensor wrappers for nothing
             node.pure_bwd = pure_bwd
+            node.in_tensors = list(in_tensors)
         for i, t in enumerate(out_tensors):
             # Integer/bool outputs (indices from topk/argsort/...) carry no
             # gradient: keep them stop_gradient=True so jax.vjp never sees a

@@ -420,3 +420,105 @@ def test_spmd_pipeline_vpp_differentiable():
     np.testing.assert_allclose(np.asarray(jax.grad(loss)(ws)),
                                np.asarray(jax.grad(ref_loss)(ws)),
                                rtol=1e-4, atol=1e-5)
+
+
+# ---------------------------------------------------------------- zero bubble
+
+
+def test_zero_bubble_schedule_validity():
+    from paddle_tpu.distributed.fleet import zero_bubble_schedule
+
+    for n_stages, n_micro in [(2, 4), (4, 8), (3, 5)]:
+        sched = zero_bubble_schedule(n_stages, n_micro)
+        done = set()
+        for t in range(len(sched[0])):
+            tick_ops = []
+            for s in range(n_stages):
+                op = sched[s][t]
+                if op is None:
+                    continue
+                kind, m = op
+                # dependencies must be satisfied by PRIOR ticks
+                if kind == "F":
+                    assert s == 0 or ("F", s - 1, m) in done
+                elif kind == "B":
+                    assert ("F", s, m) in done
+                    assert s == n_stages - 1 or ("B", s + 1, m) in done
+                else:
+                    assert ("B", s, m) in done
+                tick_ops.append((kind, s, m))
+            done.update(tick_ops)
+        # every phase of every microbatch ran exactly once per stage
+        assert len(done) == 3 * n_stages * n_micro
+        # W fills the cooldown: the last op on every stage is a W
+        for s in range(n_stages):
+            last = [op for op in sched[s] if op][-1]
+            assert last[0] == "W"
+
+
+def test_zero_bubble_matches_plain_pipeline():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet import (
+        LayerDesc, PipelineLayer, PipelineParallel,
+        ZeroBubblePipelineParallel)
+
+    def build():
+        paddle.seed(42)
+        return PipelineLayer(
+            [LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.Tanh),
+             LayerDesc(nn.Linear, 16, 16), LayerDesc(nn.Tanh),
+             LayerDesc(nn.Linear, 16, 4)],
+            num_stages=2, loss_fn=nn.CrossEntropyLoss())
+
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 8).astype(np.float32))
+    y = paddle.to_tensor(
+        np.random.RandomState(1).randint(0, 4, (8,)).astype(np.int64))
+
+    m1 = build()
+    pp1 = PipelineParallel(m1, accumulate_steps=4)
+    o1 = paddle.optimizer.SGD(learning_rate=0.1, parameters=m1.parameters())
+    l1 = pp1.train_batch((x, y), o1)
+
+    m2 = build()
+    pp2 = ZeroBubblePipelineParallel(m2, accumulate_steps=4)
+    o2 = paddle.optimizer.SGD(learning_rate=0.1, parameters=m2.parameters())
+    l2 = pp2.train_batch((x, y), o2)
+
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    for (k1, p1), (_, p2) in zip(m1.named_parameters(),
+                                 m2.named_parameters()):
+        np.testing.assert_allclose(
+            np.asarray(p1._value), np.asarray(p2._value),
+            rtol=1e-4, atol=1e-5, err_msg=k1)
+    # the dX/dW split actually deferred work: schedule contains W ops
+    assert any(op and op[0] == "W" for row in pp2.last_schedule for op in row)
+
+
+def test_zero_bubble_updates_batchnorm_buffers():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.fleet import (
+        LayerDesc, PipelineLayer, ZeroBubblePipelineParallel)
+
+    paddle.seed(3)
+    model = PipelineLayer(
+        [LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.BatchNorm1D, 16),
+         LayerDesc(nn.Tanh), LayerDesc(nn.Linear, 16, 4)],
+        num_stages=2, loss_fn=nn.CrossEntropyLoss())
+    pp = ZeroBubblePipelineParallel(model, accumulate_steps=2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 8).astype(np.float32) + 2.0)
+    y = paddle.to_tensor(
+        np.random.RandomState(1).randint(0, 4, (8,)).astype(np.int64))
+    pp.train_batch((x, y), opt)
+    means = [b for k, b in model.named_buffers() if "_mean" in k]
+    assert means and any(
+        np.abs(np.asarray(b._value)).sum() > 1e-3 for b in means)
