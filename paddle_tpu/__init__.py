@@ -45,6 +45,7 @@ from .core import (  # noqa: F401
     uint8,
 )
 from .core.dtype import dtype  # noqa: F401
+from .core.selected_rows import SelectedRows  # noqa: F401
 
 # Functional op surface (paddle.* functions) — generated from ops.yaml.
 from .ops import *  # noqa: F401,F403
@@ -59,6 +60,7 @@ from . import quantization  # noqa: F401
 from . import sparse  # noqa: F401
 from . import onnx  # noqa: F401
 from . import static  # noqa: F401
+from . import strings  # noqa: F401
 from . import text  # noqa: F401
 from . import utils  # noqa: F401
 from . import version  # noqa: F401

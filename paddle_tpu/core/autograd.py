@@ -458,7 +458,9 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             g = vals[0]
             for v in vals[1:]:
                 g = _add(g, v)
-            if isinstance(g, Tensor):
+            from .selected_rows import SelectedRows
+
+            if isinstance(g, (Tensor, SelectedRows)):
                 results.append(g)
             else:
                 results.append(Tensor._from_value(g, stop_gradient=True))

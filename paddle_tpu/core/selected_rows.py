@@ -1,0 +1,75 @@
+"""Row-sparse gradient container.
+
+Analog of the reference's SelectedRows (paddle/phi/core/selected_rows.h):
+a tall dense tensor represented by the subset of touched rows — the
+gradient type embedding lookups produce when ``sparse=True``, so a
+V×D vocab table never materializes a dense V×D gradient. Optimizers apply
+row-wise (lazy) updates (reference: paddle/phi/kernels/selected_rows/).
+
+On TPU the dense scatter-add is what XLA compiles anyway inside jit; this
+type exists for the eager path where V is large and the touched set is
+small (host memory + dispatch win), and for API parity.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows"]
+
+
+class SelectedRows:
+    """rows: int32 [n]; value: [n, ...] per-row data; height: full dim 0."""
+
+    def __init__(self, rows, value, height):
+        self.rows = jnp.asarray(rows, jnp.int32)
+        self.value = jnp.asarray(value)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return (self.height,) + tuple(self.value.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.value.dtype
+
+    def merged(self) -> "SelectedRows":
+        """Coalesce duplicate rows (sum)."""
+        uniq, inv = jnp.unique(self.rows, return_inverse=True)
+        merged = jnp.zeros((uniq.shape[0],) + self.value.shape[1:],
+                           self.value.dtype).at[inv].add(self.value)
+        return SelectedRows(uniq, merged, self.height)
+
+    def to_dense(self):
+        return jnp.zeros(self.shape, self.value.dtype).at[self.rows].add(
+            self.value)
+
+    def numpy(self):
+        import numpy as np
+
+        return np.asarray(self.to_dense())
+
+    def astype(self, dtype):
+        return SelectedRows(self.rows, self.value.astype(dtype), self.height)
+
+    def __add__(self, other):
+        if isinstance(other, SelectedRows):
+            if other.height != self.height:
+                raise ValueError("SelectedRows height mismatch")
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.value, other.value]),
+                self.height,
+            )
+        if isinstance(other, (jax.Array,)):
+            return self.to_dense() + other
+        return NotImplemented
+
+    def __radd__(self, other):
+        return self.__add__(other)
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"row_shape={tuple(self.value.shape[1:])})")

@@ -96,6 +96,23 @@ class Tensor:
         return None, 0
 
     def _accumulate_grad(self, value):
+        from .selected_rows import SelectedRows
+
+        if isinstance(value, SelectedRows):
+            # row-sparse grad (sparse embedding): keep sparse while possible
+            if self._grad is None:
+                self._grad = value
+            elif isinstance(self._grad, SelectedRows):
+                self._grad = self._grad + value
+            else:
+                self._grad = Tensor._from_value(
+                    self._grad._value + value.to_dense(), stop_gradient=True)
+            return
+        if isinstance(self._grad, SelectedRows):
+            self._grad = Tensor._from_value(
+                self._grad.to_dense() + (value._value if isinstance(value, Tensor)
+                                         else value), stop_gradient=True)
+            return
         if isinstance(value, Tensor):
             # create_graph mode: keep the grad's graph so it can be
             # differentiated again (reference: grad var with grad node)

@@ -25,7 +25,7 @@ from ..ops import (  # noqa: F401
     cross_entropy,
     dropout,
     elu,
-    embedding,
+    embedding as _dense_embedding,
     gelu,
     glu,
     group_norm,
@@ -110,6 +110,59 @@ def flash_attention(query, key, value, dropout=0.0, causal=False, *, training=Tr
         training=training,
     )
     return out, None  # (out, softmax_lse placeholder)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Embedding lookup. ``sparse=True`` produces a SelectedRows gradient
+    for ``weight`` in eager mode — only the touched rows are stored —
+    matching the reference (python/paddle/nn/functional/input.py embedding
+    + paddle/phi/core/selected_rows.h); under jit tracing (or with
+    gradients off) it falls back to the dense scatter, which is what XLA
+    compiles the sparse update into anyway."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import autograd as _engine
+    from ..core.autograd import GradNode
+    from ..core.selected_rows import SelectedRows
+    from ..core.tensor import Tensor
+
+    # reference input.py embedding: negative padding_idx counts from the end
+    if padding_idx is not None and padding_idx < 0:
+        padding_idx += weight.shape[0]
+
+    if (sparse and isinstance(weight, Tensor) and not weight.stop_gradient
+            and _engine.is_grad_enabled()):
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        wv = weight._value
+        if not (isinstance(xv, jax.core.Tracer)
+                or isinstance(wv, jax.core.Tracer)):
+            from ..ops.nn_kernels import embedding as _kernel
+
+            out_val = _kernel(xv, wv, padding_idx)
+            height = wv.shape[0]
+            edge = weight._grad_edge()
+            wdtype = wv.dtype
+
+            def backward_fn(grad_outputs):
+                g = grad_outputs[0]
+                if g is None:
+                    return (None,)
+                rows = xv.reshape(-1)
+                vals = g.reshape(-1, g.shape[-1]).astype(wdtype)
+                if padding_idx is not None and padding_idx >= 0:
+                    keep = rows != padding_idx  # concrete in eager: ok
+                    rows, vals = rows[keep], vals[keep]
+                return (SelectedRows(rows, vals, height),)
+
+            node = GradNode("embedding_sparse_grad", backward_fn, [edge], 1,
+                            (True,))
+            out = Tensor._from_value(out_val)
+            out.stop_gradient = False
+            out._grad_node = node
+            out._grad_slot = 0
+            return out
+    return _dense_embedding(x, weight, padding_idx=padding_idx)
 
 
 __all__ = [n for n in dir() if not n.startswith("_")]

@@ -178,7 +178,38 @@ class Optimizer:
         # donated twice. The compiled TrainStep path donates instead.
         return jax.jit(update)
 
+    # ------------------------------------------------ row-sparse grads
+
+    def _sparse_rule(self, p, sr, lr, t):
+        """Apply a SelectedRows grad by touching only its rows (reference:
+        paddle/phi/kernels/selected_rows/ sgd/adam, lazy_mode semantics).
+        Return True if handled; base class defers to densification."""
+        return False
+
+    def _apply_sparse_grads(self):
+        from ..core.selected_rows import SelectedRows
+
+        for p in self._parameter_list:
+            if not (p.trainable and isinstance(p._grad, SelectedRows)):
+                continue
+            self._ensure_state([p])
+            handled = False
+            if (self._grad_clip is None and self._weight_decay is None
+                    and self._master_key(p) not in self._master_weights):
+                lr = jnp.asarray(self.get_lr() * self._lr_scale(p),
+                                 jnp.float32)
+                handled = self._sparse_rule(p, p._grad.merged(), lr,
+                                            self._step_count + 1)
+            if handled:
+                p._grad = None
+            else:
+                # clip/decay/master-weight/non-lazy interplay: densify (the
+                # raw scatter-add in to_dense coalesces duplicate rows)
+                p._grad = Tensor._from_value(p._grad.to_dense(),
+                                             stop_gradient=True)
+
     def step(self):
+        self._apply_sparse_grads()
         params = [p for p in self._parameter_list
                   if p.trainable and p._grad is not None]
         if not params:
@@ -320,6 +351,12 @@ class SGD(Optimizer):
     def _rule(self, p, g, accs, lr, t, apply_decay=True):
         return p - lr.astype(p.dtype) * g, accs
 
+    def _sparse_rule(self, p, sr, lr, t):
+        dt = p._value.dtype
+        p._value = p._value.at[sr.rows].add(
+            (-lr.astype(dt) * sr.value.astype(dt)))
+        return True
+
 
 class Momentum(Optimizer):
     _accumulator_names = ("velocity",)
@@ -338,6 +375,18 @@ class Momentum(Optimizer):
         else:
             step = v
         return p - lr.astype(p.dtype) * step, {"velocity": v}
+
+    def _sparse_rule(self, p, sr, lr, t):
+        dt = p._value.dtype
+        key = self._acc_key("velocity", p)
+        vel = self._accumulators[key]
+        g = sr.value.astype(dt)
+        v_rows = self._momentum * vel[sr.rows].astype(dt) + g
+        step = g + self._momentum * v_rows if self._use_nesterov else v_rows
+        p._value = p._value.at[sr.rows].add(-lr.astype(dt) * step)
+        self._accumulators[key] = vel.at[sr.rows].set(
+            v_rows.astype(vel.dtype))
+        return True
 
 
 class Adagrad(Optimizer):
@@ -383,6 +432,7 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
+        self._lazy_mode = bool(lazy_mode)
 
     def _rule(self, p, g, accs, lr, t, apply_decay=True):
         dt = p.dtype
@@ -395,6 +445,35 @@ class Adam(Optimizer):
         vhat = v / (1 - jnp.power(b2, tf))
         new_p = p - lr.astype(dt) * mhat / (jnp.sqrt(vhat) + self._epsilon)
         return new_p, {"moment1": m, "moment2": v}
+
+    def _sparse_rule(self, p, sr, lr, t):
+        # lazy-mode adam on the touched rows only (reference:
+        # paddle/phi/kernels/selected_rows/adam_kernel.h, lazy_mode=True).
+        # With lazy_mode=False (default) the reference decays ALL rows'
+        # moments every step — that is the densify fallback.
+        if not self._lazy_mode:
+            return False
+        dt = p._value.dtype
+        k1 = self._acc_key("moment1", p)
+        k2 = self._acc_key("moment2", p)
+        m, v = self._accumulators[k1], self._accumulators[k2]
+        g = sr.value.astype(dt)
+        b1 = jnp.asarray(self._beta1, dt)
+        b2 = jnp.asarray(self._beta2, dt)
+        m_r = b1 * m[sr.rows].astype(dt) + (1 - b1) * g
+        v_r = b2 * v[sr.rows].astype(dt) + (1 - b2) * g * g
+        tf = jnp.asarray(t, dt)
+        mhat = m_r / (1 - jnp.power(b1, tf))
+        vhat = v_r / (1 - jnp.power(b2, tf))
+        delta = -lr.astype(dt) * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        if getattr(self, "_coeff", None):  # AdamW decoupled decay on rows
+            if self._decay_flag(p):
+                delta = delta - (lr.astype(dt) * self._coeff) * \
+                    p._value[sr.rows].astype(dt)
+        p._value = p._value.at[sr.rows].add(delta)
+        self._accumulators[k1] = m.at[sr.rows].set(m_r.astype(m.dtype))
+        self._accumulators[k2] = v.at[sr.rows].set(v_r.astype(v.dtype))
+        return True
 
 
 class AdamW(Adam):

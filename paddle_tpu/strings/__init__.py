@@ -1,0 +1,107 @@
+"""String tensors.
+
+Analog of the reference's StringTensor core type
+(paddle/phi/core/string_tensor.h) and its kernel set
+(paddle/phi/kernels/strings/: empty/copy/lower/upper with utf-8 support
+via unicode.h). Strings never run on the accelerator — in the reference
+the GPU kernels round-trip through host pinned memory — so the TPU-native
+representation is simply a host numpy object array with the same op
+surface.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["StringTensor", "to_string_tensor", "string_lower",
+           "string_upper", "empty", "copy"]
+
+
+class StringTensor:
+    """An n-d tensor of python strings (host-resident)."""
+
+    def __init__(self, data, name=None):
+        if isinstance(data, StringTensor):
+            data = data._data
+        self._data = np.asarray(data, dtype=object)
+        self.name = name or "string_tensor"
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    def numpy(self):
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        if isinstance(out, np.ndarray):
+            return StringTensor(out)
+        return out
+
+    def __len__(self):
+        return len(self._data)
+
+    def __eq__(self, other):
+        """Elementwise comparison (tensor semantics)."""
+        other = other._data if isinstance(other, StringTensor) else other
+        return self._data == np.asarray(other, object)
+
+    # identity hashing: __eq__ is elementwise, not an equivalence relation
+    __hash__ = object.__hash__
+
+    def equal_all(self, other) -> bool:
+        other = other._data if isinstance(other, StringTensor) else other
+        return bool(np.array_equal(self._data, np.asarray(other, object)))
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, data={self._data!r})"
+
+    def _map(self, fn):
+        flat = [fn(s) for s in self._data.reshape(-1)]
+        out = np.empty(len(flat), object)
+        out[:] = flat
+        return StringTensor(out.reshape(self._data.shape))
+
+    def lower(self, use_utf8_encoding=True):
+        return string_lower(self, use_utf8_encoding)
+
+    def upper(self, use_utf8_encoding=True):
+        return string_upper(self, use_utf8_encoding)
+
+
+def to_string_tensor(data, name=None) -> StringTensor:
+    return StringTensor(data, name=name)
+
+
+def string_lower(x: StringTensor, use_utf8_encoding=True) -> StringTensor:
+    """strings_lower (paddle/phi/kernels/strings/strings_lower_upper_kernel.h).
+    ``use_utf8_encoding=False`` restricts case mapping to ASCII, like the
+    reference's AsciiCaseConverter."""
+    if use_utf8_encoding:
+        return x._map(str.lower)
+    return x._map(lambda s: "".join(
+        c.lower() if c.isascii() else c for c in s))
+
+
+def string_upper(x: StringTensor, use_utf8_encoding=True) -> StringTensor:
+    if use_utf8_encoding:
+        return x._map(str.upper)
+    return x._map(lambda s: "".join(
+        c.upper() if c.isascii() else c for c in s))
+
+
+def empty(shape) -> StringTensor:
+    out = np.empty(tuple(shape), object)
+    out[...] = ""
+    return StringTensor(out)
+
+
+def copy(x: StringTensor) -> StringTensor:
+    return StringTensor(x._data.copy())

@@ -1,0 +1,174 @@
+"""SelectedRows (row-sparse grads) + string tensors.
+
+Mirrors the reference's selected_rows kernel tests
+(paddle/phi/kernels/selected_rows/, test/legacy_test/test_sgd_op.py's
+sparse cases) and strings kernels
+(paddle/phi/kernels/strings/strings_lower_upper_kernel.h).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import SelectedRows, strings
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(1234)
+
+
+def test_sparse_embedding_grad_is_selected_rows():
+    emb = nn.Embedding(1000, 8, sparse=True)
+    ids = paddle.to_tensor(np.array([[1, 5, 5], [7, 1, 999]], np.int64))
+    emb(ids).sum().backward()
+    g = emb.weight.grad
+    assert isinstance(g, SelectedRows)
+    assert g.height == 1000
+    dense = np.asarray(g.to_dense())
+    assert np.allclose(dense[5], 2.0)
+    assert np.allclose(dense[1], 2.0)
+    assert np.allclose(dense[999], 1.0)
+    assert np.allclose(dense[0], 0.0)
+    # merged() coalesces duplicates
+    m = g.merged()
+    assert m.rows.shape[0] == 4
+    assert np.allclose(np.asarray(m.to_dense()), dense)
+
+
+def test_sparse_embedding_padding_idx_rows_dropped():
+    emb = nn.Embedding(100, 4, padding_idx=0, sparse=True)
+    ids = paddle.to_tensor(np.array([0, 3, 0, 7], np.int64))
+    emb(ids).sum().backward()
+    g = emb.weight.grad
+    assert isinstance(g, SelectedRows)
+    assert 0 not in set(np.asarray(g.rows).tolist())
+    assert np.allclose(np.asarray(g.to_dense())[0], 0.0)
+
+
+def test_sgd_sparse_step_touches_only_rows():
+    emb = nn.Embedding(1000, 8, sparse=True)
+    ids = paddle.to_tensor(np.array([[1, 5, 5], [7, 1, 999]], np.int64))
+    emb(ids).sum().backward()
+    before = np.asarray(emb.weight._value).copy()
+    opt = paddle.optimizer.SGD(learning_rate=0.5,
+                               parameters=emb.parameters())
+    opt.step()
+    opt.clear_grad()
+    delta = np.asarray(emb.weight._value) - before
+    touched = set(np.nonzero(np.abs(delta).sum(1))[0].tolist())
+    assert touched == {1, 5, 7, 999}
+    assert np.allclose(delta[5], -0.5 * 2.0)
+    assert emb.weight.grad is None
+
+
+@pytest.mark.parametrize("opt_cls,kwargs", [
+    (paddle.optimizer.Adam, {"lazy_mode": True}),
+    (paddle.optimizer.Momentum, {"momentum": 0.9}),
+])
+def test_lazy_sparse_matches_dense_on_touched_rows(opt_cls, kwargs):
+    def run(sparse):
+        paddle.seed(1)
+        e = nn.Embedding(50, 4, sparse=sparse)
+        o = opt_cls(learning_rate=0.1, parameters=e.parameters(), **kwargs)
+        for _ in range(3):
+            ids = paddle.to_tensor(np.array([2, 2, 7], np.int64))
+            (e(ids) ** 2).sum().backward()
+            o.step()
+            o.clear_grad()
+        return np.asarray(e.weight._value)
+
+    ws, wd = run(True), run(False)
+    # rows touched every step: lazy == dense exactly; untouched unchanged
+    np.testing.assert_allclose(ws[[2, 7]], wd[[2, 7]], rtol=1e-5)
+    np.testing.assert_allclose(ws[3], wd[3])
+
+
+def test_adam_default_non_lazy_matches_dense_exactly():
+    # lazy_mode=False (default): reference semantics decay ALL moments each
+    # step, so the sparse grad densifies and trajectories match everywhere
+    def run(sparse):
+        paddle.seed(2)
+        e = nn.Embedding(30, 4, sparse=sparse)
+        o = paddle.optimizer.Adam(learning_rate=0.1,
+                                  parameters=e.parameters())
+        for step in range(3):
+            ids = paddle.to_tensor(np.array([1 if step < 2 else 9], np.int64))
+            (e(ids) ** 2).sum().backward()
+            o.step()
+            o.clear_grad()
+        return np.asarray(e.weight._value)
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
+
+
+def test_adamw_sparse_lazy_decay():
+    e = nn.Embedding(10, 4, sparse=True)
+    o = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.1,
+                               lazy_mode=True, parameters=e.parameters())
+    before = np.asarray(e.weight._value).copy()
+    e(paddle.to_tensor(np.array([3], np.int64))).sum().backward()
+    o.step()
+    after = np.asarray(e.weight._value)
+    assert np.allclose(after[4], before[4])       # untouched: no decay
+    assert not np.allclose(after[3], before[3])
+
+
+def test_mixed_sparse_dense_grad_densifies():
+    e = nn.Embedding(20, 4, sparse=True)
+    loss = (e(paddle.to_tensor(np.array([1], np.int64))).sum()
+            + (e.weight * 0.1).sum())
+    loss.backward()
+    g = e.weight.grad
+    assert isinstance(g, paddle.Tensor)
+    gv = np.asarray(g._value)
+    assert np.allclose(gv[2], 0.1)
+    assert np.allclose(gv[1], 1.1)
+
+
+def test_grad_clip_falls_back_to_dense():
+    e = nn.Embedding(30, 4, sparse=True)
+    o = paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=e.parameters(),
+        grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    e(paddle.to_tensor(np.array([2, 4], np.int64))).sum().backward()
+    before = np.asarray(e.weight._value).copy()
+    o.step()
+    delta = np.asarray(e.weight._value) - before
+    # clipped: global norm of update = lr * 1.0
+    assert abs(np.linalg.norm(delta) - 0.1) < 1e-5
+
+
+def test_sparse_embedding_under_jit_falls_back_dense():
+    from paddle_tpu.jit import to_static
+
+    e = nn.Embedding(16, 4, sparse=True)
+
+    def f(ids):
+        return e(ids).sum()
+
+    sf = to_static(f)
+    ids = paddle.to_tensor(np.array([1, 2], np.int64))
+    out = sf(ids)
+    np.testing.assert_allclose(
+        float(out), float(f(ids)), rtol=1e-6)
+
+
+# ---------------------------------------------------------------- strings
+
+
+def test_string_tensor_ops():
+    st = strings.to_string_tensor([["Hello", "WORLD"], ["Füß", "ok"]])
+    assert st.shape == (2, 2)
+    assert st.lower()[0, 0] == "hello"
+    assert st.upper()[0, 1] == "WORLD"
+    assert st.upper()[1, 1] == "OK"
+    # ascii-only mode leaves non-ascii untouched
+    ascii_up = strings.string_upper(st, use_utf8_encoding=False)
+    assert ascii_up[1, 0] == "FüSS".replace("SS", "ß")  # ü, ß preserved
+    assert strings.empty((2,)).tolist() == ["", ""]
+    c = strings.copy(st)
+    assert c.equal_all(st)
+    assert (c == st).all()
+    assert c is not st
+    assert {st: 1}[st] == 1  # identity-hashable
