@@ -143,6 +143,16 @@ class Tensor:
     def is_leaf(self) -> bool:
         return self._grad_node is None
 
+    @property
+    def trainable(self) -> bool:
+        """Plain Tensors mirror stop_gradient; Parameter overrides with its
+        own slot (so optimizers accept either)."""
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v: bool):
+        self.stop_gradient = not bool(v)
+
     def backward(self, grad_tensor=None, retain_graph=False, create_graph=False):
         autograd.backward([self], [grad_tensor] if grad_tensor is not None else None,
                           retain_graph=retain_graph, create_graph=create_graph)
@@ -168,7 +178,16 @@ class Tensor:
     def register_hook(self, hook):
         node, slot = self._grad_edge()
         if isinstance(node, AccumulationNode):
-            node.hooks.append(lambda g: _unwrap_opt(hook(Tensor._from_value(g))))
+            def wrapped(g):
+                from .selected_rows import SelectedRows
+
+                if isinstance(g, SelectedRows):
+                    # hooks see the dense view; None keeps the sparse grad
+                    new = _unwrap_opt(hook(Tensor._from_value(g.to_dense())))
+                    return g if new is None else new
+                return _unwrap_opt(hook(Tensor._from_value(g)))
+
+            node.hooks.append(wrapped)
             return
         raise RuntimeError("register_hook on non-leaf tensors is not yet supported")
 

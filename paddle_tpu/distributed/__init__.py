@@ -14,6 +14,7 @@ from . import auto_tuner  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import comm_ops  # noqa: F401
 from . import fleet  # noqa: F401
+from . import ps  # noqa: F401
 from . import rpc  # noqa: F401
 from .auto_parallel import DistModel, Strategy, to_static  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401

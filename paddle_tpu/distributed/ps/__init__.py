@@ -1,0 +1,449 @@
+"""Parameter-server training stack (L14).
+
+Analog of the reference's PS product line:
+- C++ tables/services: paddle/fluid/distributed/ps/ (memory_sparse_table.cc,
+  memory_dense_table.cc, accessors ctr_accessor.cc, brpc services)
+- Python orchestration: python/paddle/distributed/ps/ +
+  fleet/runtime/the_one_ps.py; table config from the_one_ps.proto.
+
+TPU-native design: the parameter server is a HOST service — embedding
+tables of recommender models live in host RAM and are orders of magnitude
+larger than chip HBM, and updates are row-sparse — so tables and
+accessors run on numpy over the framework's native RPC (TCPStore
+transport, distributed/rpc.py), not on the accelerator. Workers run the
+dense part of the model on chip and exchange only the touched rows:
+``pull_sparse`` → forward/backward (producing SelectedRows grads) →
+``push_sparse``. Async by default (no global barrier per step, reference
+async mode); ``GeoWorkerCache`` adds geo-async local aggregation
+(reference geo_sgd mode: accumulate deltas locally, flush every k steps).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+__all__ = [
+    "SparseTable", "DenseTable", "ParameterServer", "PSClient",
+    "GeoWorkerCache", "init_server", "init_client", "shutdown",
+    "get_server",
+]
+
+
+# ------------------------------------------------------------- accessors
+
+class _Accessor:
+    """Server-side per-row update rule (reference: sparse_sgd_rule.cc /
+    accessor registry). State rows are kept beside value rows."""
+
+    name = "base"
+    n_slots = 0
+
+    def __init__(self, lr=0.01, **hyper):
+        self.lr = float(lr)
+        self.hyper = hyper
+
+    def update(self, value, slots, grad, t):
+        raise NotImplementedError
+
+
+class _SGDAccessor(_Accessor):
+    name = "sgd"
+    n_slots = 0
+
+    def update(self, value, slots, grad, t):
+        value -= self.lr * grad
+        return value, slots
+
+
+class _MomentumAccessor(_Accessor):
+    name = "momentum"
+    n_slots = 1
+
+    def update(self, value, slots, grad, t):
+        mu = self.hyper.get("momentum", 0.9)
+        slots[0][:] = mu * slots[0] + grad
+        value -= self.lr * slots[0]
+        return value, slots
+
+
+class _AdamAccessor(_Accessor):
+    name = "adam"
+    n_slots = 2
+
+    def update(self, value, slots, grad, t):
+        b1 = self.hyper.get("beta1", 0.9)
+        b2 = self.hyper.get("beta2", 0.999)
+        eps = self.hyper.get("epsilon", 1e-8)
+        m, v = slots
+        m[:] = b1 * m + (1 - b1) * grad
+        v[:] = b2 * v + (1 - b2) * grad * grad
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        value -= self.lr * mhat / (np.sqrt(vhat) + eps)
+        return value, slots
+
+
+_ACCESSORS = {a.name: a for a in (_SGDAccessor, _MomentumAccessor,
+                                  _AdamAccessor)}
+
+
+def _make_accessor(spec, lr, hyper):
+    if isinstance(spec, _Accessor):
+        return spec
+    cls = _ACCESSORS.get(spec)
+    if cls is None:
+        raise ValueError(f"unknown accessor {spec!r}; have {sorted(_ACCESSORS)}")
+    return cls(lr=lr, **hyper)
+
+
+# --------------------------------------------------------------- tables
+
+class SparseTable:
+    """Hash-map embedding table: feature id → row, lazily initialized
+    (reference memory_sparse_table.cc — ids come from an unbounded feature
+    space, so rows materialize on first touch)."""
+
+    def __init__(self, table_id, dim, accessor="sgd", lr=0.01,
+                 initializer="uniform", init_range=0.1, seed=0, **hyper):
+        self.table_id = int(table_id)
+        self.dim = int(dim)
+        self.accessor = _make_accessor(accessor, lr, hyper)
+        self.initializer = initializer
+        self.init_range = float(init_range)
+        self._rng = np.random.RandomState(seed)
+        self._rows: dict[int, np.ndarray] = {}
+        self._slots: dict[int, list] = {}
+        self._step = 0
+        self._lock = threading.Lock()
+
+    def _init_row(self):
+        if self.initializer == "zeros":
+            return np.zeros(self.dim, np.float32)
+        return self._rng.uniform(-self.init_range, self.init_range,
+                                 self.dim).astype(np.float32)
+
+    def _ensure_row(self, fid):
+        """Lazy row + zeroed accessor slots; caller holds the lock."""
+        row = self._rows.get(fid)
+        if row is None:
+            row = self._rows[fid] = self._init_row()
+            self._slots[fid] = [np.zeros(self.dim, np.float32)
+                                for _ in range(self.accessor.n_slots)]
+        return row
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        with self._lock:
+            out = np.empty((ids.shape[0], self.dim), np.float32)
+            for i, fid in enumerate(ids.tolist()):
+                out[i] = self._ensure_row(fid)
+        return out
+
+    def push_grad(self, ids, grads):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(ids.shape[0], self.dim)
+        with self._lock:
+            self._step += 1
+            # coalesce duplicate ids within the push
+            order = {}
+            for i, fid in enumerate(ids.tolist()):
+                order.setdefault(fid, []).append(i)
+            for fid, rows in order.items():
+                g = grads[rows].sum(0)
+                row = self._ensure_row(fid)
+                self._rows[fid], self._slots[fid] = self.accessor.update(
+                    row, self._slots[fid], g, self._step)
+
+    def push_values(self, ids, values):
+        """Geo-async merge: add parameter DELTAS directly (reference
+        geo_sgd: workers train locally, push value diffs)."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        values = np.asarray(values, np.float32).reshape(ids.shape[0], self.dim)
+        with self._lock:
+            for i, fid in enumerate(ids.tolist()):
+                self._ensure_row(fid)
+                self._rows[fid] += values[i]
+
+    def size(self):
+        with self._lock:
+            return len(self._rows)
+
+    def state_dict(self):
+        """Values AND accessor state (slots + step) persist, as the
+        reference PS does — restoring adam moments avoids the post-restore
+        update spike a value-only save would cause."""
+        with self._lock:
+            ids = np.asarray(sorted(self._rows), np.int64)
+            values = np.stack([self._rows[i] for i in ids.tolist()]) \
+                if ids.size else np.zeros((0, self.dim), np.float32)
+            slots = [
+                np.stack([self._slots[i][k] for i in ids.tolist()])
+                if ids.size else np.zeros((0, self.dim), np.float32)
+                for k in range(self.accessor.n_slots)
+            ]
+        return {"ids": ids, "values": values, "slots": slots,
+                "step": self._step}
+
+    def set_state_dict(self, state):
+        with self._lock:
+            ids = np.asarray(state["ids"]).tolist()
+            self._rows = {int(i): np.array(v, np.float32)
+                          for i, v in zip(ids, np.asarray(state["values"]))}
+            slots = state.get("slots")
+            if slots is not None and len(slots) == self.accessor.n_slots:
+                self._slots = {
+                    int(i): [np.array(np.asarray(slots[k])[j], np.float32)
+                             for k in range(self.accessor.n_slots)]
+                    for j, i in enumerate(ids)
+                }
+            else:
+                self._slots = {fid: [np.zeros(self.dim, np.float32)
+                                     for _ in range(self.accessor.n_slots)]
+                               for fid in self._rows}
+            self._step = int(state.get("step", 0))
+
+
+class DenseTable:
+    """Replicated dense parameter block (reference memory_dense_table.cc)."""
+
+    def __init__(self, table_id, shape, accessor="sgd", lr=0.01,
+                 init=None, **hyper):
+        self.table_id = int(table_id)
+        self.shape = tuple(shape)
+        self.accessor = _make_accessor(accessor, lr, hyper)
+        self.value = (np.zeros(self.shape, np.float32) if init is None
+                      else np.asarray(init, np.float32).reshape(self.shape))
+        self._slots = [np.zeros(self.shape, np.float32)
+                       for _ in range(self.accessor.n_slots)]
+        self._step = 0
+        self._lock = threading.Lock()
+
+    def pull(self):
+        with self._lock:
+            return self.value.copy()
+
+    def push_grad(self, grad):
+        grad = np.asarray(grad, np.float32).reshape(self.shape)
+        with self._lock:
+            self._step += 1
+            self.value, self._slots = self.accessor.update(
+                self.value, self._slots, grad, self._step)
+
+    def state_dict(self):
+        with self._lock:
+            return {"value": self.value.copy(),
+                    "slots": [s.copy() for s in self._slots],
+                    "step": self._step}
+
+    def set_state_dict(self, state):
+        with self._lock:
+            self.value = np.asarray(state["value"], np.float32).reshape(
+                self.shape)
+            slots = state.get("slots")
+            if slots is not None and len(slots) == self.accessor.n_slots:
+                self._slots = [np.asarray(s, np.float32).reshape(self.shape)
+                               for s in slots]
+            self._step = int(state.get("step", 0))
+
+
+# --------------------------------------------------------------- server
+
+class ParameterServer:
+    """Table registry + request handlers (reference brpc_ps_server.cc's
+    service surface: PullSparse/PushSparse/PullDense/PushDense/Save/Load,
+    served here over distributed.rpc)."""
+
+    def __init__(self):
+        self._tables: dict[int, object] = {}
+
+    def register_table(self, table):
+        self._tables[table.table_id] = table
+        return table
+
+    def table(self, table_id):
+        return self._tables[int(table_id)]
+
+    # rpc-handler surface (must be plain data in/out)
+    def handle(self, op, table_id, *args):
+        t = self.table(table_id)
+        if op == "pull_sparse":
+            return t.pull(args[0])
+        if op == "push_sparse":
+            return t.push_grad(args[0], args[1])
+        if op == "push_sparse_values":
+            return t.push_values(args[0], args[1])
+        if op == "pull_dense":
+            return t.pull()
+        if op == "push_dense":
+            return t.push_grad(args[0])
+        if op == "size":
+            return t.size()
+        if op == "save":
+            return t.state_dict()
+        if op == "load":
+            return t.set_state_dict(args[0])
+        raise ValueError(f"unknown ps op {op!r}")
+
+
+_server: ParameterServer | None = None
+
+
+def get_server() -> ParameterServer:
+    global _server
+    if _server is None:
+        _server = ParameterServer()
+    return _server
+
+
+def _dispatch(op, table_id, *args):
+    """Module-level rpc target (distributed.rpc resolves functions by
+    module:qualname; the server singleton lives in the server process)."""
+    return get_server().handle(op, table_id, *args)
+
+
+# --------------------------------------------------------------- client
+
+class _DoneFuture:
+    """Already-completed result with the remote future's interface."""
+
+    def __init__(self, value):
+        self._value = value
+
+    def wait(self, timeout=None):
+        return self._value
+
+
+class PSClient:
+    """Worker-side handle. ``server`` is an rpc worker name (remote mode)
+    or None (in-process mode, direct calls — the reference's
+    single-process CPU debugging route)."""
+
+    def __init__(self, server=None):
+        self.server = server
+
+    def _call(self, op, table_id, *args, sync=True):
+        if self.server is None:
+            out = _dispatch(op, table_id, *args)
+            # async pushes hand back a future in remote mode — match that
+            # shape in-process so the two modes stay interchangeable
+            return out if sync else _DoneFuture(out)
+        from .. import rpc
+
+        if sync:
+            return rpc.rpc_sync(self.server, _dispatch,
+                                args=(op, table_id) + tuple(args))
+        return rpc.rpc_async(self.server, _dispatch,
+                             args=(op, table_id) + tuple(args))
+
+    def pull_sparse(self, table_id, ids):
+        return self._call("pull_sparse", table_id, np.asarray(ids, np.int64))
+
+    def push_sparse(self, table_id, ids, grads, sync=False):
+        """Async by default — reference async-SGD: workers don't wait for
+        the update to land before the next batch."""
+        return self._call("push_sparse", table_id,
+                          np.asarray(ids, np.int64),
+                          np.asarray(grads, np.float32), sync=sync)
+
+    def pull_dense(self, table_id):
+        return self._call("pull_dense", table_id)
+
+    def push_dense(self, table_id, grad, sync=False):
+        return self._call("push_dense", table_id,
+                          np.asarray(grad, np.float32), sync=sync)
+
+    def push_sparse_values(self, table_id, ids, deltas, sync=True):
+        """Geo-async: merge parameter deltas server-side."""
+        return self._call("push_sparse_values", table_id,
+                          np.asarray(ids, np.int64),
+                          np.asarray(deltas, np.float32), sync=sync)
+
+    def table_size(self, table_id):
+        return self._call("size", table_id)
+
+    def save(self, table_id):
+        return self._call("save", table_id)
+
+    def load(self, table_id, state):
+        return self._call("load", table_id, state)
+
+
+class GeoWorkerCache:
+    """Geo-async sparse cache (reference geo_sgd_transpiler / GeoSGD mode):
+    the worker trains against a local copy and pushes accumulated VALUE
+    deltas every ``trigger_steps``, trading staleness for round-trips."""
+
+    def __init__(self, client: PSClient, table_id, dim, trigger_steps=10):
+        self.client = client
+        self.table_id = table_id
+        self.dim = int(dim)
+        self.trigger_steps = int(trigger_steps)
+        self._local: dict[int, np.ndarray] = {}
+        self._base: dict[int, np.ndarray] = {}
+        self._steps = 0
+
+    def pull(self, ids):
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        missing = [i for i in set(ids.tolist()) if i not in self._local]
+        if missing:
+            rows = self.client.pull_sparse(self.table_id, missing)
+            for fid, row in zip(missing, np.asarray(rows)):
+                self._local[fid] = np.array(row, np.float32)
+                self._base[fid] = np.array(row, np.float32)
+        return np.stack([self._local[i] for i in ids.tolist()])
+
+    def apply_local_grad(self, ids, grads, lr):
+        """Local SGD step on the cached rows."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        grads = np.asarray(grads, np.float32).reshape(ids.shape[0], self.dim)
+        for i, fid in enumerate(ids.tolist()):
+            self._local[fid] -= lr * grads[i]
+        self._steps += 1
+        if self._steps % self.trigger_steps == 0:
+            self.flush()
+
+    def flush(self):
+        if not self._local:
+            return
+        ids = np.asarray(sorted(self._local), np.int64)
+        deltas = np.stack([self._local[i] - self._base[i]
+                           for i in ids.tolist()])
+        self.client.push_sparse_values(self.table_id, ids, deltas)
+        # re-base on the fresh server values
+        rows = self.client.pull_sparse(self.table_id, ids)
+        for fid, row in zip(ids.tolist(), np.asarray(rows)):
+            self._local[fid] = np.array(row, np.float32)
+            self._base[fid] = np.array(row, np.float32)
+
+
+# ------------------------------------------------------------ lifecycle
+
+def init_server(name="ps0", rank=0, world_size=1, master_endpoint=None,
+                in_process=False):
+    """Start serving tables. Remote mode joins the rpc group under
+    ``name``; in-process mode just returns the singleton (reference:
+    fleet.init_server/run_server)."""
+    server = get_server()
+    if not in_process:
+        from .. import rpc
+
+        rpc.init_rpc(name, rank=rank, world_size=world_size,
+                     master_endpoint=master_endpoint)
+    return server
+
+
+def init_client(server=None, rank=1, world_size=2, name=None,
+                master_endpoint=None):
+    if server is None:
+        return PSClient(None)
+    from .. import rpc
+
+    rpc.init_rpc(name or f"trainer{rank}", rank=rank, world_size=world_size,
+                 master_endpoint=master_endpoint)
+    return PSClient(server)
+
+
+def shutdown():
+    global _server
+    _server = None
