@@ -179,3 +179,6 @@ class MFCC(Layer):
         lm = self.log_mel(x)._value
         out = jnp.einsum("mk,...mt->...kt", self.dct._value, lm)
         return Tensor._from_value(out)
+
+
+from . import datasets  # noqa: E402,F401

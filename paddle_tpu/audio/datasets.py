@@ -1,0 +1,171 @@
+"""audio.datasets — ESC50, TESS over local archives/dirs.
+
+Analogs of /root/reference/python/paddle/audio/datasets/{dataset,esc50,
+tess}.py: an AudioClassificationDataset base that loads wavs and
+optionally computes features ('raw' | 'spectrogram' | 'melspectrogram' |
+'logmelspectrogram' | 'mfcc' — the reference's feature plumbing), with
+the ESC-50 filename/meta layout and the TESS directory layout. No
+network egress: datasets read extracted local directories.
+"""
+from __future__ import annotations
+
+import os
+import struct
+import wave
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS", "load_wav"]
+
+
+def load_wav(path, normalize=True):
+    """Minimal PCM WAV reader (host-side; the reference dlopens soundfile).
+    Returns (samples float32 [n], sample_rate)."""
+    with wave.open(path, "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes()
+        width = w.getsampwidth()
+        channels = w.getnchannels()
+        raw = w.readframes(n)
+    if width == 2:
+        data = np.frombuffer(raw, "<i2").astype(np.float32)
+        if normalize:
+            data = data / 32768.0
+    elif width == 4:
+        data = np.frombuffer(raw, "<i4").astype(np.float32)
+        if normalize:
+            data = data / 2147483648.0
+    elif width == 1:
+        data = (np.frombuffer(raw, np.uint8).astype(np.float32) - 128.0)
+        if normalize:
+            data = data / 128.0
+    else:
+        raise ValueError(f"unsupported sample width {width}")
+    if channels > 1:
+        data = data.reshape(-1, channels).mean(1)
+    return data, sr
+
+
+class AudioClassificationDataset(Dataset):
+    """(file, label) list + on-access wav load + optional feature
+    transform (reference audio/datasets/dataset.py)."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **feat_kwargs):
+        if len(files) != len(labels):
+            raise ValueError("files/labels length mismatch")
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_kwargs = feat_kwargs
+        self._feature_fns = {}  # keyed by sr: mixed-rate files featurize
+        # with the right filterbank (reference builds per item)
+
+    def _make_feature(self, sr):
+        from .. import audio as A
+
+        ft = self.feat_type
+        if ft == "raw":
+            return None
+        kwargs = dict(self.feat_kwargs)
+        if ft == "spectrogram":
+            return A.Spectrogram(**kwargs)
+        if ft == "melspectrogram":
+            return A.MelSpectrogram(sr=sr, **kwargs)
+        if ft == "logmelspectrogram":
+            return A.LogMelSpectrogram(sr=sr, **kwargs)
+        if ft == "mfcc":
+            return A.MFCC(sr=sr, **kwargs)
+        raise ValueError(f"unknown feat_type {ft!r}")
+
+    def __getitem__(self, idx):
+        data, sr = load_wav(self.files[idx])
+        if self.sample_rate is not None and sr != self.sample_rate:
+            # integer-factor resample via linear interpolation (host side)
+            t_new = np.linspace(0.0, 1.0, int(len(data) * self.sample_rate
+                                              / sr), endpoint=False)
+            t_old = np.linspace(0.0, 1.0, len(data), endpoint=False)
+            data = np.interp(t_new, t_old, data).astype(np.float32)
+            sr = self.sample_rate
+        if self.feat_type != "raw":
+            fn = self._feature_fns.get(sr)
+            if fn is None:
+                fn = self._feature_fns[sr] = self._make_feature(sr)
+            feat = fn(data[None, :])
+            out = np.asarray(feat._value)[0]
+        else:
+            out = data
+        return out, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference esc50.py): 2000 wavs named
+    ``{fold}-{clip}-{take}-{target}.wav``; 5-fold split where
+    ``split_fold`` is held out for mode='dev'."""
+
+    def __init__(self, data_dir=None, mode="train", split_fold=1,
+                 feat_type="raw", download=False, **feat_kwargs):
+        if download and data_dir is None:
+            raise RuntimeError("no network egress; pass data_dir")
+        if not 1 <= int(split_fold) <= 5:
+            raise ValueError("split_fold must be in [1, 5]")
+        audio_dir = data_dir
+        if data_dir and os.path.isdir(os.path.join(data_dir, "audio")):
+            audio_dir = os.path.join(data_dir, "audio")
+        if audio_dir is None or not os.path.isdir(audio_dir):
+            raise FileNotFoundError(f"ESC-50 audio dir not found {data_dir!r}")
+        files, labels = [], []
+        for name in sorted(os.listdir(audio_dir)):
+            if not name.endswith(".wav"):
+                continue
+            parts = name[:-4].split("-")
+            if len(parts) != 4:
+                continue
+            fold, target = int(parts[0]), int(parts[3])
+            keep = (fold != split_fold) if mode == "train" \
+                else (fold == split_fold)
+            if keep:
+                files.append(os.path.join(audio_dir, name))
+                labels.append(target)
+        super().__init__(files, labels, feat_type=feat_type, **feat_kwargs)
+
+
+class TESS(AudioClassificationDataset):
+    """TESS emotional speech (reference tess.py): wavs under
+    ``<speaker>_<word>_<emotion>.wav`` in per-speaker dirs; label =
+    emotion index; ``n_folds`` round-robin split by file order."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
+
+    def __init__(self, data_dir=None, mode="train", n_folds=5, split_fold=1,
+                 feat_type="raw", download=False, **feat_kwargs):
+        if download and data_dir is None:
+            raise RuntimeError("no network egress; pass data_dir")
+        if not 1 <= int(split_fold) <= int(n_folds):
+            raise ValueError(f"split_fold must be in [1, {n_folds}]")
+        if data_dir is None or not os.path.isdir(data_dir):
+            raise FileNotFoundError(f"TESS dir not found {data_dir!r}")
+        all_files = []
+        for root, _dirs, names in os.walk(data_dir):
+            for name in sorted(names):
+                if name.endswith(".wav"):
+                    all_files.append(os.path.join(root, name))
+        all_files.sort()
+        files, labels = [], []
+        for i, path in enumerate(all_files):
+            emotion = os.path.basename(path)[:-4].split("_")[-1].lower()
+            if emotion not in self.EMOTIONS:
+                continue
+            fold = i % n_folds + 1
+            keep = (fold != split_fold) if mode == "train" \
+                else (fold == split_fold)
+            if keep:
+                files.append(path)
+                labels.append(self.EMOTIONS.index(emotion))
+        super().__init__(files, labels, feat_type=feat_type, **feat_kwargs)

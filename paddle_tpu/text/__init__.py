@@ -2,9 +2,9 @@
 
 Analog of /root/reference/python/paddle/text/: ``viterbi_decode`` /
 ``ViterbiDecoder`` (the CRF decoding op, paddle/phi/kernels/
-viterbi_decode_kernel.h) plus the dataset namespace (the reference's text
-datasets are downloaders; this environment has zero egress, so they raise
-with instructions — see paddle_tpu.vision.datasets for local-file loaders).
+viterbi_decode_kernel.h) plus ``datasets`` (Imikolov/Imdb/UCIHousing/
+Movielens parsers over the reference's standard on-disk formats; zero
+egress here, so download=True raises and local paths are required).
 """
 from __future__ import annotations
 
@@ -15,7 +15,9 @@ import numpy as np
 from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 
-__all__ = ["viterbi_decode", "ViterbiDecoder"]
+from . import datasets  # noqa: E402,F401
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

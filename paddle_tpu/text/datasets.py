@@ -1,0 +1,283 @@
+"""text.datasets — Imikolov, Imdb, UCIHousing, Movielens.
+
+Analogs of /root/reference/python/paddle/text/datasets/{imikolov,imdb,
+uci_housing,movielens}.py. Zero network egress here, so ``download=True``
+raises and the parsers read the reference's standard on-disk formats from
+``data_file`` (PTB tarball / aclImdb tarball / housing data / ml-1m zip
+or extracted dirs). Conll05 and WMT14/16 (licensed corpora behind
+download endpoints) are not shipped.
+"""
+from __future__ import annotations
+
+import os
+import re
+import tarfile
+import zipfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Imikolov", "Imdb", "UCIHousing", "Movielens"]
+
+
+def _no_download(download):
+    if download:
+        raise RuntimeError(
+            "this environment has no network egress; place the dataset "
+            "archive locally and pass data_file=/path (download=False)"
+        )
+
+
+class Imikolov(Dataset):
+    """PTB language-model dataset (reference imikolov.py): builds a
+    frequency-cutoff vocab from the train split, yields ``data_type``
+    'NGRAM' windows or 'SEQ' (src, trg) shifted sequences."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=False):
+        _no_download(download and data_file is None)
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError("data_type must be NGRAM or SEQ")
+        if data_type == "NGRAM" and window_size < 1:
+            raise ValueError("NGRAM mode needs window_size >= 1")
+        if mode not in ("train", "test"):
+            raise ValueError("mode must be train/test")
+        self.data_type = data_type
+        self.window_size = window_size
+        self.mode = mode
+        self.min_word_freq = min_word_freq
+        train_lines, test_lines = self._read(data_file)
+        self.word_idx = self._build_dict(train_lines)
+        self.data = self._tokenize(
+            train_lines if mode == "train" else test_lines)
+
+    def _read(self, path):
+        if path is None or not os.path.exists(path):
+            raise FileNotFoundError(f"PTB archive/dir not found at {path!r}")
+        splits = {}
+        if os.path.isdir(path):
+            for split in ("train", "valid", "test"):
+                f = os.path.join(path, f"ptb.{split}.txt")
+                if os.path.exists(f):
+                    with open(f) as fh:
+                        splits[split] = fh.read().splitlines()
+        else:
+            with tarfile.open(path, "r:*") as tf:
+                for name in tf.getnames():
+                    m = re.search(r"ptb\.(train|valid|test)\.txt$", name)
+                    if m:
+                        splits[m.group(1)] = (
+                            tf.extractfile(name).read().decode()
+                            .splitlines())
+        if "train" not in splits or "test" not in splits:
+            raise ValueError("archive missing ptb.train.txt/ptb.test.txt")
+        return splits["train"], splits["test"]
+
+    def _build_dict(self, lines):
+        # sentence markers are counted per line so they become real
+        # in-vocab ids (reference imikolov.py word_count)
+        freq = {}
+        for line in lines:
+            for w in ["<s>"] + line.strip().split() + ["<e>"]:
+                freq[w] = freq.get(w, 0) + 1
+        freq.pop("<unk>", None)
+        kept = sorted(
+            [(w, c) for w, c in freq.items() if c > self.min_word_freq],
+            key=lambda t: (-t[1], t[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _tokenize(self, lines):
+        unk = self.word_idx["<unk>"]
+        bos = self.word_idx.get("<s>", unk)
+        eos = self.word_idx.get("<e>", unk)
+        out = []
+        for line in lines:
+            ids = [self.word_idx.get(w, unk) for w in line.strip().split()]
+            if self.data_type == "NGRAM":
+                ids = [bos] + ids + [eos]
+                n = self.window_size
+                for i in range(n, len(ids) + 1):
+                    out.append(np.asarray(ids[i - n:i], np.int64))
+            else:
+                src = [bos] + ids
+                if self.window_size > 0 and len(src) > self.window_size:
+                    continue  # reference SEQ mode drops over-long sequences
+                out.append((np.asarray(src, np.int64),
+                            np.asarray(ids + [eos], np.int64)))
+        return out
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment dataset over the standard aclImdb tarball
+    (reference imdb.py): tokenize, frequency-sorted vocab, label 0=pos
+    1=neg (the reference's convention)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=False):
+        _no_download(download and data_file is None)
+        if mode not in ("train", "test"):
+            raise ValueError("mode must be train/test")
+        self.mode = mode
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(f"aclImdb archive not found {data_file!r}")
+        self._tf = tarfile.open(data_file, "r:*")
+        self.word_idx = self._build_dict(cutoff)
+        self.docs, self.labels = self._load(mode)
+        self._tf.close()
+
+    _PUNC = re.compile(r"[^a-z0-9\s]")
+
+    def _tok(self, text):
+        # reference imdb.py tokenize(): strip punctuation, whitespace split
+        # (digits and merged contractions kept: "don't" -> "dont")
+        return self._PUNC.sub("", text.lower()).split()
+
+    def _iter_texts(self, pattern):
+        pat = re.compile(pattern)
+        for member in self._tf.getmembers():
+            if bool(pat.match(member.name)) and member.isfile():
+                yield self._tf.extractfile(member).read().decode(
+                    "utf-8", "ignore")
+
+    def _build_dict(self, cutoff):
+        # reference builds the vocab over train AND test splits
+        freq = {}
+        pattern = r".*aclImdb/(train|test)/(pos|neg)/.*\.txt$"
+        for text in self._iter_texts(pattern):
+            for w in self._tok(text):
+                freq[w] = freq.get(w, 0) + 1
+        kept = sorted([(w, c) for w, c in freq.items() if c > cutoff],
+                      key=lambda t: (-t[1], t[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self, mode):
+        unk = self.word_idx["<unk>"]
+        docs, labels = [], []
+        for label, tag in ((0, "pos"), (1, "neg")):
+            pattern = rf".*aclImdb/{mode}/{tag}/.*\.txt$"
+            for text in self._iter_texts(pattern):
+                ids = [self.word_idx.get(w, unk) for w in self._tok(text)]
+                docs.append(np.asarray(ids, np.int64))
+                labels.append(label)
+        return docs, np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression (reference uci_housing.py): 13 features
+    min-max-mean normalized on the train split, 80/20 train/test."""
+
+    FEATURE_NUM = 14
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        _no_download(download and data_file is None)
+        if mode not in ("train", "test"):
+            raise ValueError("mode must be train/test")
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(f"housing.data not found at {data_file!r}")
+        # fromfile+reshape, not loadtxt: the canonical housing.data wraps
+        # each 14-value record across physical lines (reference
+        # uci_housing.py:136)
+        raw = np.fromfile(data_file, sep=" ").reshape(-1, self.FEATURE_NUM)
+        split = int(raw.shape[0] * 0.8)
+        maxs = raw[:split].max(0)
+        mins = raw[:split].min(0)
+        means = raw[:split].mean(0)
+        feats = (raw[:, :-1] - means[:-1]) / (maxs[:-1] - mins[:-1])
+        data = raw[:split] if mode == "train" else raw[split:]
+        featn = feats[:split] if mode == "train" else feats[split:]
+        self.data = np.concatenate(
+            [featn, data[:, -1:]], axis=1).astype(np.float32)
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1], row[-1:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (reference movielens.py): each item is
+    (user_id, gender, age, job, movie_id, categories_onehot, title_ids,
+    rating) from the ml-1m .dat files (zip or extracted dir)."""
+
+    AGES = [1, 18, 25, 35, 45, 50, 56]
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        _no_download(download and data_file is None)
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(f"ml-1m archive not found {data_file!r}")
+        users = self._read(data_file, "users.dat")
+        movies = self._read(data_file, "movies.dat")
+        ratings = self._read(data_file, "ratings.dat")
+        self._users = {}
+        for line in users:
+            uid, gender, age, job, _zip = line.split("::")
+            self._users[int(uid)] = (
+                int(uid), 0 if gender == "M" else 1,
+                self.AGES.index(int(age)) if int(age) in self.AGES else 0,
+                int(job))
+        cats, titles = {}, {}
+        self._movies = {}
+        for line in movies:
+            mid, title, genres = line.split("::")
+            title_words = re.sub(r"\(\d{4}\)$", "", title).strip().lower()
+            tids = []
+            for w in title_words.split():
+                tids.append(titles.setdefault(w, len(titles)))
+            gids = [cats.setdefault(g, len(cats))
+                    for g in genres.strip().split("|")]
+            self._movies[int(mid)] = (int(mid), gids, tids)
+        self.n_categories = len(cats)
+        self.n_title_words = len(titles)
+        rng = np.random.RandomState(rand_seed)
+        items = []
+        for line in ratings:
+            uid, mid, rating, _ts = line.split("::")
+            uid, mid = int(uid), int(mid)
+            if uid in self._users and mid in self._movies:
+                items.append((uid, mid, float(rating)))
+        mask = rng.uniform(size=len(items)) < test_ratio
+        self.items = [it for it, m in zip(items, mask)
+                      if (m if mode == "test" else not m)]
+
+    def _read(self, path, name):
+        if os.path.isdir(path):
+            with open(os.path.join(path, name), encoding="latin1") as f:
+                return f.read().splitlines()
+        with zipfile.ZipFile(path) as zf:
+            for n in zf.namelist():
+                if n.endswith(name):
+                    return zf.read(n).decode("latin1").splitlines()
+        raise ValueError(f"{name} not found in {path}")
+
+    def __getitem__(self, idx):
+        uid, mid, rating = self.items[idx]
+        u = self._users[uid]
+        m = self._movies[mid]
+        onehot = np.zeros(self.n_categories, np.float32)
+        onehot[m[1]] = 1.0
+        return (np.int64(u[0]), np.int64(u[1]), np.int64(u[2]),
+                np.int64(u[3]), np.int64(m[0]), onehot,
+                np.asarray(m[2], np.int64), np.float32(rating))
+
+    def __len__(self):
+        return len(self.items)

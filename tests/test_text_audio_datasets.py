@@ -1,0 +1,214 @@
+"""text.datasets / audio.datasets over synthetic on-disk fixtures in the
+reference's standard formats (PTB tarball, aclImdb tarball, housing
+whitespace table, ml-1m .dat files, ESC-50/TESS wav trees)."""
+import io
+import os
+import tarfile
+import wave
+
+import numpy as np
+import pytest
+
+from paddle_tpu.audio.datasets import ESC50, TESS, load_wav
+from paddle_tpu.text.datasets import Imdb, Imikolov, Movielens, UCIHousing
+
+
+# ---------------------------------------------------------------- fixtures
+
+
+def _make_ptb(tmp_path):
+    train = "the cat sat on the mat\nthe dog sat on the log\n" * 30
+    test = "the cat ran\n"
+    path = tmp_path / "ptb.tgz"
+    with tarfile.open(path, "w:gz") as tf:
+        for split, text in [("train", train), ("test", test)]:
+            data = text.encode()
+            info = tarfile.TarInfo(f"simple-examples/data/ptb.{split}.txt")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    return str(path)
+
+
+def _make_imdb(tmp_path):
+    path = tmp_path / "aclImdb.tgz"
+    docs = {
+        "train/pos/0_9.txt": "a great movie truly great",
+        "train/neg/0_1.txt": "a terrible movie truly terrible",
+        "test/pos/0_10.txt": "great fun",
+        "test/neg/0_2.txt": "terrible bore",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, text in docs.items():
+            data = (text + " ") * 60  # push words over the cutoff
+            raw = data.encode()
+            info = tarfile.TarInfo(f"aclImdb/{name}")
+            info.size = len(raw)
+            tf.addfile(info, io.BytesIO(raw))
+    return str(path)
+
+
+def _make_wav(path, sr=16000, n=800, freq=440.0):
+    t = np.arange(n) / sr
+    samples = (0.4 * np.sin(2 * np.pi * freq * t) * 32767).astype("<i2")
+    with wave.open(str(path), "wb") as w:
+        w.setnchannels(1)
+        w.setsampwidth(2)
+        w.setframerate(sr)
+        w.writeframes(samples.tobytes())
+
+
+# ------------------------------------------------------------------ text
+
+
+def test_imikolov_ngram_and_seq(tmp_path):
+    path = _make_ptb(tmp_path)
+    ds = Imikolov(data_file=path, data_type="NGRAM", window_size=3,
+                  mode="train", min_word_freq=10)
+    assert len(ds) > 0
+    sample = ds[0]
+    assert sample.shape == (3,)
+    assert sample.dtype == np.int64
+    # vocab: words above cutoff + <unk>
+    assert "<unk>" in ds.word_idx
+    assert "the" in ds.word_idx
+    seq = Imikolov(data_file=path, data_type="SEQ", mode="test",
+                   min_word_freq=10)
+    src, trg = seq[0]
+    assert len(src) == len(trg)
+    np.testing.assert_array_equal(src[1:], trg[:-1])
+
+
+def test_imdb(tmp_path):
+    path = _make_imdb(tmp_path)
+    train = Imdb(data_file=path, mode="train", cutoff=5)
+    assert len(train) == 2
+    doc, label = train[0]
+    assert doc.dtype == np.int64 and label in (0, 1)
+    assert {int(train[i][1]) for i in range(2)} == {0, 1}
+    assert "great" in train.word_idx and "terrible" in train.word_idx
+    test = Imdb(data_file=path, mode="test", cutoff=5)
+    assert len(test) == 2
+
+
+def test_uci_housing(tmp_path):
+    rs = np.random.RandomState(0)
+    table = np.abs(rs.randn(50, 14)) + 0.5
+    path = tmp_path / "housing.data"
+    np.savetxt(path, table)
+    train = UCIHousing(data_file=str(path), mode="train")
+    test = UCIHousing(data_file=str(path), mode="test")
+    assert len(train) == 40 and len(test) == 10
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert x.dtype == np.float32
+
+
+def test_movielens(tmp_path):
+    d = tmp_path / "ml-1m"
+    d.mkdir()
+    (d / "users.dat").write_text(
+        "1::M::25::10::48067\n2::F::35::3::55117\n")
+    (d / "movies.dat").write_text(
+        "10::Toy Story (1995)::Animation|Comedy\n"
+        "20::Heat (1995)::Action|Crime\n")
+    (d / "ratings.dat").write_text(
+        "1::10::5::978300760\n1::20::3::978302109\n2::10::4::978301968\n")
+    ds = Movielens(data_file=str(d), mode="train", test_ratio=0.0)
+    assert len(ds) == 3
+    uid, gender, age, job, mid, cats, title, rating = ds[0]
+    assert cats.shape == (ds.n_categories,)
+    assert cats.sum() == 2.0  # two genres
+    assert rating in (3.0, 4.0, 5.0)
+    assert title.dtype == np.int64
+
+
+# ------------------------------------------------------------------ audio
+
+
+def test_load_wav_roundtrip(tmp_path):
+    p = tmp_path / "a.wav"
+    _make_wav(p, sr=8000, n=400)
+    data, sr = load_wav(str(p))
+    assert sr == 8000 and data.shape == (400,)
+    assert np.abs(data).max() <= 0.41
+
+
+def test_esc50_layout(tmp_path):
+    d = tmp_path / "esc" / "audio"
+    d.mkdir(parents=True)
+    for fold in (1, 2):
+        for clip, target in [(100, 0), (101, 7)]:
+            _make_wav(d / f"{fold}-{clip}-A-{target}.wav")
+    train = ESC50(data_dir=str(tmp_path / "esc"), mode="train", split_fold=1)
+    dev = ESC50(data_dir=str(tmp_path / "esc"), mode="dev", split_fold=1)
+    assert len(train) == 2 and len(dev) == 2
+    x, y = train[0]
+    assert x.ndim == 1 and int(y) in (0, 7)
+
+
+def test_tess_layout_and_features(tmp_path):
+    d = tmp_path / "tess" / "OAF_angry"
+    d.mkdir(parents=True)
+    for i, emo in enumerate(["angry", "happy", "sad", "fear", "neutral"]):
+        _make_wav(tmp_path / "tess" / "OAF_angry" / f"OAF_word{i}_{emo}.wav")
+    train = TESS(data_dir=str(tmp_path / "tess"), mode="train", n_folds=5,
+                 split_fold=1)
+    dev = TESS(data_dir=str(tmp_path / "tess"), mode="dev", n_folds=5,
+               split_fold=1)
+    assert len(train) + len(dev) == 5
+    x, y = train[0]
+    assert 0 <= int(y) < len(TESS.EMOTIONS)
+    # feature path: mfcc over the wav
+    feat = TESS(data_dir=str(tmp_path / "tess"), mode="train", n_folds=5,
+                split_fold=1, feat_type="mfcc", n_mfcc=13)
+    fx, fy = feat[0]
+    assert fx.shape[0] == 13 and fx.ndim == 2
+
+
+def test_download_raises():
+    with pytest.raises(RuntimeError, match="egress"):
+        Imikolov(download=True, data_type="SEQ")
+    with pytest.raises(RuntimeError, match="egress"):
+        ESC50(download=True)
+
+
+def test_imikolov_markers_in_vocab(tmp_path):
+    path = _make_ptb(tmp_path)
+    ds = Imikolov(data_file=path, data_type="NGRAM", window_size=3,
+                  mode="train", min_word_freq=5)
+    # sentence markers are real vocab entries; all ids fit an
+    # Embedding(len(word_idx)) table
+    assert "<s>" in ds.word_idx and "<e>" in ds.word_idx
+    assert ds.word_idx["<s>"] != ds.word_idx["<e>"]
+    vocab = len(ds.word_idx)
+    assert all(int(g.max()) < vocab for g in ds.data)
+
+
+def test_imikolov_seq_window_drops_long(tmp_path):
+    path = _make_ptb(tmp_path)
+    all_seq = Imikolov(data_file=path, data_type="SEQ", mode="train",
+                       min_word_freq=5)
+    capped = Imikolov(data_file=path, data_type="SEQ", window_size=3,
+                      mode="train", min_word_freq=5)
+    assert len(capped) < len(all_seq)
+    assert all(len(src) <= 3 for src, _ in capped.data)
+
+
+def test_uci_housing_wrapped_records(tmp_path):
+    # canonical housing.data wraps one record across two physical lines
+    rows = np.abs(np.random.RandomState(1).randn(10, 14)) + 0.5
+    lines = []
+    for r in rows:
+        lines.append(" ".join(f"{v:.4f}" for v in r[:8]))
+        lines.append(" ".join(f"{v:.4f}" for v in r[8:]))
+    path = tmp_path / "housing.data"
+    path.write_text("\n".join(lines) + "\n")
+    ds = UCIHousing(data_file=str(path), mode="train")
+    assert len(ds) == 8
+
+
+def test_audio_fold_validation(tmp_path):
+    with pytest.raises(ValueError, match="split_fold"):
+        ESC50(data_dir=str(tmp_path), split_fold=0)
+    with pytest.raises(ValueError, match="split_fold"):
+        TESS(data_dir=str(tmp_path), n_folds=5, split_fold=6)
