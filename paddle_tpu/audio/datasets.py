@@ -10,7 +10,6 @@ network egress: datasets read extracted local directories.
 from __future__ import annotations
 
 import os
-import struct
 import wave
 
 import numpy as np
@@ -52,10 +51,17 @@ class AudioClassificationDataset(Dataset):
     """(file, label) list + on-access wav load + optional feature
     transform (reference audio/datasets/dataset.py)."""
 
+    _FEAT_TYPES = ("raw", "spectrogram", "melspectrogram",
+                   "logmelspectrogram", "mfcc")
+
     def __init__(self, files, labels, feat_type="raw", sample_rate=None,
                  **feat_kwargs):
         if len(files) != len(labels):
             raise ValueError("files/labels length mismatch")
+        if feat_type not in self._FEAT_TYPES:
+            raise ValueError(
+                f"feat_type must be one of {self._FEAT_TYPES}, "
+                f"got {feat_type!r}")
         self.files = list(files)
         self.labels = list(labels)
         self.feat_type = feat_type
@@ -109,10 +115,12 @@ class ESC50(AudioClassificationDataset):
     ``{fold}-{clip}-{take}-{target}.wav``; 5-fold split where
     ``split_fold`` is held out for mode='dev'."""
 
-    def __init__(self, data_dir=None, mode="train", split_fold=1,
+    def __init__(self, data_dir=None, mode="train", split_fold=1, split=None,
                  feat_type="raw", download=False, **feat_kwargs):
         if download and data_dir is None:
             raise RuntimeError("no network egress; pass data_dir")
+        if split is not None:  # reference esc50.py parameter name
+            split_fold = split
         if not 1 <= int(split_fold) <= 5:
             raise ValueError("split_fold must be in [1, 5]")
         audio_dir = data_dir
@@ -144,9 +152,11 @@ class TESS(AudioClassificationDataset):
     EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps", "sad"]
 
     def __init__(self, data_dir=None, mode="train", n_folds=5, split_fold=1,
-                 feat_type="raw", download=False, **feat_kwargs):
+                 split=None, feat_type="raw", download=False, **feat_kwargs):
         if download and data_dir is None:
             raise RuntimeError("no network egress; pass data_dir")
+        if split is not None:  # reference tess.py parameter name
+            split_fold = split
         if not 1 <= int(split_fold) <= int(n_folds):
             raise ValueError(f"split_fold must be in [1, {n_folds}]")
         if data_dir is None or not os.path.isdir(data_dir):

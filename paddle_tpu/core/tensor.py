@@ -19,7 +19,13 @@ from . import autograd
 from .autograd import AccumulationNode
 from .dtype import convert_dtype, to_jax_dtype
 
-__all__ = ["Tensor", "Parameter", "to_tensor"]
+__all__ = ["Tensor", "Parameter", "to_tensor", "TracedConcretizationError"]
+
+
+class TracedConcretizationError(RuntimeError):
+    """Raised when eager-only materialization (.numpy()/.item()/bool) is
+    attempted on a traced value — the framework's graph-break signal
+    (to_static full_graph=False catches it to fall back to eager)."""
 
 
 def _is_tracer(v) -> bool:
@@ -243,7 +249,8 @@ class Tensor:
 
     def numpy(self) -> np.ndarray:
         if _is_tracer(self._value):
-            raise RuntimeError("Cannot call .numpy() inside a traced (to_static) region")
+            raise TracedConcretizationError(
+                "Cannot call .numpy() inside a traced (to_static) region")
         return np.asarray(self._value)
 
     def item(self):

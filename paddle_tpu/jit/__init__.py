@@ -25,7 +25,7 @@ import numpy as np
 
 from ..core import autograd, random as _random
 from ..core.autograd import GradNode
-from ..core.tensor import Tensor
+from ..core.tensor import Tensor, TracedConcretizationError
 
 __all__ = [
     "to_static", "TrainStep", "cond", "while_loop", "scan",
@@ -113,6 +113,12 @@ class StaticFunction:
         # One compiled executable per (training mode, arg tree, static leaves);
         # jax.jit adds shape/dtype specialization beneath this.
         self._compiled: dict = {}
+        # full_graph=False: the reference's SOT route tolerates graph breaks
+        # by falling back to eager for untraceable code; here untraceable
+        # means data-dependent Python control flow inside the trace, and the
+        # fallback is function-level (whole call runs eager, sticky).
+        self._full_graph = bool(full_graph)
+        self._eager_fallback = False
 
     def _get_compiled(self, key, tree, static_leaves, n_leaves):
         fn = self._compiled.get(key)
@@ -132,6 +138,35 @@ class StaticFunction:
         return fn
 
     def __call__(self, *args, **kwargs):
+        if self._eager_fallback:
+            return self._run_eager(args, kwargs)
+        try:
+            return self._call_traced(args, kwargs)
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerBoolConversionError,
+                jax.errors.TracerIntegerConversionError,
+                TracedConcretizationError) as e:
+            if self._full_graph:
+                raise RuntimeError(
+                    "to_static(full_graph=True) could not trace this "
+                    "function (data-dependent Python control flow); use "
+                    "jit.cond/while_loop/scan inside the graph, or pass "
+                    "full_graph=False to fall back to eager") from e
+            import warnings
+
+            warnings.warn(
+                f"to_static: graph break ({type(e).__name__}); running "
+                "eagerly (full_graph=False)")
+            self._eager_fallback = True
+            return self._run_eager(args, kwargs)
+
+    def _run_eager(self, args, kwargs):
+        if self._layer is not None:
+            return self._layer(*args, **kwargs)
+        return self._fn(*args, **kwargs)
+
+    def _call_traced(self, args, kwargs):
         layer = self._layer
         if layer is not None:
             param_objs = dict(layer.named_parameters())

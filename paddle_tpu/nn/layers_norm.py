@@ -10,11 +10,24 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..core.tensor import Tensor
+from ..core.tensor import Tensor, _is_tracer
 from ..ops import batch_norm as _batch_norm_op
 from . import functional as F
 from . import initializer as I
 from .layer_base import Layer
+
+
+def _trace_safe_state_write(buf, new_value):
+    """Write forward-updated state (BN running stats, spectral-norm u/v)
+    into a live buffer UNLESS that would leak a tracer into eager state:
+    under Layer-mode to_static the functional wrapper swaps buffers to
+    traced arrays first (so the write is captured and restored), but a
+    plain-function trace reaches this layer unswapped — there the update
+    is dropped for that traced call instead of poisoning the module."""
+    nv = new_value._value if isinstance(new_value, Tensor) else new_value
+    if _is_tracer(nv) and not _is_tracer(buf._value):
+        return
+    buf._value = nv
 
 __all__ = [
     "LayerNorm",
@@ -129,12 +142,8 @@ class _BatchNormBase(Layer):
         )
         if training:
             # Running stats are state, not differentiable outputs.
-            self._mean._value = (
-                new_mean._value if isinstance(new_mean, Tensor) else new_mean
-            )
-            self._variance._value = (
-                new_var._value if isinstance(new_var, Tensor) else new_var
-            )
+            _trace_safe_state_write(self._mean, new_mean)
+            _trace_safe_state_write(self._variance, new_var)
         return out
 
     def extra_repr(self):
@@ -222,6 +231,6 @@ class SpectralNorm(Layer):
             weight, self.weight_u, self.weight_v,
             dim=self.dim, power_iters=self.power_iters, eps=self.eps,
         )
-        self.weight_u._value = new_u._value
-        self.weight_v._value = new_v._value
+        _trace_safe_state_write(self.weight_u, new_u)
+        _trace_safe_state_write(self.weight_v, new_v)
         return out

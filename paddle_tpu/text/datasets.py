@@ -130,9 +130,11 @@ class Imdb(Dataset):
         if data_file is None or not os.path.exists(data_file):
             raise FileNotFoundError(f"aclImdb archive not found {data_file!r}")
         self._tf = tarfile.open(data_file, "r:*")
-        self.word_idx = self._build_dict(cutoff)
-        self.docs, self.labels = self._load(mode)
-        self._tf.close()
+        try:
+            self.word_idx = self._build_dict(cutoff)
+            self.docs, self.labels = self._load(mode)
+        finally:
+            self._tf.close()
 
     _PUNC = re.compile(r"[^a-z0-9\s]")
 

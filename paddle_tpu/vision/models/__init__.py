@@ -1,5 +1,9 @@
 """vision.models — reference model zoo (python/paddle/vision/models/)."""
 from .mobilenet import (  # noqa: F401
+    MobileNetV3Large,
+    MobileNetV3Small,
+    mobilenet_v3_large,
+    mobilenet_v3_small,
     MobileNetV1,
     MobileNetV2,
     mobilenet_v1,

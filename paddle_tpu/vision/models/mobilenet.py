@@ -7,7 +7,9 @@ from __future__ import annotations
 from ... import nn
 from ...ops import flatten
 
-__all__ = ["MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
+__all__ = ["MobileNetV1", "MobileNetV2", "MobileNetV3Large",
+           "MobileNetV3Small", "mobilenet_v1", "mobilenet_v2",
+           "mobilenet_v3_large", "mobilenet_v3_small"]
 
 
 def _make_divisible(v, divisor=8, min_value=None):
@@ -134,3 +136,135 @@ def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
     if pretrained:
         raise RuntimeError("no pretrained weights (zero egress)")
     return MobileNetV2(scale=scale, **kwargs)
+
+
+# -------------------------------------------------------------- MobileNetV3
+# analog of /root/reference/python/paddle/vision/models/mobilenetv3.py
+# (MobileNetV3Small/Large with squeeze-excitation + hardswish)
+
+
+class _SqueezeExcitation(nn.Layer):
+    def __init__(self, channels, squeeze_factor=4):
+        super().__init__()
+        squeeze = _make_divisible(channels // squeeze_factor)
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(channels, squeeze, 1)
+        self.fc2 = nn.Conv2D(squeeze, channels, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _V3ConvBNAct(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel=3, stride=1, groups=1, act="relu"):
+        layers = [
+            nn.Conv2D(in_c, out_c, kernel, stride=stride,
+                      padding=(kernel - 1) // 2, groups=groups,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_c),
+        ]
+        if act == "relu":
+            layers.append(nn.ReLU())
+        elif act == "hardswish":
+            layers.append(nn.Hardswish())
+        super().__init__(*layers)
+
+
+class _V3InvertedResidual(nn.Layer):
+    def __init__(self, in_c, expand_c, out_c, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        blocks = []
+        if expand_c != in_c:
+            blocks.append(_V3ConvBNAct(in_c, expand_c, 1, act=act))
+        blocks.append(_V3ConvBNAct(expand_c, expand_c, kernel, stride,
+                                   groups=expand_c, act=act))
+        if use_se:
+            blocks.append(_SqueezeExcitation(expand_c))
+        blocks.append(_V3ConvBNAct(expand_c, out_c, 1, act=None))
+        self.block = nn.Sequential(*blocks)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# per-variant inverted-residual settings: k, exp, out, se, act, stride
+_V3_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+_V3_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+
+
+class _MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        layers = [_V3ConvBNAct(3, in_c, 3, 2, act="hardswish")]
+        for k, exp, out, se, act, s in config:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            layers.append(_V3InvertedResidual(in_c, exp_c, out_c, k, s, se,
+                                              act))
+            in_c = out_c
+        last_conv = _make_divisible(6 * in_c)
+        layers.append(_V3ConvBNAct(in_c, last_conv, 1, act="hardswish"))
+        self.features = nn.Sequential(*layers)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(flatten(x, 1))
+        return x
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_LARGE, _make_divisible(1280 * scale), scale,
+                         num_classes, with_pool)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_V3_SMALL, _make_divisible(1024 * scale), scale,
+                         num_classes, with_pool)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise RuntimeError("no pretrained weights (zero egress)")
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise RuntimeError("no pretrained weights (zero egress)")
+    return MobileNetV3Small(scale=scale, **kwargs)

@@ -8,6 +8,7 @@ import pytest
 @pytest.mark.parametrize("script", [
     "examples/train_llama_distributed.py",
     "examples/export_and_serve.py",
+    "examples/train_ctr_ps.py",
 ])
 def test_example_runs(script):
     import os
@@ -18,4 +19,5 @@ def test_example_runs(script):
                           capture_output=True, text=True, timeout=300,
                           env=env)
     assert proc.returncode == 0, proc.stderr[-1500:]
-    assert "done" in proc.stdout or "served output" in proc.stdout
+    assert ("done" in proc.stdout or "served output" in proc.stdout
+            or "rows materialized" in proc.stdout)

@@ -204,3 +204,65 @@ def test_train_step_labels_are_not_baked():
         step(x, labels=y_b)
     after = float(model(x).mean())
     assert after > before + 1.0, (before, after)
+
+
+def test_full_graph_false_graph_break_fallback():
+    import warnings
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import to_static
+
+    def f(x):
+        if float(x.sum()) > 0:  # data-dependent python branch: graph break
+            return x * 2
+        return x - 1
+
+    sf = to_static(f, full_graph=False)
+    x = paddle.to_tensor(np.float32([1.0, 2.0]))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = sf(x)
+        assert any("graph break" in str(i.message) for i in w)
+    np.testing.assert_allclose(np.asarray(out._value), [2.0, 4.0])
+    # sticky eager: the other branch now works too
+    out2 = sf(paddle.to_tensor(np.float32([-5.0, 1.0])))
+    np.testing.assert_allclose(np.asarray(out2._value), [-6.0, 0.0])
+    # full_graph=True raises with guidance
+    sf2 = to_static(f)
+    try:
+        sf2(x)
+        raise AssertionError("expected RuntimeError")
+    except RuntimeError as e:
+        assert "full_graph=False" in str(e)
+    # traceable functions still compile under full_graph=False
+    g = to_static(lambda a: a * 3, full_graph=False)
+    np.testing.assert_allclose(np.asarray(g(x)._value), [3.0, 6.0])
+    assert len(g._compiled) == 1 and not g._eager_fallback
+
+
+def test_fn_mode_trace_does_not_leak_tracers_into_buffers():
+    # a plain-function to_static that reaches a BatchNorm layer must not
+    # poison the live running stats with tracers (trace-safe state write)
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import to_static
+
+    paddle.seed(0)
+    m = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+    f = to_static(lambda x: m(x).sum())
+    x = paddle.to_tensor(np.random.rand(4, 4).astype(np.float32))
+    f(x)
+    assert not any(isinstance(b._value, jax.core.Tracer)
+                   for _, b in m.named_buffers())
+    m(x)  # eager after trace works
+    # Layer-mode to_static still updates running stats (swapped buffers)
+    m2 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8))
+    g = to_static(m2)
+    g(x)
+    mean = [b for k, b in m2.named_buffers() if "_mean" in k][0]
+    assert float(abs(mean).sum()) > 0

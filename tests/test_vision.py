@@ -131,6 +131,8 @@ def test_vgg11_forward():
     (lambda: models.googlenet(num_classes=10), 64),
     (lambda: models.inception_v3(num_classes=10), 96),
     (lambda: models.shufflenet_v2_x0_5(num_classes=10), 64),
+    (lambda: models.mobilenet_v3_small(num_classes=10), 64),
+    (lambda: models.mobilenet_v3_large(num_classes=10, scale=0.5), 64),
 ])
 def test_more_model_zoo_forward(ctor, size):
     model = ctor()
