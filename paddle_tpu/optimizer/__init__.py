@@ -4,7 +4,13 @@ Analog of /root/reference/python/paddle/optimizer/.
 """
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
+    Adadelta,
     Adagrad,
+    ASGD,
+    LBFGS,
+    NAdam,
+    RAdam,
+    Rprop,
     Adam,
     Adamax,
     AdamW,

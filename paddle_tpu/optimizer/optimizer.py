@@ -20,7 +20,9 @@ from ..core.tensor import Parameter, Tensor
 from ..nn.clip import ClipGradBase
 from .lr import LRScheduler
 
-__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "RMSProp", "Adam", "AdamW", "Lamb", "Adamax"]
+__all__ = ["Optimizer", "SGD", "Momentum", "Adagrad", "RMSProp", "Adam",
+           "AdamW", "Lamb", "Adamax", "Adadelta", "ASGD", "NAdam", "RAdam",
+           "Rprop", "LBFGS"]
 
 
 class Optimizer:
@@ -554,3 +556,329 @@ class Lamb(Optimizer):
         r_norm = jnp.linalg.norm(r.reshape(-1).astype(jnp.float32))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0).astype(dt)
         return p - lr.astype(dt) * trust * r, {"moment1": m, "moment2": v}
+
+
+class Adadelta(Optimizer):
+    """reference python/paddle/optimizer/adadelta.py:
+    E[g²] ← ρE[g²] + (1−ρ)g²; Δ = −√(E[Δ²]+ε)/√(E[g²]+ε)·g;
+    E[Δ²] ← ρE[Δ²] + (1−ρ)Δ²; p += lr·Δ."""
+
+    _accumulator_names = ("avg_squared_grad", "avg_squared_update")
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+        dt = p.dtype
+        rho = jnp.asarray(self._rho, dt)
+        eg = rho * accs["avg_squared_grad"].astype(dt) + (1 - rho) * g * g
+        delta = -jnp.sqrt(
+            (accs["avg_squared_update"].astype(dt) + self._epsilon)
+            / (eg + self._epsilon)) * g
+        eu = (rho * accs["avg_squared_update"].astype(dt)
+              + (1 - rho) * delta * delta)
+        return p + lr.astype(dt) * delta, {
+            "avg_squared_grad": eg, "avg_squared_update": eu}
+
+
+class ASGD(Optimizer):
+    """Stochastic Average Gradient (reference asgd.py): per-slot gradient
+    memory y_i (i = t mod n), running sum d, update
+    x -= lr·(d/min(t, n) + λx)."""
+
+    _accumulator_names = ("d", "ys")
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._n = int(batch_num)
+
+    def _init_slot_value(self, slot, value):
+        base = jnp.zeros_like(
+            value, dtype=jnp.float32 if self._multi_precision else value.dtype)
+        if slot == "ys":
+            return jnp.broadcast_to(base, (self._n,) + base.shape).copy()
+        return base
+
+    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+        dt = p.dtype
+        i = (t - 1) % self._n
+        y_i = jax.lax.dynamic_index_in_dim(accs["ys"], i, 0,
+                                           keepdims=False).astype(dt)
+        d = accs["d"].astype(dt) - y_i + g
+        ys = jax.lax.dynamic_update_index_in_dim(
+            accs["ys"], g.astype(accs["ys"].dtype), i, 0)
+        denom = jnp.minimum(t, self._n).astype(dt)
+        new_p = p - lr.astype(dt) * d / denom
+        return new_p, {"d": d, "ys": ys}
+
+
+class NAdam(Optimizer):
+    """reference nadam.py: Nesterov-momentum Adam with the μ-product
+    schedule μ_t = β1(1 − ½·0.96^{tψ})."""
+
+    _accumulator_names = ("moment1", "moment2", "mu_product")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._psi = momentum_decay
+
+    def _init_slot_value(self, slot, value):
+        if slot == "mu_product":
+            return jnp.ones((), jnp.float32)
+        return super()._init_slot_value(slot, value)
+
+    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+        dt = p.dtype
+        b1 = jnp.asarray(self._beta1, dt)
+        b2 = jnp.asarray(self._beta2, dt)
+        tf = t.astype(dt)
+        mu_t = b1 * (1 - 0.5 * jnp.power(0.96, tf * self._psi))
+        mu_t1 = b1 * (1 - 0.5 * jnp.power(0.96, (tf + 1) * self._psi))
+        mu_prod = accs["mu_product"].astype(dt) * mu_t
+        m = b1 * accs["moment1"].astype(dt) + (1 - b1) * g
+        v = b2 * accs["moment2"].astype(dt) + (1 - b2) * g * g
+        mhat = (mu_t1 * m / (1 - mu_prod * mu_t1)
+                + (1 - mu_t) * g / (1 - mu_prod))
+        vhat = v / (1 - jnp.power(b2, tf))
+        new_p = p - lr.astype(dt) * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return new_p, {"moment1": m, "moment2": v,
+                       "mu_product": mu_prod.astype(jnp.float32)}
+
+
+class RAdam(Optimizer):
+    """reference radam.py: rectified Adam — variance-rectification term r
+    applied once ρ_t > 5, plain momentum SGD before."""
+
+    _accumulator_names = ("moment1", "moment2")
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+        dt = p.dtype
+        b1 = jnp.asarray(self._beta1, dt)
+        b2 = jnp.asarray(self._beta2, dt)
+        tf = t.astype(dt)
+        m = b1 * accs["moment1"].astype(dt) + (1 - b1) * g
+        v = b2 * accs["moment2"].astype(dt) + (1 - b2) * g * g
+        mhat = m / (1 - jnp.power(b1, tf))
+        rho_inf = 2.0 / (1 - b2) - 1
+        b2t = jnp.power(b2, tf)
+        rho_t = rho_inf - 2 * tf * b2t / (1 - b2t)
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf)
+                     / ((rho_inf - 4) * (rho_inf - 2)
+                        * jnp.maximum(rho_t, 4.001)))
+        vhat = jnp.sqrt(v / (1 - b2t)) + self._epsilon
+        rect = p - lr.astype(dt) * r * mhat / vhat
+        plain = p - lr.astype(dt) * mhat
+        return jnp.where(rho_t > 5.0, rect, plain), {
+            "moment1": m, "moment2": v}
+
+
+class Rprop(Optimizer):
+    """reference rprop.py: resilient backprop — per-weight step sizes
+    scaled by η⁺/η⁻ on gradient-sign agreement/flip, batch-only."""
+
+    _accumulator_names = ("prev_grad", "step_size")
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50.0),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_minus, self._eta_plus = etas
+
+    def _init_slot_value(self, slot, value):
+        base = super()._init_slot_value(slot, value)
+        if slot == "step_size":
+            return base + jnp.asarray(float(self.get_lr()), base.dtype)
+        return base
+
+    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+        dt = p.dtype
+        prev = accs["prev_grad"].astype(dt)
+        step = accs["step_size"].astype(dt)
+        sign = prev * g
+        scale = jnp.where(sign > 0, self._eta_plus,
+                          jnp.where(sign < 0, self._eta_minus, 1.0))
+        step = jnp.clip(step * scale, self._lr_min, self._lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        new_p = p - jnp.sign(g_eff) * step
+        return new_p, {"prev_grad": g_eff, "step_size": step}
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (reference lbfgs.py): closure-driven two-loop
+    recursion over (s, y) curvature pairs; ``line_search_fn='strong_wolfe'``
+    uses backtracking to the Armijo condition (a conservative subset of the
+    reference's strong-Wolfe zoom). weight_decay/grad_clip apply to the
+    closure gradients, and the curvature history rides in state_dict."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._max_iter = int(max_iter)
+        # reference lbfgs.py defaults max_eval to max_iter * 5 // 4
+        self._max_eval = (int(max_eval) if max_eval is not None
+                          else self._max_iter * 5 // 4)
+        self._tol_grad = tolerance_grad
+        self._tol_change = tolerance_change
+        self._history = int(history_size)
+        self._line_search = line_search_fn
+        self._s: list = []
+        self._y: list = []
+
+    def _flat_params(self):
+        return jnp.concatenate([p._value.reshape(-1).astype(jnp.float32)
+                                for p in self._parameter_list])
+
+    def _write_flat(self, flat):
+        off = 0
+        for p in self._parameter_list:
+            n = int(p._value.size)
+            p._value = flat[off:off + n].reshape(p._value.shape).astype(
+                p._value.dtype)
+            off += n
+
+    def _flat_grad(self, closure):
+        params = self._parameter_list
+        for p in params:
+            p.clear_grad()
+        loss = closure()
+        raw = [None if p._grad is None else p._grad._value for p in params]
+        if self._grad_clip is not None:
+            present = [(p, g) for p, g in zip(params, raw) if g is not None]
+            if present:
+                clipped = self._grad_clip._clip_arrays(
+                    [g for _, g in present], [p for p, _ in present])
+                it = iter(clipped)
+                raw = [next(it) if g is not None else None for g in raw]
+        parts = []
+        for p, g in zip(params, raw):
+            if g is None:
+                parts.append(jnp.zeros(int(p._value.size), jnp.float32))
+            else:
+                g = self._decay_grad(p._value.astype(jnp.float32),
+                                     g.astype(jnp.float32))
+                parts.append(g.reshape(-1))
+        lv = float(loss._value if hasattr(loss, "_value") else loss)
+        return lv, jnp.concatenate(parts)
+
+    def _direction(self, grad):
+        # two-loop recursion entirely on-device (0-d jnp scalars; no host
+        # sync per history pair)
+        q = grad
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.dot(y, s)
+            a = rho * jnp.dot(s, q)
+            alphas.append((a, rho, s, y))
+            q = q - a * y
+        if self._y:
+            y = self._y[-1]
+            s = self._s[-1]
+            q = q * (jnp.dot(s, y) / jnp.dot(y, y))
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.dot(y, q)
+            q = q + (a - b) * s
+        return -q
+
+    def step(self, closure):
+        evals = 0
+
+        def eval_closure():
+            nonlocal evals
+            evals += 1
+            return self._flat_grad(closure)
+
+        loss, grad = eval_closure()
+        self._step_count += 1
+        for _ in range(self._max_iter):
+            if evals >= self._max_eval:
+                break
+            if float(jnp.max(jnp.abs(grad))) <= self._tol_grad:
+                break
+            d = self._direction(grad)
+            x0 = self._flat_params()
+            lr = float(self.get_lr())
+            gd = float(jnp.dot(grad, d))
+            if gd > 0:  # not a descent direction: reset history
+                self._s.clear()
+                self._y.clear()
+                d = -grad
+                gd = float(jnp.dot(grad, d))
+            applied = lr
+            if self._line_search == "strong_wolfe":
+                for _bt in range(20):
+                    applied = lr
+                    self._write_flat(x0 + lr * d)
+                    new_loss, new_grad = eval_closure()
+                    if (new_loss <= loss + 1e-4 * lr * gd
+                            or evals >= self._max_eval or _bt == 19):
+                        break
+                    lr *= 0.5
+            else:
+                self._write_flat(x0 + lr * d)
+                new_loss, new_grad = eval_closure()
+            s = applied * d  # the displacement actually written
+            y = new_grad - grad
+            if float(jnp.dot(s, y)) > 1e-10:
+                self._s.append(s)
+                self._y.append(y)
+                if len(self._s) > self._history:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            if abs(new_loss - loss) < self._tol_change:
+                loss, grad = new_loss, new_grad
+                break
+            loss, grad = new_loss, new_grad
+        for p in self._parameter_list:
+            p.clear_grad()
+        return Tensor._from_value(jnp.asarray(loss))
+
+    # curvature history persists across checkpoint/resume
+    def state_dict(self):
+        out = super().state_dict()
+        for i, (s, y) in enumerate(zip(self._s, self._y)):
+            out[f"lbfgs_s@{i}"] = Tensor._from_value(s)
+            out[f"lbfgs_y@{i}"] = Tensor._from_value(y)
+        return out
+
+    def set_state_dict(self, state):
+        s_items, y_items, rest = {}, {}, {}
+        for k, v in state.items():
+            if k.startswith("lbfgs_s@"):
+                s_items[int(k.split("@")[1])] = v
+            elif k.startswith("lbfgs_y@"):
+                y_items[int(k.split("@")[1])] = v
+            else:
+                rest[k] = v
+        super().set_state_dict(rest)
+        unval = lambda v: v._value if isinstance(v, Tensor) else jnp.asarray(v)
+        self._s = [unval(s_items[i]) for i in sorted(s_items)]
+        self._y = [unval(y_items[i]) for i in sorted(y_items)]

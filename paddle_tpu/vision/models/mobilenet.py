@@ -164,7 +164,8 @@ class _V3ConvBNAct(nn.Sequential):
             nn.Conv2D(in_c, out_c, kernel, stride=stride,
                       padding=(kernel - 1) // 2, groups=groups,
                       bias_attr=False),
-            nn.BatchNorm2D(out_c),
+            # reference mobilenetv3.py uses eps=1e-3, momentum=0.99
+            nn.BatchNorm2D(out_c, epsilon=0.001, momentum=0.99),
         ]
         if act == "relu":
             layers.append(nn.ReLU())

@@ -206,3 +206,167 @@ def test_functional_update_honors_decay_exemption():
     new_p2, _, _ = o2.functional_update(named, grads, accs2, masters2, lr, t)
     for k in named:
         np.testing.assert_allclose(np.asarray(new_p[k]), np.asarray(new_p2[k]), rtol=1e-6)
+
+
+# ------------------------------------------------- round-2 late optimizers
+
+
+def _train_ours(cls, steps=5, **kw):
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    p = paddle.Parameter(np.array([1.0, -2.0, 3.0], np.float32))
+    opt = cls(learning_rate=0.1, parameters=[p], **kw)
+    g = np.array([0.5, -0.3, 0.1], np.float32)
+    for _ in range(steps):
+        (p * paddle.to_tensor(g)).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    return np.asarray(p._value)
+
+
+def _train_torch(cls, steps=5, **kw):
+    import torch
+
+    p = torch.nn.Parameter(torch.tensor([1.0, -2.0, 3.0]))
+    opt = cls([p], lr=0.1, **kw)
+    g = torch.tensor([0.5, -0.3, 0.1])
+    for _ in range(steps):
+        opt.zero_grad()
+        (p * g).sum().backward()
+        opt.step()
+    return p.detach().numpy()
+
+
+def test_adadelta_nadam_radam_rprop_match_torch():
+    import numpy as np
+    import torch
+
+    import paddle_tpu as paddle
+
+    cases = [
+        (paddle.optimizer.Adadelta, torch.optim.Adadelta,
+         dict(rho=0.9, epsilon=1e-6), dict(rho=0.9, eps=1e-6)),
+        (paddle.optimizer.NAdam, torch.optim.NAdam, {}, {}),
+        (paddle.optimizer.RAdam, torch.optim.RAdam, {}, {}),
+        (paddle.optimizer.Rprop, torch.optim.Rprop,
+         dict(learning_rate_range=(1e-6, 50.0)),
+         dict(step_sizes=(1e-6, 50.0))),
+    ]
+    for ours, theirs, kw_o, kw_t in cases:
+        np.testing.assert_allclose(
+            _train_ours(ours, **kw_o), _train_torch(theirs, **kw_t),
+            rtol=2e-4, atol=1e-6, err_msg=ours.__name__)
+
+
+def test_asgd_matches_reference_formula():
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    p = paddle.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.ASGD(learning_rate=0.1, batch_num=2,
+                                parameters=[p])
+    grads = [0.5, 0.3, 0.2, 0.7]
+    x, d, ys = 1.0, 0.0, [0.0, 0.0]
+    for m, gv in enumerate(grads):
+        (p * paddle.to_tensor(np.float32(gv))).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        i = m % 2
+        d = d - ys[i] + gv
+        ys[i] = gv
+        x = x - 0.1 * d / min(m + 1, 2)
+    np.testing.assert_allclose(float(p._value[0]), x, rtol=1e-6)
+
+
+def test_lbfgs_quadratic_converges():
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    w = paddle.Parameter(np.array([5.0, -3.0], np.float32))
+    opt = paddle.optimizer.LBFGS(learning_rate=0.5, max_iter=100,
+                                 tolerance_change=1e-12,
+                                 line_search_fn="strong_wolfe",
+                                 parameters=[w])
+    A = paddle.to_tensor(np.array([[3.0, 0.5], [0.5, 1.0]], np.float32))
+    b = paddle.to_tensor(np.array([1.0, -2.0], np.float32))
+
+    def closure():
+        r = (w @ A @ w) * 0.5 - (b * w).sum()
+        r.backward()
+        return r
+
+    loss = opt.step(closure)
+    want = np.linalg.solve(np.array([[3.0, 0.5], [0.5, 1.0]]),
+                           np.array([1.0, -2.0]))
+    np.testing.assert_allclose(np.asarray(w._value), want, atol=2e-4)
+    assert float(loss) < 0  # minimum of the quadratic is negative
+
+
+def test_lbfgs_decay_clip_and_state_roundtrip():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    w = paddle.Parameter(np.array([5.0, -3.0], np.float32))
+    opt = paddle.optimizer.LBFGS(
+        learning_rate=0.1, max_iter=3, parameters=[w], weight_decay=0.5,
+        grad_clip=nn.ClipGradByGlobalNorm(0.5))
+
+    def closure():
+        r = (w ** 2).sum()
+        r.backward()
+        return r
+
+    opt.step(closure)
+    # decay + clip actually changed the trajectory vs the plain run
+    paddle.seed(0)
+    w2 = paddle.Parameter(np.array([5.0, -3.0], np.float32))
+    opt2 = paddle.optimizer.LBFGS(learning_rate=0.1, max_iter=3,
+                                  parameters=[w2])
+
+    def closure2():
+        r = (w2 ** 2).sum()
+        r.backward()
+        return r
+
+    opt2.step(closure2)
+    assert not np.allclose(np.asarray(w._value), np.asarray(w2._value))
+    # history round-trips through state_dict
+    assert opt2._s
+    sd = opt2.state_dict()
+    opt3 = paddle.optimizer.LBFGS(learning_rate=0.1, max_iter=3,
+                                  parameters=[w2])
+    opt3.set_state_dict(sd)
+    assert len(opt3._s) == len(opt2._s)
+    np.testing.assert_allclose(np.asarray(opt3._s[0]),
+                               np.asarray(opt2._s[0]))
+
+
+def test_lbfgs_max_eval_positional_compat():
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    w = paddle.Parameter(np.array([2.0], np.float32))
+    # reference positional order: (lr, max_iter, max_eval, tolerance_grad)
+    opt = paddle.optimizer.LBFGS(1.0, 20, 5, 1e-7, parameters=[w])
+    assert opt._max_eval == 5
+    calls = []
+
+    def closure():
+        calls.append(1)
+        r = (w ** 2).sum()
+        r.backward()
+        return r
+
+    opt.step(closure)
+    assert len(calls) <= 6  # max_eval caps closure evaluations
