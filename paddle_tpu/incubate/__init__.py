@@ -1,3 +1,5 @@
 """paddle_tpu.incubate — incubating APIs (reference python/paddle/incubate/)."""
 from . import nn  # noqa: F401
 from . import asp  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401

@@ -1,0 +1,164 @@
+"""incubate.optimizer — ModelAverage + LookAhead.
+
+Analogs of /root/reference/python/paddle/incubate/optimizer/
+{modelaverage,lookahead}.py (kernels: average_accumulates). Both are
+host-orchestrated wrappers over jnp arrays — the heavy math stays on
+device, the window bookkeeping is Python.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ModelAverage", "LookAhead"]
+
+
+class ModelAverage:
+    """Sliding-window parameter averaging (reference modelaverage.py):
+    ``step()`` after each optimizer update accumulates parameters; the
+    window grows with update count as
+    ``min(max(num_updates*rate, min_average_window), max_average_window)``
+    and rolls over the three-sum scheme of the reference's
+    average_accumulates kernel. ``apply()`` swaps averaged parameters in
+    (optionally as a context manager), ``restore()`` swaps back.
+    """
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided")
+        self._params = list(parameters)
+        self._rate = float(average_window_rate)
+        self._min_w = int(min_average_window)
+        self._max_w = int(max_average_window)
+        self._sum1 = [jnp.zeros_like(p._value) for p in self._params]
+        self._sum2 = [jnp.zeros_like(p._value) for p in self._params]
+        self._sum3 = [jnp.zeros_like(p._value) for p in self._params]
+        self._num_accumulates = 0
+        self._old_num_accumulates = 0
+        self._num_updates = 0
+        self._saved = None
+
+    def step(self):
+        self._num_updates += 1
+        self._num_accumulates += 1
+        window = min(max(self._num_updates * self._rate, self._min_w),
+                     self._max_w)
+        roll = self._num_accumulates > window
+        for i, p in enumerate(self._params):
+            self._sum1[i] = self._sum1[i] + p._value.astype(
+                self._sum1[i].dtype)
+        if roll:
+            # reference average_accumulates_kernel_impl.h: the finished
+            # window folds into sum_3 and both live sums reset
+            for i in range(len(self._params)):
+                self._sum3[i] = self._sum1[i] + self._sum2[i]
+                self._sum2[i] = jnp.zeros_like(self._sum2[i])
+                self._sum1[i] = jnp.zeros_like(self._sum1[i])
+            self._old_num_accumulates = self._num_accumulates
+            self._num_accumulates = 0
+
+    def _averaged(self, i):
+        total = self._num_accumulates + self._old_num_accumulates
+        if total == 0:
+            return self._params[i]._value
+        avg = (self._sum1[i] + self._sum2[i] + self._sum3[i]) / total
+        return avg.astype(self._params[i]._value.dtype)
+
+    def apply(self, executor=None, need_restore=True):
+        self._saved = [p._value for p in self._params]
+        for i, p in enumerate(self._params):
+            p._value = self._averaged(i)
+        if need_restore:
+            return _RestoreCtx(self)
+        self._saved = None
+        return _RestoreCtx(None)
+
+    def restore(self, executor=None):
+        if self._saved is None:
+            return
+        for p, v in zip(self._params, self._saved):
+            p._value = v
+        self._saved = None
+
+    def minimize(self, loss, startup_program=None):
+        self.step()
+
+
+class _RestoreCtx:
+    def __init__(self, owner):
+        self._owner = owner
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self._owner is not None:
+            self._owner.restore()
+        return False
+
+
+class LookAhead:
+    """k-step lookahead (reference lookahead.py): the wrapped optimizer
+    advances fast weights; every ``k`` steps the slow weights move
+    ``alpha`` of the way toward them and the fast weights reset onto the
+    slow track."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if k < 1:
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._params = list(inner_optimizer._parameter_list)
+        self._slow = [p._value for p in self._params]
+        self._k_count = 0
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k == 0:
+            for i, p in enumerate(self._params):
+                slow = (self._slow[i].astype(jnp.float32)
+                        + self.alpha * (p._value.astype(jnp.float32)
+                                        - self._slow[i].astype(jnp.float32)))
+                self._slow[i] = slow.astype(p._value.dtype)
+                p._value = self._slow[i]
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def state_dict(self):
+        out = self.inner_optimizer.state_dict()
+        out["@lookahead_k_count"] = self._k_count
+        for i, v in enumerate(self._slow):
+            out[f"lookahead_slow@{i}"] = Tensor._from_value(v)
+        return out
+
+    def set_state_dict(self, state):
+        rest = {}
+        for k, v in state.items():
+            if k == "@lookahead_k_count":
+                self._k_count = int(v)
+            elif k.startswith("lookahead_slow@"):
+                i = int(k.split("@")[1])
+                self._slow[i] = (v._value if isinstance(v, Tensor)
+                                 else jnp.asarray(v))
+            else:
+                rest[k] = v
+        self.inner_optimizer.set_state_dict(rest)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()

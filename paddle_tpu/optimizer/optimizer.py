@@ -818,7 +818,7 @@ class LBFGS(Optimizer):
 
         loss, grad = eval_closure()
         self._step_count += 1
-        for _ in range(self._max_iter):
+        for it in range(self._max_iter):
             if evals >= self._max_eval:
                 break
             if float(jnp.max(jnp.abs(grad))) <= self._tol_grad:
@@ -826,6 +826,12 @@ class LBFGS(Optimizer):
             d = self._direction(grad)
             x0 = self._flat_params()
             lr = float(self.get_lr())
+            if it == 0 and not self._s:
+                # first-iteration damping (reference lbfgs.py:729):
+                # alpha = min(1, 1/|g|_1) * lr keeps the initial -g step
+                # unit-length on badly scaled problems
+                g1 = float(jnp.sum(jnp.abs(grad)))
+                lr = min(1.0, 1.0 / max(g1, 1e-12)) * lr
             gd = float(jnp.dot(grad, d))
             if gd > 0:  # not a descent direction: reset history
                 self._s.clear()
