@@ -45,6 +45,25 @@ class _RNG(threading.local):
 
 _rng = _RNG()
 
+# True while a whole-graph trace (to_static/TrainStep/_FunctionalModel) is
+# active ON THIS THREAD (thread-local like the RNG itself): kernels that
+# would insert opaque pallas_calls into a fused XLA program consult this
+# to stay as jnp compositions there (per-op eager executables keep the
+# Pallas path).
+import threading as _threading
+
+
+class _TraceState(_threading.local):
+    def __init__(self):
+        self.flag = False
+
+
+_trace_state = _TraceState()
+
+
+def in_whole_graph_trace() -> bool:
+    return _trace_state.flag
+
 
 def seed(s: int):
     _rng.root_seed = int(s)

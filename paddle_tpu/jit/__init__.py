@@ -42,13 +42,16 @@ def _traced_rng(base_key):
     instead of baking a constant mask into the compiled program. The host
     counter still increments per call site, giving each random op in the
     graph a distinct fold-in of the traced base key."""
-    saved = (_random._rng.key, _random._rng.counter)
+    saved = (_random._rng.key, _random._rng.counter,
+             _random._trace_state.flag)
     _random._rng.key = base_key
     _random._rng.counter = 0
+    _random._trace_state.flag = True
     try:
         yield
     finally:
-        _random._rng.key, _random._rng.counter = saved
+        (_random._rng.key, _random._rng.counter,
+         _random._trace_state.flag) = saved
 
 
 def _as_tensor_tree(tree):

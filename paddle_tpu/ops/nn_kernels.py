@@ -160,7 +160,27 @@ def layer_norm(x, weight=None, bias=None, epsilon=1e-05, begin_norm_axis=-1):
 
 
 def rms_norm(x, weight=None, bias=None, epsilon=1e-06):
-    """Root-mean-square norm (reference: paddle/phi/kernels/gpu/rms_norm_kernel.cu:1081)."""
+    """Root-mean-square norm (reference: paddle/phi/kernels/gpu/rms_norm_kernel.cu:1081).
+
+    The Pallas fused kernel (ops/pallas/rms_norm.py) serves aligned shapes
+    in EAGER dispatch when FLAGS_use_pallas_kernels is set — one fused
+    launch instead of the mean-square/normalize/scale chain. Inside traced
+    programs the jnp composition stays: XLA fuses it into its neighbours,
+    and an opaque pallas_call there measurably costs fusion (bench r2:
+    70.5% -> 68.4% MFU on the compiled LLaMA step)."""
+    from ..core import random as _random
+    from ..core.flags import flag as _flag
+
+    if (_flag("FLAGS_use_pallas_kernels")
+            and not _random.in_whole_graph_trace()):
+        from .pallas.rms_norm import rms_norm as _pl_rms
+        from .pallas.rms_norm import rms_norm_supported
+
+        if rms_norm_supported(x, weight):
+            has_bias = bias is not None
+            return _pl_rms(x, weight,
+                           bias if has_bias else jnp.zeros_like(weight),
+                           epsilon, has_bias)
     dt = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)

@@ -71,7 +71,15 @@ class OpDef:
         # after reference-API attrs in kernel signatures).
         if self.nojit or force_nojit or not flag("FLAGS_eager_op_jit"):
             return self.kernel(**dict(zip(self.input_names, in_vals)), **attrs)
-        key = (_freeze(attrs), tuple(_struct_key(v) for v in in_vals))
+        from ..core import random as _random
+
+        # whole-graph-trace context is part of the key: kernels may lower
+        # differently inside a fused program vs a standalone executable
+        # (e.g. rms_norm keeps the jnp composition under to_static so XLA
+        # fuses it, but takes the Pallas kernel as a per-op launch), and a
+        # cached jaxpr from one context must not leak into the other
+        key = (_freeze(attrs), tuple(_struct_key(v) for v in in_vals),
+               _random.in_whole_graph_trace())
         fn = self._jit_cache.get(key)
         if fn is None:
             kernel = self.kernel
@@ -318,8 +326,11 @@ def _apply_op_impl(op: OpDef, args, kwargs):
             # key includes WHICH positions are differentiated tensors vs
             # dynamic raw arrays: pow(x_t, y_t) and x_t ** scalar-array
             # share the value structure but need different executables
+            from ..core import random as _random
+
             key = ("@vjp", _freeze(attrs),
-                   tuple(_struct_key(v) for v in in_vals), specs, o_specs)
+                   tuple(_struct_key(v) for v in in_vals), specs, o_specs,
+                   _random.in_whole_graph_trace())
             bwd_exec = op._jit_cache.get(key)
             if bwd_exec is None:
                 kernel = op.kernel

@@ -15,7 +15,12 @@ __all__ = [
     "Compose", "ToTensor", "Normalize", "Resize", "CenterCrop", "RandomCrop",
     "RandomHorizontalFlip", "RandomVerticalFlip", "Pad", "Transpose",
     "BrightnessTransform", "ContrastTransform", "RandomResizedCrop",
+    "BaseTransform", "ColorJitter", "Grayscale", "HueTransform",
+    "SaturationTransform", "RandomAffine", "RandomErasing",
+    "RandomPerspective", "RandomRotation",
     "to_tensor", "normalize", "resize", "center_crop", "hflip", "vflip", "pad",
+    "to_grayscale", "adjust_brightness", "adjust_saturation", "adjust_hue",
+    "rotate",
 ]
 
 
@@ -268,3 +273,393 @@ class ContrastTransform:
         out = mean + alpha * (f - mean)
         return np.clip(out, 0, 255).astype(img.dtype) \
             if img.dtype == np.uint8 else out
+
+
+# ------------------------------------------------- color / geometry tranche
+# (reference transforms.py: ColorJitter, Grayscale, Hue/Saturation,
+#  RandomRotation, RandomAffine, RandomPerspective, RandomErasing)
+
+
+def to_grayscale(img, num_output_channels=1):
+    orig_dtype = np.asarray(img).dtype
+    img = _as_hwc(img).astype(np.float32)
+    if img.shape[2] == 1:
+        gray = img
+    else:
+        gray = (0.299 * img[..., 0] + 0.587 * img[..., 1]
+                + 0.114 * img[..., 2])[..., None]
+    out = np.repeat(gray, num_output_channels, axis=2)
+    if orig_dtype == np.uint8:
+        out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out
+
+
+def adjust_brightness(img, factor):
+    img = _as_hwc(img)
+    out = img.astype(np.float32) * factor
+    return np.clip(out, 0, 255 if img.dtype == np.uint8 else out.max()
+                   ).astype(img.dtype)
+
+
+def adjust_saturation(img, factor):
+    img = _as_hwc(img)
+    f = img.astype(np.float32)
+    gray = to_grayscale(f, 3)
+    out = gray + factor * (f - gray)
+    return np.clip(out, 0, 255 if img.dtype == np.uint8 else out.max()
+                   ).astype(img.dtype)
+
+
+def adjust_hue(img, factor):
+    """factor in [-0.5, 0.5]: rotate hue via HSV round-trip."""
+    img = _as_hwc(img)
+    if img.shape[2] < 3:
+        return img.copy()  # grayscale has no hue
+    f = img.astype(np.float32)
+    if img.dtype == np.uint8:
+        f = f / 255.0
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    maxc = np.max(f, -1)
+    minc = np.min(f, -1)
+    v = maxc
+    c = maxc - minc
+    s = np.where(maxc > 0, c / np.maximum(maxc, 1e-12), 0.0)
+    safe_c = np.maximum(c, 1e-12)
+    hr = np.where((maxc == r), ((g - b) / safe_c) % 6, 0.0)
+    hg = np.where((maxc == g) & (maxc != r), (b - r) / safe_c + 2, 0.0)
+    hb = np.where((maxc == b) & (maxc != r) & (maxc != g),
+                  (r - g) / safe_c + 4, 0.0)
+    h = (hr + hg + hb) / 6.0
+    h = (h + factor) % 1.0
+    # hsv -> rgb
+    i = np.floor(h * 6.0)
+    fr = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - s * fr)
+    t = v * (1 - s * (1 - fr))
+    i = i.astype(np.int32) % 6
+    rgb = np.stack([
+        np.choose(i, [v, q, p, p, t, v]),
+        np.choose(i, [t, v, v, q, p, p]),
+        np.choose(i, [p, p, t, v, v, q]),
+    ], -1)
+    if img.dtype == np.uint8:
+        rgb = np.clip(rgb * 255.0, 0, 255).astype(np.uint8)
+    return rgb
+
+
+def _sample_at(img, ys, xs, interpolation, fill):
+    """Sample HWC img at float coords (out-of-bounds -> fill)."""
+    h, w = img.shape[:2]
+    shape = ys.shape + (img.shape[2],)
+    if interpolation == "bilinear":
+        valid = (ys >= 0) & (ys <= h - 1) & (xs >= 0) & (xs <= w - 1)
+        y0 = np.clip(np.floor(ys), 0, h - 1).astype(int)
+        x0 = np.clip(np.floor(xs), 0, w - 1).astype(int)
+        y1 = np.minimum(y0 + 1, h - 1)
+        x1 = np.minimum(x0 + 1, w - 1)
+        wy = (ys - y0)[..., None]
+        wx = (xs - x0)[..., None]
+        f = img.astype(np.float32)
+        out = ((1 - wy) * (1 - wx) * f[y0, x0] + (1 - wy) * wx * f[y0, x1]
+               + wy * (1 - wx) * f[y1, x0] + wy * wx * f[y1, x1])
+        out = np.where(valid[..., None], out, np.float32(fill))
+        if img.dtype == np.uint8:
+            out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+        else:
+            out = out.astype(img.dtype)
+        return out
+    yi = np.round(ys).astype(int)
+    xi = np.round(xs).astype(int)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full(shape, fill, img.dtype)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate counter-clockwise by ``angle`` degrees. ``center`` is
+    (x, y) — the paddle/PIL convention; ``expand=True`` enlarges the
+    canvas to hold the whole rotated image (center override ignored then,
+    as in PIL)."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    if expand:
+        nw = int(np.ceil(abs(w * cos) + abs(h * sin)))
+        nh = int(np.ceil(abs(w * sin) + abs(h * cos)))
+        cx, cy = (w - 1) / 2.0, (h - 1) / 2.0
+        ocx, ocy = (nw - 1) / 2.0, (nh - 1) / 2.0
+    else:
+        nw, nh = w, h
+        if center is None:
+            cx, cy = (w - 1) / 2.0, (h - 1) / 2.0
+        else:
+            cx, cy = center
+        ocx, ocy = cx, cy
+    yy, xx = np.meshgrid(np.arange(nh), np.arange(nw), indexing="ij")
+    dx = xx - ocx
+    dy = yy - ocy
+    xs = cos * dx - sin * dy + cx
+    ys = sin * dx + cos * dy + cy
+    return _sample_at(img, ys, xs, interpolation, fill)
+
+
+def _affine_sample(img, matrix, fill=0):
+    """Inverse-map sampling with a 2x3 affine matrix over (x, y)."""
+    img = _as_hwc(img)
+    h, w = img.shape[:2]
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    xs = matrix[0, 0] * xx + matrix[0, 1] * yy + matrix[0, 2]
+    ys = matrix[1, 0] * xx + matrix[1, 1] * yy + matrix[1, 2]
+    yi = np.round(ys).astype(int)
+    xi = np.round(xs).astype(int)
+    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+    out = np.full_like(img, fill)
+    out[valid] = img[yi[valid], xi[valid]]
+    return out
+
+
+class BaseTransform:
+    """Reference transforms.py BaseTransform: keys-aware callable; the
+    lean core treats every input as a single image."""
+
+    def __init__(self, keys=None):
+        self.keys = keys
+
+    def _apply_image(self, img):
+        raise NotImplementedError
+
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        orig = np.asarray(img)
+        out = to_grayscale(img, self.num_output_channels)
+        return out.astype(orig.dtype) if orig.dtype == np.uint8 else out
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        f = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, f)
+
+
+class ColorJitter(BaseTransform):
+    """Random brightness/contrast/saturation/hue in random order
+    (reference transforms.py ColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        super().__init__(keys)
+        self.brightness = float(brightness)
+        self.contrast = float(contrast)
+        self.saturation = float(saturation)
+        self.hue = float(hue)
+
+    def _apply_image(self, img):
+        ops = []
+        if self.brightness:
+            f = 1.0 + np.random.uniform(-self.brightness, self.brightness)
+            ops.append(lambda im: adjust_brightness(im, f))
+        if self.contrast:
+            c = 1.0 + np.random.uniform(-self.contrast, self.contrast)
+
+            def _contrast(im, c=c):
+                m = im.astype(np.float32).mean()
+                out = m + c * (im.astype(np.float32) - m)
+                return np.clip(out, 0, 255 if im.dtype == np.uint8
+                               else out.max()).astype(im.dtype)
+
+            ops.append(_contrast)
+        if self.saturation:
+            s = 1.0 + np.random.uniform(-self.saturation, self.saturation)
+            ops.append(lambda im: adjust_saturation(im, s))
+        if self.hue:
+            hf = np.random.uniform(-self.hue, self.hue)
+            ops.append(lambda im: adjust_hue(im, hf))
+        np.random.shuffle(ops)
+        for op in ops:
+            img = op(img)
+        return img
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, interpolation=self.interpolation,
+                      expand=self.expand, center=self.center, fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    """Random rotation + translate + scale + shear via one inverse-mapped
+    affine (reference transforms.py RandomAffine)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        # reference shear forms: number -> x-shear range; [a, b] -> x-shear
+        # range; [a, b, c, d] -> x and y ranges
+        if shear is None:
+            self.shear = None
+        elif isinstance(shear, numbers.Number):
+            self.shear = (-abs(shear), abs(shear), 0.0, 0.0)
+        elif len(shear) == 2:
+            self.shear = (shear[0], shear[1], 0.0, 0.0)
+        elif len(shear) == 4:
+            self.shear = tuple(shear)
+        else:
+            raise ValueError("shear must be a number or a 2/4-sequence")
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        angle = np.deg2rad(np.random.uniform(*self.degrees))
+        sc = (np.random.uniform(*self.scale) if self.scale else 1.0)
+        shx = shy = 0.0
+        if self.shear is not None:
+            shx = np.deg2rad(np.random.uniform(self.shear[0], self.shear[1]))
+            shy = np.deg2rad(np.random.uniform(self.shear[2], self.shear[3]))
+        tx = ty = 0.0
+        if self.translate:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        if self.center is None:
+            cx, cy = (w - 1) / 2.0, (h - 1) / 2.0
+        else:
+            cx, cy = self.center  # (x, y), reference convention
+        cos, sin = np.cos(angle), np.sin(angle)
+        rot = np.array([[cos, -sin], [sin, cos]])
+        shear_m = (np.array([[1.0, np.tan(shx)], [0.0, 1.0]])
+                   @ np.array([[1.0, 0.0], [np.tan(shy), 1.0]]))
+        lin = sc * (rot @ shear_m)
+        fwd = np.eye(3)
+        fwd[:2, :2] = lin
+        pre = np.array([[1, 0, -cx], [0, 1, -cy], [0, 0, 1.0]])
+        post = np.array([[1, 0, cx + tx], [0, 1, cy + ty], [0, 0, 1.0]])
+        m = post @ fwd @ pre
+        inv = np.linalg.inv(m)[:2]
+        return _affine_sample(img, inv, fill=self.fill)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.fill = fill
+
+    def _apply_image(self, img):
+        if np.random.uniform() >= self.prob:
+            return _as_hwc(img)
+        img = _as_hwc(img)
+        h, w = img.shape[:2]
+        d = self.distortion_scale
+        dx = int(d * w / 2)
+        dy = int(d * h / 2)
+        # displaced corners (x, y): tl tr br bl
+        src = np.float32([[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]])
+        dst = src + np.float32([
+            [np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)],
+            [-np.random.randint(0, dx + 1), np.random.randint(0, dy + 1)],
+            [-np.random.randint(0, dx + 1), -np.random.randint(0, dy + 1)],
+            [np.random.randint(0, dx + 1), -np.random.randint(0, dy + 1)],
+        ])
+        # homography dst -> src (inverse map) by DLT
+        A = []
+        for (x, y), (u, v) in zip(dst, src):
+            A.append([x, y, 1, 0, 0, 0, -u * x, -u * y, -u])
+            A.append([0, 0, 0, x, y, 1, -v * x, -v * y, -v])
+        _, _, vt = np.linalg.svd(np.asarray(A, np.float64))
+        Hm = vt[-1].reshape(3, 3)
+        yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+        den = Hm[2, 0] * xx + Hm[2, 1] * yy + Hm[2, 2]
+        xs = (Hm[0, 0] * xx + Hm[0, 1] * yy + Hm[0, 2]) / den
+        ys = (Hm[1, 0] * xx + Hm[1, 1] * yy + Hm[1, 2]) / den
+        yi = np.round(ys).astype(int)
+        xi = np.round(xs).astype(int)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        out = np.full_like(img, self.fill)
+        out[valid] = img[yi[valid], xi[valid]]
+        return out
+
+
+class RandomErasing(BaseTransform):
+    """Erase a random rectangle (reference transforms.py RandomErasing);
+    operates on HWC arrays or CHW tensors alike by erasing along the two
+    spatial dims inferred from the value layout."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        arr = np.array(img, copy=True)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[2] > 4
+        h, w = (arr.shape[1], arr.shape[2]) if chw else (arr.shape[0],
+                                                         arr.shape[1])
+        if np.random.uniform() >= self.prob:
+            return arr
+        area = h * w
+        for _ in range(10):
+            target = np.random.uniform(*self.scale) * area
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                if chw:
+                    arr[:, i:i + eh, j:j + ew] = self.value
+                else:
+                    arr[i:i + eh, j:j + ew] = self.value
+                break
+        return arr

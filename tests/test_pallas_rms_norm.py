@@ -1,0 +1,98 @@
+"""Pallas fused RMSNorm: numerics vs the jnp composition, fwd + grads,
+gating behavior. Runs in interpret mode on CPU (same code path as TPU)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops.pallas.rms_norm import rms_norm as plrms
+from paddle_tpu.ops.pallas.rms_norm import rms_norm_supported
+
+EPS = 1e-6
+
+
+def _ref(x, w, b=None):
+    var = jnp.mean(x.astype(jnp.float32) ** 2, -1, keepdims=True)
+    out = x.astype(jnp.float32) * jax.lax.rsqrt(var + EPS) * w
+    if b is not None:
+        out = out + b
+    return out.astype(x.dtype)
+
+
+@pytest.mark.parametrize("shape", [(16, 256), (4, 8, 128), (2, 3, 4, 384)])
+def test_forward_matches_reference(shape):
+    rs = np.random.RandomState(0)
+    x = jnp.asarray(rs.randn(*shape).astype(np.float32))
+    w = jnp.asarray(rs.rand(shape[-1]).astype(np.float32) + 0.5)
+    b = jnp.asarray(rs.randn(shape[-1]).astype(np.float32) * 0.1)
+    assert rms_norm_supported(x, w)
+    out = plrms(x, w, b, EPS, True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(_ref(x, w, b)),
+                               rtol=1e-5, atol=1e-6)
+    out2 = plrms(x, w, jnp.zeros_like(w), EPS, False)
+    np.testing.assert_allclose(np.asarray(out2), np.asarray(_ref(x, w)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gradients_match_autodiff_of_reference():
+    rs = np.random.RandomState(1)
+    x = jnp.asarray(rs.randn(24, 256).astype(np.float32))
+    w = jnp.asarray(rs.rand(256).astype(np.float32) + 0.5)
+    b = jnp.asarray(rs.randn(256).astype(np.float32) * 0.1)
+    g = jnp.asarray(rs.randn(24, 256).astype(np.float32))
+    want = jax.grad(lambda *a: jnp.sum(_ref(*a) * g), argnums=(0, 1, 2))(
+        x, w, b)
+    got = jax.grad(lambda *a: jnp.sum(plrms(*a, EPS, True) * g),
+                   argnums=(0, 1, 2))(x, w, b)
+    for name, a, c in zip(("dx", "dw", "db"), want, got):
+        np.testing.assert_allclose(np.asarray(c), np.asarray(a),
+                                   rtol=1e-4, atol=1e-5, err_msg=name)
+
+
+def test_bf16_io_f32_accumulation():
+    rs = np.random.RandomState(2)
+    x = jnp.asarray(rs.randn(8, 128)).astype(jnp.bfloat16)
+    w = jnp.asarray(rs.rand(128) + 0.5).astype(jnp.bfloat16)
+    out = plrms(x, w, jnp.zeros_like(w), EPS, False)
+    assert out.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out.astype(jnp.float32)),
+        np.asarray(_ref(x, w).astype(jnp.float32)), rtol=2e-2, atol=2e-2)
+
+
+def test_gating_unaligned_shapes_fall_back():
+    x = jnp.zeros((5, 100))  # D not lane-aligned
+    w = jnp.ones((100,))
+    assert not rms_norm_supported(x, w)
+    assert not rms_norm_supported(jnp.zeros((7,)), jnp.ones((7,)))  # 1-d
+    assert not rms_norm_supported(jnp.zeros((8, 128)), None)
+
+
+def test_public_op_gated_dispatch_and_grads():
+    rs = np.random.RandomState(3)
+    x = rs.randn(16, 256).astype(np.float32)
+    w = rs.rand(256).astype(np.float32) + 0.5
+    from paddle_tpu.ops import rms_norm as op_rms
+
+    from paddle_tpu.core.flags import flag as _get_flag
+
+    prev = _get_flag("FLAGS_use_pallas_kernels")
+    paddle.set_flags({"FLAGS_use_pallas_kernels": True})
+    try:
+        t = paddle.to_tensor(x, stop_gradient=False)
+        tw = paddle.to_tensor(w, stop_gradient=False)
+        op_rms(t, tw).sum().backward()
+        paddle.set_flags({"FLAGS_use_pallas_kernels": False})
+        t2 = paddle.to_tensor(x, stop_gradient=False)
+        tw2 = paddle.to_tensor(w, stop_gradient=False)
+        op_rms(t2, tw2).sum().backward()
+    finally:
+        paddle.set_flags({"FLAGS_use_pallas_kernels": prev})
+    np.testing.assert_allclose(np.asarray(t.grad._value),
+                               np.asarray(t2.grad._value),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(tw.grad._value),
+                               np.asarray(tw2.grad._value),
+                               rtol=1e-4, atol=1e-5)

@@ -142,3 +142,33 @@ def test_more_model_zoo_forward(ctor, size):
     y.sum().backward()
     grads = [p.grad is not None for p in model.parameters() if p.trainable]
     assert all(grads)
+
+
+def test_color_and_geometry_transforms():
+    import numpy as np
+
+    from paddle_tpu.vision import transforms as T
+
+    np.random.seed(0)
+    img = (np.random.rand(32, 48, 3) * 255).astype(np.uint8)
+    for t in [T.Grayscale(3), T.ColorJitter(0.4, 0.4, 0.4, 0.2),
+              T.SaturationTransform(0.5), T.HueTransform(0.3),
+              T.RandomRotation(30),
+              T.RandomAffine(20, translate=(0.1, 0.1), scale=(0.8, 1.2),
+                             shear=10),
+              T.RandomPerspective(prob=1.0), T.RandomErasing(prob=1.0)]:
+        out = t(img)
+        assert out.shape == img.shape, type(t).__name__
+        assert out.dtype == img.dtype, type(t).__name__
+    # functional identities
+    assert np.array_equal(T.rotate(img, 0), img)
+    assert np.abs(T.adjust_hue(img, 0.0).astype(int)
+                  - img.astype(int)).max() <= 2
+    gray = T.to_grayscale(img, 1)
+    assert np.allclose(T.to_grayscale(gray, 1), gray)
+    # saturation 0 == grayscale
+    assert np.abs(T.adjust_saturation(img, 0.0).astype(np.float32)
+                  - T.to_grayscale(img, 3)).max() <= 1.0
+    # erasing leaves some pixels changed and preserves dtype
+    erased = T.RandomErasing(prob=1.0, value=0)(img)
+    assert (erased != img).any()
