@@ -1,0 +1,109 @@
+"""Error machinery — the PADDLE_ENFORCE analog.
+
+Reference: paddle/phi/core/enforce.h + paddle/common/ (error codes,
+argument-checking macros with rich context, stack summaries). Python-native
+design: ``enforce_*`` helpers raise typed errors with the same category
+names as the reference's error codes, and ``op_error_context`` wraps an op
+dispatch so a failing kernel reports the op name and every operand's
+shape/dtype — what the generated C++ ad_funcs print via
+PADDLE_ENFORCE's demangled context.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = [
+    "EnforceNotMet", "InvalidArgumentError", "NotFoundError",
+    "OutOfRangeError", "AlreadyExistsError", "PreconditionNotMetError",
+    "UnimplementedError", "enforce", "enforce_eq", "enforce_gt",
+    "enforce_shape_match", "op_error", "op_error_context",
+]
+
+
+class EnforceNotMet(RuntimeError):
+    """Base of the enforce error family (reference enforce.h EnforceNotMet)."""
+
+
+class InvalidArgumentError(EnforceNotMet, ValueError):
+    pass
+
+
+class NotFoundError(EnforceNotMet, KeyError):
+    pass
+
+
+class OutOfRangeError(EnforceNotMet, IndexError):
+    pass
+
+
+class AlreadyExistsError(EnforceNotMet):
+    pass
+
+
+class PreconditionNotMetError(EnforceNotMet):
+    pass
+
+
+class UnimplementedError(EnforceNotMet, NotImplementedError):
+    pass
+
+
+def enforce(cond, message, error_cls=InvalidArgumentError):
+    if not cond:
+        raise error_cls(message)
+
+
+def enforce_eq(a, b, message=None, error_cls=InvalidArgumentError):
+    if a != b:
+        raise error_cls(message or f"expected {a!r} == {b!r}")
+
+
+def enforce_gt(a, b, message=None, error_cls=InvalidArgumentError):
+    if not a > b:
+        raise error_cls(message or f"expected {a!r} > {b!r}")
+
+
+def enforce_shape_match(shape_a, shape_b, message=None):
+    """Broadcast-compatible check (the most common kernel precondition)."""
+    ra, rb = list(shape_a)[::-1], list(shape_b)[::-1]
+    for da, db in zip(ra, rb):
+        if da != db and da != 1 and db != 1:
+            raise InvalidArgumentError(
+                message or f"shapes {tuple(shape_a)} and {tuple(shape_b)} "
+                "are not broadcast-compatible")
+
+
+def _describe(v):
+    if isinstance(v, list):
+        return "[" + ", ".join(_describe(x) for x in v) + "]"
+    if v is None:
+        return "None"
+    shape = getattr(v, "shape", None)
+    dtype = getattr(v, "dtype", None)
+    if shape is not None:
+        return f"Tensor(shape={tuple(shape)}, dtype={dtype})"
+    return repr(v)
+
+
+def op_error(op_name, input_names, in_vals, attrs, exc):
+    """Build the rich kernel-failure error — the dispatcher-level analog of
+    PADDLE_ENFORCE's context block (built only on the failure path, so the
+    dispatch hot loop pays nothing)."""
+    args = ", ".join(
+        f"{n}={_describe(v)}" for n, v in zip(input_names, in_vals))
+    ats = ", ".join(f"{k}={v!r}" for k, v in attrs.items())
+    return InvalidArgumentError(
+        f"(InvalidArgument) operator `{op_name}` failed: {exc}\n"
+        f"  [operands] {args}\n"
+        f"  [attributes] {ats}")
+
+
+@contextlib.contextmanager
+def op_error_context(op_name, input_names, in_vals, attrs):
+    """Context-manager form of ``op_error`` for non-hot callers."""
+    try:
+        yield
+    except EnforceNotMet:
+        raise
+    except (TypeError, ValueError, IndexError, ZeroDivisionError) as e:
+        raise op_error(op_name, input_names, in_vals, attrs, e) from e

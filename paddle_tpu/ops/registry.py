@@ -27,7 +27,9 @@ import jax
 import jax.numpy as jnp
 
 from ..core import autograd
+from ..core import random as _random_mod
 from ..core.autograd import GradNode, _zero_ct as _zero_cotangent
+from ..core.enforce import EnforceNotMet, op_error
 from ..core.flags import flag
 from ..core.tensor import Tensor
 
@@ -71,15 +73,15 @@ class OpDef:
         # after reference-API attrs in kernel signatures).
         if self.nojit or force_nojit or not flag("FLAGS_eager_op_jit"):
             return self.kernel(**dict(zip(self.input_names, in_vals)), **attrs)
-        from ..core import random as _random
-
-        # whole-graph-trace context is part of the key: kernels may lower
+        # Kernel-routing context is part of the key: kernels may lower
         # differently inside a fused program vs a standalone executable
         # (e.g. rms_norm keeps the jnp composition under to_static so XLA
-        # fuses it, but takes the Pallas kernel as a per-op launch), and a
-        # cached jaxpr from one context must not leak into the other
+        # fuses it, but takes the Pallas kernel as a per-op launch) and per
+        # the Pallas flag — a cached jaxpr from one context must not leak
+        # into the other.
         key = (_freeze(attrs), tuple(_struct_key(v) for v in in_vals),
-               _random.in_whole_graph_trace())
+               _random_mod.in_whole_graph_trace(),
+               bool(flag("FLAGS_use_pallas_kernels")))
         fn = self._jit_cache.get(key)
         if fn is None:
             kernel = self.kernel
@@ -265,7 +267,12 @@ def _apply_op_impl(op: OpDef, args, kwargs):
         # A None rng_key means the kernel's stateful-RNG fallback would run at
         # trace time and bake a constant key into the cached executable —
         # bypass the jit cache for that call (public wrappers thread real keys).
-        out_vals = op.call_kernel(in_vals, attrs, force_nojit=stateful_rng)
+        try:
+            out_vals = op.call_kernel(in_vals, attrs, force_nojit=stateful_rng)
+        except EnforceNotMet:
+            raise
+        except (TypeError, ValueError, IndexError, ZeroDivisionError) as e:
+            raise op_error(op.name, op.input_names, in_vals, attrs, e) from e
         single = not isinstance(out_vals, (tuple, list))
         outs_flat = [out_vals] if single else list(out_vals)
 
@@ -326,11 +333,10 @@ def _apply_op_impl(op: OpDef, args, kwargs):
             # key includes WHICH positions are differentiated tensors vs
             # dynamic raw arrays: pow(x_t, y_t) and x_t ** scalar-array
             # share the value structure but need different executables
-            from ..core import random as _random
-
             key = ("@vjp", _freeze(attrs),
                    tuple(_struct_key(v) for v in in_vals), specs, o_specs,
-                   _random.in_whole_graph_trace())
+                   _random_mod.in_whole_graph_trace(),
+                   bool(flag("FLAGS_use_pallas_kernels")))
             bwd_exec = op._jit_cache.get(key)
             if bwd_exec is None:
                 kernel = op.kernel

@@ -407,19 +407,14 @@ def rotate(img, angle, interpolation="nearest", expand=False, center=None,
     return _sample_at(img, ys, xs, interpolation, fill)
 
 
-def _affine_sample(img, matrix, fill=0):
+def _affine_sample(img, matrix, interpolation="nearest", fill=0):
     """Inverse-map sampling with a 2x3 affine matrix over (x, y)."""
     img = _as_hwc(img)
     h, w = img.shape[:2]
     yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
     xs = matrix[0, 0] * xx + matrix[0, 1] * yy + matrix[0, 2]
     ys = matrix[1, 0] * xx + matrix[1, 1] * yy + matrix[1, 2]
-    yi = np.round(ys).astype(int)
-    xi = np.round(xs).astype(int)
-    valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
-    out = np.full_like(img, fill)
-    out[valid] = img[yi[valid], xi[valid]]
-    return out
+    return _sample_at(img, ys, xs, interpolation, fill)
 
 
 class BaseTransform:
@@ -552,6 +547,7 @@ class RandomAffine(BaseTransform):
             raise ValueError("shear must be a number or a 2/4-sequence")
         self.fill = fill
         self.center = center
+        self.interpolation = interpolation
 
     def _apply_image(self, img):
         img = _as_hwc(img)
@@ -581,7 +577,8 @@ class RandomAffine(BaseTransform):
         post = np.array([[1, 0, cx + tx], [0, 1, cy + ty], [0, 0, 1.0]])
         m = post @ fwd @ pre
         inv = np.linalg.inv(m)[:2]
-        return _affine_sample(img, inv, fill=self.fill)
+        return _affine_sample(img, inv, interpolation=self.interpolation,
+                              fill=self.fill)
 
 
 class RandomPerspective(BaseTransform):
@@ -590,6 +587,7 @@ class RandomPerspective(BaseTransform):
         super().__init__(keys)
         self.prob = prob
         self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
         self.fill = fill
 
     def _apply_image(self, img):
@@ -619,12 +617,7 @@ class RandomPerspective(BaseTransform):
         den = Hm[2, 0] * xx + Hm[2, 1] * yy + Hm[2, 2]
         xs = (Hm[0, 0] * xx + Hm[0, 1] * yy + Hm[0, 2]) / den
         ys = (Hm[1, 0] * xx + Hm[1, 1] * yy + Hm[1, 2]) / den
-        yi = np.round(ys).astype(int)
-        xi = np.round(xs).astype(int)
-        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
-        out = np.full_like(img, self.fill)
-        out[valid] = img[yi[valid], xi[valid]]
-        return out
+        return _sample_at(img, ys, xs, self.interpolation, self.fill)
 
 
 class RandomErasing(BaseTransform):

@@ -152,3 +152,38 @@ def test_geometric_sampling_and_reindex():
     np.testing.assert_array_equal(np.asarray(out_nodes._value), [1, 2, 0])
     np.testing.assert_array_equal(np.asarray(src._value), [2, 2, 0, 2])
     np.testing.assert_array_equal(np.asarray(dst._value), [0, 0, 1, 1])
+
+
+# ---------------------------------------------------------------- enforce
+
+
+def test_op_errors_carry_context():
+    import pytest
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core.enforce import EnforceNotMet, InvalidArgumentError
+
+    with pytest.raises(InvalidArgumentError) as ei:
+        paddle.matmul(paddle.ones([2, 3]), paddle.ones([4, 5]))
+    msg = str(ei.value)
+    assert "matmul" in msg
+    assert "(2, 3)" in msg and "(4, 5)" in msg
+    assert isinstance(ei.value, EnforceNotMet)
+    assert isinstance(ei.value, ValueError)  # stdlib-compatible
+
+
+def test_enforce_helpers():
+    import pytest
+
+    from paddle_tpu.core import enforce as E
+
+    E.enforce(True, "fine")
+    E.enforce_eq(3, 3)
+    E.enforce_gt(4, 3)
+    E.enforce_shape_match((2, 1, 3), (5, 3))
+    with pytest.raises(E.InvalidArgumentError):
+        E.enforce_shape_match((2, 3), (4, 5))
+    with pytest.raises(E.PreconditionNotMetError):
+        E.enforce(False, "nope", E.PreconditionNotMetError)
+    with pytest.raises(E.UnimplementedError):
+        E.enforce(False, "todo", E.UnimplementedError)
