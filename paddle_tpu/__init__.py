@@ -201,3 +201,153 @@ __all__ = (
         "autograd",
     ]
 )
+
+
+# -------------------- reference-compat surface (round-2 audit) --------------
+from .nn.layer_base import ParamAttr  # noqa: E402,F401
+from .distributed.parallel import DataParallel  # noqa: E402,F401
+from .autograd import set_grad_enabled  # noqa: E402,F401
+from .core.enforce import (  # noqa: E402,F401
+    EnforceNotMet, InvalidArgumentError,
+)
+from .core.place import CUDAPinnedPlace, CUDAPlace  # noqa: E402,F401
+
+bool = bool_  # noqa: A001 — reference exposes paddle.bool
+
+
+def iinfo(dtype):
+    import numpy as _np
+
+    from .core.dtype import to_jax_dtype
+
+    return _np.iinfo(_np.dtype(to_jax_dtype(dtype)))
+
+
+def finfo(dtype):
+    import numpy as _np
+
+    from .core.dtype import to_jax_dtype
+
+    return _np.finfo(_np.dtype(to_jax_dtype(dtype)))
+
+
+_static_mode = False
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode
+
+
+def enable_static():
+    """Reference static-graph mode toggle. Static execution here IS jit
+    tracing (SURVEY.md §7: PIR/executors absorbed by XLA) — the switch only
+    flips ``in_dynamic_mode`` for compatibility checks."""
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def disable_signal_handler():
+    """No-op: no native signal handlers are installed (reference installs
+    C++ fault handlers in libpaddle.so)."""
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    import numpy as _np
+
+    kwargs = {}
+    if precision is not None:
+        kwargs["precision"] = precision
+    if threshold is not None:
+        kwargs["threshold"] = threshold
+    if edgeitems is not None:
+        kwargs["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kwargs["linewidth"] = linewidth
+    if sci_mode is not None:
+        kwargs["suppress"] = not sci_mode
+    _np.set_printoptions(**kwargs)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Standalone Parameter factory (reference paddle.create_parameter);
+    honors ParamAttr's initializer/trainable/name/learning_rate like
+    Layer.create_parameter."""
+    from .nn import initializer as _I
+
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = (attr.initializer or default_initializer
+            or (_I.Constant(0.0) if is_bias else _I.XavierNormal()))
+    p = Parameter(init(tuple(shape), dtype=dtype),
+                  name=attr.name or name, trainable=attr.trainable)
+    if attr.learning_rate != 1.0:
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+    p.need_clip = attr.need_clip
+    return p
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader combinator (reference paddle.batch)."""
+
+    def gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return gen
+
+
+def get_cuda_rng_state():
+    """CUDA-free build: no per-GPU generator states (TPU RNG is the
+    stateless threefry root — see get_rng_state)."""
+    return []
+
+
+def set_cuda_rng_state(state):
+    if state:
+        raise RuntimeError("this build has no CUDA generators")
+
+
+__all__ += [  # noqa: F405
+    "ParamAttr", "DataParallel", "set_grad_enabled", "bool", "iinfo",
+    "finfo", "in_dynamic_mode", "enable_static", "disable_static",
+    "disable_signal_handler", "set_printoptions", "create_parameter",
+    "batch", "get_cuda_rng_state", "set_cuda_rng_state",
+    "EnforceNotMet", "InvalidArgumentError", "CUDAPlace", "CUDAPinnedPlace",
+    "addmm_", "check_shape",
+]
+
+
+def check_shape(shape):
+    """Reference paddle.check_shape: validate a shape argument."""
+    from .core.enforce import InvalidArgumentError as _E
+
+    if isinstance(shape, Tensor):
+        return
+    for d in list(shape):
+        if isinstance(d, Tensor):
+            continue
+        if int(d) < -1:
+            raise _E(f"invalid dimension {d} in shape {list(shape)}")
+
+
+def addmm_(input, x, y, beta=1.0, alpha=1.0):
+    out = addmm(input, x, y, beta=beta, alpha=alpha)  # noqa: F405
+    input._value = out._value
+    return input
+
+
+Tensor.addmm_ = addmm_

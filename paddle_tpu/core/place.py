@@ -123,3 +123,21 @@ def device_count() -> int:
 
 def is_compiled_with_tpu() -> bool:
     return any(d.platform != "cpu" for d in jax.devices())
+
+
+class CUDAPlace:
+    """Reference-compat stub: this is a TPU-native build with no CUDA
+    backend (reference CUDAPlace maps to phi::GPUPlace). Constructing one
+    raises with guidance rather than failing later inside a kernel."""
+
+    def __init__(self, device_id=0):
+        raise RuntimeError(
+            "CUDAPlace is unavailable: paddle_tpu is a TPU-native build "
+            "(use TPUPlace()/CPUPlace(), or set_device('tpu'/'cpu'))")
+
+
+class CUDAPinnedPlace:
+    def __init__(self):
+        raise RuntimeError(
+            "CUDAPinnedPlace is unavailable: paddle_tpu is a TPU-native "
+            "build; host staging is managed by PJRT")

@@ -56,6 +56,9 @@ import threading as _threading
 class _TraceState(_threading.local):
     def __init__(self):
         self.flag = False
+        # ids of buffer Tensors a functional wrapper swapped in and will
+        # capture+restore — tracer writes to these are safe mid-trace
+        self.managed_buffers = frozenset()
 
 
 _trace_state = _TraceState()

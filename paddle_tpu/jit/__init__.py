@@ -90,14 +90,21 @@ class _FunctionalModel:
                 out = self.fn(*_as_tensor_tree(args), **_as_tensor_tree(kwargs))
             return _as_array_tree(out), {}
         saved_p = {k: p._value for k, p in layer.named_parameters()}
-        saved_b = {k: b._value for k, b in layer.named_buffers()}
+        buffer_objs = dict(layer.named_buffers())
+        saved_b = {k: b._value for k, b in buffer_objs.items()}
+        saved_managed = _random._trace_state.managed_buffers
         try:
             layer.load_raw_state(params, buffers)
+            # these buffers are captured below and restored in finally, so
+            # forward-state writes (BN running stats) may hold tracers
+            _random._trace_state.managed_buffers = saved_managed | {
+                id(b) for b in buffer_objs.values()}
             with _traced_rng(jax.random.wrap_key_data(rng_key)):
                 out = layer(*_as_tensor_tree(args), **_as_tensor_tree(kwargs))
             new_buffers = {k: b._value for k, b in layer.named_buffers()}
             return _as_array_tree(out), new_buffers
         finally:
+            _random._trace_state.managed_buffers = saved_managed
             layer.load_raw_state(saved_p, saved_b)
 
 

@@ -19,13 +19,18 @@ from .layer_base import Layer
 
 def _trace_safe_state_write(buf, new_value):
     """Write forward-updated state (BN running stats, spectral-norm u/v)
-    into a live buffer UNLESS that would leak a tracer into eager state:
-    under Layer-mode to_static the functional wrapper swaps buffers to
-    traced arrays first (so the write is captured and restored), but a
-    plain-function trace reaches this layer unswapped — there the update
-    is dropped for that traced call instead of poisoning the module."""
+    into a live buffer UNLESS that would leak a tracer into eager state.
+    Safe cases: the buffer already holds a tracer (the functional wrapper
+    swapped traced arrays in), or the wrapper registered it as managed
+    (it will capture new values and restore the original — the ZBH1/
+    per-stage vjp route passes concrete buffers but still restores). A
+    plain-function trace reaching an unmanaged layer drops the update for
+    that traced call instead of poisoning the module."""
+    from ..core.random import _trace_state
+
     nv = new_value._value if isinstance(new_value, Tensor) else new_value
-    if _is_tracer(nv) and not _is_tracer(buf._value):
+    if (_is_tracer(nv) and not _is_tracer(buf._value)
+            and id(buf) not in _trace_state.managed_buffers):
         return
     buf._value = nv
 

@@ -282,3 +282,175 @@ Tensor.masked_fill_ = lambda self, mask, value: self.set_value(
 Tensor.flatten_ = lambda self, start_axis=0, stop_axis=-1: self.set_value(
     apply_op(OPS["flatten"], self, start_axis=start_axis,
              stop_axis=stop_axis)._value) or self
+
+
+# -------------------- in-place variants (reference *_ surface) --------------
+# The reference exposes ~80 trailing-underscore in-place ops
+# (python/paddle/tensor/*, e.g. abs_/tanh_/tril_). jax arrays are immutable,
+# so "in-place" here is value rebinding on the Tensor box — backward rules
+# hold snapshots, which the in-place safety test pins down
+# (tests/test_autograd.py::test_inplace_mutation_cannot_stale_gradients).
+
+_INPLACE_BASES = [
+    "abs", "acos", "asin", "atan", "ceil", "clip", "cos", "cumsum",
+    "cumprod", "cast", "copysign", "digamma", "divide", "equal", "erf",
+    "expm1", "exp", "flatten", "floor", "floor_divide", "frac", "gammainc",
+    "gammaincc", "gammaln", "gcd", "greater_equal", "greater_than",
+    "hypot", "i0", "index_add", "index_fill", "index_put", "lcm", "ldexp",
+    "less_equal", "less_than", "lgamma", "log", "log10", "log1p", "log2",
+    "logical_and", "logical_not", "logical_or", "logical_xor", "logit",
+    "masked_fill", "masked_scatter", "multigammaln", "multiply",
+    "nan_to_num", "neg", "polygamma", "pow", "reciprocal", "remainder",
+    "renorm", "reshape", "round", "rsqrt", "scatter", "sigmoid", "sign",
+    "sin", "sinc", "sinh", "sqrt", "square", "squeeze", "subtract", "t",
+    "tan", "tanh", "transpose", "tril", "triu", "trunc", "unsqueeze",
+    "where", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_left_shift", "bitwise_right_shift",
+]
+
+
+def _make_inplace(base_fn, name):
+    def method(self, *args, **kwargs):
+        out = base_fn(self, *args, **kwargs)
+        self._value = out._value
+        return self
+
+    method.__name__ = name
+    method.__qualname__ = f"Tensor.{name}"
+    return method
+
+
+for _name in _INPLACE_BASES:
+    fn = _this.get(_name)
+    if fn is None:
+        continue
+    _iname = _name + "_"
+    _m = _make_inplace(fn, _iname)
+    if not hasattr(Tensor, _iname):
+        setattr(Tensor, _iname, _m)
+    if _iname not in _this:
+        _this[_iname] = (lambda x, *a, _mm=_m, **k: _mm(x, *a, **k))
+        __all__.append(_iname)
+
+
+def _fill_random(self, sampler):
+    self._value = sampler(self._value.shape).astype(self._value.dtype)
+    return self
+
+
+def normal_(self, mean=0.0, std=1.0):
+    import jax
+
+    from ..core import random as _r
+
+    return _fill_random(self, lambda s: mean + std * jax.random.normal(
+        _r.next_key(), s))
+
+
+def uniform_(self, min=-1.0, max=1.0):
+    import jax
+
+    from ..core import random as _r
+
+    return _fill_random(self, lambda s: jax.random.uniform(
+        _r.next_key(), s, minval=min, maxval=max))
+
+
+def bernoulli_(self, p=0.5):
+    import jax
+
+    from ..core import random as _r
+
+    return _fill_random(self, lambda s: jax.random.bernoulli(
+        _r.next_key(), p, s).astype(jnp.float32))
+
+
+def log_normal_(self, mean=1.0, std=2.0):
+    import jax
+
+    from ..core import random as _r
+
+    return _fill_random(self, lambda s: jnp.exp(
+        mean + std * jax.random.normal(_r.next_key(), s)))
+
+
+def cauchy_(self, loc=0.0, scale=1.0):
+    import jax
+
+    from ..core import random as _r
+
+    return _fill_random(self, lambda s: loc + scale * jax.random.cauchy(
+        _r.next_key(), s))
+
+
+def geometric_(self, probs):
+    import jax
+
+    from ..core import random as _r
+
+    def _sample(s):
+        u = jax.random.uniform(_r.next_key(), s)
+        return jnp.floor(jnp.log(u) / jnp.log1p(-probs)) + 1
+
+    return _fill_random(self, _sample)
+
+
+for _rname in ("normal_", "uniform_", "bernoulli_", "log_normal_",
+               "cauchy_", "geometric_"):
+    if not hasattr(Tensor, _rname):
+        setattr(Tensor, _rname, _this[_rname])
+    if _rname not in __all__:
+        __all__.append(_rname)
+
+# reference aliases
+mod = _this["remainder"]
+floor_mod = _this["remainder"]
+mod_ = _this["remainder_"]
+floor_mod_ = _this["remainder_"]
+reverse = _this["flip"]
+Tensor.mod = mod
+Tensor.floor_mod = floor_mod
+__all__ += ["mod", "floor_mod", "mod_", "floor_mod_", "reverse"]
+
+
+def view(x, shape_or_dtype, name=None):
+    """Zero-copy view (reference paddle.view): reshape, or dtype
+    reinterpretation via bitcast when given a dtype."""
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return _this["reshape"](x, shape_or_dtype)
+    import jax as _jax
+
+    from ..core.dtype import to_jax_dtype
+
+    target = jnp.dtype(to_jax_dtype(shape_or_dtype))
+    src = x._value
+    fs, ts = src.dtype.itemsize, target.itemsize
+    if ts == fs:
+        out = _jax.lax.bitcast_convert_type(src, target)
+    elif ts < fs:
+        # widening-to-narrow: jax appends a ratio dim; merge it into the
+        # last axis (reference view keeps rank, scaling the last dim)
+        out = _jax.lax.bitcast_convert_type(src, target)
+        out = out.reshape(src.shape[:-1] + (src.shape[-1] * (fs // ts),))
+    else:
+        ratio = ts // fs
+        if src.shape[-1] % ratio:
+            raise ValueError(
+                f"view: last dim {src.shape[-1]} not divisible by {ratio}")
+        out = _jax.lax.bitcast_convert_type(
+            src.reshape(src.shape[:-1] + (src.shape[-1] // ratio, ratio)),
+            target)
+    return Tensor._from_value(out)
+
+
+def view_as(x, other, name=None):
+    return _this["reshape"](x, list(other.shape))
+
+
+def tolist(x):
+    return x.tolist()
+
+
+Tensor.view = view
+Tensor.view_as = view_as
+__all__ += ["view", "view_as", "tolist"]

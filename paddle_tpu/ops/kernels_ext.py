@@ -770,3 +770,60 @@ def top_p_sampling(x, ps, threshold=None, seed=None, *, rng_key=None):
     probs_out = jnp.take_along_axis(
         jax.nn.softmax(masked, -1), ids[..., None], axis=-1)
     return probs_out, ids[..., None].astype(jnp.int64)
+
+
+def bitwise_left_shift(x, y, is_arithmetic=True):
+    return jnp.left_shift(x, y)
+
+
+_UNSIGNED = {jnp.dtype(jnp.int8): jnp.uint8, jnp.dtype(jnp.int16): jnp.uint16,
+             jnp.dtype(jnp.int32): jnp.uint32, jnp.dtype(jnp.int64): jnp.uint64}
+
+
+def bitwise_right_shift(x, y, is_arithmetic=True):
+    # arithmetic shift preserves sign (numpy right_shift on signed ints);
+    # logical shift operates on the unsigned reinterpretation
+    if is_arithmetic:
+        return jnp.right_shift(x, y)
+    ut = _UNSIGNED.get(jnp.dtype(x.dtype))
+    if ut is None:
+        return jnp.right_shift(x, y)  # already unsigned
+    ux = jax.lax.bitcast_convert_type(x, ut)
+    return jax.lax.bitcast_convert_type(
+        jnp.right_shift(ux, y.astype(ut)), x.dtype)
+
+
+def pdist(x, p=2.0):
+    """Condensed pairwise distance over rows (reference paddle.pdist).
+    The triu slice happens BEFORE the root so the zero diagonal never
+    enters sqrt (whose gradient there is NaN)."""
+    n = x.shape[0]
+    iu = jnp.triu_indices(n, k=1)
+    diff = x[iu[0]] - x[iu[1]]
+    if p == 2.0:
+        return jnp.sqrt(jnp.sum(diff * diff, -1))
+    return jnp.sum(jnp.abs(diff) ** p, -1) ** (1.0 / p)
+
+
+def reduce_as(x, target):
+    """Sum-reduce x to target's shape (reference paddle.reduce_as)."""
+    t_shape = target.shape
+    extra = x.ndim - len(t_shape)
+    if extra > 0:
+        x = jnp.sum(x, axis=tuple(range(extra)))
+    axes = tuple(i for i, (a, b) in enumerate(zip(x.shape, t_shape))
+                 if a != b and b == 1)
+    if axes:
+        x = jnp.sum(x, axis=axes, keepdims=True)
+    if tuple(x.shape) != tuple(t_shape):
+        from ..core.enforce import InvalidArgumentError
+
+        raise InvalidArgumentError(
+            f"reduce_as: input shape cannot reduce to target shape "
+            f"{tuple(t_shape)} (got {tuple(x.shape)})")
+    return x
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0):
+    range_ = None if (min == 0 and max == 0) else (min, max)
+    return jnp.histogram_bin_edges(x, bins=bins, range=range_)

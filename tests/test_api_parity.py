@@ -1,0 +1,100 @@
+"""Top-level API parity: every name in the reference's paddle.__all__
+exists on paddle_tpu (the audit that drove the round-2 compat tranche),
+plus behavior checks for the in-place variants and compat helpers."""
+import ast
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _reference_all():
+    src = open("/root/reference/python/paddle/__init__.py").read()
+    tree = ast.parse(src)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if getattr(t, "id", None) == "__all__":
+                    return [ast.literal_eval(e) for e in node.value.elts]
+    raise AssertionError("reference __all__ not found")
+
+
+def test_every_reference_name_exists():
+    missing = [n for n in _reference_all() if not hasattr(paddle, n)]
+    assert missing == [], f"missing top-level names: {missing}"
+
+
+def test_inplace_variants_rebind_value():
+    x = paddle.to_tensor(np.float32([1.0, 4.0]))
+    out = x.sqrt_()
+    assert out is x
+    np.testing.assert_allclose(np.asarray(x._value), [1.0, 2.0])
+    x.tanh_()
+    x.clip_(0.0, 0.5)
+    assert float(x.max()) <= 0.5
+    y = paddle.to_tensor(np.float32([-2.0]))
+    paddle.abs_(y)  # functional form
+    np.testing.assert_allclose(np.asarray(y._value), [2.0])
+
+
+def test_random_inplace_fill():
+    paddle.seed(7)
+    z = paddle.zeros([2000])
+    z.normal_(3.0, 0.5)
+    assert abs(float(z.mean()) - 3.0) < 0.1
+    z.uniform_(0.0, 1.0)
+    assert 0.0 <= float(z.min()) and float(z.max()) <= 1.0
+    z.bernoulli_(0.25)
+    assert abs(float(z.mean()) - 0.25) < 0.05
+    draws1 = np.asarray(z._value).copy()
+    z.bernoulli_(0.25)
+    assert not np.array_equal(draws1, np.asarray(z._value))
+
+
+def test_compat_helpers():
+    assert paddle.iinfo("int16").max == 32767
+    assert paddle.finfo(paddle.float32).bits == 32
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+    try:
+        assert not paddle.in_dynamic_mode()
+    finally:
+        paddle.disable_static()
+    with pytest.raises(RuntimeError, match="TPU-native"):
+        paddle.CUDAPlace(0)
+    assert paddle.get_cuda_rng_state() == []
+    batches = [len(b) for b in paddle.batch(lambda: iter(range(7)), 3)()]
+    assert batches == [3, 3, 1]
+    assert [len(b) for b in paddle.batch(
+        lambda: iter(range(7)), 3, drop_last=True)()] == [3, 3]
+    # view: reshape form and bitcast form
+    v = paddle.view(paddle.ones([2, 2]), [4])
+    assert v.shape == [4]
+    assert paddle.view(paddle.ones([2, 2]), "int32").dtype == paddle.int32
+    # mod/floor_mod aliases
+    np.testing.assert_allclose(
+        float(paddle.mod(paddle.to_tensor(np.float32([7.0])),
+                         paddle.to_tensor(np.float32([4.0])))), 3.0)
+    # reverse == flip
+    np.testing.assert_allclose(
+        np.asarray(paddle.reverse(
+            paddle.to_tensor(np.float32([1, 2, 3])), axis=0)._value),
+        [3, 2, 1])
+
+
+def test_new_ops():
+    x = paddle.to_tensor(np.array([[0.0, 0.0], [3.0, 4.0]], np.float32))
+    np.testing.assert_allclose(np.asarray(paddle.pdist(x)._value), [5.0])
+    big = paddle.ones([4, 3, 2])
+    t = paddle.ones([3, 1])
+    out = paddle.reduce_as(big, t)
+    assert out.shape == [3, 1]
+    np.testing.assert_allclose(np.asarray(out._value), 8.0)
+    shifted = paddle.bitwise_left_shift(
+        paddle.to_tensor(np.array([1, 2], np.int32)),
+        paddle.to_tensor(np.array([3, 1], np.int32)))
+    np.testing.assert_array_equal(np.asarray(shifted._value), [8, 4])
+    edges = paddle.histogram_bin_edges(
+        paddle.to_tensor(np.arange(10.0, dtype=np.float32)), bins=5)
+    assert edges.shape == [6]

@@ -521,6 +521,23 @@ case("imag", lambda: ((T(P((3,)).astype(np.complex64)),), {}), np.imag,
      grad=False)
 case("copysign", lambda: ((T(P((3,))), T(P((3,)))), {}), np.copysign,
      grad=False)
+case("bitwise_left_shift",
+     lambda: ((T(np.array([1, 2, 4], np.int32)),
+               T(np.array([2, 1, 0], np.int32))), {}),
+     lambda x, y: np.left_shift(x, y), grad=False)
+case("bitwise_right_shift",
+     lambda: ((T(np.array([8, 4, 2], np.int32)),
+               T(np.array([2, 1, 0], np.int32))), {}),
+     lambda x, y: np.right_shift(x, y), grad=False)
+case("pdist", lambda: ((T(P((4, 3))),), {}),
+     lambda x: np.sqrt(((x[:, None, :] - x[None, :, :]) ** 2).sum(-1))[
+         np.triu_indices(x.shape[0], k=1)])
+case("reduce_as", lambda: ((T(P((4, 3, 2))), T(P((3, 1)))), {}),
+     lambda x, t: x.sum(0).sum(-1, keepdims=True))
+case("histogram_bin_edges",
+     lambda: ((T(P((20,), 0.0, 1.0)),), {"bins": 4, "min": 0.0, "max": 1.0}),
+     lambda x: np.histogram_bin_edges(x, bins=4, range=(0.0, 1.0)),
+     grad=False)
 case("deg2rad", lambda: ((T(P((3,)) * 180),), {}), np.deg2rad)
 case("rad2deg", lambda: ((T(P((3,))),), {}), np.rad2deg)
 case("digamma", lambda: ((T(PP((3,)) + 1),), {}), None)
