@@ -276,22 +276,15 @@ def set_printoptions(precision=None, threshold=None, edgeitems=None,
 
 def create_parameter(shape, dtype="float32", name=None, attr=None,
                      is_bias=False, default_initializer=None):
-    """Standalone Parameter factory (reference paddle.create_parameter);
-    honors ParamAttr's initializer/trainable/name/learning_rate like
-    Layer.create_parameter."""
-    from .nn import initializer as _I
+    """Standalone Parameter factory (reference paddle.create_parameter):
+    delegates to Layer.create_parameter so ParamAttr semantics
+    (initializer/trainable/regularizer/lr/lazy mode) stay in one place."""
+    from .nn import Layer as _Layer
 
-    attr = ParamAttr._to_attr(attr)
-    if attr is False:
-        return None
-    init = (attr.initializer or default_initializer
-            or (_I.Constant(0.0) if is_bias else _I.XavierNormal()))
-    p = Parameter(init(tuple(shape), dtype=dtype),
-                  name=attr.name or name, trainable=attr.trainable)
-    if attr.learning_rate != 1.0:
-        p.optimize_attr = {"learning_rate": attr.learning_rate}
-    p.need_clip = attr.need_clip
-    return p
+    holder = _Layer()
+    return holder.create_parameter(tuple(shape), attr=attr, dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
 
 
 def batch(reader, batch_size, drop_last=False):
@@ -332,16 +325,19 @@ __all__ += [  # noqa: F405
 
 
 def check_shape(shape):
-    """Reference paddle.check_shape: validate a shape argument."""
-    from .core.enforce import InvalidArgumentError as _E
-
+    """Reference paddle.check_shape (utils/layers_utils.py:474): every
+    element must be a positive int (or a Tensor dim)."""
     if isinstance(shape, Tensor):
         return
     for d in list(shape):
         if isinstance(d, Tensor):
             continue
-        if int(d) < -1:
-            raise _E(f"invalid dimension {d} in shape {list(shape)}")
+        if not isinstance(d, int):
+            raise TypeError(
+                f"shape elements must be int or Tensor, got {type(d)}")
+        if d < 0:
+            raise ValueError(
+                f"All elements in shape must be positive, got {d}")
 
 
 def addmm_(input, x, y, beta=1.0, alpha=1.0):

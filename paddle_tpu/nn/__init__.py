@@ -21,6 +21,7 @@ from .layers_attention import (  # noqa: F401
 )
 from .layers_common import *  # noqa: F401,F403
 from .layers_extra import *  # noqa: F401,F403
+from .layers_seq import *  # noqa: F401,F403
 from .layers_conv import *  # noqa: F401,F403
 from .layers_norm import *  # noqa: F401,F403
 from .layers_rnn import (  # noqa: F401
@@ -28,6 +29,7 @@ from .layers_rnn import (  # noqa: F401
     GRUCell,
     LSTM,
     LSTMCell,
+    RNNCellBase,
     SimpleRNN,
     SimpleRNNCell,
 )

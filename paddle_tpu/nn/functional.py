@@ -165,4 +165,6 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     return _dense_embedding(x, weight, padding_idx=padding_idx)
 
 
+from .functional_extra import *  # noqa: E402,F401,F403
+
 __all__ = [n for n in dir() if not n.startswith("_")]

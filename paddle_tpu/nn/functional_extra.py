@@ -1,0 +1,889 @@
+"""nn.functional — second tranche: the remaining reference functional
+surface (python/paddle/nn/functional/__init__.py names absent from
+functional.py). Pool/pad/shuffle forms delegate to the corresponding
+layers (layers_extra.py), losses and attention helpers are implemented
+here over jnp.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import random as _random
+from ..core.tensor import Tensor
+
+__all__ = [
+    "max_pool2d", "max_pool1d_with_mask",
+    "conv1d_transpose", "conv3d_transpose", "pairwise_distance",
+    "elu_", "hardtanh_", "leaky_relu_", "tanh_", "thresholded_relu",
+    "thresholded_relu_", "dropout2d", "dropout3d", "feature_alpha_dropout",
+    "zeropad2d", "upsample", "bilinear", "avg_pool3d", "lp_pool1d",
+    "lp_pool2d", "max_pool3d", "max_unpool1d", "max_unpool2d",
+    "max_unpool3d", "adaptive_avg_pool1d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool3d", "fractional_max_pool2d",
+    "fractional_max_pool3d", "dice_loss", "hsigmoid_loss", "log_loss",
+    "margin_ranking_loss", "multi_label_soft_margin_loss",
+    "poisson_nll_loss", "npair_loss", "sigmoid_focal_loss",
+    "margin_cross_entropy", "square_error_cost", "ctc_loss", "rnnt_loss",
+    "pixel_unshuffle", "channel_shuffle", "gather_tree", "temporal_shift",
+    "class_center_sample", "sparse_attention", "fold",
+    "cosine_embedding_loss", "rrelu", "triplet_margin_with_distance_loss",
+    "triplet_margin_loss", "adaptive_log_softmax_with_loss",
+    "multi_margin_loss", "soft_margin_loss", "gaussian_nll_loss",
+    "flashmask_attention", "flash_attn_qkvpacked",
+    "flash_attn_varlen_qkvpacked",
+]
+
+
+def _v(x):
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _t(v):
+    return Tensor._from_value(v)
+
+
+def _dispatch(fn, *tensors, **attrs):
+    from .layers_extra import _dispatch as _d
+
+    return _d(fn, *tensors, **attrs)
+
+
+# ------------------------------------------------------- layer delegations
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL"):
+    from jax import lax
+
+    from .layers_extra import _dispatch
+
+    stride_t = (stride,) if isinstance(stride, int) else tuple(stride)
+    k = weight.shape[2]
+    p = padding if isinstance(padding, int) else padding[0]
+
+    def fn(v, w, b):
+        out = lax.conv_transpose(
+            v, jnp.transpose(w, (2, 1, 0)),
+            strides=stride_t, padding=[(k - 1 - p, k - 1 - p)],
+            dimension_numbers=("NCH", "HIO", "NCH"),
+            transpose_kernel=True)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1)
+        return out
+
+    return _dispatch(fn, x, weight, bias)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW"):
+    from jax import lax
+
+    from .layers_extra import _dispatch
+
+    stride_t = ((stride,) * 3 if isinstance(stride, int) else tuple(stride))
+    ks = weight.shape[2:]
+    ps = ((padding,) * 3 if isinstance(padding, int) else tuple(padding))
+    pads = [(k - 1 - p, k - 1 - p) for k, p in zip(ks, ps)]
+
+    def fn(v, w, b):
+        out = lax.conv_transpose(
+            v, jnp.transpose(w, (2, 3, 4, 1, 0)),
+            strides=stride_t, padding=pads,
+            dimension_numbers=("NCDHW", "DHWIO", "NCDHW"),
+            transpose_kernel=True)
+        if b is not None:
+            out = out + b.reshape(1, -1, 1, 1, 1)
+        return out
+
+    return _dispatch(fn, x, weight, bias)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False):
+    def fn(a, b):
+        d = a - b + epsilon
+        out = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+        return out[..., None] if keepdim else out
+
+    return _dispatch(fn, x, y)
+
+
+# --------------------------------------------------------- activations
+
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return _dispatch(lambda v: jnp.where(v > threshold, v, value), x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True):
+    if training:
+        key = _random.next_key()
+        slope = jax.random.uniform(key, _v(x).shape, minval=lower,
+                                   maxval=upper)
+    else:
+        slope = (lower + upper) / 2.0
+    return _dispatch(lambda v: jnp.where(v >= 0, v, slope * v), x)
+
+
+def _inplace(fn):
+    def inner(x, *args, **kwargs):
+        out = fn(x, *args, **kwargs)
+        x._value = out._value
+        return x
+
+    return inner
+
+
+def _elu(x, alpha=1.0):
+    from . import functional as F
+
+    return F.elu(x, alpha)
+
+
+def _hardtanh(x, min=-1.0, max=1.0):
+    from . import functional as F
+
+    return F.hardtanh(x, min, max)
+
+
+def _leaky_relu(x, negative_slope=0.01):
+    from . import functional as F
+
+    return F.leaky_relu(x, negative_slope)
+
+
+def _tanh(x):
+    from . import functional as F
+
+    return F.tanh(x)
+
+
+elu_ = _inplace(_elu)
+hardtanh_ = _inplace(_hardtanh)
+leaky_relu_ = _inplace(_leaky_relu)
+tanh_ = _inplace(_tanh)
+thresholded_relu_ = _inplace(thresholded_relu)
+
+
+# ------------------------------------------------------------ dropout/pad
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW"):
+    from . import functional as F
+
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return F.dropout(x, p=p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW"):
+    from . import functional as F
+
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return F.dropout(x, p=p, axis=axis, training=training)
+
+
+def feature_alpha_dropout(x, p=0.5, training=True):
+    from .layers_extra import FeatureAlphaDropout
+
+    layer = FeatureAlphaDropout(p)
+    layer.training = training
+    return layer(x)
+
+
+def zeropad2d(x, padding, data_format="NCHW"):
+    from .layers_extra import ZeroPad2D
+
+    return ZeroPad2D(padding, data_format=data_format)(x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, data_format="NCHW"):
+    from . import functional as F
+
+    return F.interpolate(x, size=size, scale_factor=scale_factor, mode=mode,
+                         align_corners=align_corners,
+                         data_format=data_format)
+
+
+def bilinear(x1, x2, weight, bias=None):
+    def fn(a, b, w, bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        return out if bb is None else out + bb
+
+    return _dispatch(fn, x1, x2, weight, bias)
+
+
+# --------------------------------------------------------------- pooling
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, data_format="NCDHW"):
+    from .layers_extra import AvgPool3D
+
+    return AvgPool3D(kernel_size, stride, padding)(x)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW"):
+    """max_pool2d with the reference's return_mask form (argmax indices
+    for the unpool round-trip); plain calls go straight to the op."""
+    from ..ops import max_pool2d as _op
+
+    out = _op(x, kernel_size, stride=stride, padding=padding,
+              ceil_mode=ceil_mode, data_format=data_format)
+    if return_mask:
+        return out, _max_pool_indices(x, out, kernel_size, stride, padding,
+                                      ndim=2)
+    return out
+
+
+def max_pool1d_with_mask(x, kernel_size, stride=None, padding=0):
+    from ..ops import max_pool1d as _op
+
+    out = _op(x, kernel_size, stride=stride, padding=padding)
+    return out, _max_pool_indices(x, out, kernel_size, stride, padding,
+                                  ndim=1)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW"):
+    from .layers_extra import MaxPool3D
+
+    out = MaxPool3D(kernel_size, stride, padding)(x)
+    if return_mask:
+        return out, _max_pool_indices(x, out, kernel_size, stride, padding,
+                                      ndim=3)
+    return out
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL"):
+    from .layers_extra import LPPool1D
+
+    return LPPool1D(norm_type, kernel_size, stride, padding)(x)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW"):
+    from .layers_extra import LPPool2D
+
+    return LPPool2D(norm_type, kernel_size, stride, padding)(x)
+
+
+def adaptive_avg_pool1d(x, output_size):
+    from .layers_extra import AdaptiveAvgPool1D
+
+    return AdaptiveAvgPool1D(output_size)(x)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW"):
+    from .layers_extra import AdaptiveAvgPool3D
+
+    return AdaptiveAvgPool3D(output_size)(x)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False):
+    from .layers_extra import AdaptiveMaxPool1D
+
+    return AdaptiveMaxPool1D(output_size)(x)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False):
+    from .layers_extra import AdaptiveMaxPool3D
+
+    return AdaptiveMaxPool3D(output_size)(x)
+
+
+def _pool_regions(in_size, out_size, random_u):
+    """Fractional-pooling region boundaries (Graham 2014, the reference's
+    fractional_max_pool*): pseudo-random sequence from one uniform draw."""
+    alpha = in_size / out_size
+    import numpy as np
+
+    u = random_u if random_u is not None else float(np.random.uniform())
+    idx = np.ceil(alpha * (np.arange(out_size) + u)).astype(int) - \
+        int(np.ceil(alpha * u) - 1) - 1
+    starts = np.clip(idx, 0, in_size - 1)
+    ends = np.concatenate([starts[1:], [in_size]])
+    ends = np.maximum(ends, starts + 1)
+    return starts, ends
+
+
+def _fractional_pool(x, output_size, random_u, spatial_ndim):
+    spatial = _v(x).shape[-spatial_ndim:]
+    ndim = _v(x).ndim
+    if isinstance(output_size, int):
+        output_size = (output_size,) * spatial_ndim
+    regions = [
+        _pool_regions(in_s, out_s, random_u)
+        for in_s, out_s in zip(spatial, output_size)
+    ]
+
+    def fn(v):
+        slabs = v
+        for d, (starts, ends) in enumerate(regions):
+            axis = ndim - spatial_ndim + d
+            pieces = [
+                jnp.max(jnp.take(slabs, jnp.arange(s, e), axis=axis),
+                        axis=axis, keepdims=True)
+                for s, e in zip(starts, ends)
+            ]
+            slabs = jnp.concatenate(pieces, axis=axis)
+        return slabs
+
+    return _dispatch(fn, x)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False):
+    return _fractional_pool(x, output_size, random_u, 2)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False):
+    return _fractional_pool(x, output_size, random_u, 3)
+
+
+def _max_pool_indices(x, out, kernel_size, stride, padding, ndim):
+    # flat indices of each maximum within the input spatial volume
+    v, o = _v(x), _v(out)
+    # nearest-match scan: for parity APIs only (reference returns argmax ids)
+    flat_sp = 1
+    for s in v.shape[-ndim:]:
+        flat_sp *= s
+    vf = v.reshape(v.shape[:-ndim] + (flat_sp,))
+    idx = jnp.argmax(
+        (vf[..., None, :] == o.reshape(o.shape[:-ndim] + (1, -1,))
+         .swapaxes(-1, -2)).astype(jnp.int32), axis=-1)
+    return _t(idx.reshape(o.shape).astype(jnp.int64))
+
+
+def _unpool(x, indices, spatial_out, ndim):
+    ind = _v(indices)
+    lead = _v(x).shape[:-ndim]
+    flat_out = 1
+    for s in spatial_out:
+        flat_out *= s
+    flat_lead = 1
+    for s in lead:
+        flat_lead *= s
+    inf = ind.reshape(flat_lead, -1).astype(jnp.int32)
+
+    def fn(v):
+        vf = v.reshape(flat_lead, -1)
+        out = jnp.zeros((flat_lead, flat_out), v.dtype)
+        out2 = jax.vmap(lambda o, i, val: o.at[i].set(val))(out, inf, vf)
+        return out2.reshape(lead + tuple(spatial_out))
+
+    return _dispatch(fn, x)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL"):
+    stride = stride or kernel_size
+    L = (output_size[-1] if output_size
+         else (x.shape[-1] - 1) * stride + kernel_size - 2 * padding)
+    return _unpool(x, indices, (L,), 1)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW"):
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if output_size:
+        hw = tuple(output_size)[-2:]
+    else:
+        hw = tuple((x.shape[-2 + i] - 1) * stride[i] + kernel_size[i]
+                   - 2 * padding for i in range(2))
+    return _unpool(x, indices, hw, 2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW"):
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size,) * 3
+    stride = stride or kernel_size
+    if isinstance(stride, int):
+        stride = (stride,) * 3
+    if output_size:
+        dhw = tuple(output_size)[-3:]
+    else:
+        dhw = tuple((x.shape[-3 + i] - 1) * stride[i] + kernel_size[i]
+                    - 2 * padding for i in range(3))
+    return _unpool(x, indices, dhw, 3)
+
+
+# ----------------------------------------------------------------- losses
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def square_error_cost(input, label):
+    return _dispatch(lambda a, b: (a - b) * (a - b), input, label)
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return _dispatch(
+        lambda p, y: -y * jnp.log(p + epsilon)
+        - (1 - y) * jnp.log1p(epsilon - p), input, label)
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    def fn(p, lab):
+        y = jax.nn.one_hot(lab[..., 0], p.shape[-1], dtype=p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y, axis=reduce_dims)
+        union = jnp.sum(p, axis=reduce_dims) + jnp.sum(y, axis=reduce_dims)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return _dispatch(fn, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean"):
+    return _dispatch(
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin),
+                                reduction), input, other, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean"):
+    def fn(x, y, w):
+        loss = -(y * jax.nn.log_sigmoid(x)
+                 + (1 - y) * jax.nn.log_sigmoid(-x))
+        if w is not None:
+            loss = loss * w
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+
+    return _dispatch(fn, input, label, weight)
+
+
+def soft_margin_loss(input, label, reduction="mean"):
+    return _dispatch(
+        lambda x, y: _reduce(jnp.log1p(jnp.exp(-y * x)), reduction),
+        input, label)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean"):
+    def fn(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(
+                2 * jnp.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+
+    return _dispatch(fn, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def fn(a, p, yv):
+        y = yv.reshape(-1)
+        sim = a @ p.T
+        same = (y[:, None] == y[None, :]).astype(a.dtype)
+        same = same / jnp.sum(same, axis=1, keepdims=True)
+        xent = jnp.mean(
+            jnp.sum(-same * jax.nn.log_softmax(sim, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, 1))
+                        + jnp.mean(jnp.sum(p * p, 1))) * 0.25
+        return xent + reg
+
+    return _dispatch(fn, anchor, positive, labels)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum"):
+    def fn(x, y, norm):
+        p = jax.nn.sigmoid(x)
+        ce = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * ((1 - p_t) ** gamma) * ce
+        if norm is not None:
+            loss = loss / norm
+        return _reduce(loss, reduction)
+
+    return _dispatch(fn, logit, label, normalizer)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean"):
+    from .layers_extra import CosineEmbeddingLoss
+
+    return CosineEmbeddingLoss(margin=margin, reduction=reduction)(
+        input1, input2, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean"):
+    from .layers_extra import TripletMarginLoss
+
+    return TripletMarginLoss(margin=margin, p=p, epsilon=epsilon, swap=swap,
+                             reduction=reduction)(input, positive, negative)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean"):
+    from .layers_extra import TripletMarginWithDistanceLoss
+
+    return TripletMarginWithDistanceLoss(
+        distance_function=distance_function, margin=margin, swap=swap,
+        reduction=reduction)(input, positive, negative)
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean"):
+    from .layers_extra import GaussianNLLLoss
+
+    return GaussianNLLLoss(full=full, epsilon=epsilon,
+                           reduction=reduction)(input, label, variance)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean"):
+    from .layers_extra import MultiMarginLoss
+
+    return MultiMarginLoss(p=p, margin=margin, weight=weight,
+                           reduction=reduction)(input, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    from .layers_extra import CTCLoss
+
+    return CTCLoss(blank=blank, reduction=reduction)(
+        log_probs, labels, input_lengths, label_lengths,
+        norm_by_times=norm_by_times)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean"):
+    """RNN-T transducer loss (reference rnnt_loss, warprnnt kernel):
+    log-space forward DP over the (T, U) lattice, vectorized over U with a
+    lax.scan over T."""
+    y = _v(label).astype(jnp.int32)  # (B, U)
+    t_len = _v(input_lengths).astype(jnp.int32)
+    u_len = _v(label_lengths).astype(jnp.int32)
+
+    def fn(logits):
+        return _rnnt_forward(logits, y, t_len, u_len, blank, reduction)
+
+    return _dispatch(fn, input)
+
+
+def _rnnt_forward(logits, y, t_len, u_len, blank, reduction):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    B, T, U1, V = logp.shape
+    U = U1 - 1
+    NEG = -1e30
+
+    blank_lp = logp[..., blank]  # (B, T, U+1)
+    lab_lp = jnp.take_along_axis(
+        logp[:, :, :U, :], y[:, None, :, None].repeat(T, 1), axis=-1
+    )[..., 0]  # (B, T, U) emit prob of label u at (t, u)
+
+    u_idx = jnp.arange(U1)
+
+    def step(alpha_prev, t):
+        # alpha over u for this t: horizontal (blank from t-1,u) then
+        # vertical (emit from t,u-1) via associative scan substitute:
+        horiz = jnp.where(t == 0,
+                          jnp.where(u_idx[None, :] == 0, 0.0, NEG),
+                          alpha_prev + blank_lp[:, jnp.maximum(t - 1, 0), :])
+        # sequential emit along u: alpha[u] = logaddexp(horiz[u],
+        # alpha[u-1] + lab_lp[t, u-1]) — a scan over U (small)
+        def emit(carry, u):
+            a_prev = carry
+            val = jnp.logaddexp(
+                horiz[:, u],
+                jnp.where(u > 0,
+                          a_prev + lab_lp[:, t, jnp.maximum(u - 1, 0)], NEG))
+            return val, val
+
+        _, cols = jax.lax.scan(emit, jnp.full((B,), NEG), u_idx)
+        alpha = cols.T  # (B, U+1)
+        return alpha, alpha
+
+    _, alphas = jax.lax.scan(step, jnp.full((B, U1), NEG), jnp.arange(T))
+    # (T, B, U+1): total = alpha[t_len-1, u_len] + blank at the end
+    alphas = alphas.transpose(1, 0, 2)  # (B, T, U+1)
+    b_idx = jnp.arange(B)
+    final = alphas[b_idx, t_len - 1, u_len] + blank_lp[b_idx, t_len - 1,
+                                                       u_len]
+    loss = -final
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False):
+    """Hierarchical sigmoid over the default complete binary tree
+    (reference hsigmoid_loss): class c's path is the binary expansion of
+    c + num_classes down from the root."""
+    y = _v(label).astype(jnp.int32).reshape(-1)
+    import math as _math
+
+    depth = max(int(_math.ceil(_math.log2(num_classes))), 1)
+
+    def fn(x, w, b):
+        codes = y + num_classes
+        losses = []
+        node = codes
+        for _ in range(depth):
+            bit = node % 2
+            parent = node // 2
+            # internal node ids are 1..num_classes-1 → rows of weight
+            logit = jnp.einsum("bd,bd->b", x,
+                               w[jnp.clip(parent - 1, 0, w.shape[0] - 1)])
+            if b is not None:
+                logit = logit + b.reshape(-1)[
+                    jnp.clip(parent - 1, 0, b.size - 1)]
+            sign = 1.0 - 2.0 * bit.astype(x.dtype)  # bit 0 → +1, bit 1 → -1
+            step_loss = -jax.nn.log_sigmoid(sign * logit)
+            valid = parent >= 1
+            losses.append(jnp.where(valid, step_loss, 0.0))
+            node = parent
+        return jnp.mean(jnp.sum(jnp.stack(losses, -1), -1))
+
+    return _dispatch(fn, input, weight, bias)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-style margin softmax (reference margin_cross_entropy;
+    single-group form — the model-parallel group path shards classes)."""
+    y = _v(label).astype(jnp.int32).reshape(-1)
+
+    def fn(x):
+        theta = jnp.arccos(jnp.clip(x, -1.0, 1.0))
+        onehot = jax.nn.one_hot(y, x.shape[-1], dtype=x.dtype)
+        margin_cos = jnp.cos(margin1 * theta + margin2) - margin3
+        adjusted = jnp.where(onehot > 0, margin_cos, x) * scale
+        logp = jax.nn.log_softmax(adjusted, axis=-1)
+        loss = -jnp.take_along_axis(logp, y[:, None], axis=-1)[:, 0]
+        loss = _reduce(loss, reduction)
+        if return_softmax:
+            return loss, jnp.exp(logp)
+        return loss
+
+    return _dispatch(fn, logits)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample class centers (reference class_center_sample, PartialFC):
+    keep all positive classes plus uniformly sampled negatives; labels are
+    remapped into the sampled index space."""
+    import numpy as np
+
+    y = np.asarray(_v(label)).reshape(-1)
+    pos = np.unique(y)
+    need = max(num_samples - pos.size, 0)
+    rest = np.setdiff1d(np.arange(num_classes), pos)
+    rng = np.random.RandomState(int(_random.next_key()[0]) % (2**31))
+    neg = rng.choice(rest, size=min(need, rest.size), replace=False)
+    sampled = np.sort(np.concatenate([pos, neg]))
+    remap = {c: i for i, c in enumerate(sampled.tolist())}
+    new_y = np.asarray([remap[c] for c in y.tolist()], np.int64)
+    return _t(jnp.asarray(new_y)), _t(jnp.asarray(sampled.astype(np.int64)))
+
+
+# ------------------------------------------------------- misc structure
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW"):
+    from .layers_extra import PixelUnshuffle
+
+    return PixelUnshuffle(downscale_factor, data_format)(x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    from .layers_extra import ChannelShuffle
+
+    return ChannelShuffle(groups, data_format)(x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    from .layers_extra import Fold
+
+    return Fold(output_sizes, kernel_sizes, strides, paddings, dilations)(x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """Shift a channel slice one frame forward/backward within each segment
+    (reference temporal_shift_op: TSM)."""
+    def fn(v):
+        n, c, h, w = v.shape
+        v5 = v.reshape(n // seg_num, seg_num, c, h, w)
+        fold_c = int(c * shift_ratio)
+        back = jnp.concatenate(
+            [v5[:, 1:, :fold_c], jnp.zeros_like(v5[:, :1, :fold_c])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v5[:, :1, fold_c:2 * fold_c]),
+             v5[:, :-1, fold_c:2 * fold_c]], axis=1)
+        keep = v5[:, :, 2 * fold_c:]
+        return jnp.concatenate([back, fwd, keep], axis=2).reshape(n, c, h, w)
+
+    return _dispatch(fn, x)
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference gather_tree): walk parent pointers
+    from the last step to recover full beams. ids/parents: (T, B, W)."""
+    i = _v(ids)
+    p = _v(parents).astype(jnp.int32)
+    T = i.shape[0]
+    W = i.shape[-1]
+
+    def step(carry, t):
+        beam = carry  # (B, W) beam index selected at t+1
+        sel = jnp.take_along_axis(i[t], beam, axis=-1)
+        parent = jnp.take_along_axis(p[t], beam, axis=-1)
+        return parent, sel
+
+    init = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32), i.shape[1:])
+    _, rows = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return _t(rows[::-1])
+
+
+# ------------------------------------------------------------- attention
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None):
+    """Block-CSR sparse attention (reference sparse_attention GPU kernel).
+    Reference semantics via a dense mask built from the CSR pattern — on
+    TPU the masked softmax compiles to the same fused attention XLA
+    emits; the CSR layout is honored, not the GPU kernel's schedule."""
+    q, k, v = _v(query), _v(key), _v(value)
+    offs = _v(sparse_csr_offset).astype(jnp.int32)
+    cols = _v(sparse_csr_columns).astype(jnp.int32)
+    seq = q.shape[-2]
+    # dense allow-mask from the CSR pattern, built host-side (the pattern
+    # is static per call)
+    import numpy as np
+
+    offs_np = np.asarray(offs).reshape(offs.shape[:-1] + (seq + 1,))
+    cols_np = np.asarray(cols)
+    mask = np.zeros(offs.shape[:-1] + (seq, seq), np.bool_)
+    flat_off = offs_np.reshape(-1, seq + 1)
+    flat_cols = cols_np.reshape(flat_off.shape[0], -1)
+    flat_mask = mask.reshape(flat_off.shape[0], seq, seq)
+    for b in range(flat_off.shape[0]):
+        for r in range(seq):
+            cs = flat_cols[b, flat_off[b, r]:flat_off[b, r + 1]]
+            flat_mask[b, r, cs] = True
+    mask = jnp.asarray(flat_mask.reshape(mask.shape))
+    scale = q.shape[-1] ** -0.5
+
+    def fn(qq, kk, vv):
+        scores = jnp.einsum("...qd,...kd->...qk", qq, kk) * scale
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("...qk,...kd->...qd", probs, vv)
+
+    return _dispatch(fn, query, key, value)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, *, training=True):
+    """Packed-QKV flash attention (reference flash_attn_qkvpacked):
+    qkv is (B, S, 3, H, D)."""
+    from . import functional as F
+
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    return F.flash_attention(q, k, v, dropout=dropout, causal=causal,
+                             training=training)
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False, *,
+                                training=True):
+    """Varlen packed flash attention: sequences concatenated along dim 0
+    with cu_seqlens boundaries (reference flash_attn_varlen_qkvpacked).
+    Each segment attends within itself."""
+    from . import functional as F
+
+    import numpy as np
+
+    cu = np.asarray(_v(cu_seqlens_q)).astype(int)
+    outs = []
+    for i in range(len(cu) - 1):
+        seg = qkv[cu[i]:cu[i + 1]]
+        out, _ = flash_attn_qkvpacked(seg.unsqueeze(0), dropout=dropout,
+                                      causal=causal, training=training)
+        outs.append(out.squeeze(0))
+    from ..ops import concat
+
+    return concat(outs, axis=0), None
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False):
+    """FlashMask attention (reference flashmask_attention): the sparse
+    row-interval mask form; intervals become a dense additive mask here."""
+    from . import functional as F
+
+    if startend_row_indices is None:
+        return F.flash_attention(query, key, value, dropout=dropout,
+                                 causal=causal)[0], None
+    q = _v(query)
+    sq = q.shape[1]
+    idx = _v(startend_row_indices).astype(jnp.int32)  # (B, H|1, Sk, 1)
+    start = idx[..., 0]  # (B, H|1, Sk): query rows >= start[k] mask col k
+    rows = jnp.arange(sq)[None, None, :, None]       # (1, 1, Sq, 1)
+    mask = rows >= start[:, :, None, :]              # (B, H|1, Sq, Sk)
+    add_mask = jnp.where(mask, -1e30, 0.0)
+    from ..ops import scaled_dot_product_attention as sdpa
+
+    out = sdpa(query, key, value,
+               attn_mask=_t(add_mask.astype(q.dtype)),
+               is_causal=causal)
+    return out, None
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
+                                   cutoffs, head_bias=None):
+    """Adaptive softmax (reference adaptive_log_softmax_with_loss): head
+    classes + clustered tails with projected representations."""
+    y = _v(label).astype(jnp.int32).reshape(-1)
+    flat_tails = [w for pair in tail_weights for w in pair]
+
+    def fn(x, hw, hb, *tails):
+        head_logits = x @ hw
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_lp = jax.nn.log_softmax(head_logits, axis=-1)
+        n_head = cutoffs[0]
+        out = jnp.zeros(y.shape, x.dtype)
+        in_head = y < n_head
+        head_take = jnp.take_along_axis(
+            head_lp, jnp.clip(y, 0, head_lp.shape[-1] - 1)[:, None],
+            -1)[:, 0]
+        out = jnp.where(in_head, head_take, out)
+        for ci in range(len(cutoffs) - 1):
+            lo, hi = cutoffs[ci], cutoffs[ci + 1]
+            proj, cls_w = tails[2 * ci], tails[2 * ci + 1]
+            h = x @ proj
+            tail_lp = jax.nn.log_softmax(h @ cls_w, axis=-1)
+            cluster_lp = head_lp[:, n_head + ci]
+            rel = jnp.clip(y - lo, 0, tail_lp.shape[-1] - 1)
+            take = jnp.take_along_axis(tail_lp, rel[:, None], -1)[:, 0]
+            sel = (y >= lo) & (y < hi)
+            out = jnp.where(sel, cluster_lp + take, out)
+        return out, -jnp.mean(out)
+
+    return _dispatch(fn, input, head_weight, head_bias, *flat_tails)

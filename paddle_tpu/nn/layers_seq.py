@@ -1,0 +1,231 @@
+"""Sequence-decode + remaining layer tranche.
+
+Analogs of the last reference nn names: MaxUnPool*/FractionalMaxPool*
+(layer forms over functional_extra), RNNTLoss/HSigmoidLoss/
+AdaptiveLogSoftmaxWithLoss, and the seq2seq decode pair
+BeamSearchDecoder + dynamic_decode
+(python/paddle/nn/decode.py — host-driven beam search here; each step's
+cell/attention math runs as XLA ops, the beam bookkeeping is Python).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from . import functional_extra as FX
+from . import initializer as I
+from .layer_base import Layer
+
+__all__ = [
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "FractionalMaxPool2D",
+    "FractionalMaxPool3D", "RNNTLoss", "HSigmoidLoss",
+    "AdaptiveLogSoftmaxWithLoss", "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, data_format="NCL",
+                 output_size=None, name=None):
+        super().__init__()
+        self.kernel_size, self.stride, self.padding = (kernel_size, stride,
+                                                       padding)
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        return FX.max_unpool1d(x, indices, self.kernel_size, self.stride,
+                               self.padding, self.output_size)
+
+
+class MaxUnPool2D(MaxUnPool1D):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__(kernel_size, stride, padding,
+                         output_size=output_size)
+
+    def forward(self, x, indices):
+        return FX.max_unpool2d(x, indices, self.kernel_size, self.stride,
+                               self.padding, self.output_size)
+
+
+class MaxUnPool3D(MaxUnPool1D):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__(kernel_size, stride, padding,
+                         output_size=output_size)
+
+    def forward(self, x, indices):
+        return FX.max_unpool3d(x, indices, self.kernel_size, self.stride,
+                               self.padding, self.output_size)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+
+    def forward(self, x):
+        return FX.fractional_max_pool2d(x, self.output_size,
+                                        random_u=self.random_u)
+
+
+class FractionalMaxPool3D(FractionalMaxPool2D):
+    def forward(self, x):
+        return FX.fractional_max_pool3d(x, self.output_size,
+                                        random_u=self.random_u)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return FX.rnnt_loss(input, label, input_lengths, label_lengths,
+                            blank=self.blank, reduction=self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid classifier head (reference nn.HSigmoidLoss):
+    holds the internal-node weight table for the default complete binary
+    tree."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        bound = 1.0 / math.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = self.create_parameter((num_classes - 1,), attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label):
+        return FX.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                                self.bias)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax (reference nn.AdaptiveLogSoftmaxWithLoss): frequent
+    classes in the head, rare classes in down-projected tail clusters."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs) + [n_classes]
+        self.n_clusters = len(self.cutoffs) - 1
+        head_size = self.cutoffs[0] + self.n_clusters
+        self.head_weight = self.create_parameter(
+            (in_features, head_size),
+            default_initializer=I.XavierNormal())
+        self.head_bias = (self.create_parameter((head_size,), is_bias=True)
+                          if head_bias else None)
+        self._tail = []
+        for ci in range(self.n_clusters):
+            proj_dim = max(int(in_features / (div_value ** (ci + 1))), 1)
+            size = self.cutoffs[ci + 1] - self.cutoffs[ci]
+            proj = self.create_parameter(
+                (in_features, proj_dim), default_initializer=I.XavierNormal())
+            cls_w = self.create_parameter(
+                (proj_dim, size), default_initializer=I.XavierNormal())
+            setattr(self, f"tail_proj_{ci}", proj)
+            setattr(self, f"tail_cls_{ci}", cls_w)
+            self._tail.append((proj, cls_w))
+
+    def forward(self, input, label):
+        return FX.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self._tail, self.cutoffs,
+            self.head_bias)
+
+
+class BeamSearchDecoder:
+    """Beam-search decoder over an RNN cell (reference
+    python/paddle/nn/decode.py BeamSearchDecoder): embedding_fn maps ids
+    to inputs, output_fn maps cell output to vocab logits."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn or (lambda ids: ids)
+        self.output_fn = output_fn or (lambda x: x)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Run beam search to completion (reference dynamic_decode). Returns
+    (predicted_ids (B, T, beam), final_scores (B, beam))."""
+    cell = decoder.cell
+    W = decoder.beam_size
+    state0 = inits
+
+    # assume batch from the initial state pytree leaf
+    def leaf(s):
+        return s[0] if isinstance(s, (tuple, list)) else s
+
+    B = leaf(state0).shape[0]
+    NEG = -1e9
+
+    # replicate state per beam: (B, ...) -> (B*W, ...)
+    def rep(s):
+        if isinstance(s, (tuple, list)):
+            return type(s)(rep(x) for x in s)
+        v = s._value if isinstance(s, Tensor) else jnp.asarray(s)
+        v = jnp.repeat(v, W, axis=0)
+        return Tensor._from_value(v)
+
+    state = rep(state0)
+    ids = np.full((B, W), decoder.start_token, np.int64)
+    scores = np.where(np.arange(W)[None, :] == 0, 0.0, NEG).repeat(B, 0
+                                                                   ).reshape(B, W)
+    finished = np.zeros((B, W), bool)
+    out_ids = []
+    for _step in range(max_step_num):
+        tok = Tensor._from_value(jnp.asarray(ids.reshape(-1)))
+        inp = decoder.embedding_fn(tok)
+        out, state = cell(inp, state)
+        logits = decoder.output_fn(out)
+        logp = np.array(
+            (logits.log_softmax(-1) if hasattr(logits, "log_softmax")
+             else logits)._value).reshape(B, W, -1)
+        V = logp.shape[-1]
+        # finished beams only extend with end_token at zero cost
+        logp[finished] = NEG
+        logp[finished, decoder.end_token] = 0.0
+        total = scores[:, :, None] + logp  # (B, W, V)
+        flat = total.reshape(B, W * V)
+        top = np.argsort(-flat, axis=-1)[:, :W]
+        scores = np.take_along_axis(flat, top, -1)
+        beam_src = top // V
+        ids = (top % V).astype(np.int64)
+        finished = np.take_along_axis(finished, beam_src, -1) | (
+            ids == decoder.end_token)
+
+        # reorder state along the beam axis
+        def reorder(s):
+            if isinstance(s, (tuple, list)):
+                return type(s)(reorder(x) for x in s)
+            v = s._value if isinstance(s, Tensor) else jnp.asarray(s)
+            v = v.reshape((B, W) + v.shape[1:])
+            gathered = jnp.take_along_axis(
+                v, jnp.asarray(beam_src).reshape(
+                    (B, W) + (1,) * (v.ndim - 2)), axis=1)
+            return Tensor._from_value(
+                gathered.reshape((B * W,) + v.shape[2:]))
+
+        state = reorder(state)
+        out_ids.append(ids.copy())
+        if finished.all():
+            break
+    pred = np.stack(out_ids, axis=1)  # (B, T, W)
+    return (Tensor._from_value(jnp.asarray(pred)),
+            Tensor._from_value(jnp.asarray(scores)))

@@ -304,7 +304,7 @@ _INPLACE_BASES = [
     "renorm", "reshape", "round", "rsqrt", "scatter", "sigmoid", "sign",
     "sin", "sinc", "sinh", "sqrt", "square", "squeeze", "subtract", "t",
     "tan", "tanh", "transpose", "tril", "triu", "trunc", "unsqueeze",
-    "where", "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
     "bitwise_left_shift", "bitwise_right_shift",
 ]
 
@@ -389,7 +389,8 @@ def geometric_(self, probs):
     from ..core import random as _r
 
     def _sample(s):
-        u = jax.random.uniform(_r.next_key(), s)
+        # 1 - U lands in (0, 1]: log never sees an exact zero
+        u = 1.0 - jax.random.uniform(_r.next_key(), s)
         return jnp.floor(jnp.log(u) / jnp.log1p(-probs)) + 1
 
     return _fill_random(self, _sample)
@@ -402,6 +403,18 @@ for _rname in ("normal_", "uniform_", "bernoulli_", "log_normal_",
     if _rname not in __all__:
         __all__.append(_rname)
 
+def where_(condition, x, y):
+    """In-place where (reference paddle.where_): the result lands in x."""
+    out = _this["where"](condition, x, y)
+    x._value = out._value
+    return x
+
+
+Tensor.where_ = lambda self, x, y: where_(self, x, y)
+_this["where_"] = where_
+__all__.append("where_")
+
+
 # reference aliases
 mod = _this["remainder"]
 floor_mod = _this["remainder"]
@@ -410,6 +423,8 @@ floor_mod_ = _this["remainder_"]
 reverse = _this["flip"]
 Tensor.mod = mod
 Tensor.floor_mod = floor_mod
+Tensor.mod_ = Tensor.remainder_
+Tensor.floor_mod_ = Tensor.remainder_
 __all__ += ["mod", "floor_mod", "mod_", "floor_mod_", "reverse"]
 
 

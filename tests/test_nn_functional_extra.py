@@ -1,0 +1,258 @@
+"""Second-tranche nn.functional surface: losses vs torch oracles, structure
+ops vs hand-derived results, rnnt_loss vs brute-force alignment
+enumeration, beam-search decode on a deterministic toy cell."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _np(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    paddle.seed(0)
+
+
+rs = np.random.RandomState(0)
+
+
+def test_losses_match_torch():
+    x = rs.randn(6, 5).astype(np.float32)
+    y01 = rs.randint(0, 2, (6, 5)).astype(np.float32)
+    pairs = [
+        (F.soft_margin_loss(paddle.to_tensor(x),
+                            paddle.to_tensor(2 * y01 - 1)),
+         torch.nn.functional.soft_margin_loss(torch.tensor(x),
+                                              torch.tensor(2 * y01 - 1))),
+        (F.multi_label_soft_margin_loss(paddle.to_tensor(x),
+                                        paddle.to_tensor(y01)),
+         torch.nn.functional.multilabel_soft_margin_loss(
+             torch.tensor(x), torch.tensor(y01))),
+        (F.margin_ranking_loss(paddle.to_tensor(x[:, 0]),
+                               paddle.to_tensor(x[:, 1]),
+                               paddle.to_tensor(2 * y01[:, 0] - 1),
+                               margin=0.3),
+         torch.nn.functional.margin_ranking_loss(
+             torch.tensor(x[:, 0]), torch.tensor(x[:, 1]),
+             torch.tensor(2 * y01[:, 0] - 1), margin=0.3)),
+        (F.poisson_nll_loss(paddle.to_tensor(x),
+                            paddle.to_tensor(np.abs(x))),
+         torch.nn.functional.poisson_nll_loss(torch.tensor(x),
+                                              torch.tensor(np.abs(x)))),
+    ]
+    for got, want in pairs:
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-4)
+
+
+def test_pairwise_distance_and_square_error():
+    a = rs.randn(4, 8).astype(np.float32)
+    b = rs.randn(4, 8).astype(np.float32)
+    got = _np(F.pairwise_distance(paddle.to_tensor(a), paddle.to_tensor(b)))
+    want = torch.nn.functional.pairwise_distance(
+        torch.tensor(a), torch.tensor(b)).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    np.testing.assert_allclose(
+        _np(F.square_error_cost(paddle.to_tensor(a), paddle.to_tensor(b))),
+        (a - b) ** 2, rtol=1e-6)
+
+
+def test_sigmoid_focal_and_log_loss():
+    logit = rs.randn(8).astype(np.float32)
+    label = rs.randint(0, 2, 8).astype(np.float32)
+    got = float(F.sigmoid_focal_loss(paddle.to_tensor(logit),
+                                     paddle.to_tensor(label)))
+    p = 1 / (1 + np.exp(-logit))
+    ce = -(label * np.log(p) + (1 - label) * np.log(1 - p))
+    pt = p * label + (1 - p) * (1 - label)
+    at = 0.25 * label + 0.75 * (1 - label)
+    want = float((at * (1 - pt) ** 2 * ce).sum())
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+    prob = np.clip(rs.rand(5).astype(np.float32), 0.05, 0.95)
+    ll = _np(F.log_loss(paddle.to_tensor(prob), paddle.to_tensor(label[:5])))
+    assert ll.shape == (5,) and (ll > 0).all()
+
+
+def test_unpool_roundtrip():
+    x = paddle.to_tensor(rs.rand(2, 3, 8, 8).astype(np.float32))
+    pooled, idx = F.max_pool2d(x, 2, stride=2, return_mask=True)
+    restored = F.max_unpool2d(pooled, idx, 2, stride=2)
+    assert restored.shape == [2, 3, 8, 8]
+    # every pooled max lands back at its original argmax position
+    r = _np(restored)
+    p = _np(pooled)
+    assert np.allclose(np.sort(r[r != 0]), np.sort(p.reshape(-1)))
+
+
+def test_fractional_pool_shapes():
+    x = paddle.to_tensor(rs.rand(1, 2, 9, 9).astype(np.float32))
+    out = F.fractional_max_pool2d(x, output_size=4, random_u=0.3)
+    assert out.shape == [1, 2, 4, 4]
+    # pooling never invents values
+    assert float(out.max()) <= float(x.max()) + 1e-6
+    out3 = F.fractional_max_pool3d(
+        paddle.to_tensor(rs.rand(1, 1, 6, 6, 6).astype(np.float32)),
+        output_size=2, random_u=0.5)
+    assert out3.shape == [1, 1, 2, 2, 2]
+
+
+def test_temporal_shift_and_shuffles():
+    x = paddle.to_tensor(rs.rand(4, 8, 2, 2).astype(np.float32))
+    out = F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+    assert out.shape == [4, 8, 2, 2]
+    v = _np(x).reshape(2, 2, 8, 2, 2)
+    o = _np(out).reshape(2, 2, 8, 2, 2)
+    np.testing.assert_allclose(o[:, 0, :2], v[:, 1, :2])  # shift back
+    np.testing.assert_allclose(o[:, 1, 2:4], v[:, 0, 2:4])  # shift fwd
+    np.testing.assert_allclose(o[:, :, 4:], v[:, :, 4:])  # untouched
+    cs = F.channel_shuffle(x, groups=2)
+    assert cs.shape == [4, 8, 2, 2]
+    pu = F.pixel_unshuffle(x, 2)
+    assert pu.shape == [4, 32, 1, 1]
+
+
+def test_rnnt_loss_matches_bruteforce():
+    # tiny lattice: enumerate all monotonic alignments by hand
+    B, T, U, V = 1, 3, 2, 4
+    logits = rs.randn(B, T, U + 1, V).astype(np.float32)
+    labels = np.array([[1, 2]], np.int64)
+    lp = torch.log_softmax(torch.tensor(logits), dim=-1).numpy()[0]
+
+    import itertools
+
+    # paths: sequences of (emit|blank) totalling T blanks-advance and U emits
+    def total_prob():
+        probs = []
+        # enumerate positions of emissions among blanks: each path is a
+        # lattice walk from (0,0) to (T-1, U) ending with final blank
+        for emit_times in itertools.combinations_with_replacement(
+                range(T), U):
+            t, u, logp = 0, 0, 0.0
+            ok = True
+            et = list(emit_times)
+            for step_t in range(T):
+                while et and et[0] == step_t:
+                    logp += lp[step_t, u, labels[0, u]]
+                    u += 1
+                    et.pop(0)
+                logp += lp[step_t, u, 0]  # blank advances time
+            probs.append(logp)
+        m = max(probs)
+        return m + np.log(np.sum(np.exp(np.array(probs) - m)))
+
+    want = -total_prob()
+    got = float(np.asarray(F.rnnt_loss(
+        paddle.to_tensor(logits), paddle.to_tensor(labels),
+        paddle.to_tensor(np.array([T], np.int64)),
+        paddle.to_tensor(np.array([U], np.int64)),
+        reduction="none")._value)[0])
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_hsigmoid_loss_trains():
+    paddle.seed(1)
+    feat, ncls = 16, 10
+    layer = nn.HSigmoidLoss(feat, ncls)
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=layer.parameters())
+    x = paddle.to_tensor(rs.randn(32, feat).astype(np.float32))
+    y = paddle.to_tensor(rs.randint(0, ncls, (32, 1)).astype(np.int64))
+    first = last = None
+    for _ in range(20):
+        loss = layer(x, y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    assert last < 0.6 * first
+
+
+def test_adaptive_log_softmax():
+    layer = nn.AdaptiveLogSoftmaxWithLoss(16, 20, cutoffs=[5, 10])
+    x = paddle.to_tensor(rs.randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(rs.randint(0, 20, (8,)).astype(np.int64))
+    out, loss = layer(x, y)
+    assert out.shape == [8]
+    assert float(loss) > 0
+    assert (np.asarray(out._value) < 0).all()  # log-probs
+
+
+def test_sparse_attention_matches_dense_on_full_pattern():
+    B, H, S, D = 1, 1, 4, 8
+    q = rs.randn(B, H, S, D).astype(np.float32)
+    k = rs.randn(B, H, S, D).astype(np.float32)
+    v = rs.randn(B, H, S, D).astype(np.float32)
+    # full CSR pattern == dense attention
+    offsets = np.arange(0, S * S + 1, S, dtype=np.int32).reshape(1, 1, -1)
+    cols = np.tile(np.arange(S, dtype=np.int32), S).reshape(1, 1, -1)
+    got = _np(F.sparse_attention(paddle.to_tensor(q), paddle.to_tensor(k),
+                                 paddle.to_tensor(v),
+                                 paddle.to_tensor(offsets),
+                                 paddle.to_tensor(cols)))
+    scores = (q @ k.transpose(0, 1, 3, 2)) / np.sqrt(D)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    probs = e / e.sum(-1, keepdims=True)
+    want = probs @ v
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_gather_tree():
+    # T=3, B=1, W=2 beam trace with a known backtrace
+    ids = paddle.to_tensor(np.array(
+        [[[2, 3]], [[4, 5]], [[6, 7]]], np.int64))
+    parents = paddle.to_tensor(np.array(
+        [[[0, 0]], [[1, 0]], [[0, 1]]], np.int64))
+    out = _np(F.gather_tree(ids, parents))
+    # beam 0 at t=2 came from parent 0 at t=1 (id 4), which came from
+    # parent 1 at t=0 (id 3)
+    np.testing.assert_array_equal(out[:, 0, 0], [3, 4, 6])
+
+
+def test_beam_search_decode_prefers_high_prob_path():
+    # deterministic "cell": state is a counter; logits always favor token 2
+    class ToyCell:
+        def __call__(self, inp, state):
+            bias = np.zeros((state.shape[0], 5), np.float32)
+            bias[:, 2] = 3.0
+            bias[:, 4] = 1.0  # end token is second-best
+            return paddle.to_tensor(bias), state
+
+    dec = nn.BeamSearchDecoder(ToyCell(), start_token=0, end_token=4,
+                               beam_size=2,
+                               output_fn=lambda x: x)
+    init = paddle.zeros([2, 1])
+    pred, scores = nn.dynamic_decode(dec, inits=init, max_step_num=4)
+    p = _np(pred)
+    assert p.shape[0] == 2 and p.shape[2] == 2
+    assert (p[:, :, 0] == 2).all()  # best beam keeps emitting token 2
+    assert float(_np(scores)[:, 0].max()) > float(_np(scores)[:, 1].max())
+
+
+def test_inplace_functionals_and_rrelu():
+    x = paddle.to_tensor(np.float32([-2.0, 2.0]))
+    F.tanh_(x)
+    assert abs(float(x.max())) < 1.0
+    y = paddle.to_tensor(np.float32([-1.0, 3.0]))
+    F.hardtanh_(y)
+    np.testing.assert_allclose(_np(y), [-1.0, 1.0])
+    z = paddle.to_tensor(np.float32([-4.0, 4.0]))
+    out = F.rrelu(z, training=True)
+    assert float(out._value[1]) == 4.0
+    assert -4.0 / 3.0 - 1e-5 <= float(out._value[0]) <= -0.5 + 1e-5
+    t = F.thresholded_relu(paddle.to_tensor(np.float32([0.5, 2.0])))
+    np.testing.assert_allclose(_np(t), [0.0, 2.0])
+
+
+def test_conv_transpose_functional_matches_layer():
+    x = paddle.to_tensor(rs.randn(2, 3, 10).astype(np.float32))
+    layer = nn.Conv1DTranspose(3, 4, 3, stride=2)
+    got = _np(F.conv1d_transpose(x, layer.weight, layer.bias, stride=2))
+    want = _np(layer(x))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
