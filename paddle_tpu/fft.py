@@ -53,3 +53,67 @@ def fftfreq(n, d=1.0, dtype=None):
 
 def rfftfreq(n, d=1.0, dtype=None):
     return Tensor._from_value(jnp.fft.rfftfreq(n, d))
+
+
+def rfftn(x, s=None, axes=None, norm="backward"):
+    return Tensor._from_value(jnp.fft.rfftn(_v(x), s, axes, norm))
+
+
+def irfftn(x, s=None, axes=None, norm="backward"):
+    return Tensor._from_value(jnp.fft.irfftn(_v(x), s, axes, norm))
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return Tensor._from_value(jnp.fft.hfft(
+        jnp.fft.ifft(_v(x), None if s is None else s[0], axes[0], norm),
+        None if s is None else s[1], axes[1], norm)) if False else \
+        Tensor._from_value(_hfftn_impl(_v(x), s, axes, norm))
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward"):
+    return Tensor._from_value(_ihfftn_impl(_v(x), s, axes, norm))
+
+
+def hfftn(x, s=None, axes=None, norm="backward"):
+    return Tensor._from_value(_hfftn_impl(_v(x), s, axes, norm))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward"):
+    return Tensor._from_value(_ihfftn_impl(_v(x), s, axes, norm))
+
+
+def _hfftn_impl(v, s, axes, norm):
+    """hfftn = irfftn of the conjugate with forward/backward norms swapped
+    (the numpy identity hfft(a) == irfft(conj(a)) scaled to n)."""
+    if axes is None:
+        axes = tuple(range(v.ndim))
+    inv_norm = {"backward": "forward", "forward": "backward",
+                "ortho": "ortho"}[norm]
+    n_last = (s[-1] if s is not None
+              else 2 * (v.shape[axes[-1]] - 1))
+    full_s = list(s) if s is not None else (
+        [v.shape[a] for a in axes[:-1]] + [n_last])
+    return jnp.fft.irfftn(jnp.conj(v), full_s, axes, inv_norm) * (
+        _norm_scale(full_s, norm))
+
+
+def _ihfftn_impl(v, s, axes, norm):
+    if axes is None:
+        axes = tuple(range(v.ndim))
+    inv_norm = {"backward": "forward", "forward": "backward",
+                "ortho": "ortho"}[norm]
+    full_s = list(s) if s is not None else [v.shape[a] for a in axes]
+    out = jnp.conj(jnp.fft.rfftn(v, full_s, axes, inv_norm))
+    return out / _norm_scale(full_s, norm)
+
+
+def _norm_scale(shape, norm):
+    n = 1
+    for v in shape:
+        n *= int(v)
+    if norm == "backward":
+        return 1.0  # handled by the swapped-norm transform
+    return 1.0
+
+
+__all__ += ["rfftn", "irfftn", "hfft2", "ihfft2", "hfftn", "ihfftn"]
