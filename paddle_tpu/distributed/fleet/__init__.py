@@ -17,7 +17,9 @@ from .mp_layers import (  # noqa: F401
     VocabParallelEmbedding,
 )
 from .pipeline import (  # noqa: F401
+    CrossMeshPipelineParallel,
     ZeroBubblePipelineParallel,
+    one_f_one_b_schedule,
     zero_bubble_schedule,
     LayerDesc,
     PipelineLayer,
@@ -39,6 +41,7 @@ __all__ = [
     "ParallelCrossEntropy", "recompute", "recompute_sequential",
     "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
     "spmd_pipeline", "spmd_pipeline_vpp", "ZeroBubblePipelineParallel",
+    "CrossMeshPipelineParallel", "one_f_one_b_schedule",
     "zero_bubble_schedule", "group_sharded_parallel", "ShardedOptimizer",
     "MoELayer", "NaiveGate", "SwitchGate", "StackedExpertsFFN",
 ]

@@ -32,6 +32,7 @@ from ...nn.layers_common import LayerList
 
 __all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
            "PipelineParallel", "ZeroBubblePipelineParallel",
+           "CrossMeshPipelineParallel", "one_f_one_b_schedule",
            "zero_bubble_schedule", "spmd_pipeline", "spmd_pipeline_vpp"]
 
 
@@ -210,25 +211,23 @@ class PipelineParallel(Layer):
 
 # ---------------------------------------------------------- zero bubble (H1)
 
-def zero_bubble_schedule(n_stages, n_micro):
-    """Build a ZBH1 schedule table: per stage, a list of per-tick ops
-    ``('F'|'B'|'W', microbatch)`` or ``None`` (idle).
-
-    The reference implements this as a static-graph pass
-    (distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py,
-    ZBH1 at :62) that splits ``matmul_grad`` into separate dX/dW jobs so
-    weight-gradient work fills the 1F1B bubble. Here the schedule is built
-    by event-driven simulation with the same priorities: activation-grad
+def _build_pipeline_schedule(n_stages, n_micro, split_w):
+    """Event-driven schedule builder shared by ZBH1 and 1F1B: per stage, a
+    list of per-tick ops or ``None`` (idle). Priorities: activation-grad
     (B) first — it unblocks upstream stages — then forward under the 1F1B
-    in-flight cap, and deferred weight-grad (W) only in otherwise-idle
-    slots. Memory stays at the 1F1B level (in-flight ≤ n_stages - s).
-    """
+    in-flight cap (``≤ n_stages - s`` outstanding); with ``split_w``,
+    deferred weight-grad (W) fills otherwise-idle slots."""
     done_F, done_B = set(), set()
     next_F = [0] * n_stages
     next_B = [0] * n_stages
     next_W = [0] * n_stages
     sched = [[] for _ in range(n_stages)]
-    while not all(w == n_micro for w in next_W):
+
+    def finished():
+        return (all(w == n_micro for w in next_W) if split_w
+                else all(b == n_micro for b in next_B))
+
+    while not finished():
         decisions = []
         for s in range(n_stages):
             op = None
@@ -243,7 +242,7 @@ def zero_bubble_schedule(n_stages, n_micro):
                 op = ("B", m)
             elif f_ready:
                 op = ("F", f)
-            elif next_W[s] < next_B[s]:
+            elif split_w and next_W[s] < next_B[s]:
                 op = ("W", next_W[s])
             decisions.append(op)
         # commit synchronously: this tick's readiness was judged on prior
@@ -262,6 +261,17 @@ def zero_bubble_schedule(n_stages, n_micro):
             else:
                 next_W[s] += 1
     return sched
+
+
+def zero_bubble_schedule(n_stages, n_micro):
+    """ZBH1 schedule table: ops ``('F'|'B'|'W', microbatch)``.
+
+    The reference implements this as a static-graph pass
+    (distributed/passes/pipeline_scheduler_pass/pipeline_zero_bubble.py,
+    ZBH1 at :62) that splits ``matmul_grad`` into separate dX/dW jobs so
+    weight-gradient work fills the 1F1B bubble. Memory stays at the 1F1B
+    level (in-flight ≤ n_stages - s)."""
+    return _build_pipeline_schedule(n_stages, n_micro, split_w=True)
 
 
 def _apply_entry(entry, x):
@@ -439,6 +449,299 @@ class ZeroBubblePipelineParallel(PipelineParallel):
         if lr_scheduler is not None:
             lr_scheduler.step()
         return Tensor._from_value(total_loss, stop_gradient=True)
+
+
+# ------------------------------------------------- cross-stage (pp sub-mesh)
+
+def one_f_one_b_schedule(n_stages, n_micro):
+    """1F1B schedule table: per stage, per tick, ``('F'|'B', m)`` or None.
+
+    Same event-driven construction as :func:`zero_bubble_schedule` but B is
+    the full backward (dX and dW together) — the schedule of the reference's
+    ``PipelineParallel.forward_backward_pipeline``
+    (meta_parallel/pipeline_parallel.py:575): warmup forwards bounded by the
+    per-stage in-flight cap ``n_stages - s``, then strict 1F1B steady state,
+    then cooldown drain.
+    """
+    return _build_pipeline_schedule(n_stages, n_micro, split_w=False)
+
+
+class CrossMeshPipelineParallel(PipelineParallel):
+    """1F1B pipeline with each stage's parameters on a distinct ``pp``
+    sub-mesh — the true cross-stage schedule.
+
+    Reference anchor: ``PipelineParallel.forward_backward_pipeline``
+    (meta_parallel/pipeline_parallel.py:575) interleaves fwd/bwd
+    micro-batches across stages living on different devices, moving
+    activations with batched NCCL isend/irecv (pp_utils/
+    p2p_communication.py:327). TPU-native translation (single controller):
+
+    * stage ``s`` of the :class:`PipelineLayer` becomes a standalone
+      :class:`_StageModule` whose parameters are placed on sub-mesh
+      ``mesh.get_mesh_with_dim(pp_axis, s)`` — disjoint devices per stage,
+      exactly the ``get_mesh(ipp)`` pattern of the reference's
+      semi_auto_llama harness. Remaining mesh dims (mp/dp) shard within
+      the stage via ``shard_fn`` (e.g. a Megatron TP plan).
+    * each stage gets TWO jitted programs, compiled once and reused for
+      every micro-batch and step: ``fwd(params, x)`` and
+      ``bwd(params, x, gy) -> (gparams, gx)``. The backward re-linearizes
+      the stage (forward recompute inside the backward program) — the
+      standard TPU trade of FLOPs for activation memory; only stage
+      *inputs* are held between F and B, the 1F1B steady-state memory.
+    * activations/cotangents move stage→stage with ``jax.device_put`` onto
+      the next stage's sub-mesh (the transfer engine plays the p2p role;
+      under multi-controller the same call rides DCN).
+    * the host submits work in 1F1B table order; device execution is
+      async, so stage programs on disjoint devices genuinely overlap.
+
+    Gradients are numerically identical to the single-mesh run (tested in
+    tests/test_cross_mesh_pipeline.py).
+    """
+
+    def __init__(self, layers, mesh=None, pp_axis="pp", hcg=None,
+                 strategy=None, accumulate_steps=None, shard_fn=None):
+        super().__init__(layers, hcg=hcg, strategy=strategy,
+                         accumulate_steps=accumulate_steps,
+                         schedule_mode="1F1B")
+        if not isinstance(layers, PipelineLayer):
+            raise TypeError("CrossMeshPipelineParallel requires a "
+                            "PipelineLayer model")
+        if getattr(layers, "_shared", None):
+            raise ValueError(
+                "CrossMeshPipelineParallel does not support SharedLayerDesc "
+                "(tied weights): a layer shared across stages cannot live on "
+                "two disjoint sub-meshes. Untie the weights, or use the "
+                "single-mesh PipelineParallel / spmd_pipeline routes.")
+        if mesh is None:
+            from ..process_mesh import get_mesh
+
+            mesh = get_mesh()
+        if mesh is None or pp_axis not in mesh.dim_names:
+            raise ValueError(
+                f"CrossMeshPipelineParallel needs a mesh with a {pp_axis!r} "
+                f"dim; got {mesh!r}")
+        n_stages = layers.get_num_stages()
+        if mesh.get_dim_size(pp_axis) != n_stages:
+            raise ValueError(
+                f"mesh {pp_axis} size {mesh.get_dim_size(pp_axis)} != "
+                f"num_stages {n_stages}")
+        self._mesh = mesh
+        self._pp_axis = pp_axis
+        self._stages = [
+            _StageModule(layers.stage_layers(s)) for s in range(n_stages)
+        ]
+        # disjoint sub-mesh per stage; a pure-pp mesh leaves zero remaining
+        # dims, so wrap the stage's devices in a 1-axis mesh
+        self._sub_meshes = []
+        from ..process_mesh import ProcessMesh
+
+        for s in range(n_stages):
+            sub = mesh.get_mesh_with_dim(pp_axis, s)
+            if sub.ndim == 0:
+                sub = ProcessMesh(
+                    np.asarray(sub.mesh).reshape(1), ["_stage"])
+            self._sub_meshes.append(sub)
+        # place every stage's parameters on its sub-mesh
+        from ..api import shard_layer
+
+        for stage, sub in zip(self._stages, self._sub_meshes):
+            shard_layer(stage, sub, shard_fn)
+        self._progs = {}  # (stage, training) -> (fwd, bwd)
+        self.last_schedule = None
+
+    def _activation_sharding(self, s):
+        from jax.sharding import PartitionSpec as P
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self._sub_meshes[s].jax_mesh(), P())
+
+    def _stage_progs(self, s, training=True):
+        # keyed by training mode: the stage's self.training is read at trace
+        # time (dropout/BN), so each mode needs its own compiled programs
+        cache_key = (s, bool(training))
+        if cache_key in self._progs:
+            return self._progs[cache_key]
+        from ...jit import _FunctionalModel
+
+        fm = _FunctionalModel(self._stages[s])
+        last = s == len(self._stages) - 1
+        loss_fn = getattr(self._layers, "_loss_fn", None)
+
+        # ``factor`` (= loss_scale / n_micro in training, 1 in eval) rides
+        # as a traced operand so dynamic loss scaling never recompiles.
+        # It scales the last stage's output whether or not a loss_fn exists
+        # (without one, the stage output IS the loss, as in the base class).
+        def apply(params, buffers, x, key, labels, factor):
+            out, new_bufs = fm(params, buffers, (x,), {}, key)
+            if last:
+                if loss_fn is not None and labels is not None:
+                    loss = loss_fn(Tensor._from_value(out),
+                                   Tensor._from_value(labels))
+                    out = (loss._value if isinstance(loss, Tensor)
+                           else loss)
+                out = out * factor
+            return out, new_bufs
+
+        fwd_jit = jax.jit(apply)
+
+        def bwd_raw(params, buffers, x, key, labels, factor, gy):
+            def of(p, a):
+                out, _ = apply(p, buffers, a, key, labels, factor)
+                return out
+
+            _, pull = jax.vjp(of, params, x)
+            return pull(gy)
+
+        bwd_jit = jax.jit(bwd_raw)
+        stage = self._stages[s]
+
+        # set the mode at every call: (re)traces read stage.training, and a
+        # retrace on new shapes must bake THIS program's mode, not whichever
+        # mode ran last
+        def fwd(*a):
+            stage.train() if training else stage.eval()
+            return fwd_jit(*a)
+
+        def bwd(*a):
+            stage.train() if training else stage.eval()
+            return bwd_jit(*a)
+
+        self._progs[cache_key] = (fwd, bwd)
+        return fwd, bwd
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        from ...core import random as _random
+
+        inputs, labels = data
+        n_micro = self.accumulate_steps
+        n_stages = len(self._stages)
+        batch = inputs.shape[0]
+        assert batch % n_micro == 0, (
+            f"batch {batch} not divisible by accumulate_steps {n_micro}")
+        mb = batch // n_micro
+        scale = (float(scaler._scale) if scaler is not None
+                 and getattr(scaler, "_enable", True) else 1.0)
+
+        states = [s.raw_state() for s in self._stages]
+        sched = one_f_one_b_schedule(n_stages, n_micro)
+        self.last_schedule = sched
+        ticks = len(sched[0])
+
+        act_in = [dict() for _ in range(n_stages)]   # (s, m) stage inputs
+        keys = [dict() for _ in range(n_stages)]
+        buf_in = [dict() for _ in range(n_stages)]
+        gin = [dict() for _ in range(n_stages)]      # incoming out-cotangents
+        grad_acc = [None] * n_stages
+        total_loss = None
+
+        iv = inputs._value if isinstance(inputs, Tensor) else jnp.asarray(inputs)
+        lv = labels._value if isinstance(labels, Tensor) else jnp.asarray(labels)
+        factor = jnp.asarray(scale / n_micro, jnp.float32)
+        for m in range(n_micro):
+            act_in[0][m] = jax.device_put(
+                iv[m * mb:(m + 1) * mb], self._activation_sharding(0))
+
+        for t in range(ticks):
+            for s in range(n_stages):
+                op = sched[s][t]
+                if op is None:
+                    continue
+                kind, m = op
+                params, buffers = states[s]
+                last = s == n_stages - 1
+                fwd, bwd = self._stage_progs(s)
+                if kind == "F":
+                    key = jax.random.key_data(_random.next_key())
+                    keys[s][m] = key
+                    x = act_in[s][m]
+                    tgt = lv[m * mb:(m + 1) * mb] if last else None
+                    buf_in[s][m] = buffers
+                    out, new_buffers = fwd(params, buffers, x, key, tgt,
+                                           factor)
+                    states[s] = (params, new_buffers)
+                    if last:
+                        loss_m = out / scale
+                        total_loss = (loss_m if total_loss is None
+                                      else total_loss + loss_m)
+                        gin[s][m] = jnp.ones_like(out)
+                    else:
+                        act_in[s + 1][m] = jax.device_put(
+                            out, self._activation_sharding(s + 1))
+                else:  # B: full backward (dX + dW) on this stage's sub-mesh
+                    gy = jax.device_put(
+                        gin[s].pop(m), self._activation_sharding(s))
+                    x = act_in[s].pop(m)
+                    key = keys[s].pop(m)
+                    buffers_f = buf_in[s].pop(m)
+                    tgt = lv[m * mb:(m + 1) * mb] if last else None
+                    gw, gx = bwd(params, buffers_f, x, key, tgt, factor, gy)
+                    if s > 0:
+                        gin[s - 1][m] = gx
+                    if grad_acc[s] is None:
+                        grad_acc[s] = gw
+                    else:
+                        grad_acc[s] = jax.tree_util.tree_map(
+                            jnp.add, grad_acc[s], gw)
+
+        # write accumulated grads + forward-updated buffers back
+        for s, stage in enumerate(self._stages):
+            stage.load_raw_state({}, states[s][1])
+            if grad_acc[s] is None:
+                continue
+            index = {k: p for k, p in stage.named_parameters()}
+            for k, g in grad_acc[s].items():
+                if k in index and not index[k].stop_gradient:
+                    index[k]._accumulate_grad(g)
+
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor._from_value(total_loss, stop_gradient=True)
+
+    def parameters(self, include_sublayers=True):
+        out = []
+        for stage in self._stages:
+            out.extend(stage.parameters())
+        return out
+
+    def _chain(self, x, labels=None):
+        """Run the stage chain once (eval-mode programs, factor=1), moving
+        activations between sub-meshes."""
+        from ...core import random as _random
+
+        n_stages = len(self._stages)
+        x = jax.device_put(
+            x._value if isinstance(x, Tensor) else jnp.asarray(x),
+            self._activation_sharding(0))
+        lv = (labels._value if isinstance(labels, Tensor)
+              else jnp.asarray(labels)) if labels is not None else None
+        one = jnp.asarray(1.0, jnp.float32)
+        for s in range(n_stages):
+            fwd, _ = self._stage_progs(s, training=False)
+            params, buffers = self._stages[s].raw_state()
+            tgt = lv if s == n_stages - 1 else None
+            key = jax.random.key_data(_random.next_key())
+            x, _bufs = fwd(params, buffers,
+                           x if s == 0 else jax.device_put(
+                               x, self._activation_sharding(s)),
+                           key, tgt, one)
+        return Tensor._from_value(x, stop_gradient=True)
+
+    def forward(self, x, *args, **kwargs):
+        """Inference forward across the cross-mesh stage chain. (The
+        autograd-carrying path is ``train_batch``; the base class's eager
+        ``self._layers(x)`` cannot run here — stage params are committed to
+        disjoint device sets and need explicit transfers.)"""
+        return self._chain(x)
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        return self._chain(inputs, labels if compute_loss else None)
 
 
 # ------------------------------------------------------------ compiled route

@@ -12,6 +12,9 @@ from .llama import (  # noqa: F401
     LlamaMLP,
     LlamaModel,
     LlamaPretrainingCriterion,
+    LlamaEmbeddingPipe,
+    LlamaHeadPipe,
+    llama_pipeline_module,
     llama_shard_fn,
     llama_tiny_config,
 )
@@ -19,6 +22,7 @@ from .llama import (  # noqa: F401
 __all__ = [
     "LlamaConfig", "LlamaForCausalLM", "LlamaModel", "LlamaAttention",
     "LlamaMLP", "LlamaDecoderLayer", "LlamaPretrainingCriterion",
+    "LlamaEmbeddingPipe", "LlamaHeadPipe", "llama_pipeline_module",
     "llama_shard_fn", "llama_tiny_config",
 ]
 

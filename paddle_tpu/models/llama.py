@@ -42,6 +42,7 @@ __all__ = [
     "StaticCache",
     "LlamaConfig", "LlamaAttention", "LlamaMLP", "LlamaDecoderLayer",
     "LlamaModel", "LlamaForCausalLM", "LlamaPretrainingCriterion",
+    "LlamaEmbeddingPipe", "LlamaHeadPipe", "llama_pipeline_module",
     "llama_shard_fn", "llama_tiny_config",
 ]
 
@@ -315,6 +316,56 @@ class LlamaPretrainingCriterion(Layer):
         target = labels[:, 1:]
         loss = softmax_with_cross_entropy(shifted, target)
         return loss.mean()
+
+
+# ----------------------------------------------------------------- pipeline
+
+class LlamaEmbeddingPipe(Layer):
+    """First pipeline stage: token embedding (ids -> hidden)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.embed_tokens = Embedding(
+            config.vocab_size, config.hidden_size,
+            weight_attr=I.Normal(0.0, config.initializer_range))
+
+    def forward(self, input_ids):
+        return self.embed_tokens(input_ids)
+
+
+class LlamaHeadPipe(Layer):
+    """Last pipeline stage: final RMSNorm + LM head (hidden -> logits)."""
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.norm = RMSNorm(config.hidden_size, epsilon=config.rms_norm_eps)
+        self.lm_head = Linear(config.hidden_size, config.vocab_size,
+                              weight_attr=I.Normal(0.0, config.initializer_range),
+                              bias_attr=False)
+
+    def forward(self, hidden):
+        return self.lm_head(self.norm(hidden))
+
+
+def llama_pipeline_module(config: LlamaConfig, num_stages, loss_fn=None,
+                          recompute_interval=0):
+    """Build LLaMA as a heterogeneous :class:`PipelineLayer` — embedding
+    stage + decoder blocks + norm/head stage — for the cross-mesh 1F1B
+    trainer. Mirrors how the reference's semi_auto harness spreads
+    embedding/blocks/head over ``get_mesh(ipp)`` sub-meshes
+    (semi_auto_parallel_llama_model.py:121-160). Parameter creation order
+    matches :class:`LlamaForCausalLM` (embed, blocks, norm, head), so the
+    same seed yields identical initial weights."""
+    from ..distributed.fleet import PipelineLayer
+
+    entries = [LlamaEmbeddingPipe(config)]
+    entries += [LlamaDecoderLayer(config)
+                for _ in range(config.num_hidden_layers)]
+    entries.append(LlamaHeadPipe(config))
+    if loss_fn is None:
+        loss_fn = LlamaPretrainingCriterion(config)
+    return PipelineLayer(entries, num_stages=num_stages, loss_fn=loss_fn,
+                         recompute_interval=recompute_interval)
 
 
 # ------------------------------------------------------------------ sharding

@@ -13,6 +13,8 @@ schedules never trigger recompilation.
 """
 from __future__ import annotations
 
+import math
+
 import jax
 import jax.numpy as jnp
 
@@ -48,7 +50,7 @@ class Optimizer:
         self._accumulators: dict[str, jax.Array] = {}  # "slot@index" -> array
         self._master_weights: dict[str, jax.Array] = {}
         self._step_count = 0
-        self._update_fn = None  # compiled fused update
+        self._update_fns = {}  # compiled fused updates, per param subset
 
     # ------------------------------------------------ lr
 
@@ -210,6 +212,15 @@ class Optimizer:
                 p._grad = Tensor._from_value(p._grad.to_dense(),
                                              stop_gradient=True)
 
+    @staticmethod
+    def _device_group_key(p):
+        """Params on disjoint device sets (pipeline stages on pp sub-meshes)
+        cannot share one XLA program; group by the value's device set."""
+        try:
+            return tuple(sorted(d.id for d in p._value.sharding.device_set))
+        except AttributeError:
+            return ()
+
     def step(self):
         self._apply_sparse_grads()
         params = [p for p in self._parameter_list
@@ -217,17 +228,67 @@ class Optimizer:
         if not params:
             self._step_count += 1
             return
-        grads = [p._grad._value for p in params]
+        by_devices: dict[tuple, list] = {}
+        for p in params:
+            by_devices.setdefault(self._device_group_key(p), []).append(p)
+        groups = list(by_devices.values())
+
+        grads = {id(p): p._grad._value for p in params}
         if self._grad_clip is not None:
-            grads = self._grad_clip._clip_arrays(grads, params)
+            self._clip_groups(groups, grads)
         self._ensure_state(params)
         self._step_count += 1
+        for group in groups:
+            self._step_group(group, [grads[id(p)] for p in group])
 
+    def _clip_groups(self, groups, grads):
+        from ..nn.clip import ClipGradByGlobalNorm, _need_clip_mask
+
+        if len(groups) == 1 or not isinstance(self._grad_clip,
+                                              ClipGradByGlobalNorm):
+            # per-tensor clips (ByNorm/ByValue) are group-local; a global
+            # norm over one group is the plain fused path
+            for group in groups:
+                clipped = self._grad_clip._clip_arrays(
+                    [grads[id(p)] for p in group], group)
+                for p, g in zip(group, clipped):
+                    grads[id(p)] = g
+            return
+        # global-norm clip across device groups: per-group sum-of-squares on
+        # device, combined on host (the cross-stage reduction the reference
+        # routes through its TP/PP-aware HybridParallelOptimizer clip)
+        masks = []
+        partials = []  # launch every per-group reduction, then sync once
+        for group in groups:
+            garr = [grads[id(p)] for p in group]
+            mask = _need_clip_mask(garr, group)
+            masks.append(mask)
+            sel = [g for g, m in zip(garr, mask) if m]
+            if sel:
+                partials.append(self._grad_clip.global_norm(sel) ** 2)
+        gnorm = math.sqrt(sum(float(v) for v in partials))
+        clip = self._grad_clip.clip_norm
+        scale = clip / max(gnorm, clip)
+        if scale >= 1.0:
+            return
+        for group, mask in zip(groups, masks):
+            for p, m in zip(group, mask):
+                if m:
+                    g = grads[id(p)]
+                    grads[id(p)] = (
+                        g.astype(jnp.float32) * scale).astype(g.dtype)
+
+    def _step_group(self, params, grads):
         # Cache the compiled update per exact param subset (a param without
-        # grads this step changes the program structure).
-        key = tuple(id(p) for p in params)
-        if self._update_fn is None or self._update_fn[0] != key:
-            self._update_fn = (key, type(self)._build_update(self, params))
+        # grads this step changes the program structure). Keyed by name, not
+        # id(): ids recycle after a param is replaced, and the baked per-param
+        # facts (decay flag, lr scale) follow the name.
+        key = tuple(p.name for p in params)
+        fn = self._update_fns.get(key)
+        if fn is None:
+            if len(self._update_fns) > 64:  # bound the executable cache
+                self._update_fns.clear()
+            fn = self._update_fns[key] = type(self)._build_update(self, params)
 
         param_vals = [p._value for p in params]
         master_vals = [self._master_weights.get(self._master_key(p)) for p in params]
@@ -237,7 +298,7 @@ class Optimizer:
         ]
         lr = jnp.asarray(self.get_lr(), jnp.float32)
         t = jnp.asarray(self._step_count, jnp.int32)
-        new_params, new_masters, new_accs = self._update_fn[1](
+        new_params, new_masters, new_accs = fn(
             param_vals, grads, master_vals, acc_vals, lr, t
         )
         for p, np_, nm, na in zip(params, new_params, new_masters, new_accs):
