@@ -8,10 +8,20 @@ params rank-by-rank with hand-built broadcast/reduce-scatter schedules.
 TPU-natively each ZeRO stage is a *sharding assignment*:
 
 * stage 1 (os):     moment accumulators Shard(0) over the sharding axis
-* stage 2 (os_g):   + gradients materialize sharded (XLA reduce-scatters)
+* stage 2 (os_g):   + gradients materialize Shard(0) — a grad hook reshards
+                    every incoming gradient onto the axis, so per-device
+                    live grad bytes shrink by 1/degree
+                    (GroupShardedStage2:46 semantics); after the update the
+                    parameters are restored to their pre-step sharding (the
+                    reference's post-step param broadcast)
 * stage 3 (p_g_os): + parameters Shard(0) — gathered on use, compiled by
                     GSPMD into the same prefetch-allgather pattern stage 3
                     hand-builds
+
+``offload=True`` keeps the optimizer state in its *sharded* layout but in
+pinned host memory between steps (the reference's offload mode backed by
+the async_load copy engine, collective/async_load.cc); ``step`` transfers
+it back to device memory for the update and re-offloads after.
 
 Anything with a leading dim not divisible by the axis degree stays
 replicated (the reference pads; slicing metadata is simpler and XLA layouts
@@ -39,41 +49,119 @@ def _shard0_placements(mesh, axis_idx, shape, degree):
     return pl
 
 
+def _augmented_sharding(v, mesh, axis, degree, memory_kind=None):
+    """Sharding for ``v`` that PRESERVES its existing placements (e.g. a TP
+    Shard over mp) and additionally shards the first free, divisible tensor
+    dim over the ZeRO ``axis``. Falls back to plain dim-0 sharding when the
+    value isn't already laid out on a named mesh carrying the axis."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    sh = getattr(v, "sharding", None)
+    jm = None
+    spec = None
+    if isinstance(sh, NamedSharding) and axis in sh.mesh.axis_names:
+        jm = sh.mesh
+        spec = list(sh.spec) + [None] * (v.ndim - len(sh.spec))
+    elif axis in mesh.dim_names:
+        jm = mesh.jax_mesh()
+        spec = [None] * v.ndim
+    if jm is None:
+        return None
+    used = set()
+    for e in spec:
+        if e is None:
+            continue
+        used.update(e if isinstance(e, tuple) else (e,))
+    if axis not in used:
+        for d in range(v.ndim):
+            e = spec[d]
+            cur = 1
+            for nm in (e if isinstance(e, tuple) else ([e] if e else [])):
+                cur *= jm.shape[nm]
+            if v.shape[d] % (cur * degree) == 0:
+                spec[d] = (axis if e is None else
+                           tuple(list(e if isinstance(e, tuple) else [e])
+                                 + [axis]))
+                break
+    kw = {"memory_kind": memory_kind} if memory_kind else {}
+    return NamedSharding(jm, PartitionSpec(*spec), **kw)
+
+
 class ShardedOptimizer:
     """Optimizer wrapper that keeps accumulators (and optionally masters)
-    sharded over the sharding axis — ZeRO-1 memory footprint. With
-    ``offload=True`` the sharded state additionally lives in host memory
-    between steps (GroupShardedOptimizerStage2's offload mode backed by the
-    async_load copy engine; here jax's pinned-host transfer)."""
+    sharded over the sharding axis — ZeRO-1 memory footprint; with
+    ``grad_sharded`` (stage 2) it also restores parameter shardings after
+    the update, and with ``offload=True`` parks the sharded state in pinned
+    host memory between steps."""
 
     def __init__(self, optimizer, mesh: ProcessMesh, axis="dp",
-                 offload=False):
+                 offload=False, grad_sharded=False):
         self._inner = optimizer
         self._mesh = mesh
+        self._axis = axis
         self._axis_idx = _axis_index(mesh, axis)
         self._degree = (mesh.get_dim_size(axis)
                         if self._axis_idx is not None else 1)
         self._offload = offload
-        self._cpu = jax.devices("cpu")[0] if offload else None
+        self._grad_sharded = grad_sharded
 
-    def _shard_state(self):
+    def _move_state(self, memory_kind):
         for store in (self._inner._accumulators, self._inner._master_weights):
             for key, v in list(store.items()):
-                if self._offload:
-                    store[key] = jax.device_put(v, self._cpu)
-                    continue
-                pl = _shard0_placements(
-                    self._mesh, self._axis_idx, v.shape, self._degree)
-                sharding = to_named_sharding(self._mesh, pl)
-                if v.sharding != sharding:
+                sharding = _augmented_sharding(
+                    v, self._mesh, self._axis, self._degree, memory_kind)
+                if sharding is not None and v.sharding != sharding:
                     store[key] = jax.device_put(v, sharding)
 
     def step(self):
-        self._inner.step()
-        self._shard_state()
+        if self._offload:
+            # bring the sharded state back into device memory for the update
+            self._move_state(None)
+        if self._grad_sharded:
+            # stage 2: the update consumes Shard(0) grads; keep the model's
+            # own param layout stable across the step (the reference
+            # broadcasts updated param shards back to the group)
+            prev = [(p, p._value.sharding)
+                    for p in self._inner._parameter_list
+                    if getattr(p, "_value", None) is not None]
+            self._inner.step()
+            for p, sh in prev:
+                if p._value.sharding != sh:
+                    p._value = jax.device_put(p._value, sh)
+        else:
+            self._inner.step()
+        self._move_state("pinned_host" if self._offload else None)
 
     def __getattr__(self, name):
         return getattr(self._inner, name)
+
+
+def _shard_gradients(model, mesh, axis, degree):
+    """Stage-2 gradient partitioning: a leaf hook reshards each parameter's
+    incoming gradient over the sharding axis (preserving any existing TP
+    placements on other axes), so the live grad holds only 1/degree per
+    device. Inside a trace the hook becomes a sharding constraint (XLA then
+    emits the reduce-scatter directly)."""
+    from ...core.tensor import Tensor
+
+    for _, p in model.named_parameters():
+        if p.stop_gradient:
+            continue
+
+        def hook(g, _p=p):
+            # target computed at call time from the param's CURRENT layout
+            gv = g._value
+            sharding = _augmented_sharding(_p._value, mesh, axis, degree)
+            if sharding is None:
+                return g
+            if isinstance(gv, jax.core.Tracer):
+                return Tensor._from_value(
+                    jax.lax.with_sharding_constraint(gv, sharding),
+                    stop_gradient=True)
+            return Tensor._from_value(jax.device_put(gv, sharding),
+                                      stop_gradient=True)
+
+        p.register_hook(hook)
 
 
 def group_sharded_parallel(model, optimizer, level="os", scaler=None,
@@ -95,7 +183,23 @@ def group_sharded_parallel(model, optimizer, level="os", scaler=None,
         for _, p in model.named_parameters():
             pl = _shard0_placements(mesh, axis_idx, p.shape, degree)
             shard_tensor(p, mesh, pl)
+    else:
+        # DP semantics: parameters must live on the sharding group's device
+        # set (one update program sees params and sharded grads together) —
+        # but a param already laid out on the mesh (e.g. TP-sharded over mp)
+        # keeps its placement
+        mesh_devs = set(d.id for d in mesh.jax_mesh().devices.flat)
+        for _, p in model.named_parameters():
+            try:
+                devs = set(d.id for d in p._value.sharding.device_set)
+            except AttributeError:
+                devs = set()
+            if devs != mesh_devs:
+                shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
+    if level in ("os_g", "p_g_os"):
+        _shard_gradients(model, mesh, axis, degree)
 
     sharded_opt = ShardedOptimizer(optimizer, mesh, axis=axis,
-                                   offload=offload)
+                                   offload=offload,
+                                   grad_sharded=level in ("os_g", "p_g_os"))
     return model, sharded_opt, scaler
