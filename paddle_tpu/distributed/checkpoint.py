@@ -1,19 +1,28 @@
-"""Distributed checkpoint: sharded save + reshard-on-load.
+"""Distributed checkpoint: sharded save + reshard-on-load, multi-host safe.
 
 Analog of /root/reference/python/paddle/distributed/checkpoint/
 (save_state_dict.py, load_state_dict.py, metadata.py): per-rank ``.distcp``
-shard files + a global ``metadata`` mapping each tensor to
+shard files + metadata mapping each tensor to
 (global_shape, dtype, per-shard global offsets), with cross-rank dedup of
 replicated tensors (dedup_tensor:117) and reshard-on-load across different
 meshes/degrees (ReadItem planning, load_state_dict.py:41).
 
-Single-controller jax simplifies both halves: every ``jax.Array`` already
-knows its global value and sharding, so *dedup* is "write each global
-tensor once, from its addressable shards", and *reshard-on-load* is
-``jax.device_put`` onto the destination tensor's sharding — the transfer
-engine moves exactly the shard bytes each device needs. The on-disk format
-shards tensors along dim 0 across ``num_shards`` files so multi-host loads
-can read in parallel (file-rank balancing, load_state_dict.py:252).
+Multi-host discipline — the two reference invariants this file preserves:
+
+* **save never materializes a global tensor.** Each process writes only its
+  *addressable* shards (``jax.Array.addressable_shards``), deduped by
+  ``replica_id == 0`` — exactly one process writes each replicated piece,
+  like the reference's ``dedup_tensor``. Per-dim global offsets come from
+  each shard's ``.index``, so sharding along ANY dim (or several) is
+  recorded faithfully. Each rank also writes its own
+  ``{rank}.metadata.json`` — no cross-rank gather at save time.
+* **load plans per-shard reads.** For every addressable shard of the
+  *destination* layout, the loader computes which saved pieces overlap its
+  global index box (the ReadItem plan), reads only those entries, assembles
+  the local block, and builds the global array with
+  ``jax.make_array_from_single_device_arrays`` — each host touches only
+  the bytes its devices need, so save-dp2 → load-dp4 (or any other
+  degree/mesh change) reshards on the fly.
 """
 from __future__ import annotations
 
@@ -27,91 +36,196 @@ from ..framework.io import load_arrays, save_arrays
 
 __all__ = ["save_state_dict", "load_state_dict"]
 
-_META = "metadata.json"
+
+def _index_to_offsets(index, shape):
+    """A shard's ``.index`` (tuple of slices into the global array) as
+    concrete per-dim [start, stop)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
 
 
-def _to_np(v):
-    if isinstance(v, Tensor):
-        v = v._value
-    return np.asarray(v)
+def _is_jax_array(v):
+    import jax
+
+    return isinstance(v, jax.Array)
 
 
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, num_shards=None, async_save=False):
-    """Write ``state_dict`` as a sharded checkpoint directory."""
+    """Write ``state_dict`` as a sharded checkpoint directory: this
+    process's addressable shards + this process's metadata.
+
+    ``num_shards``/``async_save`` are accepted for reference-API parity but
+    ignored: file parallelism is one file per process (the reference's
+    per-rank ``.distcp`` layout), and saving is synchronous.
+    """
+    import jax
+
     os.makedirs(path, exist_ok=True)
-    items = {k: _to_np(v) for k, v in state_dict.items()}
-    if num_shards is None:
-        import jax
+    rank = jax.process_index()
+    fname = f"{rank}.distcp"
+    local: dict[str, np.ndarray] = {}
+    # world_size lets load ignore stale higher-rank files left behind by an
+    # earlier save into the same directory from a larger world
+    meta = {"tensors": {}, "version": 2,
+            "world_size": jax.process_count()}
 
-        num_shards = min(max(len(jax.devices()), 1), 8)
-
-    meta = {"tensors": {}, "num_shards": num_shards, "version": 1}
-    shards: list[dict] = [{} for _ in range(num_shards)]
-    for key, arr in items.items():
-        if arr.ndim > 0 and arr.shape[0] >= num_shards:
-            splits = np.array_split(arr, num_shards, axis=0)
-            offsets = []
-            off = 0
-            for i, piece in enumerate(splits):
-                shards[i][key] = piece
-                offsets.append([off, int(piece.shape[0])])
-                off += int(piece.shape[0])
+    for key, v in state_dict.items():
+        if isinstance(v, Tensor):
+            v = v._value
+        if _is_jax_array(v) and v.ndim > 0:
+            entry = {"shape": list(v.shape), "dtype": np.dtype(v.dtype).name,
+                     "shards": []}
+            for j, sh in enumerate(v.addressable_shards):
+                if sh.replica_id != 0:
+                    continue  # dedup: one writer per replicated piece
+                data = np.asarray(sh.data)
+                skey = f"{key}@{rank}.{j}"
+                local[skey] = data
+                entry["shards"].append({
+                    "key": skey, "file": fname,
+                    "offsets": _index_to_offsets(sh.index, v.shape),
+                })
+            if entry["shards"]:
+                meta["tensors"][key] = entry
+        elif rank == coordinator_rank:
+            # host scalars / plain arrays: identical on every rank, the
+            # coordinator writes them
+            arr = np.asarray(v)
+            skey = f"{key}@{rank}.0"
+            local[skey] = arr
             meta["tensors"][key] = {
                 "shape": list(arr.shape), "dtype": arr.dtype.name,
-                "sharded_dim0": offsets,
-            }
-        else:
-            shards[0][key] = arr
-            meta["tensors"][key] = {
-                "shape": list(arr.shape), "dtype": arr.dtype.name,
-                "sharded_dim0": None,
+                "shards": [{"key": skey, "file": fname,
+                            "offsets": [[0, s] for s in arr.shape]}],
             }
 
-    for i, shard in enumerate(shards):
-        save_arrays(shard, os.path.join(path, f"{i}.distcp"))
-    with open(os.path.join(path, _META), "w") as f:
+    save_arrays(local, os.path.join(path, fname))
+    with open(os.path.join(path, f"{rank}.metadata.json"), "w") as f:
         json.dump(meta, f)
+
+
+def _merged_metadata(path):
+    first = os.path.join(path, "0.metadata.json")
+    if not os.path.exists(first):
+        if os.path.exists(os.path.join(path, "metadata.json")):
+            raise ValueError(
+                f"checkpoint at {path} uses the legacy v1 single-metadata "
+                "format, which this version no longer reads; re-save it")
+        raise FileNotFoundError(f"no 0.metadata.json under {path}")
+    with open(first) as f:
+        meta0 = json.load(f)
+    world = int(meta0.get("world_size", 1))
+    # merge exactly ranks [0, world): stale higher-rank files from an older,
+    # larger-world save into this directory are ignored
+    files = [os.path.join(path, f"{r}.metadata.json") for r in range(world)]
+    missing = [fp for fp in files if not os.path.exists(fp)]
+    if missing:
+        raise FileNotFoundError(
+            f"checkpoint at {path} saved from {world} processes is missing "
+            f"metadata files: {missing}")
+    tensors: dict[str, dict] = {}
+    for fp in files:
+        with open(fp) as f:
+            meta = json.load(f)
+        for key, entry in meta["tensors"].items():
+            if key in tensors:
+                tensors[key]["shards"].extend(entry["shards"])
+            else:
+                tensors[key] = {"shape": entry["shape"],
+                                "dtype": entry["dtype"],
+                                "shards": list(entry["shards"])}
+    return tensors
+
+
+def _fill_block(block, dst_off, pieces, read):
+    """Copy every overlapping saved piece into ``block`` (whose global box
+    is ``dst_off``). Returns the number of elements filled."""
+    filled = 0
+    for piece in pieces:
+        src_off = piece["offsets"]
+        dst_sl, src_sl = [], []
+        empty = False
+        for (d0, d1), (s0, s1) in zip(dst_off, src_off):
+            lo, hi = max(d0, s0), min(d1, s1)
+            if lo >= hi:
+                empty = True
+                break
+            dst_sl.append(slice(lo - d0, hi - d0))
+            src_sl.append(slice(lo - s0, hi - s0))
+        if empty:
+            continue
+        src = read(piece["file"], piece["key"])
+        block[tuple(dst_sl)] = src[tuple(src_sl)]
+        filled += int(np.prod([sl.stop - sl.start for sl in dst_sl]))
+    return filled
 
 
 def load_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, offload=False):
     """Fill ``state_dict``'s tensors in place from a checkpoint directory,
-    resharding each tensor onto its current placement."""
+    resharding each tensor onto its current placement. Reads only the
+    pieces this process's devices need."""
     import jax
     import jax.numpy as jnp
 
-    with open(os.path.join(path, _META)) as f:
-        meta = json.load(f)
-    num_shards = meta["num_shards"]
-    shard_data = [load_arrays(os.path.join(path, f"{i}.distcp"))
-                  for i in range(num_shards)]
+    tensors = _merged_metadata(path)
+    file_cache: dict[str, dict] = {}
+
+    def read(fname, key):
+        if fname not in file_cache:
+            file_cache[fname] = load_arrays(os.path.join(path, fname))
+        return file_cache[fname][key]
 
     missing = []
     for key, target in state_dict.items():
-        info = meta["tensors"].get(key)
+        info = tensors.get(key)
         if info is None:
             missing.append(key)
             continue
-        if info["sharded_dim0"] is not None:
-            pieces = [shard_data[i][key] for i in range(num_shards)
-                      if key in shard_data[i]]
-            arr = np.concatenate(pieces, axis=0)
+        tv = target._value if isinstance(target, Tensor) else None
+        if list(info["shape"]) != list(
+                tv.shape if tv is not None else np.asarray(
+                    state_dict[key]).shape):
+            raise ValueError(
+                f"{key}: checkpoint shape {info['shape']} != target shape")
+        if tv is not None and _is_jax_array(tv) and tv.ndim > 0:
+            dtype = tv.dtype
+            blocks = []
+            for sh in tv.addressable_shards:
+                dst_off = _index_to_offsets(sh.index, tv.shape)
+                shape = [b - a for a, b in dst_off]
+                block = np.empty(shape, dtype=np.dtype(info["dtype"]))
+                n = _fill_block(block, dst_off, info["shards"], read)
+                if n != int(np.prod(shape)):
+                    raise ValueError(
+                        f"{key}: shard at {dst_off} only {n}/"
+                        f"{int(np.prod(shape))} elements covered by "
+                        f"checkpoint pieces")
+                blocks.append(jax.device_put(
+                    jnp.asarray(block, dtype=dtype), sh.device))
+            target._value = jax.make_array_from_single_device_arrays(
+                tv.shape, tv.sharding, blocks)
         else:
-            arr = shard_data[0][key]
-        if list(arr.shape) != list(info["shape"]):
-            raise ValueError(f"shard reassembly mismatch for {key}")
-        if isinstance(target, Tensor):
-            if tuple(arr.shape) != tuple(target._value.shape):
-                raise ValueError(
-                    f"{key}: checkpoint shape {arr.shape} != tensor shape "
-                    f"{tuple(target._value.shape)}")
-            value = jnp.asarray(arr, dtype=target._value.dtype)
-            # reshard-on-load: place onto the live tensor's sharding
-            value = jax.device_put(value, target._value.sharding)
-            target._value = value
-        else:
-            state_dict[key] = arr
+            # plain array / scalar target: assemble the full value
+            full = np.empty(info["shape"], dtype=np.dtype(info["dtype"]))
+            dst_off = [[0, s] for s in info["shape"]]
+            n = _fill_block(full, dst_off, info["shards"], read)
+            if n != int(np.prod(info["shape"], dtype=np.int64)):
+                raise ValueError(f"{key}: incomplete checkpoint coverage")
+            if isinstance(target, Tensor):
+                value = jnp.asarray(full, dtype=target._value.dtype)
+                if _is_jax_array(target._value):
+                    # keep the target's committed placement (0-d tensors
+                    # placed on a mesh must stay there)
+                    value = jax.device_put(value, target._value.sharding)
+                target._value = value
+            else:
+                state_dict[key] = full
     if missing:
         raise KeyError(f"checkpoint at {path} is missing keys: {missing}")
     return state_dict

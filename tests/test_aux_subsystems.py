@@ -170,7 +170,7 @@ def test_distributed_checkpoint_roundtrip(tmp_path):
     }
     path = str(tmp_path / "ckpt")
     dist.save_state_dict(sd, path)
-    assert os.path.exists(os.path.join(path, "metadata.json"))
+    assert os.path.exists(os.path.join(path, "0.metadata.json"))
 
     target = {
         "w": paddle.to_tensor(np.zeros((16, 8), np.float32)),

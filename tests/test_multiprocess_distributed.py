@@ -58,6 +58,31 @@ WORKER = textwrap.dedent("""
     l0 = float(step(data))
     l1 = float(step(data))
     assert l1 < l0, (l0, l1)
+
+    # distributed checkpoint: each process writes ONLY its addressable
+    # shards (multi-host safe — materializing the global array would throw
+    # on a real pod), then loads back into a different sharding.
+    ckpt = os.environ["CKPT_DIR"]
+    w = dist.shard_tensor(
+        paddle.to_tensor(
+            np.arange(n_dev * 16, dtype=np.float32).reshape(n_dev, 16)),
+        mesh, [dist.Shard(0)])
+    dist.save_state_dict({"w": w, "step": paddle.to_tensor(np.int64(7))},
+                         ckpt)
+    # barrier via the jax collective runtime: both ranks' files must exist
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices("ckpt_saved")
+    target = dist.shard_tensor(
+        paddle.to_tensor(np.zeros((n_dev, 16), np.float32)), mesh,
+        [dist.Shard(1)])  # different placement than saved
+    got = dist.load_state_dict(
+        {"w": target, "step": paddle.to_tensor(np.int64(0))}, ckpt)
+    expect = np.arange(n_dev * 16, dtype=np.float32).reshape(n_dev, 16)
+    for sh in target._value.addressable_shards:  # global fetch would throw
+        np.testing.assert_array_equal(np.asarray(sh.data), expect[sh.index])
+    assert int(got["step"]._value) == 7
+
     print(f"rank={rank}/{world} ndev={n_dev} ok loss {l0:.4f}->{l1:.4f}",
           flush=True)
 """)
@@ -73,10 +98,35 @@ def test_two_process_global_mesh(tmp_path):
     probe = TCPStore(is_master=True)
     port = probe.port
     probe.close()
-    rc = launch(str(script), nproc_per_node=2,
-                master=f"127.0.0.1:{port}",
-                log_dir=str(tmp_path / "logs"))
+    ckpt_dir = tmp_path / "ckpt"
+    os.environ["CKPT_DIR"] = str(ckpt_dir)
+    try:
+        rc = launch(str(script), nproc_per_node=2,
+                    master=f"127.0.0.1:{port}",
+                    log_dir=str(tmp_path / "logs"))
+    finally:
+        os.environ.pop("CKPT_DIR", None)
     logs = "".join(
         (tmp_path / "logs" / f"worker.{r}.log").read_text() for r in (0, 1))
     assert rc == 0, logs
     assert "rank=0/2 ndev=16 ok" in logs and "rank=1/2 ndev=16 ok" in logs, logs
+
+    # cross-degree load: the 2-process (16-device) checkpoint loads into
+    # THIS single process's 8-device mesh — different world size and dp
+    # degree on load vs save (ReadItem planning + reshard-on-load).
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    mesh = dist.ProcessMesh(np.arange(8).reshape(4, 2), ["dp", "mp"])
+    target = dist.shard_tensor(
+        paddle.to_tensor(np.zeros((16, 16), np.float32)), mesh,
+        [dist.Shard(0), dist.Shard(1)])
+    got = dist.load_state_dict(
+        {"w": target, "step": np.int64(0)}, str(ckpt_dir))
+    np.testing.assert_array_equal(
+        np.asarray(target._value),
+        np.arange(256, dtype=np.float32).reshape(16, 16))
+    assert int(got["step"]) == 7
+    assert target._value.addressable_shards[0].data.shape == (4, 8)
