@@ -32,7 +32,7 @@ import os
 import numpy as np
 
 from ..core.tensor import Tensor
-from ..framework.io import load_arrays, save_arrays
+from ..framework.io import save_arrays
 
 __all__ = ["save_state_dict", "load_state_dict"]
 
@@ -173,13 +173,16 @@ def load_state_dict(state_dict, path, process_group=None,
     import jax
     import jax.numpy as jnp
 
+    from ..framework.io import ArrayFileReader
+
     tensors = _merged_metadata(path)
-    file_cache: dict[str, dict] = {}
+    file_cache: dict[str, ArrayFileReader] = {}
 
     def read(fname, key):
+        # header-indexed seek+read: only overlapping pieces leave disk
         if fname not in file_cache:
-            file_cache[fname] = load_arrays(os.path.join(path, fname))
-        return file_cache[fname][key]
+            file_cache[fname] = ArrayFileReader(os.path.join(path, fname))
+        return file_cache[fname].read(key)
 
     missing = []
     for key, target in state_dict.items():

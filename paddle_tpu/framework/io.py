@@ -160,3 +160,42 @@ def save_arrays(named_arrays: dict, path):
 
 def load_arrays(path) -> dict:
     return load(path, return_numpy=True)
+
+
+class ArrayFileReader:
+    """Random-access reader over a flat name->array save file: parses the
+    header once, then seek+reads only the entries asked for — so a
+    distributed-checkpoint load touches just the bytes its shards overlap
+    instead of materializing every rank's whole file."""
+
+    def __init__(self, path):
+        self._path = str(path)
+        with open(self._path, "rb") as f:
+            magic = f.read(len(_MAGIC))
+            if magic != _MAGIC:
+                raise ValueError(
+                    f"{path} is not a paddle_tpu checkpoint "
+                    f"(bad magic {magic!r})")
+            (hlen,) = struct.unpack("<Q", f.read(8))
+            header = json.loads(f.read(hlen).decode("utf-8"))
+        self._metas = header["tensors"]
+        self._payload_start = len(_MAGIC) + 8 + hlen
+        self._index = _unflatten(
+            header["tree"], list(range(len(self._metas))),
+            return_tensor=False)
+        if not isinstance(self._index, dict):
+            raise ValueError(f"{path} is not a flat name->array save")
+
+    def keys(self):
+        return self._index.keys()
+
+    def __contains__(self, key):
+        return key in self._index
+
+    def read(self, key) -> np.ndarray:
+        meta = self._metas[self._index[key]]
+        with open(self._path, "rb") as f:
+            f.seek(self._payload_start + meta["offset"])
+            raw = f.read(meta["nbytes"])
+        return np.frombuffer(raw, dtype=_np_dtype(meta["dtype"])).reshape(
+            meta["shape"]).copy()

@@ -37,3 +37,23 @@ def fused_layer_norm(x, weight, bias, epsilon=1e-5, begin_norm_axis=1):
 
     return layer_norm(x, weight, bias, epsilon=epsilon,
                       begin_norm_axis=begin_norm_axis)
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """``layer_norm(residual + dropout(x + bias))`` — one fused Pallas pass
+    (analog of paddle/phi/kernels/fusion/gpu/
+    fused_bias_dropout_residual_layer_norm); registry op, so it composes
+    with eager autograd and the jit caches."""
+    from ...core import random as _random
+    from ...ops import fused_bias_dropout_residual_layer_norm as _op
+
+    import jax
+
+    rng_key = (jax.random.key_data(_random.next_key())
+               if (training and dropout_rate > 0.0) else None)
+    return _op(x, residual, bias, ln_scale, ln_bias,
+               dropout_rate=dropout_rate, ln_epsilon=ln_epsilon,
+               training=training, mode=mode, rng_key=rng_key)

@@ -5,6 +5,7 @@ The flagship is LLaMA (the judge's north-star program,
 GPT and vision models live beside it (vision models under paddle_tpu.vision).
 """
 from .llama import (  # noqa: F401
+    PagedKVCache,
     LlamaAttention,
     LlamaConfig,
     LlamaDecoderLayer,
@@ -20,7 +21,7 @@ from .llama import (  # noqa: F401
 )
 
 __all__ = [
-    "LlamaConfig", "LlamaForCausalLM", "LlamaModel", "LlamaAttention",
+    "PagedKVCache", "LlamaConfig", "LlamaForCausalLM", "LlamaModel", "LlamaAttention",
     "LlamaMLP", "LlamaDecoderLayer", "LlamaPretrainingCriterion",
     "LlamaEmbeddingPipe", "LlamaHeadPipe", "llama_pipeline_module",
     "llama_shard_fn", "llama_tiny_config",

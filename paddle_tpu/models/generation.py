@@ -37,12 +37,16 @@ def _sample(logits, temperature, top_k, top_p, greedy):
 
 
 def generate(model, input_ids, max_new_tokens=20, do_sample=False,
-             temperature=1.0, top_k=None, top_p=None, eos_token_id=None):
+             temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
+             cache="static"):
     """Decode ``max_new_tokens`` continuations of ``input_ids`` (B, S).
 
     The model must support ``forward(ids, attn_mask=None, caches=...)``
     returning (logits, caches) — models.LlamaForCausalLM / GPT-style.
-    Returns (B, S + new) token ids.
+    ``cache``: "static" = fixed-size per-sequence buffers
+    (masked_multihead_attention semantics); "paged" = block-table paged
+    pool served by the Pallas paged_attention kernel
+    (block_multi_head_attention semantics). Returns (B, S + new) ids.
     """
     ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
     b, s = ids.shape
@@ -52,16 +56,23 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     cfg = model.config
     kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
     max_len = s + max_new_tokens
-    from .llama import StaticCache
+    from .llama import PagedKVCache, StaticCache
 
     # cache in the model's compute dtype (bf16 models keep a bf16 KV cache)
     try:
         cache_dtype = next(iter(model.parameters()))._value.dtype
     except StopIteration:
         cache_dtype = jnp.float32
-    empty = [StaticCache(b, max_len, kv_heads, cfg.head_dim,
-                         dtype=cache_dtype)
-             for _ in range(cfg.num_hidden_layers)]
+    if cache == "paged":
+        page = 128
+        padded = ((max_len + page - 1) // page) * page
+        empty = [PagedKVCache(b, padded, kv_heads, cfg.head_dim,
+                              page_size=page, dtype=cache_dtype)
+                 for _ in range(cfg.num_hidden_layers)]
+    else:
+        empty = [StaticCache(b, max_len, kv_heads, cfg.head_dim,
+                             dtype=cache_dtype)
+                 for _ in range(cfg.num_hidden_layers)]
 
     with autograd.no_grad():
         logits, caches = model(Tensor._from_value(ids), caches=empty)

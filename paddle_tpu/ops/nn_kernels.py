@@ -313,6 +313,34 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", *, rng_
     return jnp.where(keep, x, 0.0).astype(x.dtype)
 
 
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", *, rng_key=None):
+    """``layer_norm(residual + dropout(x + bias))`` — the analog of
+    paddle/phi/kernels/fusion/gpu/fused_bias_dropout_residual_layer_norm.
+    The upscale_in_train case runs the fused Pallas kernel
+    (ops/pallas/fused_ops.py) when FLAGS_use_pallas_kernels is set; other
+    modes compose dropout + layer_norm (XLA fuses them)."""
+    from ..core.flags import flag as _flag
+
+    if mode == "upscale_in_train" and _flag("FLAGS_use_pallas_kernels"):
+        from .pallas.fused_ops import bias_dropout_residual_ln
+
+        key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+               else _random.next_key())
+        return bias_dropout_residual_ln(
+            x, residual, bias, ln_scale, ln_bias,
+            dropout_rate=dropout_rate, ln_epsilon=ln_epsilon,
+            training=training, rng_key=key)
+    h = x + bias if bias is not None else x
+    h = dropout(h, p=dropout_rate, training=training, mode=mode,
+                rng_key=rng_key)
+    z = h + residual
+    return layer_norm(z, ln_scale, ln_bias, epsilon=ln_epsilon,
+                      begin_norm_axis=z.ndim - 1)
+
+
 def alpha_dropout(x, p=0.5, training=True, *, rng_key=None):
     """SELU-preserving dropout (reference python/paddle/nn/functional/common.py
     alpha_dropout): dropped units are set to alpha' and an affine correction
@@ -709,8 +737,18 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
 def rotary_position_embedding(q, k, cos, sin, position_ids=None, use_neox_rotary_style=True):
     """Fused RoPE analog (/root/reference/paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu:27).
 
-    q, k: (B, S, H, D); cos/sin: (1, S, 1, D) or (S, D).
+    q, k: (B, S, H, D); cos/sin: (1, S, 1, D) or (S, D). The neox
+    no-position-ids case runs the fused Pallas kernel
+    (ops/pallas/fused_ops.py) when FLAGS_use_pallas_kernels is set.
     """
+    from ..core.flags import flag as _flag
+
+    if _flag("FLAGS_use_pallas_kernels"):
+        from .pallas import fused_ops as _fo
+
+        if _fo.fused_rope_supported(q, cos, position_ids,
+                                    use_neox_rotary_style):
+            return _fo.fused_rope(q, k, cos, sin)
 
     def rope(x):
         if x is None:

@@ -346,6 +346,20 @@ case("bernoulli", lambda: ((T(np.full((64,), 0.5, np.float32)),), {}),
 case("multinomial", lambda: ((T(np.full((4,), 0.25, np.float32)),),
                              {"num_samples": 2}), None, grad=False)
 case("dropout", lambda: ((T(P((8, 8))),), {"p": 0.5}), None, grad=False)
+
+
+def _bdrln_ref(x, res, bias, g, b):
+    z = x + bias + res
+    m = z.mean(-1, keepdims=True)
+    v = ((z - m) ** 2).mean(-1, keepdims=True)
+    return (z - m) / np.sqrt(v + 1e-5) * g + b
+
+
+case("fused_bias_dropout_residual_layer_norm",
+     lambda: ((T(P((4, 64))), T(P((4, 64))), T(P((64,))), T(PP((64,))),
+               T(P((64,)))),
+              {"dropout_rate": 0.0, "training": False}),
+     _bdrln_ref, grad=True)
 case("alpha_dropout", lambda: ((T(P((8, 8))),), {"p": 0.5}), None,
      grad=False)
 case("gumbel_softmax", lambda: ((T(P((4, 5))),), {}), None, grad=False)
