@@ -18,10 +18,11 @@ from ..core.tensor import Tensor
 from . import backward as _backward_rules
 from . import kernels as _k
 from . import kernels_ext as _ext
+from . import kernels_tail as _tail
 from . import nn_kernels as _nn
 from .registry import OPS, apply_op, get_op, register_op
 
-_MODULES = {"k": _k, "ext": _ext, "nn": _nn}
+_MODULES = {"k": _k, "ext": _ext, "nn": _nn, "tail": _tail}
 
 
 def _load_yaml_registry():

@@ -1,0 +1,1036 @@
+"""Op-tail kernels — the remaining reference base-yaml surface.
+
+Closes the gap against /root/reference/paddle/phi/ops/yaml/ops.yaml (467
+base ops): activations, losses, pooling/interp variants, signal framing,
+detection/box utilities, fake-quantization, AMP bookkeeping and functional
+optimizer-update ops. Pure jnp compositions — XLA fuses these; the hot
+fused paths live in ops/pallas/. Reference kernel anchors cited per
+function.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import random as _random
+
+# ------------------------------------------------------------ activations
+
+
+def logsigmoid(x):
+    return jax.nn.log_sigmoid(x)
+
+
+def tanh_shrink(x):
+    return x - jnp.tanh(x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0):
+    return jnp.where(x > threshold, x, value)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, *,
+          rng_key=None):
+    """paddle/phi/kernels/gpu/rrelu_kernel.cu: random leaky slope in
+    [lower, upper) per element when training, mean slope in eval."""
+    if not training:
+        return jnp.where(x >= 0, x, x * ((lower + upper) / 2.0))
+    key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+           else _random.next_key())
+    a = jax.random.uniform(key, x.shape, jnp.float32, lower, upper)
+    return jnp.where(x >= 0, x, x * a.astype(x.dtype))
+
+
+def swiglu(x, y=None):
+    """phi/kernels/gpu/swiglu_kernel.cu: silu(x) * y (y defaults to the
+    second half of x split on the last dim)."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+# ------------------------------------------------------------ reductions
+
+
+def mean_all(x):
+    return jnp.mean(x)
+
+
+def numel(x):
+    return jnp.asarray(np.prod(x.shape) if x.shape else 1, jnp.int64)
+
+
+def shape(x):
+    return jnp.asarray(x.shape, jnp.int32)
+
+
+def is_empty(x):
+    return jnp.asarray(x.size == 0)
+
+
+def l1_norm(x):
+    return jnp.sum(jnp.abs(x))
+
+
+def squared_l2_norm(x):
+    return jnp.sum(jnp.square(x))
+
+
+def frobenius_norm(x, axis=None, keepdim=False):
+    if axis is None:
+        return jnp.sqrt(jnp.sum(jnp.square(x)))
+    return jnp.sqrt(jnp.sum(jnp.square(x), axis=tuple(axis),
+                            keepdims=keepdim))
+
+
+def clip_by_norm(x, max_norm):
+    """phi/kernels/impl/clip_by_norm_kernel_impl.h: scale down to L2 norm
+    max_norm."""
+    norm = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+    scale = max_norm / jnp.maximum(norm, max_norm)
+    return (x.astype(jnp.float32) * scale).astype(x.dtype)
+
+
+# ------------------------------------------------------------ creation/view
+
+
+def fill(x, value=0.0):
+    return jnp.full_like(x, value)
+
+
+def fill_diagonal(x, value=0.0, offset=0, wrap=False):
+    eye = jnp.eye(x.shape[-2], x.shape[-1], k=offset, dtype=bool)
+    return jnp.where(eye, jnp.asarray(value, x.dtype), x)
+
+
+def empty(shape, dtype="float32"):
+    from ..core.dtype import to_jax_dtype
+
+    return jnp.zeros(tuple(shape), to_jax_dtype(dtype))
+
+
+def empty_like(x, dtype=None):
+    from ..core.dtype import to_jax_dtype
+
+    return jnp.zeros_like(x, dtype=to_jax_dtype(dtype) if dtype else None)
+
+
+def reverse(x, axis):
+    ax = (axis,) if isinstance(axis, int) else tuple(axis)
+    return jnp.flip(x, ax)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64"):
+    """phi/kernels/sequence_mask_kernel: mask[i, j] = j < lengths[i]."""
+    from ..core.dtype import to_jax_dtype
+
+    if maxlen is None or maxlen < 0:
+        maxlen = int(jnp.max(lengths))
+    cols = jnp.arange(maxlen)
+    mask = cols[None, :] < lengths.reshape(-1, 1)
+    return mask.reshape(*lengths.shape, maxlen).astype(to_jax_dtype(dtype))
+
+
+def share_data(x):
+    return x
+
+
+def split_with_num(x, num, axis=0):
+    return tuple(jnp.split(x, num, axis=axis))
+
+
+def partial_sum(inputs, start_index=0, length=-1):
+    """operators/partial_sum_op: sum of column slices of 2-D inputs."""
+    sl = [v[:, start_index:(None if length < 0 else start_index + length)]
+          for v in inputs]
+    return sum(sl[1:], sl[0])
+
+
+def partial_concat(inputs, start_index=0, length=-1):
+    sl = [v[:, start_index:(None if length < 0 else start_index + length)]
+          for v in inputs]
+    return jnp.concatenate(sl, axis=1)
+
+
+# ------------------------------------------------------------ losses
+
+
+def hinge_loss(logits, labels):
+    """operators/hinge_loss_op: max(1 - logits*(2*labels-1), 0)."""
+    return jnp.maximum(1.0 - logits * (2.0 * labels - 1.0), 0.0)
+
+
+def huber_loss(input, label, delta=1.0):
+    r = input - label
+    a = jnp.abs(r)
+    return jnp.where(a <= delta, 0.5 * r * r, delta * (a - 0.5 * delta))
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return (-label * jnp.log(input + epsilon)
+            - (1.0 - label) * jnp.log(1.0 - input + epsilon))
+
+
+def sigmoid_cross_entropy_with_logits(x, label, normalize=False,
+                                      ignore_index=-100):
+    loss = jnp.maximum(x, 0) - x * label + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    valid = label != ignore_index
+    loss = jnp.where(valid, loss, 0.0)
+    if normalize:
+        loss = loss / jnp.maximum(jnp.sum(valid.astype(loss.dtype)), 1.0)
+    return loss
+
+
+def identity_loss(x, reduction=1):
+    # reduction: 0=sum 1=mean 2=none (phi/kernels/identity_loss_kernel)
+    if reduction == 0:
+        return jnp.sum(x)
+    if reduction == 1:
+        return jnp.mean(x)
+    return x
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, return_softmax=False):
+    """phi/kernels/margin_cross_entropy_kernel (single-rank case):
+    cos(m1*theta + m2) - m3 margin applied to the target logit."""
+    theta = jnp.arccos(jnp.clip(logits, -1.0, 1.0))
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    onehot = jax.nn.one_hot(label, logits.shape[-1], dtype=logits.dtype)
+    adjusted = scale * jnp.where(onehot > 0, target, logits)
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.sum(onehot * logp, axis=-1, keepdims=True)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+def accuracy(out, indices, label):
+    """phi/kernels/accuracy_kernel: fraction of rows whose top-k `indices`
+    contain the label. Returns (accuracy, correct, total)."""
+    hit = jnp.any(indices == label.reshape(-1, 1), axis=1)
+    correct = jnp.sum(hit.astype(jnp.int32))
+    total = jnp.asarray(indices.shape[0], jnp.int32)
+    return (correct.astype(jnp.float32) / total.astype(jnp.float32),
+            correct, total)
+
+
+def auc(predict, label, num_thresholds=4095):
+    """phi/kernels/auc_kernel: ROC-AUC via thresholded confusion counts."""
+    pos_score = predict[:, 1] if predict.ndim == 2 else predict
+    buckets = jnp.clip((pos_score * num_thresholds).astype(jnp.int32), 0,
+                       num_thresholds)
+    lab = label.reshape(-1).astype(jnp.float32)
+    pos_hist = jnp.zeros(num_thresholds + 1).at[buckets].add(lab)
+    neg_hist = jnp.zeros(num_thresholds + 1).at[buckets].add(1.0 - lab)
+    # descending-threshold cumulative TP/FP
+    tp = jnp.cumsum(pos_hist[::-1])
+    fp = jnp.cumsum(neg_hist[::-1])
+    tot_pos, tot_neg = tp[-1], fp[-1]
+    # trapezoid over the ROC curve
+    area = jnp.sum((fp[1:] - fp[:-1]) * (tp[1:] + tp[:-1]) / 2.0)
+    area = area + tp[0] * fp[0] / 2.0  # first segment from (0,0)
+    return area / jnp.maximum(tot_pos * tot_neg, 1e-12)
+
+
+# ------------------------------------------------------------ random
+
+
+def dirichlet(alpha, *, rng_key=None):
+    key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+           else _random.next_key())
+    return jax.random.dirichlet(key, alpha)
+
+
+def truncated_gaussian_random(shape, mean=0.0, std=1.0, a=-2.0, b=2.0,
+                              dtype="float32", *, rng_key=None):
+    from ..core.dtype import to_jax_dtype
+
+    key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+           else _random.next_key())
+    z = jax.random.truncated_normal(key, a, b, tuple(shape),
+                                    to_jax_dtype(dtype))
+    return z * std + mean
+
+
+def exponential_(x, lam=1.0, *, rng_key=None):
+    key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+           else _random.next_key())
+    return jax.random.exponential(key, x.shape, x.dtype) / lam
+
+
+def uniform_inplace(x, min=-1.0, max=1.0, *, rng_key=None):
+    key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+           else _random.next_key())
+    return jax.random.uniform(key, x.shape, x.dtype, min, max)
+
+
+def gaussian_inplace(x, mean=0.0, std=1.0, *, rng_key=None):
+    key = (jax.random.wrap_key_data(rng_key) if rng_key is not None
+           else _random.next_key())
+    return jax.random.normal(key, x.shape, x.dtype) * std + mean
+
+
+# ------------------------------------------------------------ quantization
+
+
+def fake_quantize_abs_max(x, bit_length=8):
+    """phi/kernels/fake_quantize_kernels: symmetric per-tensor quantize.
+    Returns (quantized, scale)."""
+    qmax = float((1 << (bit_length - 1)) - 1)
+    scale = jnp.max(jnp.abs(x))
+    q = jnp.round(x / jnp.maximum(scale, 1e-12) * qmax)
+    return jnp.clip(q, -qmax, qmax), scale.reshape(1)
+
+
+def fake_quantize_dequantize_abs_max(x, bit_length=8):
+    q, scale = fake_quantize_abs_max(x, bit_length)
+    qmax = float((1 << (bit_length - 1)) - 1)
+    return q * scale[0] / qmax, scale
+
+
+def fake_channel_wise_quantize_abs_max(x, bit_length=8, quant_axis=0):
+    qmax = float((1 << (bit_length - 1)) - 1)
+    axes = tuple(i for i in range(x.ndim) if i != quant_axis)
+    scale = jnp.max(jnp.abs(x), axis=axes, keepdims=True)
+    q = jnp.clip(jnp.round(x / jnp.maximum(scale, 1e-12) * qmax), -qmax, qmax)
+    return q, scale.reshape(-1)
+
+
+def fake_channel_wise_quantize_dequantize_abs_max(x, bit_length=8,
+                                                  quant_axis=0):
+    qmax = float((1 << (bit_length - 1)) - 1)
+    q, scale = fake_channel_wise_quantize_abs_max(x, bit_length, quant_axis)
+    shape = [1] * x.ndim
+    shape[quant_axis] = -1
+    return q * scale.reshape(shape) / qmax, scale
+
+
+def fake_dequantize_max_abs(x, scale, max_range):
+    return x * scale / max_range
+
+
+def dequantize_abs_max(x, scale, max_range):
+    return x.astype(jnp.float32) * scale / max_range
+
+
+# ------------------------------------------------------------ AMP ops
+
+
+def check_finite_and_unscale_(xs, scale):
+    """phi/kernels/check_finite_and_unscale_kernel: divide grads by scale,
+    flag non-finite. Returns (unscaled..., found_inf)."""
+    inv = 1.0 / scale
+    found = jnp.asarray(False)
+    outs = []
+    for x in xs:
+        y = x.astype(jnp.float32) * inv
+        found = found | ~jnp.all(jnp.isfinite(y))
+        outs.append(y.astype(x.dtype))
+    return (*outs, found)
+
+
+def update_loss_scaling_(scale, found_inf, good_steps,
+                         incr_every_n_steps=2000,
+                         decr_every_n_nan_or_inf=1, incr_ratio=2.0,
+                         decr_ratio=0.5):
+    """phi/kernels/update_loss_scaling_kernel: dynamic loss-scale update.
+    Returns (new_scale, new_good_steps)."""
+    grew = good_steps + 1 >= incr_every_n_steps
+    new_scale = jnp.where(
+        found_inf, jnp.maximum(scale * decr_ratio, 1.0),
+        jnp.where(grew, scale * incr_ratio, scale))
+    new_good = jnp.where(found_inf | grew, 0, good_steps + 1)
+    return new_scale, new_good
+
+
+# ------------------------------------------------------- optimizer updates
+
+
+def sgd_(param, learning_rate, grad):
+    return param - learning_rate * grad
+
+
+def momentum_(param, grad, velocity, learning_rate, mu=0.9,
+              use_nesterov=False):
+    v = mu * velocity + grad
+    if use_nesterov:
+        return param - learning_rate * (grad + mu * v), v
+    return param - learning_rate * v, v
+
+
+def adam_(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+          learning_rate, beta1=0.9, beta2=0.999, epsilon=1e-8):
+    m = beta1 * moment1 + (1 - beta1) * grad
+    v = beta2 * moment2 + (1 - beta2) * grad * grad
+    mhat = m / (1 - beta1_pow)
+    vhat = v / (1 - beta2_pow)
+    new_p = param - learning_rate * mhat / (jnp.sqrt(vhat) + epsilon)
+    return new_p, m, v, beta1_pow * beta1, beta2_pow * beta2
+
+
+def adamw_(param, grad, moment1, moment2, beta1_pow, beta2_pow,
+           learning_rate, beta1=0.9, beta2=0.999, epsilon=1e-8,
+           weight_decay=0.01):
+    p = param * (1 - learning_rate * weight_decay)
+    return adam_(p, grad, moment1, moment2, beta1_pow, beta2_pow,
+                 learning_rate, beta1, beta2, epsilon)
+
+
+def adagrad_(param, grad, moment, learning_rate, epsilon=1e-6):
+    m = moment + grad * grad
+    return param - learning_rate * grad / (jnp.sqrt(m) + epsilon), m
+
+
+def rmsprop_(param, grad, mean_square, learning_rate, rho=0.95,
+             epsilon=1e-6, momentum=0.0):
+    ms = rho * mean_square + (1 - rho) * grad * grad
+    return param - learning_rate * grad / jnp.sqrt(ms + epsilon), ms
+
+
+def merged_momentum_(params, grads, velocities, learning_rate, mu=0.9,
+                     use_nesterov=False):
+    outs = [momentum_(p, g, v, learning_rate, mu, use_nesterov)
+            for p, g, v in zip(params, grads, velocities)]
+    return tuple(o[0] for o in outs), tuple(o[1] for o in outs)
+
+
+# ------------------------------------------------------------ structure
+
+
+def pixel_unshuffle(x, downscale_factor=2, data_format="NCHW"):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        return x.transpose(0, 1, 3, 5, 2, 4).reshape(
+            n, c * r * r, h // r, w // r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(n, h // r, w // r, c * r * r)
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        return x.reshape(n, groups, c // groups, h, w).transpose(
+            0, 2, 1, 3, 4).reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    return x.reshape(n, h, w, groups, c // groups).transpose(
+        0, 1, 2, 4, 3).reshape(n, h, w, c)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW"):
+    """phi/kernels/temporal_shift_kernel: shift 1/4 channels fwd, 1/4 bwd
+    along the segment (time) axis."""
+    if data_format != "NCHW":
+        x = x.transpose(0, 3, 1, 2)
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    v = x.reshape(n, seg_num, c, h, w)
+    c1 = int(c * shift_ratio)
+    c2 = int(c * 2 * shift_ratio)
+    pad = jnp.zeros_like(v[:, :1])
+    fwd = jnp.concatenate([v[:, 1:, :c1], pad[:, :, :c1]], axis=1)
+    bwd = jnp.concatenate([pad[:, :, c1:c2], v[:, :-1, c1:c2]], axis=1)
+    keep = v[:, :, c2:]
+    out = jnp.concatenate([fwd, bwd, keep], axis=2).reshape(nt, c, h, w)
+    if data_format != "NCHW":
+        out = out.transpose(0, 2, 3, 1)
+    return out
+
+
+def add_position_encoding(x, alpha=1.0, beta=1.0):
+    """operators/add_position_encoding_op: sinusoidal PE added to (B,S,H)."""
+    b, s, h = x.shape
+    pos = np.arange(s, dtype=np.float64)[:, None]
+    div = np.power(10000.0, 2 * (np.arange(h // 2, dtype=np.float64)) / h)
+    ang = pos / div
+    pe = np.concatenate([np.sin(ang), np.cos(ang)], axis=1)
+    return alpha * x + beta * jnp.asarray(pe, x.dtype)[None]
+
+
+def bilinear(x, y, weight, bias=None):
+    """phi/kernels/bilinear_kernel: out[b, o] = x[b] @ W[o] @ y[b]."""
+    out = jnp.einsum("bi,oij,bj->bo", x, weight, y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def affine_channel(x, scale, bias, data_format="NCHW"):
+    if data_format == "NCHW":
+        return x * scale.reshape(1, -1, 1, 1) + bias.reshape(1, -1, 1, 1)
+    return x * scale + bias
+
+
+def fused_softmax_mask(x, mask):
+    return jax.nn.softmax(x + mask, axis=-1)
+
+
+def fused_softmax_mask_upper_triangle(x):
+    s = x.shape[-1]
+    rows = jnp.arange(x.shape[-2])[:, None]
+    cols = jnp.arange(s)[None, :]
+    return jax.nn.softmax(jnp.where(cols <= rows, x, -1e9), axis=-1)
+
+
+def gather_tree(ids, parents):
+    """phi/kernels/gather_tree_kernel: beam-search backtrace.
+    ids/parents: (max_time, batch, beam)."""
+    max_time = ids.shape[0]
+
+    def step(carry, t):
+        beams = carry  # (batch, beam) active parent pointers
+        out = jnp.take_along_axis(ids[t], beams, axis=1)
+        new_beams = jnp.take_along_axis(parents[t], beams, axis=1)
+        return new_beams, out
+
+    init = jnp.tile(jnp.arange(ids.shape[2])[None, :], (ids.shape[1], 1))
+    _, outs = jax.lax.scan(step, init, jnp.arange(max_time - 1, -1, -1))
+    return outs[::-1]
+
+
+# ------------------------------------------------------------ pool/interp
+
+
+def pool2d(x, kernel_size, stride=None, padding=0, pooling_type="max",
+           ceil_mode=False, exclusive=True, adaptive=False,
+           data_format="NCHW"):
+    """Generic pool2d op (phi/kernels/pool_kernel): routes to the existing
+    max/avg/adaptive pooling kernels by attribute, like the reference's
+    single pool2d op with a pooling_type attr."""
+    from . import nn_kernels as _nn
+
+    if adaptive:
+        if pooling_type == "max":
+            return _nn.adaptive_max_pool2d(x, kernel_size)
+        return _nn.adaptive_avg_pool2d(x, kernel_size)
+    if pooling_type == "max":
+        return _nn.max_pool2d(x, kernel_size, stride=stride, padding=padding,
+                              ceil_mode=ceil_mode)
+    return _nn.avg_pool2d(x, kernel_size, stride=stride, padding=padding,
+                          ceil_mode=ceil_mode, exclusive=exclusive)
+
+
+def _pool_nd(x, kernel_size, stride, padding, nd, op, init, ceil_mode=False):
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size,) * nd
+    if stride is None:
+        stride = kernel_size
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    if isinstance(padding, int):
+        padding = (padding,) * nd
+    window = (1, 1) + tuple(kernel_size)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + tuple(
+        (p, p + (s - 1 if ceil_mode else 0))
+        for p, s in zip(padding, stride))
+    return jax.lax.reduce_window(x, init, op, window, strides, pads)
+
+
+def pool3d(x, kernel_size, stride=None, padding=0, pooling_type="max",
+           ceil_mode=False, exclusive=True, adaptive=False,
+           data_format="NCDHW"):
+    if pooling_type == "max":
+        return _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.max,
+                        -jnp.inf, ceil_mode)
+    s = _pool_nd(x, kernel_size, stride, padding, 3, jax.lax.add, 0.0,
+                 ceil_mode)
+    ones = _pool_nd(jnp.ones_like(x), kernel_size, stride, padding, 3,
+                    jax.lax.add, 0.0, ceil_mode)
+    if exclusive:
+        return s / ones
+    k = kernel_size if isinstance(kernel_size, int) else int(
+        np.prod(kernel_size))
+    k = k ** 3 if isinstance(kernel_size, int) else k
+    return s / k
+
+
+def lp_pool2d(x, kernel_size, stride=None, padding=0, norm_type=2.0,
+              ceil_mode=False, data_format="NCHW"):
+    p = float(norm_type)
+    s = _pool_nd(jnp.abs(x) ** p, kernel_size, stride, padding, 2,
+                 jax.lax.add, 0.0, ceil_mode)
+    return s ** (1.0 / p)
+
+
+def _pool_with_index(x, kernel_size, stride, padding, nd):
+    """Max pool that also returns flat spatial argmax indices (reference
+    max_pool2d_with_index / max_pool3d_with_index)."""
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size,) * nd
+    if stride is None:
+        stride = kernel_size
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    if isinstance(padding, int):
+        padding = (padding,) * nd
+    spatial = x.shape[2:]
+    flat_idx = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+    flat_idx = jnp.broadcast_to(flat_idx, x.shape)
+    window = (1, 1) + tuple(kernel_size)
+    strides = (1, 1) + tuple(stride)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+
+    out, idx = jax.lax.reduce_window(
+        (x, flat_idx), (-jnp.inf, jnp.asarray(0)), sel,
+        window, strides, pads)
+    return out, idx
+
+
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False):
+    if global_pooling:
+        kernel_size = x.shape[2:]
+        stride, padding = None, 0
+    return _pool_with_index(x, kernel_size, stride, padding, 2)
+
+
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0,
+                          global_pooling=False, adaptive=False):
+    if global_pooling:
+        kernel_size = x.shape[2:]
+        stride, padding = None, 0
+    return _pool_with_index(x, kernel_size, stride, padding, 3)
+
+
+def unpool(x, indices, kernel_size=2, stride=None, padding=0,
+           output_size=None, data_format="NCHW"):
+    """phi/kernels/unpool_kernel: scatter pooled values back to the
+    positions recorded by max_pool2d_with_index."""
+    n, c = x.shape[:2]
+    if output_size is None:
+        if stride is None:
+            stride = kernel_size
+        k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+        s = stride if isinstance(stride, int) else stride[0]
+        output_size = [(d - 1) * s + k - 2 * padding for d in x.shape[2:]]
+    out_spatial = int(np.prod(output_size))
+    flat = jnp.zeros((n, c, out_spatial), x.dtype)
+    idx = indices.reshape(n, c, -1)
+    vals = x.reshape(n, c, -1)
+    flat = flat.at[
+        jnp.arange(n)[:, None, None], jnp.arange(c)[None, :, None], idx
+    ].set(vals)
+    return flat.reshape(n, c, *output_size)
+
+
+def unpool3d(x, indices, kernel_size=2, stride=None, padding=0,
+             output_size=None, data_format="NCDHW"):
+    return unpool(x, indices, kernel_size, stride, padding, output_size)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False):
+    """phi/kernels/fractional_max_pool2d: pseudo-random pooling regions;
+    deterministic alpha-sequence variant (random_u supplies the offset)."""
+    n, c, h, w = x.shape
+    oh, ow = ((output_size, output_size) if isinstance(output_size, int)
+              else tuple(output_size))
+    u = 0.5 if random_u is None else float(random_u)
+
+    def edges(insz, outsz):
+        alpha = insz / outsz
+        idx = np.floor(alpha * (np.arange(outsz) + u)) - np.floor(alpha * u)
+        idx = np.clip(idx.astype(np.int64), 0, insz - 1)
+        ends = np.append(idx[1:], insz)
+        return idx, ends
+
+    hs, he = edges(h, oh)
+    ws, we = edges(w, ow)
+    rows = []
+    for i in range(oh):
+        cols = []
+        for j in range(ow):
+            cols.append(jnp.max(x[:, :, hs[i]:he[i], ws[j]:we[j]],
+                                axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False):
+    n, c, d, h, w = x.shape
+    od, oh, ow = ((output_size,) * 3 if isinstance(output_size, int)
+                  else tuple(output_size))
+    out = []
+    u = 0.5 if random_u is None else float(random_u)
+    alpha = d / od
+    idx = np.floor(alpha * (np.arange(od) + u)) - np.floor(alpha * u)
+    idx = np.clip(idx.astype(np.int64), 0, d - 1)
+    ends = np.append(idx[1:], d)
+    for i in range(od):
+        sl = jnp.max(x[:, :, idx[i]:ends[i]], axis=2)
+        out.append(fractional_max_pool2d(sl, (oh, ow), random_u=random_u))
+    return jnp.stack(out, axis=2)
+
+
+def depthwise_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                     data_format="NCHW"):
+    from . import nn_kernels as _nn
+
+    return _nn.conv2d(x, weight, bias, stride=stride, padding=padding,
+                      dilation=dilation, groups=x.shape[1],
+                      data_format=data_format)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, dilation=1, groups=1,
+                     data_format="NCDHW"):
+    nd = 3
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    if isinstance(padding, int):
+        padding = (padding,) * nd
+    if isinstance(dilation, int):
+        dilation = (dilation,) * nd
+    if isinstance(output_padding, int):
+        output_padding = (output_padding,) * nd
+    # weight: (Cin, Cout/g, kD, kH, kW) — conv_transpose as a forward conv
+    # with lhs_dilation; per-group I/O swap so feature_group_count applies
+    cin, outg = weight.shape[0], weight.shape[1]
+    kernel = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    kernel = kernel.reshape(groups, cin // groups, outg,
+                            *weight.shape[2:])
+    kernel = jnp.swapaxes(kernel, 1, 2).reshape(
+        groups * outg, cin // groups, *weight.shape[2:])
+    pads = tuple(
+        (d * (k - 1) - p, d * (k - 1) - p + op)
+        for k, p, d, op in zip(weight.shape[2:], padding, dilation,
+                               output_padding))
+    out = jax.lax.conv_general_dilated(
+        x, kernel, window_strides=(1,) * nd, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1, 1)
+    return out
+
+
+def depthwise_conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                               output_padding=0, dilation=1,
+                               data_format="NCHW"):
+    from . import nn_kernels as _nn
+
+    return _nn.conv2d_transpose(x, weight, bias, stride=stride,
+                                padding=padding,
+                                output_padding=output_padding,
+                                dilation=dilation, groups=x.shape[1])
+
+
+def _interp(x, size, scale_factor, mode, align_corners=False):
+    from . import nn_kernels as _nn
+
+    return _nn.interpolate(x, size=size, scale_factor=scale_factor,
+                           mode=mode, align_corners=align_corners)
+
+
+def bilinear_interp(x, size=None, scale_factor=None, align_corners=False):
+    return _interp(x, size, scale_factor, "bilinear", align_corners)
+
+
+def nearest_interp(x, size=None, scale_factor=None, align_corners=False):
+    return _interp(x, size, scale_factor, "nearest", align_corners)
+
+
+def bicubic_interp(x, size=None, scale_factor=None, align_corners=False):
+    return _interp(x, size, scale_factor, "bicubic", align_corners)
+
+
+def linear_interp(x, size=None, scale_factor=None, align_corners=False):
+    # 3-D (N, C, W) input: jax.image.resize linear
+    size = size if size is not None else (
+        int(x.shape[-1] * scale_factor),)
+    out_shape = x.shape[:2] + tuple(size)
+    return jax.image.resize(x, out_shape, method="linear")
+
+
+def trilinear_interp(x, size=None, scale_factor=None, align_corners=False):
+    size = size if size is not None else tuple(
+        int(d * scale_factor) for d in x.shape[2:])
+    out_shape = x.shape[:2] + tuple(size)
+    return jax.image.resize(x, out_shape, method="linear")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):
+    """phi/kernels/fold_kernel (col2im — the inverse of unfold): x is
+    (N, C*prod(k), L); returns (N, C, H, W) with overlapping patches
+    summed."""
+    def pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    oh, ow = pair(output_sizes)
+    kh, kw = pair(kernel_sizes)
+    sh, sw = pair(strides)
+    ph, pw = pair(paddings)
+    dh, dw = pair(dilations)
+    n, ckk, L = x.shape
+    c = ckk // (kh * kw)
+    nh = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    nw = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = x.reshape(n, c, kh, kw, nh, nw)
+    out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            hi = i * dh
+            wj = j * dw
+            out = out.at[:, :, hi:hi + sh * nh:sh,
+                         wj:wj + sw * nw:sw].add(cols[:, :, i, j])
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+def pad3d(x, paddings, mode="constant", value=0.0, data_format="NCDHW"):
+    p = list(paddings)  # (left, right, top, bottom, front, back)
+    full = [(0, 0), (0, 0), (p[4], p[5]), (p[2], p[3]), (p[0], p[1])]
+    if mode == "constant":
+        return jnp.pad(x, full, constant_values=value)
+    jmode = {"reflect": "reflect", "replicate": "edge",
+             "circular": "wrap"}[mode]
+    return jnp.pad(x, full, mode=jmode)
+
+
+# ------------------------------------------------------------ signal
+
+
+def frame(x, frame_length, hop_length, axis=-1):
+    """phi/kernels/frame_kernel: slide overlapping frames along axis.
+    axis=-1: (..., n) → (..., frame_length, num_frames);
+    axis=0:  (n, ...) → (num_frames, frame_length, ...)."""
+    if axis not in (0, -1, x.ndim - 1):
+        raise ValueError("frame: axis must be 0 or -1")
+    first = axis == 0 and x.ndim > 1
+    if first:
+        x = jnp.moveaxis(x, 0, -1)
+    n = x.shape[-1]
+    num = (n - frame_length) // hop_length + 1
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num)[:, None])
+    out = x[..., idx]                       # (..., num, frame_length)
+    if first:
+        return jnp.moveaxis(out, (-2, -1), (0, 1))  # (num, fl, ...)
+    return jnp.swapaxes(out, -1, -2)        # (..., frame_length, num)
+
+
+def overlap_add(x, hop_length, axis=-1):
+    """phi/kernels/overlap_add_kernel: inverse of frame.
+    axis=-1: (..., frame_length, num) → (..., n);
+    axis=0:  (num, frame_length, ...) → (n, ...)."""
+    if axis not in (0, -1, x.ndim - 1):
+        raise ValueError("overlap_add: axis must be 0 or -1")
+    first = axis == 0 and x.ndim > 1
+    if first:
+        x = jnp.moveaxis(x, (0, 1), (-1, -2))  # (..., frame_length, num)
+    fl, num = x.shape[-2], x.shape[-1]
+    n = (num - 1) * hop_length + fl
+    out = jnp.zeros(x.shape[:-2] + (n,), x.dtype)
+    for i in range(num):
+        out = out.at[..., i * hop_length:i * hop_length + fl].add(x[..., i])
+    if first:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def stft(x, n_fft, hop_length=None, axis=-1, onesided=True, normalized=False):
+    hop = hop_length or n_fft // 4
+    frames = frame(x, n_fft, hop, axis=-1)   # (..., n_fft, num)
+    frames = jnp.swapaxes(frames, -1, -2)    # (..., num, n_fft)
+    spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+            else jnp.fft.fft(frames, axis=-1))
+    if normalized:
+        spec = spec / jnp.sqrt(n_fft)
+    return jnp.swapaxes(spec, -1, -2)        # (..., freq, num)
+
+
+def fft_c2c(x, axes=None, normalization="backward", forward=True):
+    axes = tuple(axes) if axes is not None else (-1,)
+    fn = jnp.fft.fftn if forward else jnp.fft.ifftn
+    return fn(x, axes=axes, norm=normalization)
+
+
+def fft_r2c(x, axes=None, normalization="backward", forward=True,
+            onesided=True):
+    axes = tuple(axes) if axes is not None else (-1,)
+    if onesided:
+        return jnp.fft.rfftn(x, axes=axes, norm=normalization)
+    return jnp.fft.fftn(x.astype(jnp.complex64), axes=axes,
+                        norm=normalization)
+
+
+def fft_c2r(x, axes=None, normalization="backward", forward=False,
+            last_dim_size=None):
+    axes = tuple(axes) if axes is not None else (-1,)
+    s = None
+    if last_dim_size is not None:
+        s = [x.shape[a] for a in axes]
+        s[-1] = last_dim_size
+    return jnp.fft.irfftn(x, s=s, axes=axes, norm=normalization)
+
+
+# ------------------------------------------------------------ sequence/text
+
+
+def edit_distance(hyps, refs, hyp_lens, ref_lens, normalized=False):
+    """phi/kernels/edit_distance_kernel: batched Levenshtein distance over
+    padded int sequences, via a wavefront lax.scan."""
+    b, hmax = hyps.shape
+    rmax = refs.shape[1]
+
+    def one(hyp, ref, hl, rl):
+        row0 = jnp.arange(rmax + 1, dtype=jnp.float32)
+
+        def step(prev, i):
+            def inner(row, j):
+                cost = jnp.where(hyp[i] == ref[j], 0.0, 1.0)
+                val = jnp.minimum(
+                    jnp.minimum(prev[j + 1] + 1.0, row[j] + 1.0),
+                    prev[j] + cost)
+                return row.at[j + 1].set(val), None
+
+            row = jnp.zeros(rmax + 1, jnp.float32).at[0].set(i + 1.0)
+            row, _ = jax.lax.scan(inner, row, jnp.arange(rmax))
+            return row, row
+
+        _, rows = jax.lax.scan(step, row0, jnp.arange(hmax))
+        table = jnp.concatenate([row0[None], rows], axis=0)
+        d = table[hl, rl]  # distance at the true (unpadded) lengths
+        return jnp.where(normalized, d / jnp.maximum(rl, 1), d)
+
+    return jax.vmap(one)(hyps, refs, hyp_lens, ref_lens)
+
+
+# ------------------------------------------------------------ detection
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True):
+    """phi/kernels/box_coder_kernel: encode/decode boxes against priors."""
+    pw = prior_box[:, 2] - prior_box[:, 0] + (0 if box_normalized else 1)
+    ph = prior_box[:, 3] - prior_box[:, 1] + (0 if box_normalized else 1)
+    px = prior_box[:, 0] + pw * 0.5
+    py = prior_box[:, 1] + ph * 0.5
+    var = (prior_box_var if prior_box_var is not None
+           else jnp.ones((1, 4), prior_box.dtype))
+    if code_type == "encode_center_size":
+        tw = target_box[:, 2] - target_box[:, 0] + (0 if box_normalized else 1)
+        th = target_box[:, 3] - target_box[:, 1] + (0 if box_normalized else 1)
+        tx = target_box[:, 0] + tw * 0.5
+        ty = target_box[:, 1] + th * 0.5
+        out = jnp.stack([
+            (tx[:, None] - px[None, :]) / pw[None, :],
+            (ty[:, None] - py[None, :]) / ph[None, :],
+            jnp.log(tw[:, None] / pw[None, :]),
+            jnp.log(th[:, None] / ph[None, :]),
+        ], axis=-1)
+        return out / var.reshape(1, -1, 4)
+    # decode: target (N, M, 4) deltas against priors
+    t = target_box * var.reshape(1, -1, 4)
+    ox = t[..., 0] * pw + px
+    oy = t[..., 1] * ph + py
+    ow = jnp.exp(t[..., 2]) * pw
+    oh = jnp.exp(t[..., 3]) * ph
+    sub = 0 if box_normalized else 1
+    return jnp.stack([ox - ow * 0.5, oy - oh * 0.5,
+                      ox + ow * 0.5 - sub, oy + oh * 0.5 - sub], axis=-1)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variances=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              step_w=0.0, step_h=0.0, offset=0.5):
+    """phi/kernels/prior_box_kernel: SSD prior boxes for one feature map."""
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    sw = step_w or iw / fw
+    sh = step_h or ih / fh
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    boxes = []
+    for i in range(fh):
+        for j in range(fw):
+            cx = (j + offset) * sw
+            cy = (i + offset) * sh
+            cell = []
+            for k, ms in enumerate(min_sizes):
+                cell.append((cx - ms / 2, cy - ms / 2, cx + ms / 2,
+                             cy + ms / 2))
+                if max_sizes:
+                    s = math.sqrt(ms * max_sizes[k])
+                    cell.append((cx - s / 2, cy - s / 2, cx + s / 2,
+                                 cy + s / 2))
+                for a in ars:
+                    if abs(a - 1.0) < 1e-6:
+                        continue
+                    bw = ms * math.sqrt(a)
+                    bh = ms / math.sqrt(a)
+                    cell.append((cx - bw / 2, cy - bh / 2, cx + bw / 2,
+                                 cy + bh / 2))
+            boxes.extend(cell)
+    out = np.asarray(boxes, np.float32).reshape(fh, fw, -1, 4)
+    out = out / np.asarray([iw, ih, iw, ih], np.float32)
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variances, np.float32),
+                          out.shape).copy()
+    return jnp.asarray(out), jnp.asarray(var)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """phi/kernels/yolo_box_kernel: decode YOLOv3 head to boxes+scores."""
+    n, c, h, w = x.shape
+    an = len(anchors) // 2
+    x = x.reshape(n, an, 5 + class_num, h, w)
+    grid_x = jnp.arange(w, dtype=jnp.float32).reshape(1, 1, 1, w)
+    grid_y = jnp.arange(h, dtype=jnp.float32).reshape(1, 1, h, 1)
+    bx = (jax.nn.sigmoid(x[:, :, 0]) * scale_x_y
+          - 0.5 * (scale_x_y - 1.0) + grid_x) / w
+    by = (jax.nn.sigmoid(x[:, :, 1]) * scale_x_y
+          - 0.5 * (scale_x_y - 1.0) + grid_y) / h
+    aw = jnp.asarray(anchors[0::2], jnp.float32).reshape(1, an, 1, 1)
+    ah = jnp.asarray(anchors[1::2], jnp.float32).reshape(1, an, 1, 1)
+    # per-axis input sizes (yolo_box_kernel.cc:48-49): non-square maps keep
+    # distinct w/h normalizers
+    bw = jnp.exp(x[:, :, 2]) * aw / (downsample_ratio * w)
+    bh = jnp.exp(x[:, :, 3]) * ah / (downsample_ratio * h)
+    conf = jax.nn.sigmoid(x[:, :, 4])
+    probs = jax.nn.sigmoid(x[:, :, 5:]) * conf[:, :, None]
+    imh = img_size[:, 0].astype(jnp.float32).reshape(n, 1, 1, 1)
+    imw = img_size[:, 1].astype(jnp.float32).reshape(n, 1, 1, 1)
+    x0 = (bx - bw / 2.0) * imw
+    y0 = (by - bh / 2.0) * imh
+    x1 = (bx + bw / 2.0) * imw
+    y1 = (by + bh / 2.0) * imh
+    if clip_bbox:
+        x0 = jnp.clip(x0, 0.0, imw - 1)
+        y0 = jnp.clip(y0, 0.0, imh - 1)
+        x1 = jnp.clip(x1, 0.0, imw - 1)
+        y1 = jnp.clip(y1, 0.0, imh - 1)
+    boxes = jnp.stack([x0, y0, x1, y1], axis=-1).reshape(n, -1, 4)
+    scores = probs.transpose(0, 1, 3, 4, 2).reshape(n, -1, class_num)
+    keep = conf.reshape(n, -1, 1) >= conf_thresh
+    return boxes * keep, scores * keep
+
+
+def matrix_rank(x, tol=None, hermitian=False, use_default_tol=True):
+    """phi/kernels/matrix_rank_kernel: rank via singular values."""
+    if hermitian:
+        s = jnp.abs(jnp.linalg.eigvalsh(x))
+    else:
+        s = jnp.linalg.svd(x, compute_uv=False)
+    if tol is None:
+        tol = s.max(axis=-1) * max(x.shape[-2], x.shape[-1]) * \
+            jnp.finfo(x.dtype).eps
+        tol = tol[..., None]
+    return jnp.sum((s > tol).astype(jnp.int64), axis=-1)

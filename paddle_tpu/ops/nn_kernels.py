@@ -523,10 +523,34 @@ def conv2d_transpose(
         stride = (stride, stride)
     if isinstance(dilation, int):
         dilation = (dilation, dilation)
-    if groups != 1:
-        raise NotImplementedError("grouped conv_transpose not yet supported")
     if isinstance(padding, int):
         padding = (padding, padding)
+    if groups != 1:
+        # grouped transpose as a forward conv: lhs_dilation=stride, kernel
+        # flipped spatially and I/O swapped within each group; rhs shape
+        # (g*out/g, in/g, kh, kw) with feature_group_count=g
+        cin, outg = weight.shape[0], weight.shape[1]
+        kh, kw = weight.shape[2], weight.shape[3]
+        kern = jnp.flip(weight, axis=(2, 3))
+        kern = kern.reshape(groups, cin // groups, outg, kh, kw)
+        kern = jnp.swapaxes(kern, 1, 2).reshape(
+            groups * outg, cin // groups, kh, kw)
+        opad = ((output_padding, output_padding)
+                if isinstance(output_padding, int) else tuple(output_padding))
+        pads = [
+            ((kh - 1) * dilation[0] - padding[0],
+             (kh - 1) * dilation[0] - padding[0] + opad[0]),
+            ((kw - 1) * dilation[1] - padding[1],
+             (kw - 1) * dilation[1] - padding[1] + opad[1]),
+        ]
+        out = lax.conv_general_dilated(
+            x, kern, window_strides=(1, 1), padding=pads,
+            lhs_dilation=tuple(stride), rhs_dilation=tuple(dilation),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            feature_group_count=groups)
+        if bias is not None:
+            out = out + bias.reshape(1, -1, 1, 1)
+        return out
     # weight layout: (in, out, kh, kw) — paddle convention. With
     # transpose_kernel=True lax swaps the kernel's I/O axes internally, so
     # pass HWIO with I=out, O=in. lax explicit padding is in FORWARD conv

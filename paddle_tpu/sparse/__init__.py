@@ -24,6 +24,12 @@ __all__ = [
     "is_same_shape", "add", "subtract", "multiply", "divide", "matmul",
     "masked_matmul", "relu", "abs", "sqrt", "sin", "tanh", "pow",
     "transpose", "coalesce",
+    # extended surface (reference sparse_ops.yaml, 40 ops)
+    "asin", "asinh", "atan", "atanh", "acos", "acosh", "expm1", "log1p",
+    "leaky_relu", "relu6", "square", "sinh", "tan", "isnan", "cast",
+    "scale", "divide_scalar", "reshape", "sum", "softmax", "to_dense",
+    "to_sparse_coo", "to_sparse_csr", "values", "conv3d", "subm_conv3d",
+    "batch_norm", "attention",
 ]
 
 
@@ -241,3 +247,240 @@ def transpose(x, perm):
 
 def coalesce(x):
     return x.coalesce()
+
+
+# ------------------------------------------------ extended unary surface
+# (reference sparse_ops.yaml applies the op to stored values only — zeros
+# stay implicit, matching phi/kernels/sparse/unary_kernel.h semantics)
+
+def asin(x):
+    return _unary(x, jnp.arcsin)
+
+
+def asinh(x):
+    return _unary(x, jnp.arcsinh)
+
+
+def atan(x):
+    return _unary(x, jnp.arctan)
+
+
+def atanh(x):
+    return _unary(x, jnp.arctanh)
+
+
+def acos(x):
+    return _unary(x, jnp.arccos)
+
+
+def acosh(x):
+    return _unary(x, jnp.arccosh)
+
+
+def expm1(x):
+    return _unary(x, jnp.expm1)
+
+
+def log1p(x):
+    return _unary(x, jnp.log1p)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return _unary(x, lambda v: jax.nn.leaky_relu(v, negative_slope))
+
+
+def relu6(x):
+    return _unary(x, lambda v: jnp.clip(v, 0.0, 6.0))
+
+
+def square(x):
+    return _unary(x, jnp.square)
+
+
+def sinh(x):
+    return _unary(x, jnp.sinh)
+
+
+def tan(x):
+    return _unary(x, jnp.tan)
+
+
+def isnan(x):
+    return _unary(x, jnp.isnan)
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    from ..core.dtype import to_jax_dtype
+
+    data = x._bcoo.data
+    idx = x._bcoo.indices
+    if value_dtype is not None:
+        data = data.astype(to_jax_dtype(value_dtype))
+    if index_dtype is not None:
+        idx = idx.astype(to_jax_dtype(index_dtype))
+    return SparseTensor(jsparse.BCOO((data, idx), shape=x._bcoo.shape),
+                        x._fmt)
+
+
+def scale(x, scale_, bias=0.0, bias_after_scale=True):
+    if bias != 0.0:
+        # bias touches implicit zeros: result is dense
+        d = x.to_dense()._value
+        out = d * scale_ + bias if bias_after_scale else (d + bias) * scale_
+        return SparseTensor(jsparse.BCOO.fromdense(out), x._fmt)
+    return _unary(x, lambda v: v * scale_)
+
+
+def divide_scalar(x, scalar):
+    return _unary(x, lambda v: v / scalar)
+
+
+def reshape(x, shape):
+    d = x.to_dense()._value.reshape(tuple(shape))
+    return SparseTensor(jsparse.BCOO.fromdense(d), x._fmt)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    d = jnp.sum(x.to_dense()._value,
+                axis=None if axis is None else axis, keepdims=keepdim)
+    if axis is None:
+        return Tensor._from_value(d)
+    return SparseTensor(jsparse.BCOO.fromdense(d), x._fmt)
+
+
+def softmax(x, axis=-1):
+    """Row softmax over the stored values only (CSR semantics,
+    phi/kernels/sparse/softmax_kernel: implicit zeros are NOT part of the
+    distribution). Batched N-D inputs group by ALL leading dims — each
+    (batch..., row) softmaxes independently along the last dim."""
+    idx = x._bcoo.indices          # (nnz, ndim)
+    vals = x._bcoo.data
+    lead_shape = x.shape[:-1]
+    nrows = int(np.prod(lead_shape))
+    # ravel all leading dims into one segment id per stored element
+    rows = jnp.zeros(idx.shape[0], jnp.int32)
+    for d, size in enumerate(lead_shape):
+        rows = rows * size + idx[:, d].astype(jnp.int32)
+    rowmax = jax.ops.segment_max(vals, rows, num_segments=nrows)
+    e = jnp.exp(vals - rowmax[rows])
+    denom = jax.ops.segment_sum(e, rows, num_segments=nrows)
+    out = e / denom[rows]
+    return SparseTensor(jsparse.BCOO((out, x._bcoo.indices),
+                                     shape=x._bcoo.shape), x._fmt)
+
+
+def to_dense(x):
+    return x.to_dense()
+
+
+def to_sparse_coo(x, sparse_dim=None):
+    if isinstance(x, SparseTensor):
+        return x.to_sparse_coo(sparse_dim)
+    return SparseTensor(jsparse.BCOO.fromdense(_val(x)), "coo")
+
+
+def to_sparse_csr(x):
+    if isinstance(x, SparseTensor):
+        return x.to_sparse_csr()
+    return SparseTensor(jsparse.BCOO.fromdense(_val(x)), "csr")
+
+
+def values(x):
+    return x.values()
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           subm=False, key=None):
+    """Sparse 3-D convolution (phi/kernels/sparse/conv_kernel: COO input
+    (N, D, H, W, C), dense kernel (kd, kh, kw, Cin, Cout)). TPU-native
+    route: densify → XLA conv (the MXU path) → re-sparsify; ``subm=True``
+    restricts the output pattern to the input's occupancy (submanifold
+    conv). The reference's gather-GEMM-scatter pipeline is a host-memory
+    optimization XLA does not need at these densities."""
+    if isinstance(stride, int):
+        stride = (stride,) * 3
+    if isinstance(padding, int):
+        padding = (padding,) * 3
+    if isinstance(dilation, int):
+        dilation = (dilation,) * 3
+    dense = x.to_dense()._value  # (N, D, H, W, C)
+    out = jax.lax.conv_general_dilated(
+        dense, _val(weight),
+        window_strides=tuple(stride),
+        padding=tuple((p, p) for p in padding),
+        rhs_dilation=tuple(dilation),
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+        feature_group_count=groups)
+    if bias is not None:
+        out = out + _val(bias)
+    if subm:
+        if out.shape[:-1] != dense.shape[:-1]:
+            raise ValueError(
+                "submanifold conv3d requires shape-preserving geometry "
+                f"(odd kernel, pad=(k-1)//2, stride 1); got output "
+                f"{out.shape} for input {dense.shape}")
+        occ = jnp.any(dense != 0, axis=-1, keepdims=True)
+        out = jnp.where(occ, out, 0.0)
+    return SparseTensor(jsparse.BCOO.fromdense(out, n_batch=0), x._fmt)
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, key=None):
+    return conv3d(x, weight, bias, stride, padding, dilation, groups,
+                  subm=True)
+
+
+def batch_norm(x, running_mean, running_var, weight, bias, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NDHWC"):
+    """Sparse batch norm (phi/kernels/sparse/batch_norm_kernel): normalize
+    the stored values channel-wise; implicit zeros stay zero."""
+    vals = x._bcoo.data  # (nnz, C)
+    if training or running_mean is None:
+        mean = jnp.mean(vals, axis=0)
+        var = jnp.var(vals, axis=0)
+    else:
+        mean = _val(running_mean)
+        var = _val(running_var)
+    out = (vals - mean) / jnp.sqrt(var + epsilon)
+    if weight is not None:
+        out = out * _val(weight)
+    if bias is not None:
+        out = out + _val(bias)
+    return SparseTensor(jsparse.BCOO((out, x._bcoo.indices),
+                                     shape=x._bcoo.shape), x._fmt)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None):
+    """Sparse attention (phi/kernels/sparse/sparse_attention /
+    fused_attention_kernel over a CSR pattern): scores only at the mask's
+    nonzero positions (SDDMM) → row softmax on stored values → SpMM.
+    query/key/value: (B, H, S, D); sparse_mask: (S, S) CSR pattern."""
+    q, k, v = _val(query), _val(key), _val(value)
+    b, h, s, d = q.shape
+    idx = sparse_mask._bcoo.indices
+    rows, cols = idx[:, 0], idx[:, 1]
+    scale_ = 1.0 / float(np.sqrt(d))
+    qr = q.reshape(b * h, s, d)
+    kr = k.reshape(b * h, s, d)
+    vr = v.reshape(b * h, s, d)
+    # SDDMM at the pattern positions, per (b, h)
+    scores = jnp.einsum("znd,znd->zn", qr[:, rows], kr[:, cols]) * scale_
+    if attn_mask is not None:
+        am = _val(attn_mask)  # (S, S) additive mask
+        scores = scores + am[rows, cols][None, :]
+    if key_padding_mask is not None:
+        kp = _val(key_padding_mask)  # (B, S); True/nonzero = masked out
+        bad = kp.astype(bool)[:, cols]                     # (B, nnz)
+        bad = jnp.repeat(bad, h, axis=0)                   # (B*H, nnz)
+        scores = jnp.where(bad, -1e30, scores)
+    rowmax = jax.vmap(
+        lambda sc: jax.ops.segment_max(sc, rows, num_segments=s))(scores)
+    e = jnp.exp(scores - rowmax[:, rows])
+    denom = jax.vmap(
+        lambda ev: jax.ops.segment_sum(ev, rows, num_segments=s))(e)
+    p = e / denom[:, rows]
+    out = jax.vmap(
+        lambda pv, vv: jax.ops.segment_sum(
+            pv[:, None] * vv[cols], rows, num_segments=s))(p, vr)
+    return Tensor._from_value(out.reshape(b, h, s, d))

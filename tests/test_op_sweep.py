@@ -758,6 +758,301 @@ case("grid_sample", lambda: ((T(P((1, 2, 4, 4))),
      None)
 
 # (exemptions)
+# ---- op tail (kernels_tail.py)
+
+case("logsigmoid", lambda: ((T(P((3, 4))),), {}),
+     lambda x: np.log(_sigmoid(x)))
+case("tanh_shrink", lambda: ((T(P((3, 4))),), {}),
+     lambda x: x - np.tanh(x))
+case("thresholded_relu", lambda: ((T(P((3, 4))),), {"threshold": 0.2}),
+     lambda x: np.where(x > 0.2, x, 0.0))
+case("rrelu", lambda: ((T(P((3, 4))),), {"training": False}),
+     lambda x: np.where(x >= 0, x, x * ((1 / 8 + 1 / 3) / 2)), grad=False)
+case("swiglu", lambda: ((T(P((3, 8))),), {}),
+     lambda x: (lambda a, b: a * _sigmoid(a) * b)(x[:, :4], x[:, 4:]))
+case("mean_all", lambda: ((T(P((3, 4))),), {}), lambda x: x.mean())
+case("numel", lambda: ((T(P((3, 4))),), {}), lambda x: np.int64(12))
+case("shape", lambda: ((T(P((3, 4))),), {}),
+     lambda x: np.asarray([3, 4], np.int32))
+case("is_empty", lambda: ((T(P((3, 4))),), {}), lambda x: np.asarray(False))
+case("l1_norm", lambda: ((T(P((3, 4))),), {}),
+     lambda x: np.abs(x).sum())
+case("squared_l2_norm", lambda: ((T(P((3, 4))),), {}),
+     lambda x: (x ** 2).sum())
+case("frobenius_norm", lambda: ((T(P((3, 4))),), {}),
+     lambda x: np.sqrt((x ** 2).sum()))
+case("clip_by_norm", lambda: ((T(P((3, 4), 1.0, 2.0)),), {"max_norm": 1.0}),
+     lambda x: x / np.sqrt((x ** 2).sum()))
+case("fill", lambda: ((T(P((3, 4))),), {"value": 2.5}),
+     lambda x: np.full_like(x, 2.5), grad=False)
+case("fill_diagonal", lambda: ((T(P((4, 4))),), {"value": 9.0}),
+     lambda x: x * (1 - np.eye(4)) + 9.0 * np.eye(4))
+case("empty", lambda: ((), {"shape": [2, 3]}), None, grad=False)
+case("empty_like", lambda: ((T(P((2, 3))),), {}), None, grad=False)
+case("reverse", lambda: ((T(P((3, 4))),), {"axis": 1}),
+     lambda x: x[:, ::-1])
+case("sequence_mask",
+     lambda: ((T(np.asarray([2, 4])),), {"maxlen": 5}),
+     lambda x: (np.arange(5)[None] < x[:, None]).astype(np.int64),
+     grad=False)
+case("share_data", lambda: ((T(P((3, 4))),), {}), lambda x: x)
+case("split_with_num", lambda: ((T(P((4, 4))),), {"num": 2}),
+     lambda x: x[:2], grad=False)
+case("partial_sum",
+     lambda: (([T(P((3, 6))), T(P((3, 6)))],), {"start_index": 1,
+                                                "length": 3}),
+     None, grad=False)
+case("partial_concat",
+     lambda: (([T(P((3, 6))), T(P((3, 6)))],), {"start_index": 1,
+                                                "length": 3}),
+     None, grad=False)
+case("hinge_loss", lambda: ((T(P((4, 1))), T(np.asarray(
+    [[1.0], [0.0], [1.0], [0.0]], np.float32))), {}),
+     lambda x, y: np.maximum(1 - x * (2 * y - 1), 0))
+case("huber_loss", lambda: ((T(P((3, 4))), T(P((3, 4)))), {"delta": 0.5}),
+     lambda x, y: np.where(np.abs(x - y) <= 0.5,
+                           0.5 * (x - y) ** 2,
+                           0.5 * (np.abs(x - y) - 0.25)))
+case("log_loss", lambda: ((T(PP((3, 1)) * 0.8), T(np.asarray(
+    [[1.0], [0.0], [1.0]], np.float32))), {}),
+     lambda x, y: -y * np.log(x + 1e-4) - (1 - y) * np.log(1 - x + 1e-4))
+case("sigmoid_cross_entropy_with_logits",
+     lambda: ((T(P((3, 4))), T((rng.rand(3, 4) > 0.5).astype(np.float32))),
+              {}),
+     lambda x, y: np.maximum(x, 0) - x * y + np.log1p(np.exp(-np.abs(x))))
+case("identity_loss", lambda: ((T(P((3, 4))),), {"reduction": 1}),
+     lambda x: x.mean())
+case("margin_cross_entropy",
+     lambda: ((T(P((4, 8), -0.9, 0.9)), T(np.asarray([0, 1, 2, 3]))),
+              {"margin1": 1.0, "margin2": 0.0, "margin3": 0.0,
+               "scale": 1.0}),
+     None, grad=False)
+case("accuracy",
+     lambda: ((T(P((4, 3))), T(np.asarray([[0, 1, 2]] * 4)),
+               T(np.asarray([[0], [5], [1], [9]]))), {}),
+     None, grad=False)
+case("auc",
+     lambda: ((T(PP((16,))), T((rng.rand(16) > 0.5).astype(np.int64))), {}),
+     None, grad=False)
+case("dirichlet", lambda: ((T(PP((4, 3)) * 3),), {}), None, grad=False)
+case("truncated_gaussian_random",
+     lambda: ((), {"shape": [64], "mean": 0.0, "std": 1.0}), None,
+     grad=False)
+case("exponential_", lambda: ((T(P((8, 8))),), {}), None, grad=False)
+case("uniform_inplace", lambda: ((T(P((8, 8))),), {}), None, grad=False)
+case("gaussian_inplace", lambda: ((T(P((8, 8))),), {}), None, grad=False)
+case("fake_quantize_abs_max", lambda: ((T(P((4, 4))),), {}),
+     lambda x: np.clip(np.round(x / np.abs(x).max() * 127), -127, 127),
+     grad=False)
+case("fake_quantize_dequantize_abs_max", lambda: ((T(P((4, 4))),), {}),
+     lambda x: np.clip(np.round(x / np.abs(x).max() * 127), -127,
+                       127) * np.abs(x).max() / 127, grad=False)
+case("fake_channel_wise_quantize_abs_max", lambda: ((T(P((3, 4))),), {}),
+     None, grad=False)
+case("fake_channel_wise_quantize_dequantize_abs_max",
+     lambda: ((T(P((3, 4))),), {}), None, grad=False)
+case("fake_dequantize_max_abs",
+     lambda: ((T(P((3, 4))), T(np.float32(2.0))), {"max_range": 127.0}),
+     lambda x, s: x * 2.0 / 127.0, grad=False)
+case("dequantize_abs_max",
+     lambda: ((T(P((3, 4))), T(np.float32(2.0))), {"max_range": 127.0}),
+     lambda x, s: x * 2.0 / 127.0, grad=False)
+case("check_finite_and_unscale_",
+     lambda: (([T(P((3, 4))), T(P((2, 2)))], T(np.float32(2.0))), {}),
+     None, grad=False)
+case("update_loss_scaling_",
+     lambda: ((T(np.float32(1024.0)), T(np.asarray(False)),
+               T(np.asarray(5, np.int32))), {}),
+     None, grad=False)
+case("sgd_",
+     lambda: ((T(P((4,))), T(np.float32(0.1)), T(P((4,)))), {}),
+     lambda p, lr, g: p - 0.1 * g, grad=False)
+case("momentum_",
+     lambda: ((T(P((4,))), T(P((4,))), T(P((4,))), T(np.float32(0.1))), {}),
+     None, grad=False)
+case("adam_",
+     lambda: ((T(P((4,))), T(P((4,))), T(P((4,))), T(PP((4,))),
+               T(np.float32(0.9)), T(np.float32(0.999)),
+               T(np.float32(0.1))), {}),
+     None, grad=False)
+case("adamw_",
+     lambda: ((T(P((4,))), T(P((4,))), T(P((4,))), T(PP((4,))),
+               T(np.float32(0.9)), T(np.float32(0.999)),
+               T(np.float32(0.1))), {}),
+     None, grad=False)
+case("adagrad_",
+     lambda: ((T(P((4,))), T(P((4,))), T(PP((4,))), T(np.float32(0.1))),
+              {}),
+     None, grad=False)
+case("rmsprop_",
+     lambda: ((T(P((4,))), T(P((4,))), T(PP((4,))), T(np.float32(0.1))),
+              {}),
+     None, grad=False)
+case("merged_momentum_",
+     lambda: (([T(P((4,))), T(P((3,)))], [T(P((4,))), T(P((3,)))],
+               [T(P((4,))), T(P((3,)))], T(np.float32(0.1))), {}),
+     None, grad=False)
+case("pixel_unshuffle", lambda: ((T(P((1, 2, 4, 4))),),
+                                 {"downscale_factor": 2}),
+     None)
+case("channel_shuffle", lambda: ((T(P((1, 4, 2, 2))),), {"groups": 2}),
+     None)
+case("shuffle_channel", lambda: ((T(P((1, 4, 2, 2))),), {"groups": 2}),
+     None)
+case("temporal_shift", lambda: ((T(P((4, 8, 2, 2))),), {"seg_num": 2}),
+     None)
+case("add_position_encoding", lambda: ((T(P((2, 4, 8))),), {}), None)
+case("bilinear",
+     lambda: ((T(P((3, 4))), T(P((3, 5))), T(P((2, 4, 5))), T(P((2,)))),
+              {}),
+     lambda x, y, w, b: np.einsum("bi,oij,bj->bo", x, w, y) + b)
+case("affine_channel",
+     lambda: ((T(P((2, 3, 2, 2))), T(P((3,))), T(P((3,)))), {}),
+     lambda x, s, b: x * s.reshape(1, -1, 1, 1) + b.reshape(1, -1, 1, 1))
+case("fused_softmax_mask",
+     lambda: ((T(P((2, 2, 3, 4))), T(P((2, 1, 3, 4)) * 0)), {}),
+     None)
+case("fused_softmax_mask_upper_triangle",
+     lambda: ((T(P((2, 2, 4, 4))),), {}), None)
+case("gather_tree",
+     lambda: ((T(rng.randint(0, 9, (3, 2, 2))),
+               T(rng.randint(0, 2, (3, 2, 2)))), {}),
+     None, grad=False)
+case("pool2d", lambda: ((T(P((1, 2, 4, 4))),),
+                        {"kernel_size": 2, "pooling_type": "avg"}),
+     None)
+case("pool3d", lambda: ((T(P((1, 2, 4, 4, 4))),),
+                        {"kernel_size": 2, "pooling_type": "max"}),
+     None)
+case("lp_pool2d", lambda: ((T(PP((1, 2, 4, 4))),), {"kernel_size": 2}),
+     None)
+case("max_pool2d_with_index", lambda: ((T(P((1, 2, 4, 4))),),
+                                       {"kernel_size": 2}),
+     None, grad=False)
+case("max_pool3d_with_index", lambda: ((T(P((1, 2, 4, 4, 4))),),
+                                       {"kernel_size": 2}),
+     None, grad=False)
+
+
+def _unpool_args():
+    x = T(P((1, 1, 4, 4)))
+    import paddle_tpu.ops as ops
+
+    v, idx = ops.max_pool2d_with_index(x, kernel_size=2)
+    return (v, idx), {"kernel_size": 2}
+
+
+case("unpool", _unpool_args, None, grad=False)
+case("unpool3d", lambda: ((T(P((1, 1, 2, 2, 2))),
+                           T(np.arange(8).reshape(1, 1, 2, 2, 2) * 8)),
+                          {"kernel_size": 2}),
+     None, grad=False)
+case("fractional_max_pool2d", lambda: ((T(P((1, 2, 8, 8))),),
+                                       {"output_size": 4}),
+     None, grad=False)
+case("fractional_max_pool3d", lambda: ((T(P((1, 2, 8, 8, 8))),),
+                                       {"output_size": 4}),
+     None, grad=False)
+case("depthwise_conv2d",
+     lambda: ((T(P((1, 3, 5, 5))), T(P((3, 1, 3, 3)))), {"padding": 1}),
+     None)
+case("conv3d_transpose",
+     lambda: ((T(P((1, 2, 3, 3, 3))), T(P((2, 2, 2, 2, 2)))),
+              {"stride": 2}),
+     None, grad=False)
+case("depthwise_conv2d_transpose",
+     lambda: ((T(P((1, 3, 4, 4))), T(P((3, 1, 2, 2)))), {"stride": 2}),
+     None, grad=False)
+case("bilinear_interp", lambda: ((T(P((1, 2, 4, 4))),), {"size": (8, 8)}),
+     None)
+case("nearest_interp", lambda: ((T(P((1, 2, 4, 4))),), {"size": (8, 8)}),
+     None)
+case("bicubic_interp", lambda: ((T(P((1, 2, 4, 4))),), {"size": (8, 8)}),
+     None, grad=False)
+case("linear_interp", lambda: ((T(P((1, 2, 8))),), {"size": (16,)}),
+     None, grad=False)
+case("trilinear_interp", lambda: ((T(P((1, 2, 4, 4, 4))),),
+                                  {"size": (8, 8, 8)}),
+     None, grad=False)
+
+
+def _fold_ref(x):
+    # inverse of unfold for non-overlapping 2x2 patches on 4x4
+    out = np.zeros((1, 1, 4, 4), np.float32)
+    cols = x.reshape(1, 1, 2, 2, 2, 2)
+    for i in range(2):
+        for j in range(2):
+            out[:, :, i::2, j::2] += cols[:, :, i, j]
+    return out
+
+
+case("fold", lambda: ((T(P((1, 4, 4))),),
+                      {"output_sizes": (4, 4), "kernel_sizes": 2,
+                       "strides": 2}),
+     _fold_ref)
+case("pad3d", lambda: ((T(P((1, 1, 2, 2, 2))),),
+                       {"paddings": [1, 1, 0, 0, 0, 0]}),
+     lambda x: np.pad(x, [(0, 0), (0, 0), (0, 0), (0, 0), (1, 1)]))
+case("frame", lambda: ((T(P((2, 16))),),
+                       {"frame_length": 4, "hop_length": 2}),
+     None)
+case("overlap_add", lambda: ((T(P((2, 4, 7))),), {"hop_length": 4}),
+     None)
+case("stft", lambda: ((T(P((2, 32))),), {"n_fft": 8}), None, grad=False)
+case("fft_c2c",
+     lambda: ((T((rng.rand(4, 8) + 1j * rng.rand(4, 8)).astype(
+         np.complex64)),), {"axes": [-1]}),
+     lambda x: np.fft.fft(x, axis=-1), grad=False)
+case("fft_r2c", lambda: ((T(P((4, 8))),), {"axes": [-1]}),
+     lambda x: np.fft.rfft(x, axis=-1), grad=False)
+case("fft_c2r",
+     lambda: ((T((rng.rand(4, 5) + 1j * rng.rand(4, 5)).astype(
+         np.complex64)),), {"axes": [-1]}),
+     lambda x: np.fft.irfft(x, axis=-1), grad=False)
+
+
+def _edit_ref(h, r, hl, rl):
+    import difflib
+
+    out = []
+    for i in range(h.shape[0]):
+        a = list(h[i][: hl[i]])
+        b = list(r[i][: rl[i]])
+        # classic DP
+        d = np.zeros((len(a) + 1, len(b) + 1))
+        d[:, 0] = np.arange(len(a) + 1)
+        d[0, :] = np.arange(len(b) + 1)
+        for x in range(1, len(a) + 1):
+            for y in range(1, len(b) + 1):
+                d[x, y] = min(d[x - 1, y] + 1, d[x, y - 1] + 1,
+                              d[x - 1, y - 1] + (a[x - 1] != b[y - 1]))
+        out.append(d[-1, -1])
+    return np.asarray(out, np.float32)
+
+
+case("edit_distance",
+     lambda: ((T(rng.randint(0, 5, (3, 6))), T(rng.randint(0, 5, (3, 7))),
+               T(np.asarray([6, 4, 2])), T(np.asarray([7, 3, 1]))), {}),
+     _edit_ref, grad=False)
+case("box_coder",
+     lambda: ((T(np.asarray([[0., 0., 10., 10.], [5., 5., 9., 9.]],
+                            np.float32)),
+               T(np.ones((1, 4), np.float32)),
+               T(np.asarray([[1., 1., 5., 5.]], np.float32))), {}),
+     None, grad=False)
+case("prior_box",
+     lambda: ((T(P((1, 8, 2, 2))), T(P((1, 3, 16, 16)))),
+              {"min_sizes": [4.0], "aspect_ratios": [1.0, 2.0]}),
+     None, grad=False)
+case("yolo_box",
+     lambda: ((T(P((1, 14, 2, 2))),
+               T(np.asarray([[64, 64]], np.int32))),
+              {"anchors": [10, 13, 16, 30], "class_num": 2}),
+     None, grad=False)
+case("matrix_rank", lambda: ((T(np.eye(4, dtype=np.float32) * 2),), {}),
+     lambda x: np.int64(4), grad=False)
+
+
 EXEMPT = {
     "_gru_scan": "internal RNN kernel (tests/test_nn_layers.py)",
     "_lstm_scan": "internal RNN kernel (tests/test_nn_layers.py)",
@@ -877,3 +1172,37 @@ def test_op_gradient_finite_difference(name):
         assert abs(num - got) / denom < 5e-2, (
             f"{name}: grad mismatch at {i}: numeric {num:.5f} vs "
             f"autograd {got:.5f}")
+
+
+def test_tail_op_regressions():
+    """Behaviors found by review: axis=0 frame/overlap_add layout,
+    non-square yolo_box, conv3d_transpose output_padding/groups, default
+    sequence_mask."""
+    import paddle_tpu.ops as ops
+
+    x = T(P((16, 2)))
+    f = ops.frame(x, frame_length=4, hop_length=2, axis=0)
+    assert f.shape == [7, 4, 2], f.shape
+    back = ops.overlap_add(f, hop_length=4, axis=0)
+    assert back.shape[0] == (7 - 1) * 4 + 4
+
+    # non-square grid: width normalized by w, height by h
+    z = T(np.zeros((1, 7, 1, 2), np.float32))  # logits 0 -> exp() = 1
+    boxes, _ = ops.yolo_box(z, T(np.asarray([[32, 64]], np.int32)),
+                            anchors=[16, 16], class_num=2,
+                            downsample_ratio=32, clip_bbox=False)
+    b = np.asarray(boxes._value).reshape(-1, 4)
+    w_norm = (b[0, 2] - b[0, 0]) / 64.0   # img_w = 64
+    h_norm = (b[0, 3] - b[0, 1]) / 32.0   # img_h = 32
+    np.testing.assert_allclose(w_norm, 16 / (32 * 2), rtol=1e-5)
+    np.testing.assert_allclose(h_norm, 16 / (32 * 1), rtol=1e-5)
+
+    out = ops.conv3d_transpose(T(P((1, 2, 3, 3, 3))), T(P((2, 2, 2, 2, 2))),
+                               stride=2, output_padding=1)
+    assert out.shape[2:] == [7, 7, 7], out.shape
+    g = ops.conv3d_transpose(T(P((1, 4, 3, 3, 3))), T(P((4, 1, 2, 2, 2))),
+                             stride=2, groups=2)
+    assert g.shape[1] == 2, g.shape
+
+    m = ops.sequence_mask(T(np.asarray([2, 4])))  # default maxlen
+    assert m.shape == [2, 4]

@@ -1,0 +1,150 @@
+"""Extended sparse op surface (analog of the reference's sparse_ops.yaml /
+phi/kernels/sparse/): unaries on stored values, CSR softmax, conv3d (+
+submanifold), batch_norm, and SDDMM-softmax-SpMM sparse attention."""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.sparse as sp
+
+rng = np.random.RandomState(7)
+
+
+def _coo(dense):
+    idx = np.nonzero(dense)
+    vals = dense[idx]
+    return sp.sparse_coo_tensor(np.stack(idx), vals, dense.shape)
+
+
+def _rand_sparse(shape, density=0.3):
+    d = rng.rand(*shape).astype(np.float32)
+    d[rng.rand(*shape) > density] = 0.0
+    return d
+
+
+@pytest.mark.parametrize("name,np_fn", [
+    ("asin", np.arcsin), ("asinh", np.arcsinh), ("atan", np.arctan),
+    ("atanh", np.arctanh), ("expm1", np.expm1), ("log1p", np.log1p),
+    ("square", np.square), ("sinh", np.sinh), ("tan", np.tan),
+    ("relu6", lambda v: np.clip(v, 0, 6)),
+])
+def test_sparse_unary_on_values(name, np_fn):
+    d = _rand_sparse((6, 8)) * 0.5
+    x = _coo(d)
+    out = getattr(sp, name)(x)
+    expect = np.where(d != 0, np_fn(d), 0.0)
+    np.testing.assert_allclose(np.asarray(out.to_dense()._value), expect,
+                               rtol=1e-5, atol=1e-6)
+    assert out.nnz == x.nnz  # zeros stay implicit
+
+
+def test_sparse_cast_scale_divide_reshape_sum():
+    d = _rand_sparse((4, 6))
+    x = _coo(d)
+    y = sp.cast(x, value_dtype="float32")
+    assert str(y.dtype) == "float32"
+    np.testing.assert_allclose(
+        np.asarray(sp.scale(x, 2.0).to_dense()._value), d * 2, rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sp.divide_scalar(x, 2.0).to_dense()._value), d / 2,
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(sp.reshape(x, [6, 4]).to_dense()._value),
+        d.reshape(6, 4), rtol=1e-6)
+    np.testing.assert_allclose(float(sp.sum(x)._value), d.sum(), rtol=1e-5)
+
+
+def test_csr_softmax_rowwise_over_stored_values():
+    d = _rand_sparse((5, 7), density=0.5)
+    x = sp.to_sparse_csr(paddle.to_tensor(d))
+    out = sp.softmax(x)
+    dense = np.asarray(out.to_dense()._value)
+    for r in range(5):
+        nz = d[r] != 0
+        if nz.sum() == 0:
+            continue
+        e = np.exp(d[r][nz] - d[r][nz].max())
+        np.testing.assert_allclose(dense[r][nz], e / e.sum(), rtol=1e-5)
+        np.testing.assert_allclose(dense[r][~nz], 0.0)
+
+
+def test_sparse_conv3d_matches_dense_conv():
+    d = _rand_sparse((1, 4, 4, 4, 2), density=0.4)
+    w = rng.rand(2, 2, 2, 2, 3).astype(np.float32)
+    x = _coo(d)
+    out = sp.conv3d(x, jnp.asarray(w), padding=0)
+    import jax
+
+    expect = jax.lax.conv_general_dilated(
+        jnp.asarray(d), jnp.asarray(w), (1, 1, 1), [(0, 0)] * 3,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"))
+    np.testing.assert_allclose(np.asarray(out.to_dense()._value),
+                               np.asarray(expect), rtol=1e-4, atol=1e-5)
+
+    # submanifold: output occupancy ⊆ input occupancy (odd kernel, pad 1)
+    w3 = rng.rand(3, 3, 3, 2, 3).astype(np.float32)
+    sout = sp.subm_conv3d(x, jnp.asarray(w3), padding=1)
+    occ_in = np.any(d != 0, axis=-1)
+    occ_out = np.any(np.asarray(sout.to_dense()._value) != 0, axis=-1)
+    assert not np.any(occ_out & ~occ_in)
+
+
+def test_sparse_batch_norm_normalizes_values():
+    d = _rand_sparse((10, 3), density=0.8)
+    x = _coo(d)
+    out = sp.batch_norm(x, None, None, None, None, training=True)
+    vals = np.asarray(out.values()._value)
+    np.testing.assert_allclose(vals.mean(axis=0), 0.0, atol=1e-5)
+    np.testing.assert_allclose(vals.std(axis=0), 1.0, atol=1e-2)
+
+
+def test_sparse_attention_matches_masked_dense():
+    B, H, S, D = 2, 2, 8, 4
+    q = rng.rand(B, H, S, D).astype(np.float32)
+    k = rng.rand(B, H, S, D).astype(np.float32)
+    v = rng.rand(B, H, S, D).astype(np.float32)
+    mask = np.tril(np.ones((S, S), np.float32))  # causal pattern
+    sm = sp.to_sparse_csr(paddle.to_tensor(mask))
+    out = sp.attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), sm)
+
+    scores = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+    scores = np.where(mask[None, None] > 0, scores, -1e30)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expect = np.einsum("bhqk,bhkd->bhqd", p, v)
+    np.testing.assert_allclose(np.asarray(out._value), expect, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_sparse_softmax_batched_3d():
+    d = _rand_sparse((2, 4, 6), density=0.5)
+    x = _coo(d)
+    out = np.asarray(sp.softmax(x).to_dense()._value)
+    for b in range(2):
+        for r in range(4):
+            nz = d[b, r] != 0
+            if nz.sum() == 0:
+                continue
+            e = np.exp(d[b, r][nz] - d[b, r][nz].max())
+            np.testing.assert_allclose(out[b, r][nz], e / e.sum(),
+                                       rtol=1e-5)
+
+
+def test_sparse_attention_key_padding_mask():
+    B, H, S, D = 2, 1, 6, 4
+    q = rng.rand(B, H, S, D).astype(np.float32)
+    mask = np.tril(np.ones((S, S), np.float32))
+    sm = sp.to_sparse_csr(paddle.to_tensor(mask))
+    kp = np.zeros((B, S), np.float32)
+    kp[:, -2:] = 1.0  # last two keys padded out
+    out = sp.attention(jnp.asarray(q), jnp.asarray(q), jnp.asarray(q), sm,
+                       key_padding_mask=jnp.asarray(kp))
+    scores = np.einsum("bhqd,bhkd->bhqk", q, q) / np.sqrt(D)
+    scores = np.where(mask[None, None] > 0, scores, -1e30)
+    scores = np.where(kp[:, None, None, :] > 0, -1e30, scores)
+    e = np.exp(scores - scores.max(-1, keepdims=True))
+    p = e / e.sum(-1, keepdims=True)
+    expect = np.einsum("bhqk,bhkd->bhqd", p, q)
+    np.testing.assert_allclose(np.asarray(out._value), expect, rtol=1e-4,
+                               atol=1e-5)
