@@ -349,8 +349,11 @@ class TrainStep:
             for k, p in params.items()
         }
         self._compiled = None
+        # scanned multi-step program; jax.jit's cache keys on the rng-key
+        # operand shape (N, ...), so different `steps` values coexist
+        self._multi = None
 
-    def _build(self):
+    def _one_step_fn(self):
         functional = self._functional
         optimizer = self.optimizer
         loss_fn = self.loss_fn
@@ -402,7 +405,60 @@ class TrainStep:
             out_params.update(new_p)
             return loss_val, out_params, new_buffers, new_accs, new_masters
 
-        return jax.jit(one_step, donate_argnums=(0, 2, 3))
+        return one_step
+
+    def _build(self):
+        return jax.jit(self._one_step_fn(), donate_argnums=(0, 2, 3))
+
+    def _build_multi(self):
+        """N whole train steps chained by lax.scan inside ONE donated
+        program — the multi-step product path. Per-step RNG keys ride as a
+        scanned (N, ...) operand drawn from the host stream, so stochastic
+        models reproduce N sequential ``__call__``s exactly; lr is held for
+        the scanned window since schedulers step on host."""
+        one_step = self._one_step_fn()
+
+        def many(params, buffers, accs, masters, lr, t0, rng_keys, args,
+                 kwargs, labels):
+            def body(carry, it):
+                i, key_i = it
+                params, buffers, accs, masters = carry
+                loss, params, buffers, accs, masters = one_step(
+                    params, buffers, accs, masters, lr, t0 + i, key_i,
+                    args, kwargs, labels)
+                return (params, buffers, accs, masters), loss
+
+            n = rng_keys.shape[0]
+            (params, buffers, accs, masters), losses = jax.lax.scan(
+                body, (params, buffers, accs, masters),
+                (jnp.arange(n, dtype=jnp.int32), rng_keys))
+            return losses, params, buffers, accs, masters
+
+        return jax.jit(many, donate_argnums=(0, 2, 3))
+
+    def run(self, *args, steps, labels=None, **kwargs):
+        """Run ``steps`` full train steps as ONE compiled dispatch; returns
+        the per-step losses (shape (steps,)). State — parameters, buffers,
+        optimizer accumulators, step count, AND the host RNG stream — lands
+        exactly as after ``steps`` sequential ``__call__``s."""
+        if self._multi is None:
+            self._multi = self._build_multi()
+        model, optimizer = self.model, self.optimizer
+        params = {k: p._value for k, p in model.named_parameters()}
+        buffers = {k: b._value for k, b in model.named_buffers()}
+        lr = jnp.asarray(optimizer.get_lr(), jnp.float32)
+        t0 = jnp.asarray(optimizer._step_count + 1, jnp.int32)
+        rng_keys = jnp.stack([
+            jax.random.key_data(_random.next_key())
+            for _ in range(int(steps))
+        ])
+        losses, new_params, new_buffers, self._accs, self._masters = \
+            self._multi(params, buffers, self._accs, self._masters, lr,
+                        t0, rng_keys, _as_array_tree(args),
+                        _as_array_tree(kwargs), _as_array_tree(labels))
+        optimizer._step_count += int(steps)
+        model.load_raw_state(new_params, new_buffers)
+        return Tensor._from_value(losses)
 
     def __call__(self, *args, labels=None, **kwargs):
         if self._compiled is None:

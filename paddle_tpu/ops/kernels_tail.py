@@ -333,18 +333,25 @@ def check_finite_and_unscale_(xs, scale):
     return (*outs, found)
 
 
-def update_loss_scaling_(scale, found_inf, good_steps,
+def update_loss_scaling_(scale, found_inf, good_steps, bad_steps=None,
                          incr_every_n_steps=2000,
                          decr_every_n_nan_or_inf=1, incr_ratio=2.0,
                          decr_ratio=0.5):
     """phi/kernels/update_loss_scaling_kernel: dynamic loss-scale update.
-    Returns (new_scale, new_good_steps)."""
-    grew = good_steps + 1 >= incr_every_n_steps
+    Decreases only after ``decr_every_n_nan_or_inf`` consecutive bad steps
+    (tracked by ``bad_steps``), increases after ``incr_every_n_steps``
+    consecutive good ones. Returns (new_scale, new_good, new_bad)."""
+    if bad_steps is None:
+        bad_steps = jnp.zeros_like(good_steps)
+    new_bad = jnp.where(found_inf, bad_steps + 1, 0)
+    shrink = new_bad >= decr_every_n_nan_or_inf
+    grew = (~found_inf) & (good_steps + 1 >= incr_every_n_steps)
     new_scale = jnp.where(
-        found_inf, jnp.maximum(scale * decr_ratio, 1.0),
+        shrink, jnp.maximum(scale * decr_ratio, 1.0),
         jnp.where(grew, scale * incr_ratio, scale))
     new_good = jnp.where(found_inf | grew, 0, good_steps + 1)
-    return new_scale, new_good
+    new_bad = jnp.where(shrink, 0, new_bad)
+    return new_scale, new_good, new_bad
 
 
 # ------------------------------------------------------- optimizer updates
@@ -688,35 +695,10 @@ def depthwise_conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1,
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
                      output_padding=0, dilation=1, groups=1,
                      data_format="NCDHW"):
-    nd = 3
-    if isinstance(stride, int):
-        stride = (stride,) * nd
-    if isinstance(padding, int):
-        padding = (padding,) * nd
-    if isinstance(dilation, int):
-        dilation = (dilation,) * nd
-    if isinstance(output_padding, int):
-        output_padding = (output_padding,) * nd
-    # weight: (Cin, Cout/g, kD, kH, kW) — conv_transpose as a forward conv
-    # with lhs_dilation; per-group I/O swap so feature_group_count applies
-    cin, outg = weight.shape[0], weight.shape[1]
-    kernel = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
-    kernel = kernel.reshape(groups, cin // groups, outg,
-                            *weight.shape[2:])
-    kernel = jnp.swapaxes(kernel, 1, 2).reshape(
-        groups * outg, cin // groups, *weight.shape[2:])
-    pads = tuple(
-        (d * (k - 1) - p, d * (k - 1) - p + op)
-        for k, p, d, op in zip(weight.shape[2:], padding, dilation,
-                               output_padding))
-    out = jax.lax.conv_general_dilated(
-        x, kernel, window_strides=(1,) * nd, padding=pads,
-        lhs_dilation=stride, rhs_dilation=dilation,
-        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
-        feature_group_count=groups)
-    if bias is not None:
-        out = out + bias.reshape(1, -1, 1, 1, 1)
-    return out
+    from .nn_kernels import grouped_conv_transpose_nd
+
+    return grouped_conv_transpose_nd(x, weight, bias, stride, padding,
+                                     output_padding, dilation, groups, nd=3)
 
 
 def depthwise_conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
@@ -749,19 +731,35 @@ def bicubic_interp(x, size=None, scale_factor=None, align_corners=False):
     return _interp(x, size, scale_factor, "bicubic", align_corners)
 
 
+def _linear_resize_last(x, out_w, align_corners):
+    """1-D linear resample along the last axis, honoring align_corners."""
+    in_w = x.shape[-1]
+    if align_corners and out_w > 1:
+        pos = jnp.linspace(0.0, in_w - 1.0, out_w)
+    else:
+        pos = (jnp.arange(out_w) + 0.5) * (in_w / out_w) - 0.5
+    lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, in_w - 1)
+    hi = jnp.clip(lo + 1, 0, in_w - 1)
+    w = jnp.clip(pos - lo, 0.0, 1.0).astype(x.dtype)
+    return x[..., lo] * (1 - w) + x[..., hi] * w
+
+
 def linear_interp(x, size=None, scale_factor=None, align_corners=False):
-    # 3-D (N, C, W) input: jax.image.resize linear
+    # 3-D (N, C, W) input
     size = size if size is not None else (
         int(x.shape[-1] * scale_factor),)
-    out_shape = x.shape[:2] + tuple(size)
-    return jax.image.resize(x, out_shape, method="linear")
+    return _linear_resize_last(x, int(size[0]), align_corners)
 
 
 def trilinear_interp(x, size=None, scale_factor=None, align_corners=False):
+    # 5-D (N, C, D, H, W): separable per-axis linear resample
     size = size if size is not None else tuple(
         int(d * scale_factor) for d in x.shape[2:])
-    out_shape = x.shape[:2] + tuple(size)
-    return jax.image.resize(x, out_shape, method="linear")
+    for ax, out_d in zip((2, 3, 4), size):
+        x = jnp.moveaxis(
+            _linear_resize_last(jnp.moveaxis(x, ax, -1), int(out_d),
+                                align_corners), -1, ax)
+    return x
 
 
 def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1):

@@ -516,6 +516,42 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
     return out
 
 
+def grouped_conv_transpose_nd(x, weight, bias, stride, padding,
+                              output_padding, dilation, groups, nd):
+    """Transposed conv of any spatial rank as a forward conv:
+    lhs_dilation=stride, kernel flipped spatially, I/O swapped within each
+    group so ``feature_group_count`` applies. Weight layout (paddle):
+    (Cin, Cout/g, *k). Shared by conv2d_transpose (groups>1) and
+    conv3d_transpose / depthwise variants."""
+    def tup(v):
+        return (v,) * nd if isinstance(v, int) else tuple(v)
+
+    if isinstance(padding, str):
+        raise NotImplementedError(
+            "string padding with grouped conv_transpose is not supported; "
+            "pass explicit per-dim padding")
+    stride, padding = tup(stride), tup(padding)
+    dilation, opad = tup(dilation), tup(output_padding)
+    cin, outg = weight.shape[0], weight.shape[1]
+    ks = weight.shape[2:]
+    kern = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    kern = kern.reshape(groups, cin // groups, outg, *ks)
+    kern = jnp.swapaxes(kern, 1, 2).reshape(groups * outg, cin // groups,
+                                            *ks)
+    pads = tuple(
+        (d * (k - 1) - p, d * (k - 1) - p + op)
+        for k, p, d, op in zip(ks, padding, dilation, opad))
+    spatial = "DHW"[-nd:]
+    dn = (f"NC{spatial}", f"OI{spatial}", f"NC{spatial}")
+    out = lax.conv_general_dilated(
+        x, kern, window_strides=(1,) * nd, padding=pads,
+        lhs_dilation=stride, rhs_dilation=dilation,
+        dimension_numbers=dn, feature_group_count=groups)
+    if bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
 def conv2d_transpose(
     x, weight, bias=None, stride=1, padding=0, output_padding=0, dilation=1, groups=1
 ):
@@ -526,31 +562,9 @@ def conv2d_transpose(
     if isinstance(padding, int):
         padding = (padding, padding)
     if groups != 1:
-        # grouped transpose as a forward conv: lhs_dilation=stride, kernel
-        # flipped spatially and I/O swapped within each group; rhs shape
-        # (g*out/g, in/g, kh, kw) with feature_group_count=g
-        cin, outg = weight.shape[0], weight.shape[1]
-        kh, kw = weight.shape[2], weight.shape[3]
-        kern = jnp.flip(weight, axis=(2, 3))
-        kern = kern.reshape(groups, cin // groups, outg, kh, kw)
-        kern = jnp.swapaxes(kern, 1, 2).reshape(
-            groups * outg, cin // groups, kh, kw)
-        opad = ((output_padding, output_padding)
-                if isinstance(output_padding, int) else tuple(output_padding))
-        pads = [
-            ((kh - 1) * dilation[0] - padding[0],
-             (kh - 1) * dilation[0] - padding[0] + opad[0]),
-            ((kw - 1) * dilation[1] - padding[1],
-             (kw - 1) * dilation[1] - padding[1] + opad[1]),
-        ]
-        out = lax.conv_general_dilated(
-            x, kern, window_strides=(1, 1), padding=pads,
-            lhs_dilation=tuple(stride), rhs_dilation=tuple(dilation),
-            dimension_numbers=("NCHW", "OIHW", "NCHW"),
-            feature_group_count=groups)
-        if bias is not None:
-            out = out + bias.reshape(1, -1, 1, 1)
-        return out
+        return grouped_conv_transpose_nd(
+            x, weight, bias, stride, padding, output_padding, dilation,
+            groups, nd=2)
     # weight layout: (in, out, kh, kw) — paddle convention. With
     # transpose_kernel=True lax swaps the kernel's I/O axes internally, so
     # pass HWIO with I=out, O=in. lax explicit padding is in FORWARD conv

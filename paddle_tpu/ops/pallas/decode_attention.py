@@ -75,16 +75,17 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         h, d = q_ref.shape[1], q_ref.shape[2]
         group = h // kvh
         q = q_ref[0, :, :].astype(jnp.float32) * scale        # (H, D)
-        k = k_ref[0, :, :, :].astype(jnp.float32)             # (page, KVH, D)
-        v = v_ref[0, :, :, :].astype(jnp.float32)
-        q3 = q.reshape(kvh, group, d)
-        kt = jnp.swapaxes(k, 0, 1)                            # (KVH, page, D)
-        vt = jnp.swapaxes(v, 0, 1)
-        # scores per kv-head group: (KVH, G, page)
-        s = jax.lax.dot_general(
-            q3, kt, (((2,), (2,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32)
-        s = s.reshape(h, page_size)                           # (H, page)
+        # per-kv-head 2-D matmuls, statically unrolled: Mosaic has no
+        # mismatched-batch-dim dot, and sublane transposes of the page
+        # block are far slower than kvh small matmuls
+        s_parts = []
+        for i in range(kvh):
+            k_i = k_ref[0, :, i, :].astype(jnp.float32)       # (page, D)
+            q_i = q[i * group:(i + 1) * group, :]             # (G, D)
+            s_parts.append(jax.lax.dot_general(
+                q_i, k_i, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))          # (G, page)
+        s = jnp.concatenate(s_parts, axis=0)                  # (H, page)
         pos = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + p * page_size
         s = jnp.where(pos < length, s, NEG_INF)
         m_prev = m_ref[:, :]                                  # (H, 1)
@@ -94,12 +95,15 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[:, :] = alpha * l_ref[:, :] + jnp.sum(pr, axis=1,
                                                     keepdims=True)
         m_ref[:, :] = m_new
-        # (KVH, G, page) @ (KVH, page, D) -> (KVH, G, D) -> (H, D)
-        pv = jax.lax.dot_general(
-            pr.reshape(kvh, group, page_size), vt,
-            (((2,), (1,)), ((0,), (0,))),
-            preferred_element_type=jnp.float32).reshape(h, d)
-        acc_ref[:, :] = alpha * acc_ref[:, :] + pv
+        pv_parts = []
+        for i in range(kvh):
+            v_i = v_ref[0, :, i, :].astype(jnp.float32)       # (page, D)
+            pr_i = pr[i * group:(i + 1) * group, :]           # (G, page)
+            pv_parts.append(jax.lax.dot_general(
+                pr_i, v_i, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))          # (G, D)
+        acc_ref[:, :] = alpha * acc_ref[:, :] + jnp.concatenate(
+            pv_parts, axis=0)
 
     @pl.when(p == pages_per_seq - 1)
     def _finalize():

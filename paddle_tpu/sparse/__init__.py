@@ -433,11 +433,19 @@ def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
 def batch_norm(x, running_mean, running_var, weight, bias, training=False,
                momentum=0.9, epsilon=1e-5, data_format="NDHWC"):
     """Sparse batch norm (phi/kernels/sparse/batch_norm_kernel): normalize
-    the stored values channel-wise; implicit zeros stay zero."""
+    the stored values channel-wise; implicit zeros stay zero. In training,
+    ``running_mean``/``running_var`` Tensors are updated in place with the
+    momentum-weighted batch statistics (the reference kernel's mutable
+    mean_out/variance_out outputs)."""
     vals = x._bcoo.data  # (nnz, C)
     if training or running_mean is None:
         mean = jnp.mean(vals, axis=0)
         var = jnp.var(vals, axis=0)
+        if training and isinstance(running_mean, Tensor):
+            running_mean._value = (momentum * running_mean._value
+                                   + (1 - momentum) * mean)
+            running_var._value = (momentum * running_var._value
+                                  + (1 - momentum) * var)
     else:
         mean = _val(running_mean)
         var = _val(running_var)

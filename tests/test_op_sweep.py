@@ -860,10 +860,23 @@ case("dequantize_abs_max",
 case("check_finite_and_unscale_",
      lambda: (([T(P((3, 4))), T(P((2, 2)))], T(np.float32(2.0))), {}),
      None, grad=False)
-case("update_loss_scaling_",
-     lambda: ((T(np.float32(1024.0)), T(np.asarray(False)),
-               T(np.asarray(5, np.int32))), {}),
-     None, grad=False)
+def _uls_check():
+    import paddle_tpu.ops as ops
+
+    # decr_every_n_nan_or_inf=2: first inf step must NOT shrink the scale
+    s1, g1, b1 = ops.update_loss_scaling_(
+        T(np.float32(1024.0)), T(np.asarray(True)),
+        T(np.asarray(5, np.int32)), T(np.asarray(0, np.int32)),
+        decr_every_n_nan_or_inf=2)
+    assert float(s1._value) == 1024.0 and int(b1._value) == 1
+    s2, g2, b2 = ops.update_loss_scaling_(
+        s1, T(np.asarray(True)), g1, b1, decr_every_n_nan_or_inf=2)
+    assert float(s2._value) == 512.0 and int(b2._value) == 0
+    return (T(np.float32(1024.0)), T(np.asarray(False)),
+            T(np.asarray(5, np.int32)), T(np.asarray(0, np.int32))), {}
+
+
+case("update_loss_scaling_", _uls_check, None, grad=False)
 case("sgd_",
      lambda: ((T(P((4,))), T(np.float32(0.1)), T(P((4,)))), {}),
      lambda p, lr, g: p - 0.1 * g, grad=False)
