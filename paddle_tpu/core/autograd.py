@@ -73,7 +73,8 @@ class GradNode:
     """
 
     __slots__ = ("name", "backward_fn", "edges", "num_outputs",
-                 "input_needs_grad", "pure_bwd", "in_tensors", "__weakref__")
+                 "input_needs_grad", "pure_bwd", "in_tensors", "slot_hooks",
+                 "__weakref__")
 
     def __init__(self, name, backward_fn, edges, num_outputs, input_needs_grad):
         self.name = name
@@ -88,6 +89,10 @@ class GradNode:
         # paths that can't support it (stateful RNG / nojit vjp fallback).
         self.pure_bwd = None
         self.in_tensors = None
+        # non-leaf Tensor.register_hook: slot -> [hook(raw) -> raw]; applied
+        # to the accumulated cotangent arriving at that output slot
+        # (reference: hooks on any tensor, paddle/fluid/eager/hooks.h)
+        self.slot_hooks = None
 
     def __repr__(self):
         return f"<GradNode {self.name}>"
@@ -350,6 +355,23 @@ def _run_sweep(queue, processed, buffers, indeg, capture, write_grads,
                     if t is not None:
                         t._accumulate_grad(g)
             continue
+
+        if node.slot_hooks:
+            # non-leaf hooks fire on the fully-accumulated cotangent of
+            # their slot, before backprop through the node and before any
+            # paddle.grad capture sees it
+            for slot, hooks in node.slot_hooks.items():
+                if slot not in slot_grads:
+                    continue
+                g = slot_grads[slot]
+                raw = not isinstance(g, Tensor)
+                gv = g if raw else g._value
+                for h in hooks:
+                    new = h(gv)
+                    if new is not None:
+                        gv = new
+                slot_grads[slot] = gv if raw else Tensor._from_value(
+                    gv, stop_gradient=True)
 
         if capture is not None:
             for slot, g in slot_grads.items():

@@ -43,6 +43,7 @@ class Tensor:
         "name",
         "persistable",
         "_placements_hint",
+        "_partial_info",
         "_lazy_init",
         "__weakref__",
     )
@@ -67,6 +68,7 @@ class Tensor:
         self.name = name or f"tensor_{Tensor._next_id()}"
         self.persistable = False
         self._placements_hint = None
+        self._partial_info = None
         self._lazy_init = None
 
     @classmethod
@@ -86,6 +88,7 @@ class Tensor:
         t.name = name or f"tensor_{cls._next_id()}"
         t.persistable = False
         t._placements_hint = None
+        t._partial_info = None
         t._lazy_init = None
         return t
 
@@ -195,7 +198,20 @@ class Tensor:
 
             node.hooks.append(wrapped)
             return
-        raise RuntimeError("register_hook on non-leaf tensors is not yet supported")
+        if node is None:
+            raise RuntimeError(
+                "register_hook: tensor has no grad edge (stop_gradient "
+                "or no recorded op)")
+
+        # non-leaf: hook fires when this tensor's cotangent is computed
+        # during backward (reference: hooks on any tensor,
+        # paddle/fluid/eager/hooks.h)
+        def wrapped_nl(g):
+            return _unwrap_opt(hook(Tensor._from_value(g)))
+
+        if node.slot_hooks is None:
+            node.slot_hooks = {}
+        node.slot_hooks.setdefault(slot, []).append(wrapped_nl)
 
     # ---------------- metadata ----------------
 

@@ -66,6 +66,42 @@ def _normalize_placements(placements, mesh):
     return placements
 
 
+def _partial_mesh_dims(placements):
+    return [i for i, p in enumerate(placements) if isinstance(p, Partial)]
+
+
+def _make_partial(value, mesh, placements):
+    """Materialize ``Partial`` semantics: each device along the partial
+    mesh dim holds an unreduced contribution, represented as a stacked
+    (axis_size, *shape) array Shard(0) over that dim. Entering partial from
+    a full value follows the reference's ``r_to_p`` rule (rank 0 keeps the
+    value, the rest hold zeros — the global SUM is preserved,
+    paddle/phi/core/distributed/auto_parallel/reshard/r_to_p_reshard_function.cc)."""
+    pdims = _partial_mesh_dims(placements)
+    if len(pdims) != 1:
+        raise NotImplementedError(
+            "Partial placement is supported over exactly one mesh dim")
+    pdim = pdims[0]
+    n = mesh.shape[pdim]
+    stacked = jnp.concatenate(
+        [value[None], jnp.zeros((n - 1,) + value.shape, value.dtype)], 0)
+    # stacked dim 0 shards over the partial mesh dim; remaining placements
+    # shift one tensor dim right
+    pl = []
+    for i, p in enumerate(placements):
+        if i == pdim:
+            pl.append(Shard(0))
+        elif isinstance(p, Shard):
+            pl.append(Shard(p.get_dim() + 1))
+        else:
+            pl.append(p)
+    arr = jax.device_put(stacked, to_named_sharding(mesh, pl))
+    out = Tensor._from_value(arr, stop_gradient=True)
+    out._placements_hint = (mesh, list(placements))
+    out._partial_info = (mesh, pdim)
+    return out
+
+
 def shard_tensor(data, mesh: ProcessMesh = None, placements=None,
                  dtype=None, place=None, stop_gradient=None):
     """Create a distributed tensor: lay ``data`` out over ``mesh`` according
@@ -107,6 +143,16 @@ def shard_tensor(data, mesh: ProcessMesh = None, placements=None,
         t = None
         value = jnp.asarray(data, dtype=None)
 
+    if _partial_mesh_dims(placements):
+        if getattr(data, "_partial_info", None) is not None:
+            hint = getattr(data, "_placements_hint", None)
+            if hint is not None and hint[0] == mesh \
+                    and list(hint[1]) == list(placements):
+                return data  # identical partial layout: identity
+            # different mesh/placements: resolve the pending sum, re-enter
+            value = jnp.sum(data._value, axis=0)
+        return _make_partial(value, mesh, placements)
+
     for mesh_dim, pl in enumerate(placements):
         if isinstance(pl, Shard):
             dim_size = value.shape[pl.get_dim()]
@@ -147,10 +193,23 @@ def reshard(x: Tensor, mesh: ProcessMesh = None, placements=None):
     (allgather/slice/alltoall equivalents happen in the transfer engine);
     inside jit use :func:`shard_constraint`, which XLA turns into the optimal
     collective (S→R=all-gather, P→R=all-reduce, S→S′=all-to-all,
-    R→S=local slice)."""
+    R→S=local slice). A ``Partial`` source reduces on exit (p→r=all-reduce,
+    p→s=reduce-scatter — the sum over the stacked contribution dim, which
+    XLA lowers onto the sharded axis); a ``Partial`` destination follows
+    r_to_p (one owner keeps the value)."""
     if mesh is None:
         mesh = get_mesh()
     placements = _normalize_placements(placements or [], mesh)
+    pinfo = getattr(x, "_partial_info", None)
+    if pinfo is not None:
+        if _partial_mesh_dims(placements):
+            # p→p: identity only for the identical layout; otherwise the
+            # pending sum resolves and re-enters (shard_tensor checks)
+            return shard_tensor(x, mesh, placements)
+        # p→r / p→s: reduce the pending sum, then lay out as requested
+        full = jnp.sum(x._value, axis=0)
+        return shard_tensor(Tensor._from_value(full, stop_gradient=True),
+                            mesh, placements)
     return shard_tensor(x, mesh, placements)
 
 

@@ -31,7 +31,12 @@ class Config:
         self._memory_pool_mb = None
 
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
-        self._device = "tpu"  # accelerator of this build
+        import warnings
+
+        warnings.warn(
+            "Config.enable_use_gpu: this build's accelerator is TPU; "
+            "routing to the TPU backend", stacklevel=2)
+        self._device = "tpu"
 
     def enable_tpu(self):
         self._device = "tpu"
@@ -53,29 +58,44 @@ class Config:
 
 
 class _IOTensor:
-    """Zero-copy-ish handle (reference ZeroCopyTensor)."""
+    """IO handle (reference ZeroCopyTensor): ``copy_from_cpu`` stages a
+    device array once; outputs stay device-resident until ``copy_to_cpu``
+    asks for host bytes."""
 
     def __init__(self, store, name):
         self._store = store
         self._name = name
 
     def copy_from_cpu(self, arr):
-        self._store[self._name] = np.asarray(arr)
+        import jax.numpy as jnp
+
+        self._store[self._name] = jnp.asarray(arr)
+
+    def share_external_data(self, tensor):
+        self._store[self._name] = (tensor._value if isinstance(tensor, Tensor)
+                                   else tensor)
 
     def copy_to_cpu(self):
         return np.asarray(self._store[self._name])
 
     def shape(self):
-        return list(np.asarray(self._store[self._name]).shape)
+        return list(self._store[self._name].shape)
 
 
 class Predictor:
+    """Runs a ``jit.save`` artifact with the SAVED IO contract: input names
+    come from the artifact's metadata (InputSpec.name or the forward
+    signature), not synthesized positions."""
+
     def __init__(self, config: Config):
         from ..jit.serialization import load
 
         self._layer = load(config.model_prefix)
-        n = self._layer._meta.get("n_inputs", 1)
-        self._input_names = [f"x{i}" for i in range(n)]
+        meta = self._layer._meta
+        n = meta.get("n_inputs", 1)
+        self._input_names = list(
+            meta.get("input_names") or [f"x{i}" for i in range(n)])
+        self._output_names = list(meta.get("output_names") or [])
         self._inputs = {}
         self._outputs = {}
 
@@ -83,30 +103,43 @@ class Predictor:
         return list(self._input_names)
 
     def get_input_handle(self, name):
+        if name not in self._input_names:
+            raise KeyError(
+                f"unknown input {name!r}; this model's inputs are "
+                f"{self._input_names}")
         return _IOTensor(self._inputs, name)
 
     def get_output_names(self):
-        return list(self._outputs)
+        return list(self._output_names) if self._output_names \
+            else list(self._outputs)
 
     def get_output_handle(self, name):
         return _IOTensor(self._outputs, name)
 
     def run(self, inputs=None):
-        """Either positional ndarray list, or pre-staged input handles."""
+        """Either positional array list, or pre-staged input handles.
+        Values stay on device end-to-end; numpy conversion happens only in
+        ``copy_to_cpu``."""
         if inputs is None:
+            missing = [n for n in self._input_names if n not in self._inputs]
+            if missing:
+                raise RuntimeError(
+                    f"inputs not staged: {missing} (use "
+                    "get_input_handle(name).copy_from_cpu(...))")
             inputs = [self._inputs[n] for n in self._input_names]
         outs = self._layer(*[
-            x if isinstance(x, Tensor) else Tensor(np.asarray(x))
-            for x in inputs
+            x if isinstance(x, Tensor) else Tensor(x) for x in inputs
         ])
         if not isinstance(outs, (tuple, list)):
             outs = [outs]
         self._outputs.clear()
         result = []
         for i, o in enumerate(outs):
-            arr = np.asarray(o._value if isinstance(o, Tensor) else o)
-            self._outputs[f"out{i}"] = arr
-            result.append(arr)
+            val = o._value if isinstance(o, Tensor) else o
+            name = (self._output_names[i] if i < len(self._output_names)
+                    else f"out{i}")
+            self._outputs[name] = val
+            result.append(val)
         return result
 
 

@@ -88,10 +88,50 @@ def save(layer, path, input_spec=None, example_inputs=None, **configs):
         with open(path + ".pdmodel", "wb") as f:
             f.write(blob)
         fio.save({"params": params, "buffers": buffers}, path + ".pdiparams")
+        # input names: explicit InputSpec.name wins, else the forward
+        # signature's argument names — the saved IO contract the Predictor
+        # recovers (reference: feed/fetch var names in the inference model)
+        names: list = [None] * len(avals)
+        if input_spec is not None:
+            from ..static import InputSpec
+
+            for i, spec in enumerate(input_spec):
+                if isinstance(spec, InputSpec) and spec.name:
+                    names[i] = spec.name
+        if any(n is None for n in names):
+            import inspect
+
+            fwd = getattr(inner, "forward", inner)
+            try:
+                sig_names = [p.name for p in
+                             inspect.signature(fwd).parameters.values()
+                             if p.kind in (p.POSITIONAL_ONLY,
+                                           p.POSITIONAL_OR_KEYWORD)]
+            except (TypeError, ValueError):
+                sig_names = []
+            for i in range(len(avals)):
+                if names[i] is None:
+                    names[i] = (sig_names[i] if i < len(sig_names)
+                                else f"x{i}")
+        explicit = [n for n in
+                    (getattr(s, "name", None) for s in (input_spec or []))
+                    if n]
+        if len(set(explicit)) != len(explicit):
+            raise ValueError(f"duplicate InputSpec names: {explicit}")
+        # fallback-derived names must not collide with anything (a staged
+        # array would silently feed two inputs)
+        seen: set = set()
+        for i, n in enumerate(names):
+            if n in seen:
+                names[i] = f"{n}_{i}"
+            seen.add(names[i])
+        n_out = len(jax.tree_util.tree_leaves(exported.out_avals))
         meta = {
             "n_inputs": len(avals),
+            "input_names": names,
             "input_shapes": [list(a.shape) for a in avals],
             "input_dtypes": [str(a.dtype) for a in avals],
+            "output_names": [f"out{i}" for i in range(n_out)],
         }
         with open(path + ".pdmodel.json", "w") as f:
             json.dump(meta, f)

@@ -15,20 +15,27 @@ from __future__ import annotations
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=None, **configs):
-    """Export ``layer`` for deployment. Writes the StableHLO artifact pair
-    (``<path>.pdmodel`` + ``.pdiparams``); ONNX protobuf emission would
-    require a StableHLO→ONNX converter, which does not exist in this
-    environment (zero egress, no onnx package baked in)."""
-    import warnings
+def export(layer, path, input_spec=None, opset_version=None,
+           export_format="onnx", **configs):
+    """Export ``layer`` for deployment.
 
-    from ..jit.serialization import save
+    ``export_format="onnx"`` (the default, matching the reference API)
+    raises: no ONNX emitter exists in this environment (no onnx package,
+    zero egress — like the reference raising when paddle2onnx is absent).
+    Pass ``export_format="stablehlo"`` to write the TPU-native portable
+    artifact pair (``<path>.pdmodel`` + ``.pdiparams``) instead, loadable
+    by ``paddle_tpu.jit.load`` / ``paddle_tpu.inference.Predictor`` or any
+    StableHLO consumer.
+    """
+    if export_format == "stablehlo":
+        from ..jit.serialization import save
 
-    warnings.warn(
-        "paddle_tpu.onnx.export produces a StableHLO artifact "
-        "(the TPU-native portable format), not ONNX protobufs; load it with "
-        "paddle_tpu.jit.load or paddle_tpu.inference.Predictor",
-        stacklevel=2,
-    )
-    save(layer, path, input_spec=input_spec)
-    return path
+        save(layer, path, input_spec=input_spec)
+        return path
+    raise RuntimeError(
+        "paddle_tpu.onnx.export cannot emit ONNX protobufs: no ONNX "
+        "emitter/converter is available in this environment (the reference "
+        "delegates to the external paddle2onnx package, which consumes a "
+        "program format this framework does not have). Use "
+        "export_format='stablehlo' for the portable deployment artifact, "
+        "or paddle_tpu.jit.save directly.")

@@ -734,8 +734,10 @@ def bicubic_interp(x, size=None, scale_factor=None, align_corners=False):
 def _linear_resize_last(x, out_w, align_corners):
     """1-D linear resample along the last axis, honoring align_corners."""
     in_w = x.shape[-1]
-    if align_corners and out_w > 1:
-        pos = jnp.linspace(0.0, in_w - 1.0, out_w)
+    if align_corners:
+        # out_w == 1: ratio (in-1)/(out-1) is defined as 0 -> sample x[0]
+        pos = (jnp.linspace(0.0, in_w - 1.0, out_w) if out_w > 1
+               else jnp.zeros((1,)))
     else:
         pos = (jnp.arange(out_w) + 0.5) * (in_w / out_w) - 0.5
     lo = jnp.clip(jnp.floor(pos).astype(jnp.int32), 0, in_w - 1)

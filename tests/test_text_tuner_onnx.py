@@ -78,14 +78,26 @@ def test_auto_tuner_tune_loop():
         c["dp_degree"] for c, _ in tuner.history)
 
 
-def test_onnx_export_produces_stablehlo(tmp_path):
+def test_onnx_export_default_is_explicit_error(tmp_path):
     import paddle_tpu.nn as nn
     from paddle_tpu.static import InputSpec
 
     model = nn.Linear(4, 2)
-    with pytest.warns(UserWarning, match="StableHLO"):
+    # no ONNX emitter exists in this environment: the default must say so
+    # loudly, never silently relabel another format as ONNX
+    with pytest.raises(RuntimeError, match="cannot emit ONNX"):
         paddle.onnx.export(model, str(tmp_path / "m"),
                            input_spec=[InputSpec([1, 4], "float32")])
+
+
+def test_onnx_export_stablehlo_opt_in(tmp_path):
+    import paddle_tpu.nn as nn
+    from paddle_tpu.static import InputSpec
+
+    model = nn.Linear(4, 2)
+    paddle.onnx.export(model, str(tmp_path / "m"),
+                       input_spec=[InputSpec([1, 4], "float32")],
+                       export_format="stablehlo")
     loaded = paddle.jit.load(str(tmp_path / "m"))
     out = loaded(paddle.to_tensor(np.ones((1, 4), np.float32)))
     assert out.shape == [1, 2]
