@@ -81,6 +81,8 @@ def _make_partial(value, mesh, placements):
     if len(pdims) != 1:
         raise NotImplementedError(
             "Partial placement is supported over exactly one mesh dim")
+    if isinstance(value, Tensor):
+        value = value._value
     pdim = pdims[0]
     n = mesh.shape[pdim]
     stacked = jnp.concatenate(
@@ -144,6 +146,11 @@ def shard_tensor(data, mesh: ProcessMesh = None, placements=None,
         value = jnp.asarray(data, dtype=None)
 
     if _partial_mesh_dims(placements):
+        if (stop_gradient is False
+                or (isinstance(data, Tensor) and not data.stop_gradient)):
+            raise NotImplementedError(
+                "autograd through Partial entry is not supported; reshard "
+                "to Replicate/Shard before differentiating")
         if getattr(data, "_partial_info", None) is not None:
             hint = getattr(data, "_placements_hint", None)
             if hint is not None and hint[0] == mesh \
@@ -152,6 +159,12 @@ def shard_tensor(data, mesh: ProcessMesh = None, placements=None,
             # different mesh/placements: resolve the pending sum, re-enter
             value = jnp.sum(data._value, axis=0)
         return _make_partial(value, mesh, placements)
+    if getattr(data, "_partial_info", None) is not None:
+        # partial source, non-partial target: resolve the pending sum
+        # first (p→r all-reduce / p→s reduce-scatter), never lay out the
+        # stacked internal representation
+        t = None
+        value = jnp.sum(data._value, axis=0)
 
     for mesh_dim, pl in enumerate(placements):
         if isinstance(pl, Shard):

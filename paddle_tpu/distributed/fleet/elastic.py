@@ -29,10 +29,17 @@ class ElasticStatus:
 
 
 class ElasticManager:
-    """Track live hosts by heartbeat keys; report scale events."""
+    """Track live hosts by heartbeat keys; report scale events.
+
+    ``np_range=(np_min, np_max)`` enables elastic membership (the
+    reference's ``--np 2:4``): the world may shrink to ``np_min`` when
+    hosts die (scale-in) and grow toward ``np_max`` when new hosts
+    announce themselves (scale-out), each via re-rendezvous at a bumped
+    generation (manager.py _update_fault_tolerance:457)."""
 
     def __init__(self, store=None, rank=0, world_size=1,
-                 heartbeat_interval=2.0, lease=6.0, prefix="elastic"):
+                 heartbeat_interval=2.0, lease=6.0, prefix="elastic",
+                 np_range=None):
         from ..store import TCPStore
 
         self.store = store or TCPStore(is_master=(rank == 0))
@@ -41,6 +48,7 @@ class ElasticManager:
         self.interval = heartbeat_interval
         self.lease = lease
         self.prefix = prefix
+        self.np_min, self.np_max = np_range or (world_size, world_size)
         self._stop = threading.Event()
         self._thread = None
 
@@ -89,6 +97,77 @@ class ElasticManager:
         if len(alive) == 0:
             return ElasticStatus.EXIT
         return ElasticStatus.RESTART
+
+    # ------------------------------------------------ scale in/out
+
+    def announce_join(self):
+        """A NEW host (not in the current world) volunteers for the next
+        generation; heartbeats under a join slot (reference: host register
+        under the etcd node prefix)."""
+        idx = self.store.add(f"{self.prefix}/joiners", 1) - 1
+        self.store.set(f"{self.prefix}/join/{idx}",
+                       str(time.time()).encode())
+        return idx
+
+    def _alive_joiners(self):
+        try:
+            n = self.store.add(f"{self.prefix}/joiners", 0)
+            base = self.store.add(f"{self.prefix}/join_base", 0)
+        except RuntimeError:
+            return 0
+        now = time.time()
+        alive = 0
+        for i in range(base, n):
+            key = f"{self.prefix}/join/{i}"
+            if not self.store.check(key):
+                continue
+            try:
+                t = float(self.store.get(key).decode())
+            except (ValueError, RuntimeError):
+                continue
+            if now - t <= self.lease:
+                alive += 1
+        return alive
+
+    def scale_plan(self):
+        """(status, new_world): HOLD = keep running; RESTART = re-rendezvous
+        at ``new_world`` members; EXIT = not enough hosts to continue.
+        Scale-in when members died but ≥ np_min survive; scale-out when
+        joiners can grow the world toward np_max."""
+        alive = len(self.alive_ranks())
+        joiners = self._alive_joiners()
+        if alive == 0 and joiners == 0:
+            return ElasticStatus.EXIT, 0
+        target = min(alive + joiners, self.np_max)
+        if alive == self.world_size:
+            if target > self.world_size:
+                return ElasticStatus.RESTART, target  # scale-out
+            return ElasticStatus.HOLD, self.world_size
+        if target >= self.np_min:
+            return ElasticStatus.RESTART, target      # scale-in (or mixed)
+        return ElasticStatus.EXIT, target
+
+    def re_rendezvous(self, new_world):
+        """Commit a scale event: bump the generation and publish the new
+        world size; running workers observe the bump and exit for restart
+        (the reference's endpoint re-registration + pre_hook re-exec)."""
+        gen = self.store.add(f"{self.prefix}/generation", 1)
+        self.store.set(f"{self.prefix}/world", str(new_world).encode())
+        # absorb joiners by advancing a watermark (slots are index-keyed:
+        # a host announcing concurrently gets a slot past the watermark
+        # and stays visible for the NEXT generation)
+        n = self.store.add(f"{self.prefix}/joiners", 0)
+        base = self.store.add(f"{self.prefix}/join_base", 0)
+        if n > base:
+            self.store.add(f"{self.prefix}/join_base", n - base)
+        self.world_size = new_world
+        return gen
+
+    def current_generation(self):
+        try:
+            return self.store.add(f"{self.prefix}/generation", 0)
+        except RuntimeError:
+            return 0
 
 
 class CommTaskManager:

@@ -93,13 +93,20 @@ class Pod:
 
 
 def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
-           max_restarts=0, env=None):
+           max_restarts=0, env=None, elastic_np=None):
     """Run ``entry`` as ``nproc_per_node`` ranked worker processes.
 
     Returns 0 on success. Reference flow (launch/main.py → CollectiveController
     → Pod): start a TCPStore master, spawn ranked workers, watch; on worker
     failure stop the pod and (if restarts remain) relaunch everyone —
     elastic manager semantics (fleet/elastic/manager.py ElasticManager:125).
+
+    ``elastic_np=(np_min, np_max)`` enables scale-in/out re-rendezvous
+    (manager.py _update_fault_tolerance:457): after a worker failure the
+    pod relaunches with the surviving worker count (clamped to np_min),
+    each generation exported as ``PADDLE_ELASTIC_GENERATION``; a pending
+    scale-out request (``request_scale_out``, e.g. from a recovered host)
+    grows the next generation toward np_max.
     """
     from ..store import TCPStore
 
@@ -109,10 +116,14 @@ def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
         master = f"127.0.0.1:{store.port}"
 
     restarts = 0
+    nproc = nproc_per_node
+    generation = 0
     try:
         while True:
-            pod = Pod(nproc_per_node, entry, list(entry_args), master,
-                      log_dir=log_dir, env=env)
+            gen_env = dict(env or {})
+            gen_env["PADDLE_ELASTIC_GENERATION"] = str(generation)
+            pod = Pod(nproc, entry, list(entry_args), master,
+                      log_dir=log_dir, env=gen_env)
             pod.start()
             while True:
                 status = pod.poll()
@@ -123,14 +134,55 @@ def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
                 break
             if rc == 0:
                 return 0
+            survivors = sum(1 for p in pod.procs
+                            if p.poll() in (None, 0))
             pod.stop()
             if restarts >= max_restarts:
                 print(f"[launch] worker {rank} failed with code {rc}; "
                       f"no restarts left", file=sys.stderr)
                 return rc
             restarts += 1
+            generation += 1
+            if elastic_np is not None:
+                np_min, np_max = elastic_np
+                want = _pending_scale_out(store, master)
+                new_n = max(min(max(survivors, want), np_max), np_min)
+                if new_n != nproc:
+                    print(f"[launch] elastic re-rendezvous: world "
+                          f"{nproc} -> {new_n} (generation {generation})",
+                          file=sys.stderr)
+                nproc = new_n
+                if survivors < np_min and want == 0:
+                    print(f"[launch] only {survivors} survivors < np_min "
+                          f"{np_min}; relaunching at np_min", file=sys.stderr)
             print(f"[launch] worker {rank} failed (code {rc}); restart "
                   f"{restarts}/{max_restarts}", file=sys.stderr)
     finally:
         if store is not None:
             store.close()
+
+
+def _pending_scale_out(store, master):
+    """Consume a pending scale-out request (0 if none). Requests are posted
+    with :func:`request_scale_out` against the job's master endpoint; with
+    an external master the controller connects as a client to read them."""
+    if store is None:
+        from ..store import TCPStore
+
+        try:
+            host, port = master.rsplit(":", 1)
+            store = TCPStore(host=host, port=int(port), is_master=False,
+                             timeout=5)
+        except (ValueError, RuntimeError):
+            return 0
+    n = store.add("launch/scale_out", 0)
+    if n:
+        store.add("launch/scale_out", -n)
+    return n
+
+
+def request_scale_out(store, target_world):
+    """Ask the controller to grow the next generation to ``target_world``
+    (the reference's host-rejoin path: a recovered node re-registers and
+    the manager scales out at the next restart)."""
+    store.add("launch/scale_out", int(target_world))

@@ -108,6 +108,23 @@ class _FunctionalModel:
             layer.load_raw_state(saved_p, saved_b)
 
 
+_TRACE_BREAKS = (jax.errors.ConcretizationTypeError,
+                 jax.errors.TracerArrayConversionError,
+                 jax.errors.TracerBoolConversionError,
+                 jax.errors.TracerIntegerConversionError,
+                 TracedConcretizationError)
+
+
+class _GraphBreak(Exception):
+    """Internal: a trace failed for one call signature; carries the cache
+    key so the fallback stays per-signature."""
+
+    def __init__(self, key, cause):
+        super().__init__(str(cause))
+        self.key = key
+        self.cause = cause
+
+
 class StaticFunction:
     """Returned by ``to_static``: runs the traced, XLA-compiled whole-graph
     program while still composing with eager autograd."""
@@ -125,10 +142,14 @@ class StaticFunction:
         self._compiled: dict = {}
         # full_graph=False: the reference's SOT route tolerates graph breaks
         # by falling back to eager for untraceable code; here untraceable
-        # means data-dependent Python control flow inside the trace, and the
-        # fallback is function-level (whole call runs eager, sticky).
+        # means data-dependent Python control flow inside the trace. The
+        # fallback is PER CALL SIGNATURE (training mode, arg tree, static
+        # leaves): a signature that breaks runs eager from then on, while
+        # signatures that trace keep their compiled programs — the
+        # jit-level analog of SOT's per-code-path guard sets
+        # (python/paddle/jit/sot/, opcode_translator guards).
         self._full_graph = bool(full_graph)
-        self._eager_fallback = False
+        self._eager_keys: set = set()
 
     def _get_compiled(self, key, tree, static_leaves, n_leaves):
         fn = self._compiled.get(key)
@@ -148,15 +169,10 @@ class StaticFunction:
         return fn
 
     def __call__(self, *args, **kwargs):
-        if self._eager_fallback:
-            return self._run_eager(args, kwargs)
         try:
             return self._call_traced(args, kwargs)
-        except (jax.errors.ConcretizationTypeError,
-                jax.errors.TracerArrayConversionError,
-                jax.errors.TracerBoolConversionError,
-                jax.errors.TracerIntegerConversionError,
-                TracedConcretizationError) as e:
+        except _GraphBreak as gb:
+            e = gb.cause
             if self._full_graph:
                 raise RuntimeError(
                     "to_static(full_graph=True) could not trace this "
@@ -166,9 +182,9 @@ class StaticFunction:
             import warnings
 
             warnings.warn(
-                f"to_static: graph break ({type(e).__name__}); running "
-                "eagerly (full_graph=False)")
-            self._eager_fallback = True
+                f"to_static: graph break ({type(e).__name__}); this call "
+                "signature runs eagerly (other signatures stay compiled)")
+            self._eager_keys.add(gb.key)
             return self._run_eager(args, kwargs)
 
     def _run_eager(self, args, kwargs):
@@ -203,6 +219,8 @@ class StaticFunction:
                 static_leaves[i] = v
 
         key = (training, tree, _freeze(static_leaves))
+        if key in self._eager_keys:
+            return self._run_eager(args, kwargs)
         compiled = self._get_compiled(key, tree, static_leaves, len(flat))
         rng_key = jax.random.key_data(_random.next_key())
 
@@ -212,26 +230,30 @@ class StaticFunction:
         }
         needs_grad = autograd.is_grad_enabled() and (diff_params or diff_tensors)
 
-        if not needs_grad:
-            out, new_buffers = compiled(params, buffers, dyn, rng_key)
-            self._write_buffers(new_buffers)
-            return _as_tensor_tree(out)
+        try:
+            if not needs_grad:
+                out, new_buffers = compiled(params, buffers, dyn, rng_key)
+                self._write_buffers(new_buffers)
+                return _as_tensor_tree(out)
 
-        frozen = {k: v for k, v in params.items() if k not in diff_params}
+            frozen = {k: v for k, v in params.items() if k not in diff_params}
 
-        def fwd(p_diff, diff_vals):
-            full = dict(frozen)
-            full.update(p_diff)
-            dyn2 = dict(dyn)
-            for pos, val in zip(diff_pos, diff_vals):
-                dyn2[pos] = val
-            return compiled(full, buffers, dyn2, rng_key)
+            def fwd(p_diff, diff_vals):
+                full = dict(frozen)
+                full.update(p_diff)
+                dyn2 = dict(dyn)
+                for pos, val in zip(diff_pos, diff_vals):
+                    dyn2[pos] = val
+                return compiled(full, buffers, dyn2, rng_key)
 
-        (out, new_buffers), vjp_fn = jax.vjp(
-            fwd,
-            {k: p._value for k, p in diff_params.items()},
-            [t._value for t in diff_tensors],
-        )
+            (out, new_buffers), vjp_fn = jax.vjp(
+                fwd,
+                {k: p._value for k, p in diff_params.items()},
+                [t._value for t in diff_tensors],
+            )
+        except _TRACE_BREAKS as e:
+            self._compiled.pop(key, None)  # drop the half-traced program
+            raise _GraphBreak(key, e) from e
         self._write_buffers(new_buffers)
 
         out_flat, out_tree = jax.tree_util.tree_flatten(out)

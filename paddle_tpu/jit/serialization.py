@@ -77,27 +77,24 @@ def save(layer, path, input_spec=None, example_inputs=None, **configs):
             return out
 
         avals = _resolve_avals(inner, input_spec, example_inputs)
-        params_avals = jax.tree_util.tree_map(
-            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params)
-        exported = jexport.export(jax.jit(pure))(params_avals, *avals)
-        blob = exported.serialize()
 
-        d = os.path.dirname(path)
-        if d:
-            os.makedirs(d, exist_ok=True)
-        with open(path + ".pdmodel", "wb") as f:
-            f.write(blob)
-        fio.save({"params": params, "buffers": buffers}, path + ".pdiparams")
         # input names: explicit InputSpec.name wins, else the forward
         # signature's argument names — the saved IO contract the Predictor
-        # recovers (reference: feed/fetch var names in the inference model)
+        # recovers (reference: feed/fetch var names in the inference
+        # model). Computed and validated BEFORE any file is written, so a
+        # bad spec never leaves a partial artifact behind.
         names: list = [None] * len(avals)
+        explicit_idx: set = set()
         if input_spec is not None:
             from ..static import InputSpec
 
             for i, spec in enumerate(input_spec):
                 if isinstance(spec, InputSpec) and spec.name:
                     names[i] = spec.name
+                    explicit_idx.add(i)
+        explicit = [names[i] for i in sorted(explicit_idx)]
+        if len(set(explicit)) != len(explicit):
+            raise ValueError(f"duplicate InputSpec names: {explicit}")
         if any(n is None for n in names):
             import inspect
 
@@ -109,22 +106,29 @@ def save(layer, path, input_spec=None, example_inputs=None, **configs):
                                            p.POSITIONAL_OR_KEYWORD)]
             except (TypeError, ValueError):
                 sig_names = []
+            # fallback names avoid every explicit name and each other;
+            # explicit names are never renamed
+            taken = set(explicit)
             for i in range(len(avals)):
-                if names[i] is None:
-                    names[i] = (sig_names[i] if i < len(sig_names)
-                                else f"x{i}")
-        explicit = [n for n in
-                    (getattr(s, "name", None) for s in (input_spec or []))
-                    if n]
-        if len(set(explicit)) != len(explicit):
-            raise ValueError(f"duplicate InputSpec names: {explicit}")
-        # fallback-derived names must not collide with anything (a staged
-        # array would silently feed two inputs)
-        seen: set = set()
-        for i, n in enumerate(names):
-            if n in seen:
-                names[i] = f"{n}_{i}"
-            seen.add(names[i])
+                if names[i] is not None:
+                    continue
+                cand = sig_names[i] if i < len(sig_names) else f"x{i}"
+                if cand in taken:
+                    cand = f"{cand}_{i}"
+                names[i] = cand
+                taken.add(cand)
+
+        params_avals = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params)
+        exported = jexport.export(jax.jit(pure))(params_avals, *avals)
+        blob = exported.serialize()
+
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(blob)
+        fio.save({"params": params, "buffers": buffers}, path + ".pdiparams")
         n_out = len(jax.tree_util.tree_leaves(exported.out_avals))
         meta = {
             "n_inputs": len(avals),

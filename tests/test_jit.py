@@ -239,7 +239,7 @@ def test_full_graph_false_graph_break_fallback():
     # traceable functions still compile under full_graph=False
     g = to_static(lambda a: a * 3, full_graph=False)
     np.testing.assert_allclose(np.asarray(g(x)._value), [3.0, 6.0])
-    assert len(g._compiled) == 1 and not g._eager_fallback
+    assert len(g._compiled) == 1 and not g._eager_keys
 
 
 def test_fn_mode_trace_does_not_leak_tracers_into_buffers():
@@ -299,3 +299,37 @@ def test_train_step_run_matches_sequential():
         np.testing.assert_allclose(np.asarray(p1._value),
                                    np.asarray(p2._value), rtol=1e-5,
                                    err_msg=k1)
+
+
+def test_graph_break_is_per_signature():
+    """full_graph=False: a breaking call signature falls back to eager,
+    but OTHER signatures keep their compiled programs (SOT-style guard
+    granularity, vs the old whole-function sticky fallback)."""
+    import warnings
+
+    calls = {"eager": 0}
+
+    @paddle.jit.to_static(full_graph=False)
+    def f(x, mode):
+        if mode == "branchy":
+            # data-dependent python control flow: untraceable
+            if float(x.sum()) > 0:
+                calls["eager"] += 1
+                return x * 2.0
+            return x
+        return x + 1.0
+
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out_b = f(x, "branchy")            # breaks -> eager
+    np.testing.assert_allclose(np.asarray(out_b._value), 2.0 * np.ones((2, 2)))
+    out_t = f(x, "plain")                  # different signature: compiled
+    np.testing.assert_allclose(np.asarray(out_t._value), 2.0 * np.ones((2, 2)))
+    # the broken signature stays eager; the good one stays compiled
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f(x, "branchy")
+    assert calls["eager"] >= 2
+    assert len(f._eager_keys) == 1
+    assert len(f._compiled) == 1

@@ -148,3 +148,39 @@ def test_predictor_names_fall_back_to_forward_signature(tmp_path):
                     input_spec=[InputSpec([2, 8], "float32")])
     predictor = inference.create_predictor(inference.Config(path))
     assert predictor.get_input_names() == ["token_embeddings"]
+
+
+def test_save_never_renames_explicit_input_names(tmp_path):
+    """A signature-derived fallback colliding with an explicit
+    InputSpec.name must yield to it — the explicit contract wins."""
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = paddle.nn.Linear(8, 4)
+
+        def forward(self, a, b):
+            return self.fc(a) + b
+
+    path = str(tmp_path / "nm")
+    paddle.jit.save(Net(), path, input_spec=[
+        InputSpec([2, 8], "float32"),              # fallback wants 'a'...
+        InputSpec([2, 4], "float32", name="a"),    # ...explicitly taken
+    ])
+    from paddle_tpu import inference
+
+    p = inference.create_predictor(inference.Config(path))
+    names = p.get_input_names()
+    assert names[1] == "a" and names[0] != "a", names
+
+
+def test_save_duplicate_explicit_names_fail_before_writing(tmp_path):
+    import os
+
+    path = str(tmp_path / "dup")
+    with pytest.raises(ValueError, match="duplicate"):
+        paddle.jit.save(_mlp(), path, input_spec=[
+            InputSpec([2, 8], "float32", name="x"),
+        InputSpec([2, 8], "float32", name="x"),
+        ])
+    assert not os.path.exists(path + ".pdmodel")  # no partial artifact

@@ -137,3 +137,21 @@ def test_non_leaf_hook_on_intermediate_activation():
     base = run(None)
     doubled = run(2.0)
     np.testing.assert_allclose(doubled, 2 * base, rtol=1e-6)
+
+
+def test_partial_source_non_partial_shard_tensor(mesh):
+    """shard_tensor (the public entry) on a partial source with a
+    non-partial target must resolve the pending sum, never lay out the
+    stacked internal representation."""
+    t = _make("p", mesh)
+    out = dist.shard_tensor(t, mesh, [Replicate()])
+    assert out.shape == [16, 8]
+    np.testing.assert_allclose(np.asarray(out._value), DATA)
+    out_s = dist.shard_tensor(_make("p", mesh), mesh, [Shard(0)])
+    assert out_s._value.addressable_shards[0].data.shape == (2, 8)
+
+
+def test_partial_entry_rejects_autograd(mesh):
+    t = paddle.to_tensor(DATA.copy(), stop_gradient=False)
+    with pytest.raises(NotImplementedError, match="autograd"):
+        dist.shard_tensor(t, mesh, [Partial()])
