@@ -147,10 +147,13 @@ def shard_tensor(data, mesh: ProcessMesh = None, placements=None,
 
     if _partial_mesh_dims(placements):
         if (stop_gradient is False
-                or (isinstance(data, Tensor) and not data.stop_gradient)):
+                or (stop_gradient is None and isinstance(data, Tensor)
+                    and not data.stop_gradient)):
+            # an explicit stop_gradient=True detaches and is fine
             raise NotImplementedError(
                 "autograd through Partial entry is not supported; reshard "
-                "to Replicate/Shard before differentiating")
+                "to Replicate/Shard before differentiating (or pass "
+                "stop_gradient=True to detach)")
         if getattr(data, "_partial_info", None) is not None:
             hint = getattr(data, "_placements_hint", None)
             if hint is not None and hint[0] == mesh \

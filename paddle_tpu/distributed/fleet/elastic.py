@@ -51,6 +51,7 @@ class ElasticManager:
         self.np_min, self.np_max = np_range or (world_size, world_size)
         self._stop = threading.Event()
         self._thread = None
+        self._join_thread = None
 
     def _key(self, rank):
         return f"{self.prefix}/host/{rank}"
@@ -67,9 +68,14 @@ class ElasticManager:
         return self
 
     def stop(self):
+        """MUST run before the backing store is closed: the beat threads
+        hold the native store client, and a set() after close is a
+        use-after-free."""
         self._stop.set()
         if self._thread:
             self._thread.join(self.interval + 1)
+        if self._join_thread:
+            self._join_thread.join(self.interval + 1)
 
     def alive_ranks(self):
         """Ranks whose heartbeat is within the lease (reference
@@ -102,11 +108,23 @@ class ElasticManager:
 
     def announce_join(self):
         """A NEW host (not in the current world) volunteers for the next
-        generation; heartbeats under a join slot (reference: host register
-        under the etcd node prefix)."""
+        generation; a daemon thread HEARTBEATS the join slot until this
+        manager stops (reference: host lease refresh under the etcd node
+        prefix) — a one-shot write would expire after ``lease`` seconds."""
         idx = self.store.add(f"{self.prefix}/joiners", 1) - 1
-        self.store.set(f"{self.prefix}/join/{idx}",
-                       str(time.time()).encode())
+        key = f"{self.prefix}/join/{idx}"
+        self.store.set(key, str(time.time()).encode())
+
+        def beat():
+            while not self._stop.is_set():
+                try:
+                    self.store.set(key, str(time.time()).encode())
+                except RuntimeError:
+                    return
+                self._stop.wait(self.interval)
+
+        self._join_thread = threading.Thread(target=beat, daemon=True)
+        self._join_thread.start()
         return idx
 
     def _alive_joiners(self):

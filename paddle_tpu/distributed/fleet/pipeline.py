@@ -466,6 +466,11 @@ def one_f_one_b_schedule(n_stages, n_micro):
     return _build_pipeline_schedule(n_stages, n_micro, split_w=False)
 
 
+import collections
+
+_StageProgs = collections.namedtuple("_StageProgs", "fwd bwd bwd_x bwd_w")
+
+
 class CrossMeshPipelineParallel(PipelineParallel):
     """1F1B pipeline with each stage's parameters on a distinct ``pp``
     sub-mesh — the true cross-stage schedule.
@@ -499,10 +504,14 @@ class CrossMeshPipelineParallel(PipelineParallel):
     """
 
     def __init__(self, layers, mesh=None, pp_axis="pp", hcg=None,
-                 strategy=None, accumulate_steps=None, shard_fn=None):
+                 strategy=None, accumulate_steps=None, shard_fn=None,
+                 schedule="1F1B"):
         super().__init__(layers, hcg=hcg, strategy=strategy,
                          accumulate_steps=accumulate_steps,
                          schedule_mode="1F1B")
+        if schedule not in ("1F1B", "ZBH1"):
+            raise ValueError("schedule must be 1F1B or ZBH1")
+        self.schedule_mode = schedule
         if not isinstance(layers, PipelineLayer):
             raise TypeError("CrossMeshPipelineParallel requires a "
                             "PipelineLayer model")
@@ -593,21 +602,52 @@ class CrossMeshPipelineParallel(PipelineParallel):
             return pull(gy)
 
         bwd_jit = jax.jit(bwd_raw)
+
+        # ZBH1 split: activation-grad only (unblocks the upstream stage
+        # immediately — the whole point of zero-bubble) and weight-grad
+        # only (fills bubble slots) — the cross-mesh analog of
+        # pipeline_zero_bubble.py's dX/dW job split. As with the host
+        # ZeroBubblePipelineParallel, W re-linearizes the stage in its
+        # bubble slot (recompute-in-bubble): the extra FLOPs occupy time
+        # the stage's devices would have idled away, and no dW residuals
+        # are held between B and W. When bubbles are scarce (deep
+        # steady-state, few micro-batches) 1F1B can be faster end-to-end.
+        def bwd_x_raw(params, buffers, x, key, labels, factor, gy):
+            def of(a):
+                out, _ = apply(params, buffers, a, key, labels, factor)
+                return out
+
+            _, pull = jax.vjp(of, x)
+            (gx,) = pull(gy)
+            return gx
+
+        def bwd_w_raw(params, buffers, x, key, labels, factor, gy):
+            def of(p):
+                out, _ = apply(p, buffers, x, key, labels, factor)
+                return out
+
+            _, pull = jax.vjp(of, params)
+            (gw,) = pull(gy)
+            return gw
+
+        bwd_x_jit = jax.jit(bwd_x_raw)
+        bwd_w_jit = jax.jit(bwd_w_raw)
         stage = self._stages[s]
 
         # set the mode at every call: (re)traces read stage.training, and a
         # retrace on new shapes must bake THIS program's mode, not whichever
         # mode ran last
-        def fwd(*a):
-            stage.train() if training else stage.eval()
-            return fwd_jit(*a)
+        def _moded(jit_fn):
+            def call(*a):
+                stage.train() if training else stage.eval()
+                return jit_fn(*a)
 
-        def bwd(*a):
-            stage.train() if training else stage.eval()
-            return bwd_jit(*a)
+            return call
 
-        self._progs[cache_key] = (fwd, bwd)
-        return fwd, bwd
+        progs = _StageProgs(_moded(fwd_jit), _moded(bwd_jit),
+                            _moded(bwd_x_jit), _moded(bwd_w_jit))
+        self._progs[cache_key] = progs
+        return progs
 
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         from ...core import random as _random
@@ -623,7 +663,9 @@ class CrossMeshPipelineParallel(PipelineParallel):
                  and getattr(scaler, "_enable", True) else 1.0)
 
         states = [s.raw_state() for s in self._stages]
-        sched = one_f_one_b_schedule(n_stages, n_micro)
+        zbh1 = self.schedule_mode == "ZBH1"
+        sched = (zero_bubble_schedule(n_stages, n_micro) if zbh1
+                 else one_f_one_b_schedule(n_stages, n_micro))
         self.last_schedule = sched
         ticks = len(sched[0])
 
@@ -631,6 +673,7 @@ class CrossMeshPipelineParallel(PipelineParallel):
         keys = [dict() for _ in range(n_stages)]
         buf_in = [dict() for _ in range(n_stages)]
         gin = [dict() for _ in range(n_stages)]      # incoming out-cotangents
+        gy_saved = [dict() for _ in range(n_stages)]  # held for ZBH1 W phase
         grad_acc = [None] * n_stages
         total_loss = None
 
@@ -649,15 +692,15 @@ class CrossMeshPipelineParallel(PipelineParallel):
                 kind, m = op
                 params, buffers = states[s]
                 last = s == n_stages - 1
-                fwd, bwd = self._stage_progs(s)
+                progs = self._stage_progs(s)
+                tgt = lv[m * mb:(m + 1) * mb] if last else None
                 if kind == "F":
                     key = jax.random.key_data(_random.next_key())
                     keys[s][m] = key
                     x = act_in[s][m]
-                    tgt = lv[m * mb:(m + 1) * mb] if last else None
                     buf_in[s][m] = buffers
-                    out, new_buffers = fwd(params, buffers, x, key, tgt,
-                                           factor)
+                    out, new_buffers = progs.fwd(params, buffers, x, key,
+                                                 tgt, factor)
                     states[s] = (params, new_buffers)
                     if last:
                         loss_m = out / scale
@@ -667,16 +710,36 @@ class CrossMeshPipelineParallel(PipelineParallel):
                     else:
                         act_in[s + 1][m] = jax.device_put(
                             out, self._activation_sharding(s + 1))
-                else:  # B: full backward (dX + dW) on this stage's sub-mesh
+                elif kind == "B" and zbh1:
+                    # activation-grad only: unblocks the upstream stage;
+                    # the weight-grad work is deferred to a bubble slot
+                    gy = jax.device_put(
+                        gin[s].pop(m), self._activation_sharding(s))
+                    gy_saved[s][m] = gy
+                    gx = progs.bwd_x(params, buf_in[s][m], act_in[s][m],
+                                     keys[s][m], tgt, factor, gy)
+                    if s > 0:
+                        gin[s - 1][m] = gx
+                elif kind == "B":  # 1F1B: full backward (dX + dW)
                     gy = jax.device_put(
                         gin[s].pop(m), self._activation_sharding(s))
                     x = act_in[s].pop(m)
                     key = keys[s].pop(m)
                     buffers_f = buf_in[s].pop(m)
-                    tgt = lv[m * mb:(m + 1) * mb] if last else None
-                    gw, gx = bwd(params, buffers_f, x, key, tgt, factor, gy)
+                    gw, gx = progs.bwd(params, buffers_f, x, key, tgt,
+                                       factor, gy)
                     if s > 0:
                         gin[s - 1][m] = gx
+                    if grad_acc[s] is None:
+                        grad_acc[s] = gw
+                    else:
+                        grad_acc[s] = jax.tree_util.tree_map(
+                            jnp.add, grad_acc[s], gw)
+                else:  # W (ZBH1): weight-grad in the bubble slot
+                    gy = gy_saved[s].pop(m)
+                    gw = progs.bwd_w(params, buf_in[s].pop(m),
+                                     act_in[s].pop(m), keys[s].pop(m), tgt,
+                                     factor, gy)
                     if grad_acc[s] is None:
                         grad_acc[s] = gw
                     else:
@@ -722,14 +785,14 @@ class CrossMeshPipelineParallel(PipelineParallel):
               else jnp.asarray(labels)) if labels is not None else None
         one = jnp.asarray(1.0, jnp.float32)
         for s in range(n_stages):
-            fwd, _ = self._stage_progs(s, training=False)
+            progs = self._stage_progs(s, training=False)
             params, buffers = self._stages[s].raw_state()
             tgt = lv if s == n_stages - 1 else None
             key = jax.random.key_data(_random.next_key())
-            x, _bufs = fwd(params, buffers,
-                           x if s == 0 else jax.device_put(
-                               x, self._activation_sharding(s)),
-                           key, tgt, one)
+            x, _bufs = progs.fwd(params, buffers,
+                                 x if s == 0 else jax.device_put(
+                                     x, self._activation_sharding(s)),
+                                 key, tgt, one)
         return Tensor._from_value(x, stop_gradient=True)
 
     def forward(self, x, *args, **kwargs):

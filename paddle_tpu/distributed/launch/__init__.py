@@ -118,6 +118,8 @@ def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
     restarts = 0
     nproc = nproc_per_node
     generation = 0
+    scale_store = store  # client connection created lazily for external masters
+    owns_scale_store = False
     try:
         while True:
             gen_env = dict(env or {})
@@ -145,7 +147,17 @@ def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
             generation += 1
             if elastic_np is not None:
                 np_min, np_max = elastic_np
-                want = _pending_scale_out(store, master)
+                if scale_store is None:
+                    from ..store import TCPStore
+
+                    try:
+                        host, port = master.rsplit(":", 1)
+                        scale_store = TCPStore(host=host, port=int(port),
+                                               is_master=False, timeout=5)
+                        owns_scale_store = True
+                    except (ValueError, RuntimeError):
+                        pass
+                want = _pending_scale_out(scale_store)
                 new_n = max(min(max(survivors, want), np_max), np_min)
                 if new_n != nproc:
                     print(f"[launch] elastic re-rendezvous: world "
@@ -158,23 +170,18 @@ def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
             print(f"[launch] worker {rank} failed (code {rc}); restart "
                   f"{restarts}/{max_restarts}", file=sys.stderr)
     finally:
+        if owns_scale_store and scale_store is not None:
+            scale_store.close()
         if store is not None:
             store.close()
 
 
-def _pending_scale_out(store, master):
+def _pending_scale_out(store):
     """Consume a pending scale-out request (0 if none). Requests are posted
-    with :func:`request_scale_out` against the job's master endpoint; with
-    an external master the controller connects as a client to read them."""
+    with :func:`request_scale_out` against the job's master endpoint (the
+    controller holds one client connection for the job's lifetime)."""
     if store is None:
-        from ..store import TCPStore
-
-        try:
-            host, port = master.rsplit(":", 1)
-            store = TCPStore(host=host, port=int(port), is_master=False,
-                             timeout=5)
-        except (ValueError, RuntimeError):
-            return 0
+        return 0
     n = store.add("launch/scale_out", 0)
     if n:
         store.add("launch/scale_out", -n)

@@ -113,8 +113,10 @@ def save(layer, path, input_spec=None, example_inputs=None, **configs):
                 if names[i] is not None:
                     continue
                 cand = sig_names[i] if i < len(sig_names) else f"x{i}"
-                if cand in taken:
-                    cand = f"{cand}_{i}"
+                base, j = cand, i
+                while cand in taken:  # suffixed names must be fresh too
+                    cand = f"{base}_{j}"
+                    j += 1
                 names[i] = cand
                 taken.add(cand)
 

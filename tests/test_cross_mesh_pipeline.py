@@ -110,6 +110,26 @@ def test_cross_mesh_matches_single_mesh(tp):
         seen |= devs
 
 
+def test_cross_mesh_zbh1_matches_1f1b():
+    """ZBH1 on disjoint sub-meshes (dX/dW split, W in bubble slots) must
+    reproduce the 1F1B cross-mesh loss trajectory exactly — gradients are
+    schedule-invariant (pipeline_zero_bubble.py ZBH1:62 semantics)."""
+    cfg = llama_tiny_config()
+    batches = _make_batches(cfg)
+    mesh = dist.ProcessMesh(np.arange(PP), ["pp"])
+
+    def run(schedule):
+        paddle.seed(0)
+        pipe = CrossMeshPipelineParallel(
+            llama_pipeline_module(cfg, num_stages=PP), mesh=mesh,
+            accumulate_steps=N_MICRO, schedule=schedule)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=pipe.parameters())
+        return _train(pipe, opt, batches)
+
+    np.testing.assert_allclose(run("ZBH1"), run("1F1B"), rtol=1e-6)
+
+
 def test_cross_mesh_eval_batch():
     cfg = llama_tiny_config()
     mesh = dist.ProcessMesh(np.arange(PP), ["pp"])

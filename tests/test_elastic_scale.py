@@ -16,6 +16,8 @@ def _mk_store():
 
 def test_scale_plan_events():
     store = _mk_store()
+    joiner = None
+    mgrs = []
     try:
         mgrs = [ElasticManager(store=store, rank=r, world_size=4,
                                heartbeat_interval=0.05, lease=0.5,
@@ -47,10 +49,12 @@ def test_scale_plan_events():
         # joiners absorbed: no further scale-out pending
         status, world = lead.scale_plan()
         assert world <= 4
-
-        for m in mgrs[:3]:
-            m.stop()
     finally:
+        # beat threads hold the native store client: stop BEFORE close
+        if joiner is not None:
+            joiner.stop()
+        for m in mgrs:
+            m.stop()
         store.close()
 
 
@@ -97,3 +101,26 @@ def test_launch_scale_in_restart(tmp_path):
     logs = "".join((tmp_path / "logs" / f"worker.{r}.log").read_text()
                    for r in range(2))
     assert "gen1 rank=0/2 ok" in logs and "gen1 rank=1/2 ok" in logs, logs
+
+
+def test_joiner_heartbeat_survives_lease(monkeypatch=None):
+    """A joiner must stay visible past the lease window (its slot is
+    heartbeat-refreshed, not written once)."""
+    store = _mk_store()
+    lead = ElasticManager(store=store, rank=0, world_size=1,
+                          heartbeat_interval=0.05, lease=0.3,
+                          np_range=(1, 3))
+    joiner = ElasticManager(store=store, rank=50, world_size=1,
+                            heartbeat_interval=0.05, lease=0.3,
+                            np_range=(1, 3))
+    try:
+        lead.start()
+        joiner.announce_join()
+        time.sleep(0.6)  # well past the lease: one-shot writes would expire
+        status, world = lead.scale_plan()
+        assert status == ElasticStatus.RESTART and world == 2, (status, world)
+    finally:
+        # beat threads hold the native store client: stop BEFORE close
+        joiner.stop()
+        lead.stop()
+        store.close()
