@@ -483,7 +483,13 @@ class CrossMeshPipelineParallel(PipelineParallel):
 
     * stage ``s`` of the :class:`PipelineLayer` becomes a standalone
       :class:`_StageModule` whose parameters are placed on sub-mesh
-      ``mesh.get_mesh_with_dim(pp_axis, s)`` — disjoint devices per stage,
+      ``mesh.get_mesh_with_dim(pp_axis, s)`` — disjoint devices per stage
+      (with ``vpp > 1``, virtual stages round-robin over the sub-meshes,
+      so each sub-mesh hosts ``vpp`` non-adjacent chunks; co-located
+      chunks serialize on their shared devices — the host table orders
+      submission, the per-sub-mesh device queue is the real schedule.
+      The bubble-OPTIMAL interleave is the compiled ``spmd_pipeline_vpp``
+      route; host-driven vpp here is the placement/parity surface),
       exactly the ``get_mesh(ipp)`` pattern of the reference's
       semi_auto_llama harness. Remaining mesh dims (mp/dp) shard within
       the stage via ``shard_fn`` (e.g. a Megatron TP plan).
@@ -505,13 +511,14 @@ class CrossMeshPipelineParallel(PipelineParallel):
 
     def __init__(self, layers, mesh=None, pp_axis="pp", hcg=None,
                  strategy=None, accumulate_steps=None, shard_fn=None,
-                 schedule="1F1B"):
+                 schedule="1F1B", vpp=1):
         super().__init__(layers, hcg=hcg, strategy=strategy,
                          accumulate_steps=accumulate_steps,
                          schedule_mode="1F1B")
         if schedule not in ("1F1B", "ZBH1"):
             raise ValueError("schedule must be 1F1B or ZBH1")
         self.schedule_mode = schedule
+        self.vpp = int(vpp)
         if not isinstance(layers, PipelineLayer):
             raise TypeError("CrossMeshPipelineParallel requires a "
                             "PipelineLayer model")
@@ -530,22 +537,26 @@ class CrossMeshPipelineParallel(PipelineParallel):
                 f"CrossMeshPipelineParallel needs a mesh with a {pp_axis!r} "
                 f"dim; got {mesh!r}")
         n_stages = layers.get_num_stages()
-        if mesh.get_dim_size(pp_axis) != n_stages:
+        n_mesh = mesh.get_dim_size(pp_axis)
+        if n_mesh * self.vpp != n_stages:
             raise ValueError(
-                f"mesh {pp_axis} size {mesh.get_dim_size(pp_axis)} != "
+                f"mesh {pp_axis} size {n_mesh} x vpp {self.vpp} != "
                 f"num_stages {n_stages}")
         self._mesh = mesh
         self._pp_axis = pp_axis
         self._stages = [
             _StageModule(layers.stage_layers(s)) for s in range(n_stages)
         ]
-        # disjoint sub-mesh per stage; a pure-pp mesh leaves zero remaining
-        # dims, so wrap the stage's devices in a 1-axis mesh
+        # sub-mesh per VIRTUAL stage: round-robin over the pp dim, so with
+        # vpp>1 each sub-mesh hosts vpp non-adjacent chunks — the
+        # interleaved-VPP placement (PipelineParallelWithInterleave:1174,
+        # chunk k of device d = virtual stage k*n + d). A pure-pp mesh
+        # leaves zero remaining dims, so wrap the devices in a 1-axis mesh.
         self._sub_meshes = []
         from ..process_mesh import ProcessMesh
 
         for s in range(n_stages):
-            sub = mesh.get_mesh_with_dim(pp_axis, s)
+            sub = mesh.get_mesh_with_dim(pp_axis, s % n_mesh)
             if sub.ndim == 0:
                 sub = ProcessMesh(
                     np.asarray(sub.mesh).reshape(1), ["_stage"])

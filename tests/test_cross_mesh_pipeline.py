@@ -130,6 +130,43 @@ def test_cross_mesh_zbh1_matches_1f1b():
     np.testing.assert_allclose(run("ZBH1"), run("1F1B"), rtol=1e-6)
 
 
+def test_cross_mesh_interleaved_vpp():
+    """vpp>1: n_mesh*vpp virtual stages round-robin over the sub-meshes
+    (interleaved placement, PipelineParallelWithInterleave:1174); losses
+    still match the single-mesh run exactly."""
+    cfg = llama_tiny_config()
+    batches = _make_batches(cfg)
+
+    paddle.seed(0)
+    ref = PipelineParallel(llama_pipeline_module(cfg, num_stages=4),
+                           accumulate_steps=N_MICRO)
+    ref_opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=ref.parameters())
+    ref_losses = _train(ref, ref_opt, batches)
+
+    mesh = dist.ProcessMesh(np.arange(2), ["pp"])
+    paddle.seed(0)
+    pipe = CrossMeshPipelineParallel(
+        llama_pipeline_module(cfg, num_stages=4), mesh=mesh,
+        accumulate_steps=N_MICRO, vpp=2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=pipe.parameters())
+    losses = _train(pipe, opt, batches)
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-5)
+
+    # interleaved placement: virtual stages 0,2 share sub-mesh 0; 1,3
+    # share sub-mesh 1; the two sub-meshes are disjoint
+    def devs(s):
+        out = set()
+        for _, p in pipe._stages[s].named_parameters():
+            for sh in p._value.addressable_shards:
+                out.add(sh.device.id)
+        return out
+
+    assert devs(0) == devs(2) and devs(1) == devs(3)
+    assert not (devs(0) & devs(1))
+
+
 def test_cross_mesh_eval_batch():
     cfg = llama_tiny_config()
     mesh = dist.ProcessMesh(np.arange(PP), ["pp"])
