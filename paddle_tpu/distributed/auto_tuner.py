@@ -17,11 +17,43 @@ import math
 
 __all__ = ["AutoTuner", "default_candidates"]
 
-# alpha-beta link model (cost/base_cost.py analog), ICI per-link
-_ICI_BW = 4.5e10      # bytes/s effective all-reduce bw per chip (v5e ICI)
-_ICI_ALPHA = 1e-6     # latency per collective
-_DEFAULT_PEAK = 197e12
+# Per-device peak-spec table — the analog of the reference's
+# cluster.py:1414 V100/A100 specs, from public TPU spec sheets:
+# (bf16 peak FLOP/s, HBM bytes, ICI effective all-reduce bytes/s per chip)
+DEVICE_SPECS = {
+    "v4":       (275e12, 32e9, 6.0e10),
+    "v5 lite":  (197e12, 16e9, 4.5e10),
+    "v5e":      (197e12, 16e9, 4.5e10),
+    "v5p":      (459e12, 95e9, 1.2e11),
+    "v6 lite":  (918e12, 32e9, 9.0e10),
+    "v6e":      (918e12, 32e9, 9.0e10),
+    "trillium": (918e12, 32e9, 9.0e10),
+    # bare "v5": libtpu reports v5p chips as device_kind "TPU v5"
+    # (v5e reports "TPU v5 lite"), so a plain v5 match means v5p
+    "v5":       (459e12, 95e9, 1.2e11),
+}
+_ICI_ALPHA = 1e-6     # latency per collective (alpha of the alpha-beta model)
 _MXU_EFF = 0.5        # achievable fraction of peak (measured ~0.55 on-chip)
+
+
+def device_spec(kind=None):
+    """(peak_flops, hbm_bytes, ici_bw) for a device kind; detects the local
+    chip when ``kind`` is None and falls back to v5e numbers for unknown
+    parts (the reference asserts V100/A100 only; a table lookup degrades
+    more gracefully)."""
+    if kind is None:
+        try:
+            import jax
+
+            kind = getattr(jax.devices()[0], "device_kind", "")
+        except Exception:
+            kind = ""
+    k = str(kind).lower()
+    for name in ("v6 lite", "v6e", "trillium", "v5 lite", "v5e", "v5p",
+                 "v5", "v4"):
+        if name in k:
+            return DEVICE_SPECS[name]
+    return DEVICE_SPECS["v5e"]
 
 
 def default_candidates(num_devices):
@@ -41,12 +73,22 @@ class AutoTuner:
         """tuner_cfg keys (reference tuner_cfg schema): ``num_devices``,
         ``model_cfg`` {hidden_size, num_layers, vocab_size, seq_length,
         global_batch_size, param_bytes=2, dtype_bytes=2}, optional
-        ``candidates`` overriding default_candidates, ``hbm_bytes``."""
+        ``candidates`` overriding default_candidates, and hardware keys:
+        ``device_kind`` (resolves peak/HBM/ICI from DEVICE_SPECS — pass it
+        explicitly; the tuner is a pure planning object and will NOT touch
+        the jax runtime) with per-value overrides ``hbm_bytes``,
+        ``peak_flops``, ``ici_bw``. Defaults to v5e specs."""
         self.cfg = tuner_cfg
         self.num_devices = int(tuner_cfg["num_devices"])
         self.model = dict(tuner_cfg.get("model_cfg", {}))
-        self.hbm = float(tuner_cfg.get("hbm_bytes", 16e9))
-        self.peak = float(tuner_cfg.get("peak_flops", _DEFAULT_PEAK))
+        kind = tuner_cfg.get("device_kind")
+        # no jax contact from the planner: detection (device_spec(None))
+        # initializes the backend and locks local chips — callers opt in
+        spec_peak, spec_hbm, spec_ici = (
+            device_spec(kind) if kind is not None else DEVICE_SPECS["v5e"])
+        self.hbm = float(tuner_cfg.get("hbm_bytes", spec_hbm))
+        self.peak = float(tuner_cfg.get("peak_flops", spec_peak))
+        self.ici_bw = float(tuner_cfg.get("ici_bw", spec_ici))
         cands = tuner_cfg.get("candidates") or default_candidates(
             self.num_devices)
         self.space = self._product(cands)
@@ -127,12 +169,12 @@ class AutoTuner:
         pbytes = m.get("param_bytes", 2)
         comm = 0.0
         if c["dp_degree"] > 1:  # grad all-reduce (or reduce-scatter+gather)
-            comm += 2 * n_local * pbytes / _ICI_BW + _ICI_ALPHA
+            comm += 2 * n_local * pbytes / self.ici_bw + _ICI_ALPHA
         if c["mp_degree"] > 1:  # per-layer activation all-reduces
             L = m.get("num_layers", 12)
             act_bytes = c["micro_batch_size"] * s * m.get("hidden_size", 1024) * 2
             n_micro = gbs // (c["dp_degree"] * c["micro_batch_size"])
-            comm += 4 * L * n_micro * (act_bytes / _ICI_BW + _ICI_ALPHA)
+            comm += 4 * L * n_micro * (act_bytes / self.ici_bw + _ICI_ALPHA)
         if c["pp_degree"] > 1:  # bubble
             n_micro = gbs // (c["dp_degree"] * c["micro_batch_size"])
             bubble = (c["pp_degree"] - 1) / max(n_micro, 1)

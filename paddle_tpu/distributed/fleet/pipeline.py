@@ -552,15 +552,17 @@ class CrossMeshPipelineParallel(PipelineParallel):
         # interleaved-VPP placement (PipelineParallelWithInterleave:1174,
         # chunk k of device d = virtual stage k*n + d). A pure-pp mesh
         # leaves zero remaining dims, so wrap the devices in a 1-axis mesh.
-        self._sub_meshes = []
         from ..process_mesh import ProcessMesh
 
-        for s in range(n_stages):
-            sub = mesh.get_mesh_with_dim(pp_axis, s % n_mesh)
+        physical = []
+        for d in range(n_mesh):
+            sub = mesh.get_mesh_with_dim(pp_axis, d)
             if sub.ndim == 0:
                 sub = ProcessMesh(
                     np.asarray(sub.mesh).reshape(1), ["_stage"])
-            self._sub_meshes.append(sub)
+            physical.append(sub)
+        # co-located chunks share ONE mesh object (and one NamedSharding)
+        self._sub_meshes = [physical[s % n_mesh] for s in range(n_stages)]
         # place every stage's parameters on its sub-mesh
         from ..api import shard_layer
 

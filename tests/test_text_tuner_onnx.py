@@ -101,3 +101,26 @@ def test_onnx_export_stablehlo_opt_in(tmp_path):
     loaded = paddle.jit.load(str(tmp_path / "m"))
     out = loaded(paddle.to_tensor(np.ones((1, 4), np.float32)))
     assert out.shape == [1, 2]
+
+
+def test_auto_tuner_device_spec_table():
+    """Per-device peak table (reference cluster.py:1414 analog): specs
+    resolve by device kind, unknown kinds degrade to v5e, and tuner_cfg
+    overrides win."""
+    from paddle_tpu.distributed.auto_tuner import AutoTuner, device_spec
+
+    assert device_spec("TPU v5p")[0] == 459e12
+    assert device_spec("TPU v6 lite")[1] == 32e9
+    assert device_spec("weird-part") == device_spec("v5e")
+
+    t = AutoTuner({"num_devices": 8, "device_kind": "v5p",
+                   "model_cfg": {"hidden_size": 256, "num_layers": 2,
+                                 "vocab_size": 1000, "seq_length": 128,
+                                 "global_batch_size": 8}})
+    assert t.peak == 459e12 and t.hbm == 95e9
+    t2 = AutoTuner({"num_devices": 8, "device_kind": "v5p",
+                    "peak_flops": 1.0e12,
+                    "model_cfg": {"hidden_size": 256, "num_layers": 2,
+                                  "vocab_size": 1000, "seq_length": 128,
+                                  "global_batch_size": 8}})
+    assert t2.peak == 1.0e12  # explicit override wins
