@@ -75,17 +75,106 @@ from ..ops.registry import _freeze  # shared cache-key freezer
 _IS_TENSOR = lambda v: isinstance(v, Tensor)  # noqa: E731
 
 
+def _loaded_global_names(code):
+    """Names the bytecode resolves via LOAD_GLOBAL, recursing into nested
+    code objects (lambdas/comprehensions/genexps) — co_names alone also
+    contains ATTRIBUTE names, which must not pull in unrelated globals."""
+    import dis
+    import types
+
+    names = set()
+    for ins in dis.get_instructions(code):
+        if ins.opname == "LOAD_GLOBAL":
+            names.add(ins.argval)
+    for const in code.co_consts:
+        if isinstance(const, types.CodeType):
+            names |= _loaded_global_names(const)
+    return names
+
+
+def _closure_layers(fn):
+    """Layers a plain function references via its closure cells or module
+    globals — the parameters the reference's dy2static still trains when a
+    decorated FUNCTION (not a Layer method) closes over a model. Resolved
+    lazily at CALL time by StaticFunction, so globals assigned or swapped
+    after decoration are seen."""
+    from ..nn import Layer
+
+    found = []
+
+    def visit(v):
+        if isinstance(v, Layer) and all(v is not f for f in found):
+            found.append(v)
+
+    for cell in getattr(fn, "__closure__", None) or ():
+        try:
+            visit(cell.cell_contents)
+        except ValueError:
+            continue
+    code = getattr(fn, "__code__", None)
+    glb = getattr(fn, "__globals__", None)
+    if code is not None and glb is not None:
+        for name in sorted(_loaded_global_names(code)):
+            visit(glb.get(name))
+    return found
+
+
 class _FunctionalModel:
     """Pure-function view of a Layer (or plain function): swap traced arrays
-    into the live Parameters, run forward, capture buffer updates, restore."""
+    into the live Parameters, run forward, capture buffer updates, restore.
+    A plain function's closure-captured Layers are tracked too (their
+    params enter as pytree inputs keyed ``{i}:{name}``), so gradients flow
+    instead of the params being baked in as constants."""
 
-    def __init__(self, layer, fn=None):
+    def __init__(self, layer, fn=None, closure_layers=()):
         self.layer = layer
         self.fn = fn
+        self.closure_layers = list(closure_layers)
+
+    def named_closure_params(self):
+        return {f"{i}:{k}": p
+                for i, lay in enumerate(self.closure_layers)
+                for k, p in lay.named_parameters()}
+
+    def named_closure_buffers(self):
+        return {f"{i}:{k}": b
+                for i, lay in enumerate(self.closure_layers)
+                for k, b in lay.named_buffers()}
+
+    def _call_fn_mode(self, params, buffers, args, kwargs, rng_key):
+        layers = self.closure_layers
+        saved = [(dict((k, p._value) for k, p in lay.named_parameters()),
+                  dict((k, b._value) for k, b in lay.named_buffers()))
+                 for lay in layers]
+        buffer_objs = self.named_closure_buffers()
+        saved_managed = _random._trace_state.managed_buffers
+        try:
+            for i, lay in enumerate(layers):
+                pre = f"{i}:"
+                lay.load_raw_state(
+                    {k[len(pre):]: v for k, v in params.items()
+                     if k.startswith(pre)},
+                    {k[len(pre):]: v for k, v in buffers.items()
+                     if k.startswith(pre)})
+            _random._trace_state.managed_buffers = saved_managed | {
+                id(b) for b in buffer_objs.values()}
+            with _traced_rng(jax.random.wrap_key_data(rng_key)):
+                out = self.fn(*_as_tensor_tree(args),
+                              **_as_tensor_tree(kwargs))
+            new_buffers = {k: b._value
+                           for k, b in self.named_closure_buffers().items()}
+            return _as_array_tree(out), new_buffers
+        finally:
+            _random._trace_state.managed_buffers = saved_managed
+            for lay, (sp, sb) in zip(layers, saved):
+                lay.load_raw_state(sp, sb)
 
     def __call__(self, params, buffers, args, kwargs, rng_key):
         layer = self.layer
         if layer is None:
+            if self.closure_layers:
+                return self._call_fn_mode(params, buffers, args, kwargs,
+                                          rng_key)
             with _traced_rng(jax.random.wrap_key_data(rng_key)):
                 out = self.fn(*_as_tensor_tree(args), **_as_tensor_tree(kwargs))
             return _as_array_tree(out), {}
@@ -200,7 +289,24 @@ class StaticFunction:
             buffers = {k: b._value for k, b in layer.named_buffers()}
             training = layer.training
         else:
-            param_objs, params, buffers, training = {}, {}, {}, False
+            # plain function: re-resolve closure-captured Layers at CALL
+            # time (globals may be assigned/swapped after decoration);
+            # their params ride as pytree inputs so optimizer updates
+            # don't recompile and gradients flow (reference: dy2static
+            # trains decorated fns)
+            self._functional.closure_layers = _closure_layers(self._fn)
+            if self._functional.closure_layers:
+                param_objs = self._functional.named_closure_params()
+                params = {k: p._value for k, p in param_objs.items()}
+                buffers = {k: b._value
+                           for k, b in
+                           self._functional.named_closure_buffers().items()}
+                # per-layer flags: different train/eval combinations must
+                # not share a compiled program
+                training = tuple(lay.training
+                                 for lay in self._functional.closure_layers)
+            else:
+                param_objs, params, buffers, training = {}, {}, {}, False
 
         flat, tree = jax.tree_util.tree_flatten((args, kwargs), is_leaf=_IS_TENSOR)
         dyn: dict[int, jax.Array] = {}
@@ -285,11 +391,17 @@ class StaticFunction:
         return jax.tree_util.tree_unflatten(out_tree, out_tensors)
 
     def _write_buffers(self, new_buffers):
-        if self._layer is not None and new_buffers:
+        if not new_buffers:
+            return
+        if self._layer is not None:
             bindex = dict(self._layer.named_buffers())
-            for k, v in new_buffers.items():
-                if k in bindex and not isinstance(v, jax.core.Tracer):
-                    bindex[k]._value = v
+        elif self._functional.closure_layers:
+            bindex = self._functional.named_closure_buffers()
+        else:
+            return
+        for k, v in new_buffers.items():
+            if k in bindex and not isinstance(v, jax.core.Tracer):
+                bindex[k]._value = v
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
