@@ -5,11 +5,12 @@ moe_layer.py:263 (``MoELayer``) and its gates (gate/naive_gate.py,
 switch_gate.py, gshard_gate.py), plus the global_scatter/global_gather
 collective ops used for expert-parallel dispatch.
 
-TPU-native dispatch: tokens→(expert, capacity) one-hot einsum (the GShard
-formulation) instead of the reference's index-based global_scatter; under a
-mesh with an ``ep`` axis the expert dim of the dispatched tensor is sharded,
-and XLA lowers the dispatch/combine einsums to the same all-to-all exchange
-the reference issues manually.
+TPU-native dispatch: index-based scatter-add into the (E*C) slot space and
+a weighted gather back (the global_scatter/global_gather shapes) — O(T*K)
+routing state, never a dense (T, E, C) combine tensor. Under a mesh with an
+``ep`` axis the expert dim of the dispatched tensor and the stacked expert
+weights shard Shard(0); XLA lowers the slot scatter/gather across the axis
+to the same all-to-all exchange the reference issues manually.
 """
 from __future__ import annotations
 
@@ -25,9 +26,17 @@ __all__ = ["MoELayer", "NaiveGate", "SwitchGate", "GShardGate"]
 
 
 def _moe_dispatch_kernel(x, gate_logits, capacity, top_k):
-    """tokens (T, D) + logits (T, E) -> dispatched (E, C, D), combine weights
-    (T, E, C), aux load-balance loss. Pure jnp; registered as an op so eager
-    calls are jit-cached and gradients flow via jax.vjp."""
+    """tokens (T, D) + logits (T, E) -> dispatched (E, C, D), routing
+    indices (K, T) into the flattened (E*C) slot space (-1 = dropped),
+    routing weights (K, T), aux load-balance loss.
+
+    Index-based formulation (the reference's global_scatter shape): the
+    dispatch is a scatter-add into E*C slots and the combine a gather —
+    O(T*K) routing state instead of the dense (T, E, C) one-hot combine
+    tensor of the GShard-einsum formulation, which at real scale
+    (T=8192, E=64, C≈1.25T/E) is memory-hostile. Pure jnp; registered as
+    an op so eager calls are jit-cached and gradients flow via jax.vjp
+    (scatter-add/gather transpose to each other)."""
     import jax
 
     T, D = x.shape
@@ -36,34 +45,45 @@ def _moe_dispatch_kernel(x, gate_logits, capacity, top_k):
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
     probs = probs.astype(x.dtype)  # (T, E)
 
-    combine_c = jnp.zeros((T, E, C), x.dtype)
     remaining = probs
-    # iterative top-k with capacity (GShard top-2 when top_k=2)
     position_in_expert = jnp.zeros((E,), jnp.int32)
-    masks = []
-    for _ in range(top_k):
+    slot_rounds = []
+    weight_rounds = []
+    first_mask = None
+    # iterative top-k with capacity (GShard top-2 when top_k=2)
+    for r in range(top_k):
         idx = jnp.argmax(remaining, axis=1)                      # (T,)
-        onehot = jnp.eye(E, dtype=jnp.int32)[idx]                # (T, E)
+        onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)         # (T, E)
         pos = jnp.cumsum(onehot, axis=0) - 1 + position_in_expert[None, :]
         pos_tok = jnp.sum(pos * onehot, axis=1)                  # (T,)
         fits = pos_tok < C
         w = jnp.sum(probs * onehot, axis=1) * fits               # (T,)
-        oh_c = jnp.eye(C, dtype=x.dtype)[jnp.clip(pos_tok, 0, C - 1)]
-        combine_c = combine_c + (w[:, None] * onehot.astype(x.dtype))[
-            :, :, None] * oh_c[:, None, :]
+        slot = jnp.where(fits, idx * C + jnp.clip(pos_tok, 0, C - 1), -1)
+        slot_rounds.append(slot.astype(jnp.int32))
+        weight_rounds.append(w)
         position_in_expert = position_in_expert + jnp.sum(
             onehot * fits[:, None], axis=0)
         remaining = remaining * (1 - onehot)
-        masks.append(onehot)
+        if first_mask is None:
+            first_mask = onehot
+
+    slots = jnp.stack(slot_rounds)        # (K, T)
+    weights = jnp.stack(weight_rounds)    # (K, T)
 
     # load-balance aux loss (GShard eq.4): E * mean(frac_tokens * frac_prob)
     me = jnp.mean(probs, axis=0)
-    ce = jnp.mean(masks[0].astype(jnp.float32), axis=0)
+    ce = jnp.mean(first_mask.astype(jnp.float32), axis=0)
     aux = jnp.sum(me * ce) * E
 
-    dispatched = jnp.einsum("tec,td->ecd",
-                            (combine_c > 0).astype(x.dtype), x)
-    return dispatched.astype(x.dtype), combine_c, aux
+    # dispatch = scatter-add into the E*C slot space; dropped tokens go to
+    # a discarded overflow row (no masking needed — the slice drops them,
+    # and its transpose gives those tokens a zero cotangent)
+    flat = jnp.zeros((E * C + 1, D), x.dtype)
+    for r in range(top_k):
+        tgt = jnp.where(slots[r] >= 0, slots[r], E * C)
+        flat = flat.at[tgt].add(x)
+    dispatched = flat[:E * C].reshape(E, C, D)
+    return dispatched, slots, weights, aux
 
 
 _registry.register_op(
@@ -99,7 +119,8 @@ class MoELayer(Layer):
 
     moe_layer.py:263 semantics: ``experts`` is a list of Layers (one per
     local expert); ``gate`` a Gate layer or config dict. Capacity factor
-    bounds tokens per expert; overflow tokens pass through (residual).
+    bounds tokens per expert; overflow tokens contribute ZERO output (add
+    a residual connection around the layer if pass-through is wanted).
     """
 
     def __init__(self, d_model, experts=None, gate=None, moe_group=None,
@@ -140,7 +161,7 @@ class MoELayer(Layer):
         logits = self.gate(xf)
         capacity = max(int(self.capacity_factor * T / self.num_experts), 1)
 
-        dispatched, combine_c, aux = _registry.apply_op(
+        dispatched, slots, weights, aux = _registry.apply_op(
             _registry.get_op("moe_dispatch"), xf, logits,
             capacity=capacity, top_k=self.top_k)
         self.aux_loss = aux
@@ -156,21 +177,31 @@ class MoELayer(Layer):
             from ...ops import stack
 
             expert_out = stack(outs, axis=0)  # (E, C, D)
-        yf = _combine(combine_c, expert_out)
+        yf = _combine(slots, weights, expert_out)
         return reshape(yf, list(orig_shape))
 
 
-def _combine_kernel(combine_c, expert_out):
-    return jnp.einsum("tec,ecd->td", combine_c, expert_out)
+def _combine_kernel(slots, weights, expert_out):
+    """Gather each token's expert outputs from its (K, T) slots and weight
+    them — the global_gather shape. Dropped tokens (slot -1) already carry
+    weight 0, so a clipped gather suffices (no zero-row concat copy)."""
+    E, C, D = expert_out.shape
+    flat = expert_out.reshape(E * C, D)
+    out = 0.0
+    for r in range(slots.shape[0]):
+        tgt = jnp.clip(slots[r], 0, E * C - 1)
+        out = out + weights[r][:, None] * flat[tgt]
+    return out
 
 
 _registry.register_op(
-    "moe_combine", _combine_kernel, inputs=("combine_c", "expert_out"))
+    "moe_combine", _combine_kernel,
+    inputs=("slots", "weights", "expert_out"))
 
 
-def _combine(combine_c, expert_out):
+def _combine(slots, weights, expert_out):
     return _registry.apply_op(
-        _registry.get_op("moe_combine"), combine_c, expert_out)
+        _registry.get_op("moe_combine"), slots, weights, expert_out)
 
 
 class StackedExpertsFFN(Layer):
