@@ -4,6 +4,7 @@ Analog of /root/reference/python/paddle/incubate/nn/.
 """
 from . import functional  # noqa: F401
 from .fused_transformer import (  # noqa: F401
+    FusedBiasDropoutResidualLayerNorm,
     FusedFeedForward,
     FusedMultiHeadAttention,
     FusedMultiTransformer,
@@ -11,4 +12,5 @@ from .fused_transformer import (  # noqa: F401
 )
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+           "FusedBiasDropoutResidualLayerNorm"]

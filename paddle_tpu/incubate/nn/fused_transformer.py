@@ -146,3 +146,42 @@ class FusedMultiTransformer(Layer):
         for layer in self.layers:
             x = layer(x, src_mask=attn_mask)
         return x
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """``layer_norm(residual + dropout(x + linear_bias))`` as a layer with
+    learnable LN scale/bias (+ optional linear bias) — the analog of the
+    reference's FusedBiasDropoutResidualLayerNorm
+    (python/paddle/incubate/nn/layer/fused_transformer.py:94), backed by
+    the fused Pallas kernel via the registry op."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        if embed_dim <= 0:
+            raise ValueError(
+                f"embed_dim must be positive, got {embed_dim}")
+        from ...nn import initializer as I
+
+        self.embed_dim = embed_dim
+        self.dropout_rate = dropout_rate
+        self._epsilon = epsilon
+        self.linear_bias = (None if bias_attr is False else
+                            self.create_parameter(
+                                (embed_dim,), attr=bias_attr, is_bias=True))
+        self.ln_scale = self.create_parameter(
+            (embed_dim,), attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            (embed_dim,), attr=bias_attr, is_bias=True)
+
+    def forward(self, x, residual):
+        from .functional import fused_bias_dropout_residual_layer_norm
+
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            dropout_rate=self.dropout_rate, ln_epsilon=self._epsilon,
+            training=self.training)
+
+    def extra_repr(self):
+        return f"embed_dim={self.embed_dim}, dropout_rate={self.dropout_rate}"

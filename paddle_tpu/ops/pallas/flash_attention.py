@@ -67,6 +67,8 @@ def flash_attention_supported(q, k, v, attn_mask=None, dropout_p=0.0):
         return False
     if d > 256:
         return False
+    if h % k.shape[2]:  # GQA: q heads must group evenly onto kv heads
+        return False
     return True
 
 
@@ -118,6 +120,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 def _fwd(q, k, v, causal, scale):
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    group = h // k.shape[1]  # GQA: q heads per kv head (1 = MHA)
     BQ = _block_for(sq)
     BK = _block_for(sk)
     grid = (b, h, sq // BQ)
@@ -129,8 +132,10 @@ def _fwd(q, k, v, causal, scale):
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, 1, BQ, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d),
+                         lambda b_, h_, i: (b_, h_ // group, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d),
+                         lambda b_, h_, i: (b_, h_ // group, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, BQ, d), lambda b_, h_, i: (b_, h_, i, 0)),
@@ -151,9 +156,17 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                      dk_ref, dv_ref, *, scale, causal, block_q, seq_q,
                      seq_k):
     ki = pl.program_id(2)
+    g = pl.program_id(3)  # position within the GQA group (0 for MHA)
     k = k_ref[0, 0, :, :].astype(jnp.float32)  # (bk, d)
     v = v_ref[0, 0, :, :].astype(jnp.float32)
     bk, d = k.shape
+
+    # the dk/dv block is revisited across the (fastest) group dim: zero it
+    # on the first group member, accumulate in place for the rest
+    @pl.when(g == 0)
+    def _init():
+        dk_ref[0, 0, :, :] = jnp.zeros((bk, d), dk_ref.dtype)
+        dv_ref[0, 0, :, :] = jnp.zeros((bk, d), dv_ref.dtype)
 
     dk0 = jnp.zeros((bk, d), jnp.float32)
     dv0 = jnp.zeros((bk, d), jnp.float32)
@@ -189,8 +202,8 @@ def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         return dk_new, dv_new
 
     dk, dv = jax.lax.fori_loop(q_start, num_q, body, (dk0, dv0))
-    dk_ref[0, 0, :, :] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0, :, :] = dv.astype(dv_ref.dtype)
+    dk_ref[0, 0, :, :] += dk.astype(dk_ref.dtype)
+    dv_ref[0, 0, :, :] += dv.astype(dv_ref.dtype)
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
@@ -237,34 +250,46 @@ def _bwd(causal, scale, res, g):
     do = g
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    kvh = k.shape[1]
+    group = h // kvh  # GQA: dk/dv accumulate over each kv head's group
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1,
                     keepdims=True)
 
     BQ = _block_for(sq)
     BK = _block_for(sk)
+    # grid: group is the FASTEST dim so the (b, kvh, i) dk/dv block is
+    # revisited on consecutive steps (init at g==0, accumulate in VMEM)
     dkdv = pl.pallas_call(
         functools.partial(_bwd_dkdv_kernel, scale=scale, causal=causal,
                           block_q=BQ, seq_q=sq, seq_k=sk),
-        grid=(b, h, sk // BK),
+        grid=(b, kvh, sk // BK, group),
         in_specs=[
-            pl.BlockSpec((1, 1, sq, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, BK, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, BK, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, sq, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, sq, 1), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, sq, d),
+                         lambda b_, j_, i, g_: (b_, j_ * group + g_, 0, 0)),
+            pl.BlockSpec((1, 1, BK, d), lambda b_, j_, i, g_: (b_, j_, i, 0)),
+            pl.BlockSpec((1, 1, BK, d), lambda b_, j_, i, g_: (b_, j_, i, 0)),
+            pl.BlockSpec((1, 1, sq, d),
+                         lambda b_, j_, i, g_: (b_, j_ * group + g_, 0, 0)),
+            pl.BlockSpec((1, 1, sq, 1),
+                         lambda b_, j_, i, g_: (b_, j_ * group + g_, 0, 0)),
+            pl.BlockSpec((1, 1, sq, 1),
+                         lambda b_, j_, i, g_: (b_, j_ * group + g_, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, BK, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, BK, d), lambda b_, h_, i: (b_, h_, i, 0)),
+            pl.BlockSpec((1, 1, BK, d), lambda b_, j_, i, g_: (b_, j_, i, 0)),
+            pl.BlockSpec((1, 1, BK, d), lambda b_, j_, i, g_: (b_, j_, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b, h, sk, d), v.dtype),
+            # f32 accumulators: the cross-group revisit adds must not
+            # round through bf16 (cast to the input dtypes after)
+            jax.ShapeDtypeStruct((b, kvh, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, kvh, sk, d), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
     dk, dv = dkdv
+    dk = dk.astype(k.dtype)
+    dv = dv.astype(v.dtype)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -272,8 +297,10 @@ def _bwd(causal, scale, res, g):
         grid=(b, h, sq // BQ),
         in_specs=[
             pl.BlockSpec((1, 1, BQ, d), lambda b_, h_, i: (b_, h_, i, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda b_, h_, i: (b_, h_, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d),
+                         lambda b_, h_, i: (b_, h_ // group, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d),
+                         lambda b_, h_, i: (b_, h_ // group, 0, 0)),
             pl.BlockSpec((1, 1, BQ, d), lambda b_, h_, i: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, BQ, 1), lambda b_, h_, i: (b_, h_, i, 0)),
             pl.BlockSpec((1, 1, BQ, 1), lambda b_, h_, i: (b_, h_, i, 0)),
@@ -302,17 +329,15 @@ _flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
 
 
 def flash_attention(q, k, v, is_causal=False):
-    """(B, S, H, D) flash attention. GQA: kv heads are repeated to the query
-    head count before the kernel (head-repeat is memory-light relative to
-    the O(S^2) work the kernel saves)."""
+    """(B, S, H, D) flash attention. GQA-native: kv heads are NOT
+    materialized to the query head count — the kernel index maps fold each
+    query head onto its kv head (``h // group``), and the dk/dv pass
+    accumulates over the group in VMEM, so KV memory/bandwidth stays at
+    the grouped size."""
     b, sq, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)
     vh = jnp.swapaxes(v, 1, 2)
-    if kh.shape[1] != h:
-        rep = h // kh.shape[1]
-        kh = jnp.repeat(kh, rep, axis=1)
-        vh = jnp.repeat(vh, rep, axis=1)
     out = _flash_bhsd(qh, kh, vh, bool(is_causal), scale)
     return jnp.swapaxes(out, 1, 2)

@@ -114,3 +114,25 @@ def test_incubate_functional_surface():
     (y ** 2).mean().backward()
     assert x._grad is not None
     assert np.isfinite(np.asarray(x._grad._value)).all()
+
+
+def test_fused_bias_dropout_residual_ln_layer():
+    from paddle_tpu.incubate.nn import FusedBiasDropoutResidualLayerNorm
+
+    paddle.seed(0)
+    lay = FusedBiasDropoutResidualLayerNorm(64, dropout_rate=0.1)
+    x = paddle.to_tensor(rng.rand(2, 8, 64).astype(np.float32),
+                         stop_gradient=False)
+    res = paddle.to_tensor(rng.rand(2, 8, 64).astype(np.float32))
+    (lay(x, res) ** 2).mean().backward()
+    for t in (x._grad, lay.ln_scale._grad, lay.ln_bias._grad,
+              lay.linear_bias._grad):
+        assert t is not None and np.isfinite(np.asarray(t._value)).all()
+    lay.eval()
+    z = x._value + lay.linear_bias._value + res._value
+    m = z.mean(-1, keepdims=True)
+    v = ((z - m) ** 2).mean(-1, keepdims=True)
+    ref = (z - m) / jnp.sqrt(v + 1e-5) * lay.ln_scale._value \
+        + lay.ln_bias._value
+    np.testing.assert_allclose(np.asarray(lay(x, res)._value),
+                               np.asarray(ref), rtol=2e-5, atol=2e-5)
