@@ -144,5 +144,6 @@ from .ring_attention import RingAttention, ring_attention  # noqa: F401
 __all__ += ["ring_attention", "RingAttention"]
 
 from .elastic import CommTaskManager, ElasticManager, ElasticStatus, watch  # noqa: F401
+from . import utils  # noqa: F401
 
 __all__ += ["ElasticManager", "ElasticStatus", "CommTaskManager", "watch"]

@@ -1,0 +1,167 @@
+"""fleet.utils — training-loop helpers.
+
+Analogs of /root/reference/python/paddle/distributed/fleet/utils/:
+
+* ``timer_helper`` (get_timers/_Timer: named phase timers with
+  elapsed/reset, used by hybrid-parallel training loops for throughput
+  accounting). Device work is async under jax, so ``stop`` synchronizes
+  on an optional array to time real execution, not dispatch.
+* ``mix_precision_utils`` (MixPrecisionLayer/MixPrecisionOptimizer:
+  master-grad wrappers) — thin over ``paddle.amp.decorate`` + the
+  multi_precision optimizer path, which already keep fp32 masters.
+* ``hybrid_parallel_util`` broadcast helpers — single-controller: a
+  replicated ``device_put`` over the group's mesh IS the broadcast
+  (the transfer engine moves the bytes; under multi-controller the same
+  call rides the DCN collective runtime).
+
+The reference's ``tensor_fusion_helper`` (FusedCommBuffer: bucketing
+grads into flat buffers for fused NCCL calls) is absorbed: XLA fuses and
+schedules in-program collectives itself, and eager DP gradients are
+full-tensor psums — there is no manual bucketing surface to expose.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["get_timers", "set_timers", "mix_precision_utils",
+           "broadcast_dp_parameters", "broadcast_mp_parameters",
+           "broadcast_sharding_parameters", "fused_allreduce_gradients"]
+
+
+class _Timer:
+    def __init__(self, name):
+        self.name = name
+        self._elapsed = 0.0
+        self._started = None
+
+    def start(self):
+        if self._started is not None:
+            raise RuntimeError(f"timer {self.name!r} already started")
+        self._started = time.time()
+
+    def stop(self, sync_on=None):
+        if self._started is None:
+            raise RuntimeError(f"timer {self.name!r} not started")
+        if sync_on is not None:  # async dispatch: wait for real work
+            v = getattr(sync_on, "_value", sync_on)
+            try:
+                v.block_until_ready()
+            except AttributeError:
+                pass
+        self._elapsed += time.time() - self._started
+        self._started = None
+
+    def elapsed(self, reset=True):
+        out = self._elapsed
+        if self._started is not None:
+            out += time.time() - self._started
+        if reset:
+            self._elapsed = 0.0
+        return out
+
+    def reset(self):
+        self._elapsed = 0.0
+        self._started = None
+
+
+class _Timers:
+    def __init__(self):
+        self._timers = {}
+
+    def __call__(self, name):
+        if name not in self._timers:
+            self._timers[name] = _Timer(name)
+        return self._timers[name]
+
+    def log(self, names=None, normalizer=1.0):
+        names = names or list(self._timers)
+        parts = [f"{n}: {self._timers[n].elapsed(reset=False)/normalizer:.4f}s"
+                 for n in names if n in self._timers]
+        return " | ".join(parts)
+
+
+_GLOBAL_TIMERS = None
+
+
+def get_timers():
+    global _GLOBAL_TIMERS
+    if _GLOBAL_TIMERS is None:
+        _GLOBAL_TIMERS = _Timers()
+    return _GLOBAL_TIMERS
+
+
+def set_timers(timers):
+    global _GLOBAL_TIMERS
+    _GLOBAL_TIMERS = timers
+
+
+class mix_precision_utils:
+    """Namespace parity with fleet.utils.mix_precision_utils."""
+
+    @staticmethod
+    def MixPrecisionLayer(layer, dtype="bfloat16"):
+        from ... import amp
+
+        model, _ = amp.decorate(layer, None, level="O2", dtype=dtype)
+        return model
+
+    @staticmethod
+    def MixPrecisionOptimizer(optimizer):
+        optimizer._multi_precision = True
+        return optimizer
+
+
+def _ensure_on_mesh(layer_or_params, group):
+    """Single-controller broadcast semantics: one logical value exists, so
+    consistency is automatic; the helper's real job is placing parameters
+    onto the group's mesh (replicated) when they are still single-device.
+    Params already laid out on the mesh (e.g. TP-sharded) are untouched."""
+    from ..api import shard_tensor
+    from ..placement import Replicate
+
+    mesh = group.mesh
+    if mesh is None:
+        return
+    mesh_devs = set(int(i) for i in mesh.process_ids)
+    params = (layer_or_params.parameters()
+              if hasattr(layer_or_params, "parameters")
+              else list(layer_or_params))
+    for p in params:
+        try:
+            devs = set(d.id for d in p._value.sharding.device_set)
+        except AttributeError:
+            devs = set()
+        if devs != mesh_devs:
+            shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
+
+
+def broadcast_dp_parameters(model, hcg):
+    _ensure_on_mesh(model, hcg.get_data_parallel_group())
+
+
+def broadcast_mp_parameters(model, hcg):
+    _ensure_on_mesh(model, hcg.get_model_parallel_group())
+
+
+def broadcast_sharding_parameters(model, hcg):
+    _ensure_on_mesh(model, hcg.get_sharding_parallel_group())
+
+
+def fused_allreduce_gradients(parameter_list, hcg=None):
+    """Average each parameter's grad across the dp group (eager DP sync —
+    reference hybrid_parallel_util.fused_allreduce_gradients). Under the
+    single-controller mesh gradients of replicated params are already
+    globally-reduced by GSPMD; this helper exists for hand-rolled loops
+    that keep per-replica grads (e.g. after no_sync windows): it reshards
+    each grad to Replicate over the mesh, which IS the mean for identical
+    replicas and an all-reduce placement-wise otherwise."""
+    from ..api import shard_tensor
+    from ..placement import Replicate
+    from ..process_mesh import get_mesh
+
+    mesh = get_mesh()
+    if mesh is None:
+        return
+    for p in parameter_list:
+        if getattr(p, "_grad", None) is not None:
+            shard_tensor(p._grad, mesh, [Replicate()] * mesh.ndim)

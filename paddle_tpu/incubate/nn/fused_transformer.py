@@ -23,7 +23,8 @@ from ...nn.layers_norm import LayerNorm
 from ...ops import concat, reshape, scaled_dot_product_attention
 
 __all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer", "FusedMultiTransformer"]
+           "FusedTransformerEncoderLayer", "FusedMultiTransformer",
+           "FusedBiasDropoutResidualLayerNorm"]
 
 
 class FusedMultiHeadAttention(Layer):
@@ -161,14 +162,11 @@ class FusedBiasDropoutResidualLayerNorm(Layer):
         if embed_dim <= 0:
             raise ValueError(
                 f"embed_dim must be positive, got {embed_dim}")
-        from ...nn import initializer as I
-
         self.embed_dim = embed_dim
         self.dropout_rate = dropout_rate
         self._epsilon = epsilon
-        self.linear_bias = (None if bias_attr is False else
-                            self.create_parameter(
-                                (embed_dim,), attr=bias_attr, is_bias=True))
+        self.linear_bias = self.create_parameter(
+            (embed_dim,), attr=bias_attr, is_bias=True)
         self.ln_scale = self.create_parameter(
             (embed_dim,), attr=weight_attr,
             default_initializer=I.Constant(1.0))

@@ -280,16 +280,21 @@ def _bwd(causal, scale, res, g):
             pl.BlockSpec((1, 1, BK, d), lambda b_, j_, i, g_: (b_, j_, i, 0)),
         ],
         out_shape=[
-            # f32 accumulators: the cross-group revisit adds must not
-            # round through bf16 (cast to the input dtypes after)
-            jax.ShapeDtypeStruct((b, kvh, sk, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, kvh, sk, d), jnp.float32),
+            # GQA (group>1): f32 accumulators so the cross-group revisit
+            # adds never round through bf16; MHA keeps the input dtype
+            # (no revisits, no extra HBM footprint or cast kernels)
+            jax.ShapeDtypeStruct((b, kvh, sk, d),
+                                 jnp.float32 if group > 1 else k.dtype),
+            jax.ShapeDtypeStruct((b, kvh, sk, d),
+                                 jnp.float32 if group > 1 else v.dtype),
         ],
         interpret=_interpret(),
     )(q, k, v, do, lse, delta)
     dk, dv = dkdv
-    dk = dk.astype(k.dtype)
-    dv = dv.astype(v.dtype)
+    if dk.dtype != k.dtype:
+        dk = dk.astype(k.dtype)
+    if dv.dtype != v.dtype:
+        dv = dv.astype(v.dtype)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,

@@ -644,3 +644,31 @@ def test_moe_index_dispatch_matches_dense_reference():
             np.testing.assert_allclose(float(weights[r, t]), w, rtol=1e-5)
     # routing state is O(T*K), not O(T*E*C)
     assert slots.shape == (K, T) and weights.shape == (K, T)
+
+
+def test_fleet_utils_timers_and_broadcast():
+    from paddle_tpu.distributed.fleet import HybridCommunicateGroup
+    from paddle_tpu.distributed.fleet.utils import (
+        broadcast_dp_parameters, fused_allreduce_gradients, get_timers)
+
+    timers = get_timers()
+    t = timers("step")
+    t.start()
+    x = paddle.to_tensor(np.random.rand(64, 64).astype(np.float32))
+    y = x @ x
+    t.stop(sync_on=y)
+    assert timers("step").elapsed() > 0.0
+    assert "step" in timers.log(["step"]) or timers.log() == ""
+
+    hcg = HybridCommunicateGroup(dp_degree=4, mp_degree=2)
+    model = nn.Linear(8, 8)
+    broadcast_dp_parameters(model, hcg)
+    g = hcg.get_data_parallel_group()
+    assert len(model.weight._value.addressable_shards) == len(
+        g.mesh.process_ids)
+
+    dist.set_mesh(dist.ProcessMesh(np.arange(8), ["dp"]))
+    (model(paddle.to_tensor(np.random.rand(4, 8).astype(np.float32)))
+     ** 2).mean().backward()
+    fused_allreduce_gradients(list(model.parameters()))
+    assert model.weight._grad._value.sharding.is_fully_replicated
