@@ -170,11 +170,15 @@ log("compiling multi-step TrainStep program...")
 warm = np.asarray(step.run(ids, steps=STEPS)._value)
 log(f"compiled; warmup losses {warm[0]:.3f} -> {warm[-1]:.3f}")
 
-log(f"timing {STEPS} steps (one TrainStep.run dispatch)...")
-t = time.time()
-losses = step.run(ids, steps=STEPS)
-loss = float(np.asarray(losses._value)[-1])  # value fetch = the only sync
-dt = max(time.time() - t - RTT, 1e-9) / STEPS
+log(f"timing {STEPS} steps (one TrainStep.run dispatch), median of 3...")
+tr_samples = []
+loss = None
+for rep in range(1 if SMOKE else 3):
+    t = time.time()
+    losses = step.run(ids, steps=STEPS)
+    loss = float(np.asarray(losses._value)[-1])  # value fetch = the only sync
+    tr_samples.append(max(time.time() - t - RTT, 1e-9) / STEPS)
+dt = sorted(tr_samples)[len(tr_samples) // 2]
 tokens_per_sec = BATCH * SEQ / dt
 
 # PaLM-style MFU: 6N matmul flops/token + attention 12*L*h*s
@@ -192,7 +196,7 @@ import paddle_tpu.nn as _nn  # noqa: E402
 if SMOKE:
     RN_BATCH, RN_STEPS = 8, 2
 else:
-    RN_BATCH, RN_STEPS = 256, 100  # small model: enough steps to clear the sync RTT
+    RN_BATCH, RN_STEPS = 256, 400  # small model: enough steps that true work (~0.4s) dwarfs the sync RTT
 log(f"resnet18 bench: batch={RN_BATCH} @3x32x32...")
 paddle.seed(0)
 rn = _vmodels.resnet18(num_classes=10)
@@ -205,10 +209,13 @@ rn_step = paddle.jit.TrainStep(rn, lambda out: rn_crit(out, rn_y), rn_opt)
 
 sync_fetch(rn_step.run(rn_x, steps=RN_STEPS)._value)
 RTT = measure_rtt()  # re-measure at steady state for the small-model timing
-t = time.time()
-rn_losses = rn_step.run(rn_x, steps=RN_STEPS)
-sync_fetch(rn_losses._value)
-rn_dt = max(time.time() - t - RTT, 1e-9) / RN_STEPS
+rn_samples = []
+for rep in range(1 if SMOKE else 3):
+    t = time.time()
+    rn_losses = rn_step.run(rn_x, steps=RN_STEPS)
+    sync_fetch(rn_losses._value)
+    rn_samples.append(max(time.time() - t - RTT, 1e-9) / RN_STEPS)
+rn_dt = sorted(rn_samples)[len(rn_samples) // 2]
 resnet_img_s = RN_BATCH / rn_dt
 log(f"resnet18: {rn_dt*1e3:.1f}ms/step {resnet_img_s:,.0f} img/s")
 
@@ -216,9 +223,20 @@ log(f"resnet18: {rn_dt*1e3:.1f}ms/step {resnet_img_s:,.0f} img/s")
 # Serving-path kernel throughput: Pallas paged_attention at batch 8 over a
 # 4K-token paged KV cache (the block_multi_head_attention analog). The
 # kernel is scanned device-side over DEC_STEPS fresh queries so the number
-# is cache-bandwidth throughput, not tunnel dispatch latency. (Full-model
-# decode drives one program per step; per-op dispatch costs are the eager
-# path's, measured separately in BASELINE.md.)
+# is cache-bandwidth throughput, not tunnel dispatch latency.
+#
+# Methodology (round-4 hardening, after the r3 capture proved unrepeatable):
+#   1. In-run CALIBRATION: a plain-XLA streaming reduction over the SAME
+#      page arrays, 3 reps, median -> the environment's streaming floor.
+#   2. The decode program is AOT-compiled ONCE (lower().compile()); timed
+#      calls invoke the compiled executable, so recompilation between warm
+#      and timed runs is structurally impossible.
+#   3. TWO warm executions with fresh inputs (the first real execution on
+#      this tunnel absorbs deferred work a value-fetch doesn't sync), then
+#      >=5 timed reps with fresh inputs; the MEDIAN is reported, min/max
+#      recorded for transparency.
+#   4. Residency check: page buffers are committed device arrays before
+#      any timed run.
 from paddle_tpu.ops.pallas.decode_attention import paged_attention  # noqa: E402
 
 if SMOKE:
@@ -234,10 +252,35 @@ v_pages = jax.random.normal(key, (npages, PAGE, DKVH, DD), jnp.bfloat16)
 tables = jnp.asarray(
     np.random.permutation(npages).reshape(DB, pages_per_seq), jnp.int32)
 dlens = jnp.full((DB,), DKV, jnp.int32)
+cache_bytes = 2 * DB * DKV * DKVH * DD * 2  # bf16, read once per step
 
-
+# (d.1) calibration: what does a plain XLA streaming read of the same
+# bytes cost in this process right now?
 @jax.jit
-def decode_scan(qs, k_pages, v_pages):
+def stream_reduce(k, v, s):
+    return (k.astype(jnp.float32) * s).sum() + (v.astype(jnp.float32) * s).sum()
+
+sync_fetch(stream_reduce(k_pages, v_pages, 1.0))
+floor_samples = []
+for rep in range(3):
+    t = time.time()
+    sync_fetch(stream_reduce(k_pages, v_pages, 2.0 + rep))
+    floor_samples.append(max(time.time() - t - RTT, 1e-9))
+floor_dt = sorted(floor_samples)[len(floor_samples) // 2]
+floor_gbs = cache_bytes / floor_dt / 1e9
+log(f"streaming-read calibration: {floor_dt*1e3:.1f}ms for "
+    f"{cache_bytes/1e6:.0f}MB -> floor {floor_gbs:.1f} GB/s "
+    f"(equiv decode floor {DB*floor_gbs*1e9/cache_bytes:,.0f} tok/s)")
+
+# (d.2) residency: pages must be committed device arrays before timing
+for name, arr in (("k_pages", k_pages), ("v_pages", v_pages),
+                  ("tables", tables)):
+    devs = getattr(arr, "devices", lambda: set())()
+    assert devs and all(d.platform == platform for d in devs), \
+        f"{name} not device-resident: {devs}"
+
+
+def decode_scan_fn(qs, k_pages, v_pages):
     # cache rides as arguments: closure-captured arrays are baked into the
     # executable as constants (and this setup's remote-compile rejects
     # >100MB programs outright)
@@ -250,20 +293,54 @@ def decode_scan(qs, k_pages, v_pages):
 
 
 qs = jax.random.normal(key, (DEC_STEPS, DB, DH, DD), jnp.bfloat16)
-sync_fetch(decode_scan(qs, k_pages, v_pages))  # compile + warm
-t = time.time()
-sync_fetch(decode_scan(qs + 0.01, k_pages, v_pages))
-dec_dt = max(time.time() - t - RTT, 1e-9) / DEC_STEPS
+# AOT: one executable, reused for every warm + timed call -> no recompile
+decode_exec = jax.jit(decode_scan_fn).lower(qs, k_pages, v_pages).compile()
+sync_fetch(decode_exec(qs, k_pages, v_pages))          # warm 1
+sync_fetch(decode_exec(qs + 0.5, k_pages, v_pages))    # warm 2 (fresh input)
+dec_samples = []
+for rep in range(2 if SMOKE else 5):
+    t = time.time()
+    sync_fetch(decode_exec(qs + 0.01 * (rep + 1), k_pages, v_pages))
+    dec_samples.append(max(time.time() - t - RTT, 1e-9) / DEC_STEPS)
+dec_sorted = sorted(dec_samples)
+dec_dt = dec_sorted[len(dec_sorted) // 2]  # median
 decode_tok_s = DB / dec_dt
-# bytes touched per decode step: full K+V cache read once. NOTE: on this
-# virtualized chip, streaming HBM reads measure ~7-15 GB/s even for plain
-# XLA reductions (the MXU-reuse-bound training path is unaffected), so
-# the decode number is an environment floor, not the kernel ceiling.
-cache_bytes = 2 * DB * DKV * DKVH * DD * 2  # bf16
 dec_gbs = cache_bytes / dec_dt / 1e9
-log(f"paged decode attention: {dec_dt*1e6:.0f}us/step  "
+log(f"paged decode attention: median {dec_dt*1e6:.0f}us/step "
+    f"(min {dec_sorted[0]*1e6:.0f} max {dec_sorted[-1]*1e6:.0f})  "
     f"{decode_tok_s:,.0f} tok/s (batch {DB}, KV {DKV})  "
-    f"cache read {dec_gbs:.0f} GB/s")
+    f"cache read {dec_gbs:.1f} GB/s  vs floor {dec_gbs/floor_gbs:.2f}x")
+
+# ------------------------------------------------------- (e) model decode
+# Whole-model serving throughput: generate() with the compiled decode loop
+# (prefill program + ONE scanned decode program over donated paged KV
+# caches — the fused_multi_transformer decode-loop analog) on the same
+# 438M LLaMA, batch 8. Median of 3 timed calls with fresh prompts.
+from paddle_tpu.models.generation import generate as _generate  # noqa: E402
+
+if SMOKE:
+    GB, GS, GNEW = 2, 8, 8
+else:
+    GB, GS, GNEW = 8, 16, 64
+log(f"model decode bench: batch={GB} prompt={GS} new={GNEW} (paged cache)...")
+model.eval()
+prompt = paddle.to_tensor(
+    np.random.randint(0, cfg.vocab_size, (GB, GS)).astype(np.int32))
+t = time.time()
+_generate(model, prompt, max_new_tokens=GNEW, cache="paged")
+log(f"decode programs compiled+warm in {time.time()-t:.1f}s")
+gen_samples = []
+for rep in range(1 if SMOKE else 3):
+    fresh = paddle.to_tensor(np.random.randint(
+        0, cfg.vocab_size, (GB, GS)).astype(np.int32))
+    t = time.time()
+    out = _generate(model, fresh, max_new_tokens=GNEW, cache="paged")
+    np.asarray(out._value)  # host fetch = sync
+    gen_samples.append(max(time.time() - t - RTT, 1e-9))
+gen_dt = sorted(gen_samples)[len(gen_samples) // 2]
+model_decode_tok_s = GB * GNEW / gen_dt
+log(f"model decode: {gen_dt*1e3:.0f}ms for {GNEW} tokens x batch {GB} -> "
+    f"{model_decode_tok_s:,.0f} tok/s ({gen_dt/GNEW*1e3:.1f}ms/token-step)")
 
 result = {
     "metric": "llama_train_mfu",
@@ -279,6 +356,13 @@ result = {
     "resnet18_img_per_sec": round(resnet_img_s, 1),
     "decode_tokens_per_sec": round(decode_tok_s, 1),
     "decode_cache_read_gb_s": round(dec_gbs, 1),
+    "decode_us_per_step_min_med_max": [
+        round(dec_sorted[0] * 1e6), round(dec_dt * 1e6),
+        round(dec_sorted[-1] * 1e6)],
+    "streaming_floor_gb_s": round(floor_gbs, 1),
+    "decode_vs_streaming_floor": round(dec_gbs / floor_gbs, 2),
+    "model_decode_tokens_per_sec": round(model_decode_tok_s, 1),
+    "model_decode_ms_per_token_step": round(gen_dt / GNEW * 1e3, 2),
     "n_params_m": round(n_params / 1e6, 1),
     "device": kind,
     "platform": platform,

@@ -3,8 +3,13 @@
 Analog of the reference's generation path (the fused_multi_transformer /
 masked_multihead_attention decode kernels,
 paddle/phi/kernels/fusion/gpu/fused_multi_transformer_op.cu, plus
-PaddleNLP's generate loop). TPU-natively: prefill is one compiled forward;
-each decode step re-uses the KV cache; sampling is stateless-PRNG.
+PaddleNLP's generate loop). TPU-natively: prefill is ONE compiled program
+and the whole decode loop is a SECOND compiled program — model forward
+over donated KV-cache buffers plus sampling, scanned over the new tokens
+inside one executable (the decoder-inference-loop-in-one-program shape of
+fused_multi_transformer_op.cu), so serving pays one dispatch per generate
+call instead of hundreds per token. ``use_jit=False`` keeps the per-token
+eager loop (each op served from the cached-executable dispatch).
 """
 from __future__ import annotations
 
@@ -18,7 +23,9 @@ from ..core.tensor import Tensor
 __all__ = ["generate"]
 
 
-def _sample(logits, temperature, top_k, top_p, greedy):
+def _sample_with_key(logits, key, temperature, top_k, top_p, greedy):
+    """Pure sampling rule — traceable; ``key`` is a PRNG key (ignored when
+    greedy)."""
     if greedy:
         return jnp.argmax(logits, axis=-1)
     logits = logits / max(temperature, 1e-5)
@@ -32,13 +39,146 @@ def _sample(logits, temperature, top_k, top_p, greedy):
         cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
         cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
         logits = jnp.where(logits < cutoff, -1e30, logits)
-    key = _random.next_key()
     return jax.random.categorical(key, logits, axis=-1)
+
+
+def _sample(logits, temperature, top_k, top_p, greedy):
+    key = None if greedy else _random.next_key()
+    return _sample_with_key(logits, key, temperature, top_k, top_p, greedy)
+
+
+def _make_static_cache(k, v, length):
+    from .llama import StaticCache
+
+    c = StaticCache.__new__(StaticCache)
+    c.k, c.v, c.length = k, v, length
+    return c
+
+
+def _make_paged_cache(kp, vp, tables, page_size, length):
+    from .llama import PagedKVCache
+
+    c = PagedKVCache.__new__(PagedKVCache)
+    c.k_pages, c.v_pages, c.tables = kp, vp, tables
+    c.page_size, c.length = page_size, length
+    return c
+
+
+def _generate_jit(model, ids, max_new_tokens, do_sample, temperature,
+                  top_k, top_p, eos_token_id, paged, empty):
+    """Compiled serving path: prefill program + ONE scanned decode program
+    with donated cache buffers. Token/RNG semantics match the eager loop
+    (same host-stream key per sampled token), except that generation never
+    stops early — finished rows are eos-padded to the full length."""
+    from ..jit import _FunctionalModel
+
+    b, s = ids.shape
+    n_layers = len(empty)
+    functional = _FunctionalModel(model)
+    params = {k: p._value for k, p in model.named_parameters()}
+    buffers = {k: bu._value for k, bu in model.named_buffers()}
+    zero_key = jax.random.key_data(jax.random.PRNGKey(0))
+    if paged:
+        tables = empty[0].tables
+        page_size = empty[0].page_size
+
+        # tables ride as a PROGRAM OPERAND (never a closure constant): the
+        # cached programs must serve any batch/prompt shape, keyed by jit's
+        # own shape specialization
+        def rebuild(ks, vs, length, tbl):
+            return [_make_paged_cache(ks[i], vs[i], tbl, page_size, length)
+                    for i in range(n_layers)]
+
+        cache_ks = [c.k_pages for c in empty]
+        cache_vs = [c.v_pages for c in empty]
+    else:
+        tables = None
+        page_size = None
+
+        def rebuild(ks, vs, length, tbl):
+            return [_make_static_cache(ks[i], vs[i], length)
+                    for i in range(n_layers)]
+
+        cache_ks = [c.k for c in empty]
+        cache_vs = [c.v for c in empty]
+
+    # programs cached on the model instance; jax.jit specializes by shape.
+    # Everything ELSE baked into the trace must be in this key.
+    progs = model.__dict__.setdefault("_generation_programs", {})
+    prog_key = (paged, page_size, do_sample, temperature, top_k, top_p,
+                eos_token_id)
+    if prog_key not in progs:
+
+        def prefill(params, buffers, ids, ks, vs, tbl):
+            caches = rebuild(ks, vs, 0, tbl)
+            (logits, caches2), _ = functional(
+                params, buffers, (ids,), {"caches": caches}, zero_key)
+            if paged:
+                return (logits[:, -1, :], [c.k_pages for c in caches2],
+                        [c.v_pages for c in caches2])
+            return (logits[:, -1, :], [c.k for c in caches2],
+                    [c.v for c in caches2])
+
+        def decode(params, buffers, ks, vs, tbl, length0, tok0, fin0, keys):
+            def body(carry, key_i):
+                tok, ks, vs, length, fin = carry
+                caches = rebuild(ks, vs, length, tbl)
+                (logits, caches2), _ = functional(
+                    params, buffers, (tok[:, None],), {"caches": caches},
+                    zero_key)
+                nxt = _sample_with_key(
+                    logits[:, -1, :], jax.random.wrap_key_data(key_i),
+                    temperature, top_k, top_p, not do_sample)
+                nxt = nxt.astype(tok.dtype)
+                if eos_token_id is not None:
+                    nxt = jnp.where(fin, eos_token_id, nxt)
+                    fin = fin | (nxt == eos_token_id)
+                if paged:
+                    new_ks = [c.k_pages for c in caches2]
+                    new_vs = [c.v_pages for c in caches2]
+                else:
+                    new_ks = [c.k for c in caches2]
+                    new_vs = [c.v for c in caches2]
+                return (nxt, new_ks, new_vs, caches2[0].length, fin), nxt
+
+            (tok, ks, vs, length, fin), toks = jax.lax.scan(
+                body, (tok0, ks, vs, length0, fin0), keys)
+            # final cache buffers ride out so the donated inputs alias the
+            # outputs (and a caller could continue decoding from them)
+            return toks, ks, vs  # toks: (steps, B)
+
+        progs[prog_key] = (jax.jit(prefill),
+                           jax.jit(decode, donate_argnums=(2, 3)))
+    prefill_p, decode_p = progs[prog_key]
+
+    last_logits, cache_ks, cache_vs = prefill_p(
+        params, buffers, ids, cache_ks, cache_vs, tables)
+    # token 0 sampled host-side from the prefill logits — consumes the host
+    # RNG stream exactly like the eager loop's first _sample
+    tok0 = _sample(last_logits, temperature, top_k, top_p, not do_sample)
+    tok0 = tok0.astype(ids.dtype)
+    fin0 = jnp.zeros((b,), bool)
+    if eos_token_id is not None:
+        fin0 = fin0 | (tok0 == eos_token_id)
+    steps = max_new_tokens - 1
+    if steps > 0:
+        if do_sample:
+            keys = jnp.stack([jax.random.key_data(_random.next_key())
+                              for _ in range(steps)])
+        else:
+            keys = jnp.zeros((steps,) + zero_key.shape, zero_key.dtype)
+        toks, cache_ks, cache_vs = decode_p(
+            params, buffers, cache_ks, cache_vs, tables,
+            jnp.asarray(s, jnp.int32), tok0, fin0, keys)
+        out = jnp.concatenate([ids, tok0[:, None], toks.T], axis=1)
+    else:
+        out = jnp.concatenate([ids, tok0[:, None]], axis=1)
+    return Tensor._from_value(out)
 
 
 def generate(model, input_ids, max_new_tokens=20, do_sample=False,
              temperature=1.0, top_k=None, top_p=None, eos_token_id=None,
-             cache="static"):
+             cache="static", use_jit=True):
     """Decode ``max_new_tokens`` continuations of ``input_ids`` (B, S).
 
     The model must support ``forward(ids, attn_mask=None, caches=...)``
@@ -47,6 +187,12 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     (masked_multihead_attention semantics); "paged" = block-table paged
     pool served by the Pallas paged_attention kernel
     (block_multi_head_attention semantics). Returns (B, S + new) ids.
+
+    ``use_jit=True`` (default) compiles prefill + the whole decode loop
+    into two XLA programs (fused_multi_transformer decode-loop semantics);
+    with an ``eos_token_id`` the output is always eos-padded to the full
+    ``S + max_new_tokens`` width. ``use_jit=False`` decodes token-by-token
+    eagerly and stops early once every row has finished.
     """
     ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
     b, s = ids.shape
@@ -73,6 +219,16 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
         empty = [StaticCache(b, max_len, kv_heads, cfg.head_dim,
                              dtype=cache_dtype)
                  for _ in range(cfg.num_hidden_layers)]
+
+    if use_jit:
+        try:
+            with autograd.no_grad():
+                return _generate_jit(model, ids, max_new_tokens, do_sample,
+                                     temperature, top_k, top_p, eos_token_id,
+                                     cache == "paged", empty)
+        finally:
+            if was_training:
+                model.train()
 
     with autograd.no_grad():
         logits, caches = model(Tensor._from_value(ids), caches=empty)

@@ -209,11 +209,14 @@ class LlamaAttention(Layer):
         if isinstance(cache, (StaticCache, PagedKVCache)):
             # fixed-shape decode (masked_multihead_attention semantics):
             # write into the pre-allocated buffers, attend over the full
-            # cache with a valid-length mask — shapes never change.
+            # cache with a valid-length mask — shapes never change. The
+            # offset may be a traced scalar (the compiled decode loop
+            # carries it through lax.scan), so positions are computed as
+            # static-arange + offset rather than branching on its value.
             offset = cache.length
-            if offset > 0:
+            if not isinstance(offset, int) or offset > 0:
                 position_ids = Tensor._from_value(
-                    jnp.arange(offset, offset + s))
+                    jnp.arange(s) + offset)
             q, k = rotary_position_embedding(
                 q, k, self.rope_cos, self.rope_sin,
                 position_ids=position_ids)
@@ -264,7 +267,7 @@ class LlamaAttention(Layer):
                     q._value[:, 0], cache.k_pages, cache.v_pages,
                     cache.tables, lengths)
                 return Tensor._from_value(out[:, None])
-            if offset == 0 and s > 1:
+            if s > 1 and offset == 0:  # static s first: offset may be traced
                 # prefill: the new tokens attend only among themselves —
                 # plain causal attention while the pages fill
                 return scaled_dot_product_attention(q, k, v, is_causal=True)

@@ -726,16 +726,22 @@ def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
 # ============================================================ attention
 
 
+_flash_fallback_warned = set()
+
+
 def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
-                                 is_causal=False, training=True, *, rng_key=None):
+                                 is_causal=False, training=True, *,
+                                 seq_lens=None, segment_ids=None,
+                                 rng_key=None):
     """Attention core, (B, S, H, D) layout like the reference's flash_attn
     (/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu:587).
 
     Routes to the Pallas flash-attention kernel
     (ops/pallas/flash_attention.py) when FLAGS_use_pallas_kernels is set and
-    the call qualifies (no mask/dropout, block-aligned seq); otherwise runs
-    the XLA composition below. ``rng_key`` is raw uint32 key data for
-    dropout (jit-cacheable).
+    the call qualifies (no dense attn_mask, no dropout, block-aligned seq —
+    ``seq_lens`` padding masks and packed ``segment_ids`` ARE kernel-served);
+    otherwise runs the XLA composition below, warning once per fallback
+    reason. ``rng_key`` is raw uint32 key data for dropout (jit-cacheable).
     """
     from ..core.flags import flag as _flag
 
@@ -743,7 +749,20 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
         from .pallas import flash_attention as _fa
 
         if _fa.flash_attention_supported(q, k, v, attn_mask, dropout_p):
-            return _fa.flash_attention(q, k, v, is_causal=is_causal)
+            return _fa.flash_attention(q, k, v, is_causal=is_causal,
+                                       seq_lens=seq_lens,
+                                       segment_ids=segment_ids)
+        reason = ("dense attn_mask" if attn_mask is not None else
+                  "dropout" if dropout_p > 0.0 else "shape/layout")
+        if reason not in _flash_fallback_warned:
+            _flash_fallback_warned.add(reason)
+            import warnings
+
+            warnings.warn(
+                f"flash-attention Pallas kernel unavailable ({reason}); "
+                "falling back to the XLA sdpa composition (warned once per "
+                "reason). Padding masks can ride the kernel via seq_lens=, "
+                "packed sequences via segment_ids=.")
     b, sq, h, d = q.shape
     scale = 1.0 / math.sqrt(d)
     qh = jnp.swapaxes(q, 1, 2)  # B,H,S,D
@@ -765,6 +784,16 @@ def scaled_dot_product_attention(q, k, v, attn_mask=None, dropout_p=0.0,
             logits = jnp.where(attn_mask, logits, -jnp.inf)
         else:
             logits = logits + attn_mask.astype(logits.dtype)
+    if seq_lens is not None or segment_ids is not None:
+        from .pallas.flash_attention import build_segments
+
+        q_seg, k_seg = build_segments(b, sq, kh.shape[2], seq_lens,
+                                      segment_ids)
+        # -1e30 (not -inf): fully-masked padding rows stay finite, matching
+        # the Pallas kernel, instead of NaN-ing through softmax
+        logits = jnp.where(
+            q_seg[:, None, :, None] == k_seg[:, None, None, :],
+            logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     if dropout_p > 0.0 and training:
         probs = dropout(probs, p=dropout_p, training=True, rng_key=rng_key)

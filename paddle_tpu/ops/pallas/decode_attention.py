@@ -127,7 +127,7 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths):
     across the page dimension.
     """
     b, h, d = q.shape
-    _, page_size, kvh, _ = k_pages.shape
+    npages, page_size, kvh, _ = k_pages.shape
     pages_per_seq = block_tables.shape[1]
     scale = 1.0 / math.sqrt(d)
 
@@ -137,7 +137,12 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths):
         return (bi, 0, 0)
 
     def kv_map(bi, pi, tables, lens):
-        return (tables[bi, pi], 0, 0, 0)
+        # Table tails past lengths[b] may be uninitialized in real paged
+        # serving: redirect the (masked-anyway) DMA to the row's first page
+        # and clamp into the pool, so garbage entries never address memory.
+        pid = jnp.where(pi * page_size < lens[bi], tables[bi, pi],
+                        tables[bi, 0])
+        return (jnp.clip(pid, 0, npages - 1), 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,

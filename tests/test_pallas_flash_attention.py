@@ -115,6 +115,116 @@ def test_mixed_block_sizes(sq, sk):
                                atol=2e-5, rtol=2e-5)
 
 
+def _naive_masked(q, k, v, causal, seq_lens=None, segment_ids=None):
+    """Oracle with -1e30 segment masking (matches kernel semantics)."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    q_seg, k_seg = fa.build_segments(b, sq, sk, seq_lens, segment_ids)
+    qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q, k, v))
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) / np.sqrt(d)
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -1e30)
+    logits = jnp.where(q_seg[:, None, :, None] == k_seg[:, None, None, :],
+                       logits, -1e30)
+    p = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vh), 1, 2)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_forward_seq_lens_padding(causal):
+    """Per-sequence valid lengths (flash_attn varlen/padding analog,
+    VERDICT r3 item 3): valid rows must match the masked oracle; padded-key
+    columns must not leak into valid rows."""
+    rng = np.random.RandomState(4)
+    B, S, H, D = 2, 256, 2, 32
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    lens = jnp.asarray([200, 131], jnp.int32)
+    out = fa.flash_attention(q, k, v, is_causal=causal, seq_lens=lens)
+    ref = _naive_masked(q, k, v, causal, seq_lens=lens)
+    for b in range(B):
+        n = int(lens[b])
+        np.testing.assert_allclose(np.asarray(out)[b, :n],
+                                   np.asarray(ref)[b, :n],
+                                   atol=2e-5, rtol=2e-5)
+
+
+def test_forward_segment_ids_packed():
+    """Packed sequences: tokens attend only within their own segment."""
+    rng = np.random.RandomState(5)
+    B, S, H, D = 1, 256, 2, 32
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    seg = jnp.asarray(
+        np.concatenate([np.zeros(100), np.ones(90), np.full(66, 2)])[None, :],
+        jnp.int32)
+    out = fa.flash_attention(q, k, v, is_causal=True, segment_ids=seg)
+    ref = _naive_masked(q, k, v, True, segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_backward_masked():
+    """Grads through the masked kernel match the oracle on valid positions,
+    and padded-key dk/dv are exactly zero (loss reads valid rows only)."""
+    rng = np.random.RandomState(6)
+    B, S, H, D = 2, 128, 2, 32
+    q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+    lens = jnp.asarray([128, 70], jnp.int32)
+    valid = (jnp.arange(S)[None, :] < lens[:, None]).astype(jnp.float32)
+    w = valid[:, :, None, None]
+
+    def loss_fa(q, k, v):
+        o = fa.flash_attention(q, k, v, is_causal=True, seq_lens=lens)
+        return ((o * w) ** 2).sum()
+
+    def loss_ref(q, k, v):
+        o = _naive_masked(q, k, v, True, seq_lens=lens)
+        return ((o * w) ** 2).sum()
+
+    g_fa = jax.grad(loss_fa, argnums=(0, 1, 2))(q, k, v)
+    g_nv = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, n in zip(g_fa, g_nv, "qkv"):
+        np.testing.assert_allclose(np.asarray(a) * (np.asarray(w) if n != "q" else 1.0),
+                                   np.asarray(b) * (np.asarray(w) if n != "q" else 1.0),
+                                   atol=1e-3, rtol=1e-3, err_msg=n)
+    # padded keys must receive exactly zero gradient from the kernel
+    assert np.abs(np.asarray(g_fa[1])[1, 70:]).max() == 0.0
+    assert np.abs(np.asarray(g_fa[2])[1, 70:]).max() == 0.0
+
+
+def test_sdpa_seq_lens_routes_and_fallback_warns():
+    """The public op serves seq_lens through the kernel; a dense mask warns
+    once and falls back."""
+    assert flag("FLAGS_use_pallas_kernels")
+    import warnings
+
+    from paddle_tpu.ops import nn_kernels
+
+    q = paddle.to_tensor(np.random.rand(2, 128, 2, 32).astype(np.float32))
+    lens = paddle.to_tensor(np.asarray([128, 64], np.int32))
+    out = paddle.scaled_dot_product_attention(q, q, q, is_causal=True,
+                                              seq_lens=lens)
+    ref = _naive_masked(q._value, q._value, q._value, True,
+                        seq_lens=lens._value)
+    np.testing.assert_allclose(np.asarray(out._value)[1, :64],
+                               np.asarray(ref)[1, :64], atol=2e-5, rtol=2e-5)
+    # dense-mask fallback warns exactly once
+    nn_kernels._flash_fallback_warned.discard("dense attn_mask")
+    mask = paddle.to_tensor(np.ones((1, 1, 128, 128), bool))
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        paddle.scaled_dot_product_attention(q, q, q, attn_mask=mask)
+        paddle.scaled_dot_product_attention(q, q, q, attn_mask=mask)
+    msgs = [str(r.message) for r in rec if "flash-attention" in str(r.message)]
+    assert len(msgs) == 1, msgs
+
+
 def test_flash_attention_gqa_native():
     """GQA kv heads are used directly (no head materialization): forward
     and all three grads match the repeated-head reference exactly in

@@ -239,3 +239,55 @@ def test_pallas_flash_attention_gqa_on_chip():
     g2 = np.asarray(jax.grad(lambda k_: ref(q, k_, v).sum())(k))
     rel = np.linalg.norm(g1 - g2) / np.linalg.norm(g2)
     assert rel < 1e-2, rel
+
+
+def test_pallas_flash_attention_masked_on_chip():
+    """seq_lens padding + segment-id masking must lower through Mosaic
+    ((1, S) int32 seg blocks in all three kernels) and match the masked
+    oracle on valid rows, fwd + dq/dk (VERDICT r3 item 3)."""
+    from paddle_tpu.ops.pallas.flash_attention import (
+        build_segments, flash_attention,
+    )
+
+    B, S, H, D = 2, 256, 4, 128
+    q = jnp.asarray(rng.rand(B, S, H, D).astype(np.float32))
+    k = jnp.asarray(rng.rand(B, S, H, D).astype(np.float32))
+    v = jnp.asarray(rng.rand(B, S, H, D).astype(np.float32))
+    lens = jnp.asarray([256, 140], jnp.int32)
+    seg = jnp.asarray(
+        np.concatenate([np.zeros(128), np.ones(128)])[None, :].repeat(B, 0),
+        jnp.int32)
+    hi = jax.lax.Precision.HIGHEST
+
+    def ref(q_, k_, v_):
+        q_seg, k_seg = build_segments(B, S, S, lens, seg)
+        qh, kh, vh = (jnp.swapaxes(x, 1, 2) for x in (q_, k_, v_))
+        s = jnp.einsum("bhqd,bhkd->bhqk", qh, kh,
+                       precision=hi) / math.sqrt(D)
+        s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, -1e30)
+        s = jnp.where(q_seg[:, None, :, None] == k_seg[:, None, None, :],
+                      s, -1e30)
+        return jnp.swapaxes(
+            jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(s, -1), vh,
+                       precision=hi), 1, 2)
+
+    valid = (jnp.arange(S)[None, :] < lens[:, None]).astype(
+        jnp.float32)[:, :, None, None]
+    out = flash_attention(q, k, v, is_causal=True, seq_lens=lens,
+                          segment_ids=seg)
+    np.testing.assert_allclose(np.asarray(out * valid),
+                               np.asarray(ref(q, k, v) * valid),
+                               rtol=2e-3, atol=2e-3)
+    # bwd: elementwise at the f32-cancellation noise floor (~1e-2, same as
+    # the unmasked on-chip bwd check); interpret mode holds exact math
+    loss = lambda fn: (lambda a: ((fn(a) * valid) ** 2).sum())
+    gq1 = np.asarray(jax.grad(loss(
+        lambda q_: flash_attention(q_, k, v, True, lens, seg)))(q))
+    gq2 = np.asarray(jax.grad(loss(lambda q_: ref(q_, k, v)))(q))
+    np.testing.assert_allclose(gq1, gq2, atol=2e-2, rtol=2e-2)
+    gk1 = np.asarray(jax.grad(loss(
+        lambda k_: flash_attention(q, k_, v, True, lens, seg)))(k))
+    gk2 = np.asarray(jax.grad(loss(lambda k_: ref(q, k_, v)))(k))
+    np.testing.assert_allclose(gk1, gk2, atol=2e-2, rtol=2e-2)
+    # padded keys get exactly zero grad from the kernel
+    assert np.abs(gk1[1, 140:]).max() == 0.0
