@@ -74,6 +74,10 @@ define_flag("FLAGS_flash_attention_block_size", 256,
             "Preferred q/k block for the Pallas flash-attention kernel "
             "(256 measured fastest on v5e; falls back to 128 when the "
             "sequence is not divisible)")
+define_flag("FLAGS_cross_host_device_put", False,
+            "Cross-mesh pipeline: use native cross-host device_put (DCN; "
+            "requires jax_cross_host_transfer_socket_address) instead of "
+            "the coordination-KV host transport")
 define_flag("FLAGS_default_dtype", "float32", "Default floating dtype for creation ops")
 define_flag("FLAGS_retain_grad_for_all", False, "Retain .grad for non-leaf tensors")
 define_flag("FLAGS_log_level", 0, "Framework VLOG level")

@@ -7,10 +7,16 @@ collective ops used for expert-parallel dispatch.
 
 TPU-native dispatch: index-based scatter-add into the (E*C) slot space and
 a weighted gather back (the global_scatter/global_gather shapes) — O(T*K)
-routing state, never a dense (T, E, C) combine tensor. Under a mesh with an
-``ep`` axis the expert dim of the dispatched tensor and the stacked expert
-weights shard Shard(0); XLA lowers the slot scatter/gather across the axis
-to the same all-to-all exchange the reference issues manually.
+routing state, never a dense (T, E, C) combine tensor.
+
+Expert parallelism: with stacked experts on an ``ep`` mesh axis, the
+forward runs an EXPLICIT shard_map EP exchange — tokens sharded over
+``ep``, each device dispatches its local tokens into per-(rank, expert)
+capacity slots, ``lax.all_to_all`` moves the slots to the experts' owners
+and back (the literal global_scatter/global_gather pair,
+moe_layer.py:263) — so dispatch bandwidth stays at T*D/ep instead of the
+full all-gather GSPMD falls back to when left to propagate the scatter on
+its own (verified by HLO inspection in tests/test_fleet.py).
 """
 from __future__ import annotations
 
@@ -90,6 +96,56 @@ _registry.register_op(
     "moe_dispatch", _moe_dispatch_kernel, inputs=("x", "gate_logits"))
 
 
+def _moe_ep_kernel(x, gate_logits, w_in, w_out, *, mesh, ep_axis, capacity,
+                   top_k, activation):
+    """Expert-parallel MoE forward as ONE shard_map program over ``ep``:
+    local dispatch -> all_to_all (global_scatter) -> local stacked-expert
+    FFN -> all_to_all (global_gather) -> local combine. ``capacity`` is
+    per (source rank, expert); the per-expert total is ``ep * capacity``,
+    matching the replicated kernel's global capacity."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    E = gate_logits.shape[1]
+    ep = mesh.shape[ep_axis]
+    E_loc = E // ep
+
+    def body(x_loc, lg_loc, w_in_loc, w_out_loc):
+        T_loc, D = x_loc.shape
+        dispatched, slots, weights, aux = _moe_dispatch_kernel(
+            x_loc, lg_loc, capacity, top_k)             # (E, C, D) local
+        # global_scatter: destination-rank-major blocks, transposed so
+        # rank r receives every source's slots for ITS experts
+        d = dispatched.reshape(ep, E_loc, capacity, D)
+        d = jax.lax.all_to_all(d, ep_axis, split_axis=0, concat_axis=0,
+                               tiled=True)              # (ep, E_loc, C, D)
+        d = d.transpose(1, 0, 2, 3).reshape(E_loc, ep * capacity, D)
+        # exact-erf gelu to match the replicated path's F.gelu
+        # (jax.nn.gelu defaults to the tanh approximation)
+        act = {"gelu": lambda v: jax.nn.gelu(v, approximate=False)}.get(
+            activation) or getattr(jax.nn, activation)
+        h = act(jnp.einsum("ecd,edh->ech", d, w_in_loc))
+        out_e = jnp.einsum("ech,ehd->ecd", h, w_out_loc)
+        # global_gather: send each source rank its tokens' outputs back
+        g = out_e.reshape(E_loc, ep, capacity, D).transpose(1, 0, 2, 3)
+        g = jax.lax.all_to_all(g, ep_axis, split_axis=0, concat_axis=0,
+                               tiled=True)              # (ep, E_loc, C, D)
+        expert_out = g.reshape(E, capacity, D)          # global expert order
+        yf = _combine_kernel(slots, weights, expert_out)
+        return yf, jax.lax.pmean(aux, ep_axis)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(P(ep_axis), P(ep_axis), P(ep_axis), P(ep_axis)),
+        out_specs=(P(ep_axis), P()),
+    )(x, gate_logits, w_in, w_out)
+
+
+_registry.register_op("moe_ep_forward", _moe_ep_kernel,
+                      inputs=("x", "gate_logits", "w_in", "w_out"))
+
+
 class NaiveGate(Layer):
     """Linear router, top-k (reference gate/naive_gate.py)."""
 
@@ -161,6 +217,24 @@ class MoELayer(Layer):
         logits = self.gate(xf)
         capacity = max(int(self.capacity_factor * T / self.num_experts), 1)
 
+        ep_cfg = getattr(self._stacked, "_ep", None)
+        if ep_cfg is not None:
+            jmesh, ep_axis = ep_cfg
+            ep = jmesh.shape[ep_axis]
+            if T % ep == 0 and self.num_experts % ep == 0:
+                # explicit EP: per-(rank, expert) capacity, all_to_all
+                # dispatch/return (global_scatter/global_gather)
+                cap_loc = max(
+                    int(self.capacity_factor * (T // ep) / self.num_experts),
+                    1)
+                yf, aux = _registry.apply_op(
+                    _registry.get_op("moe_ep_forward"), xf, logits,
+                    self._stacked.w_in, self._stacked.w_out,
+                    mesh=jmesh, ep_axis=ep_axis, capacity=cap_loc,
+                    top_k=self.top_k, activation=self._stacked.activation)
+                self.aux_loss = aux
+                return reshape(yf, list(orig_shape))
+
         dispatched, slots, weights, aux = _registry.apply_op(
             _registry.get_op("moe_dispatch"), xf, logits,
             capacity=capacity, top_k=self.top_k)
@@ -225,6 +299,7 @@ class StackedExpertsFFN(Layer):
             (num_experts, d_hidden, d_model),
             default_initializer=I.XavierNormal())
         self.activation = activation
+        self._ep = None
         if mesh is not None and ep_axis in mesh.dim_names:
             from ..api import shard_tensor
             from ..placement import Replicate, Shard
@@ -233,6 +308,7 @@ class StackedExpertsFFN(Layer):
             pl[mesh.dim_names.index(ep_axis)] = Shard(0)
             shard_tensor(self.w_in, mesh, pl)
             shard_tensor(self.w_out, mesh, pl)
+            self._ep = (mesh.jax_mesh(), ep_axis)
 
     def forward(self, dispatched):
         """(E, C, D) -> (E, C, D), one batched matmul pair over experts."""

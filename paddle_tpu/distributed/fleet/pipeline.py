@@ -471,6 +471,44 @@ import collections
 _StageProgs = collections.namedtuple("_StageProgs", "fwd bwd bwd_x bwd_w")
 
 
+def _host_p2p_transfer(value, tgt_sharding, tag, timeout_ms=120_000):
+    """Move a replicated array between per-process sub-meshes via the jax
+    coordination-service KV — the host(DCN) fallback for multi-controller
+    runs where peer-to-peer device transfers aren't available (e.g. the
+    CPU test harness; real TPU pods can enable the native path with
+    FLAGS_cross_host_device_put + jax_cross_host_transfer_socket_address).
+    EVERY process must call this with the same tag (SPMD host program);
+    only the source owner publishes, only target owners fetch, and all
+    processes get the global array handle. Keys are retained for the
+    coordinator's lifetime (test-scale traffic)."""
+    import base64
+
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    me = jax.process_index()
+    src = {d.process_index for d in value.sharding.device_set}
+    dst = {d.process_index for d in tgt_sharding.device_set}
+    key = f"xmeshp2p/{tag}"
+    if me == min(src):  # one publisher even when the sub-mesh spans procs
+        data = np.asarray(value.addressable_shards[0].data)
+        client.key_value_set(key, base64.b64encode(data.tobytes()).decode())
+    cache = {}
+
+    def cb(index):
+        if "d" not in cache:
+            raw = client.blocking_key_value_get(key, timeout_ms)
+            cache["d"] = np.frombuffer(
+                base64.b64decode(raw),
+                dtype=value.dtype).reshape(value.shape)
+        return jnp.asarray(cache["d"][index])
+
+    # non-target processes hold no addressable devices in tgt_sharding, so
+    # cb never runs there — they just get the global handle
+    return jax.make_array_from_callback(value.shape, tgt_sharding, cb,
+                                        dtype=value.dtype)
+
+
 class CrossMeshPipelineParallel(PipelineParallel):
     """1F1B pipeline with each stage's parameters on a distinct ``pp``
     sub-mesh — the true cross-stage schedule.
@@ -522,12 +560,6 @@ class CrossMeshPipelineParallel(PipelineParallel):
         if not isinstance(layers, PipelineLayer):
             raise TypeError("CrossMeshPipelineParallel requires a "
                             "PipelineLayer model")
-        if getattr(layers, "_shared", None):
-            raise ValueError(
-                "CrossMeshPipelineParallel does not support SharedLayerDesc "
-                "(tied weights): a layer shared across stages cannot live on "
-                "two disjoint sub-meshes. Untie the weights, or use the "
-                "single-mesh PipelineParallel / spmd_pipeline routes.")
         if mesh is None:
             from ..process_mesh import get_mesh
 
@@ -563,13 +595,87 @@ class CrossMeshPipelineParallel(PipelineParallel):
             physical.append(sub)
         # co-located chunks share ONE mesh object (and one NamedSharding)
         self._sub_meshes = [physical[s % n_mesh] for s in range(n_stages)]
-        # place every stage's parameters on its sub-mesh
+        # Tied weights (SharedLayerDesc, pp_layers.py:76): a layer shared
+        # across stages keeps ONE Parameter object — single optimizer
+        # entry, no double count in global-norm clip — whose canonical
+        # array lives on its FIRST stage's sub-mesh. Every other stage
+        # computes with a per-stage device copy, refreshed after each
+        # optimizer step; both stages' grad contributions land on the one
+        # Parameter (the cross-mesh analog of the reference's
+        # shared-weight allreduce in pipeline_parallel.py).
+        seen: dict = {}
+        self._tied: dict = {}  # (stage, name) -> (canon_stage, name, param)
+        for s, stage in enumerate(self._stages):
+            for name, p in stage.named_parameters():
+                if id(p) in seen:
+                    cs, cname = seen[id(p)]
+                    if cs != s:
+                        self._tied[(s, name)] = (cs, cname, p)
+                else:
+                    seen[id(p)] = (s, name)
+        # place every stage's parameters on its sub-mesh — REVERSED so a
+        # tied Parameter's final (object-level) placement is its canonical
+        # first stage's
         from ..api import shard_layer
 
-        for stage, sub in zip(self._stages, self._sub_meshes):
+        for stage, sub in reversed(list(zip(self._stages,
+                                            self._sub_meshes))):
             shard_layer(stage, sub, shard_fn)
+        # cross-process transport: a deterministic tag stream (same
+        # construction + call order on every controller) — set up BEFORE
+        # _refresh_tied, which may already cross processes
+        CrossMeshPipelineParallel._instance_seq += 1
+        self._p2p_prefix = f"cmpp{CrossMeshPipelineParallel._instance_seq}"
+        self._xfer_seq = 0
+        self._tied_copies: dict = {}
+        self._refresh_tied()
         self._progs = {}  # (stage, training) -> (fwd, bwd)
         self.last_schedule = None
+
+    _instance_seq = 0
+
+    def _put(self, value, tgt):
+        """Place ``value`` under ``tgt`` sharding, crossing processes when
+        needed. Single-controller: a plain transfer-engine device_put.
+        Multi-controller: device_put within one process's devices, or when
+        the hop crosses processes, native cross-host device_put if enabled
+        (FLAGS_cross_host_device_put, rides DCN on real pods) else the
+        coordination-KV host path."""
+        if jax.process_count() == 1:
+            return jax.device_put(value, tgt)
+        src = {d.process_index for d in value.sharding.device_set}
+        dst = {d.process_index for d in tgt.device_set}
+        if src == dst:
+            return jax.device_put(value, tgt)
+        from ...core.flags import flag as _flag
+
+        if _flag("FLAGS_cross_host_device_put"):
+            return jax.device_put(value, tgt)
+        self._xfer_seq += 1
+        return _host_p2p_transfer(
+            value, tgt, f"{self._p2p_prefix}/{self._xfer_seq}")
+
+    def _transfer(self, value, s_to):
+        """Move an activation/cotangent onto stage ``s_to``'s sub-mesh."""
+        return self._put(value, self._activation_sharding(s_to))
+
+    def _refresh_tied(self):
+        """Re-copy each tied Parameter's canonical array onto the other
+        stages' sub-meshes (same partition spec, that stage's mesh)."""
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        for (s, name), (_cs, _cn, p) in self._tied.items():
+            val = p._value
+            spec = (val.sharding.spec
+                    if isinstance(val.sharding, NamedSharding) else P())
+            tgt = NamedSharding(self._sub_meshes[s].jax_mesh(), spec)
+            self._tied_copies[(s, name)] = self._put(val, tgt)
+
+    def _patch_tied(self, states):
+        """Swap the per-stage tied copies into freshly-read raw states."""
+        for (s, name) in self._tied:
+            states[s][0][name] = self._tied_copies[(s, name)]
 
     def _activation_sharding(self, s):
         from jax.sharding import PartitionSpec as P
@@ -676,6 +782,7 @@ class CrossMeshPipelineParallel(PipelineParallel):
                  and getattr(scaler, "_enable", True) else 1.0)
 
         states = [s.raw_state() for s in self._stages]
+        self._patch_tied(states)
         zbh1 = self.schedule_mode == "ZBH1"
         sched = (zero_bubble_schedule(n_stages, n_micro) if zbh1
                  else one_f_one_b_schedule(n_stages, n_micro))
@@ -721,21 +828,18 @@ class CrossMeshPipelineParallel(PipelineParallel):
                                       else total_loss + loss_m)
                         gin[s][m] = jnp.ones_like(out)
                     else:
-                        act_in[s + 1][m] = jax.device_put(
-                            out, self._activation_sharding(s + 1))
+                        act_in[s + 1][m] = self._transfer(out, s + 1)
                 elif kind == "B" and zbh1:
                     # activation-grad only: unblocks the upstream stage;
                     # the weight-grad work is deferred to a bubble slot
-                    gy = jax.device_put(
-                        gin[s].pop(m), self._activation_sharding(s))
+                    gy = self._transfer(gin[s].pop(m), s)
                     gy_saved[s][m] = gy
                     gx = progs.bwd_x(params, buf_in[s][m], act_in[s][m],
                                      keys[s][m], tgt, factor, gy)
                     if s > 0:
                         gin[s - 1][m] = gx
                 elif kind == "B":  # 1F1B: full backward (dX + dW)
-                    gy = jax.device_put(
-                        gin[s].pop(m), self._activation_sharding(s))
+                    gy = self._transfer(gin[s].pop(m), s)
                     x = act_in[s].pop(m)
                     key = keys[s].pop(m)
                     buffers_f = buf_in[s].pop(m)
@@ -767,6 +871,13 @@ class CrossMeshPipelineParallel(PipelineParallel):
             index = {k: p for k, p in stage.named_parameters()}
             for k, g in grad_acc[s].items():
                 if k in index and not index[k].stop_gradient:
+                    if (s, k) in self._tied:
+                        # tied: move this stage's contribution onto the
+                        # canonical array's mesh; _accumulate_grad SUMS it
+                        # with the canonical stage's (shared-weight
+                        # allreduce semantics)
+                        g = self._put(
+                            g, self._tied[(s, k)][2]._value.sharding)
                     index[k]._accumulate_grad(g)
 
         if scaler is not None:
@@ -777,12 +888,17 @@ class CrossMeshPipelineParallel(PipelineParallel):
         optimizer.clear_grad()
         if lr_scheduler is not None:
             lr_scheduler.step()
+        if self._tied:
+            self._refresh_tied()
         return Tensor._from_value(total_loss, stop_gradient=True)
 
     def parameters(self, include_sublayers=True):
-        out = []
+        out, ids = [], set()
         for stage in self._stages:
-            out.extend(stage.parameters())
+            for p in stage.parameters():
+                if id(p) not in ids:  # tied params appear once
+                    ids.add(id(p))
+                    out.append(p)
         return out
 
     def _chain(self, x, labels=None):
@@ -797,14 +913,15 @@ class CrossMeshPipelineParallel(PipelineParallel):
         lv = (labels._value if isinstance(labels, Tensor)
               else jnp.asarray(labels)) if labels is not None else None
         one = jnp.asarray(1.0, jnp.float32)
+        chain_states = [st.raw_state() for st in self._stages]
+        self._patch_tied(chain_states)
         for s in range(n_stages):
             progs = self._stage_progs(s, training=False)
-            params, buffers = self._stages[s].raw_state()
+            params, buffers = chain_states[s]
             tgt = lv if s == n_stages - 1 else None
             key = jax.random.key_data(_random.next_key())
             x, _bufs = progs.fwd(params, buffers,
-                                 x if s == 0 else jax.device_put(
-                                     x, self._activation_sharding(s)),
+                                 x if s == 0 else self._transfer(x, s),
                                  key, tgt, one)
         return Tensor._from_value(x, stop_gradient=True)
 

@@ -189,12 +189,21 @@ def group_sharded_parallel(model, optimizer, level="os", scaler=None,
         # but a param already laid out on the mesh (e.g. TP-sharded over mp)
         # keeps its placement
         mesh_devs = set(d.id for d in mesh.jax_mesh().devices.flat)
-        for _, p in model.named_parameters():
+        for name, p in model.named_parameters():
             try:
                 devs = set(d.id for d in p._value.sharding.device_set)
             except AttributeError:
                 devs = set()
             if devs != mesh_devs:
+                if devs and not devs.issubset(mesh_devs):
+                    # committed elsewhere (e.g. a cross-mesh pipeline
+                    # stage): silently relocating it onto the ZeRO mesh
+                    # would break that placement — refuse loudly
+                    raise ValueError(
+                        f"group_sharded_parallel: parameter {name!r} is "
+                        f"committed to devices outside the sharding mesh; "
+                        f"build the ZeRO group on that mesh or exclude the "
+                        f"parameter")
                 shard_tensor(p, mesh, [Replicate()] * mesh.ndim)
     if level in ("os_g", "p_g_os"):
         _shard_gradients(model, mesh, axis, degree)

@@ -436,21 +436,43 @@ class LlamaHeadPipe(Layer):
         return self.lm_head(self.norm(hidden))
 
 
+def _tied_head_forward(layer, x):
+    """Tied LM head: logits = x @ embed_weight^T (the SharedLayerDesc
+    forward_func — pp_layers.py:76 embedding<->head tying)."""
+    return matmul(x, layer.embed_tokens.weight, transpose_y=True)
+
+
 def llama_pipeline_module(config: LlamaConfig, num_stages, loss_fn=None,
-                          recompute_interval=0):
+                          recompute_interval=0, tie_embeddings=False):
     """Build LLaMA as a heterogeneous :class:`PipelineLayer` — embedding
     stage + decoder blocks + norm/head stage — for the cross-mesh 1F1B
     trainer. Mirrors how the reference's semi_auto harness spreads
     embedding/blocks/head over ``get_mesh(ipp)`` sub-meshes
     (semi_auto_parallel_llama_model.py:121-160). Parameter creation order
     matches :class:`LlamaForCausalLM` (embed, blocks, norm, head), so the
-    same seed yields identical initial weights."""
-    from ..distributed.fleet import PipelineLayer
+    same seed yields identical initial weights.
 
-    entries = [LlamaEmbeddingPipe(config)]
+    ``tie_embeddings`` (or ``config.tie_word_embeddings``) shares the
+    embedding weight with the LM head via :class:`SharedLayerDesc` — the
+    GPT-2-style tying the cross-mesh trainer syncs with a summed tied-grad
+    (reference: pp_layers.py:76 + shared-weight allreduce)."""
+    from ..distributed.fleet import PipelineLayer, SharedLayerDesc
+
+    tied = tie_embeddings or config.tie_word_embeddings
+    if tied:
+        entries = [SharedLayerDesc("embed_tied", LlamaEmbeddingPipe, config)]
+    else:
+        entries = [LlamaEmbeddingPipe(config)]
     entries += [LlamaDecoderLayer(config)
                 for _ in range(config.num_hidden_layers)]
-    entries.append(LlamaHeadPipe(config))
+    if tied:
+        entries.append(RMSNorm(config.hidden_size,
+                               epsilon=config.rms_norm_eps))
+        entries.append(SharedLayerDesc("embed_tied", LlamaEmbeddingPipe,
+                                       config,
+                                       forward_func=_tied_head_forward))
+    else:
+        entries.append(LlamaHeadPipe(config))
     if loss_fn is None:
         loss_fn = LlamaPretrainingCriterion(config)
     return PipelineLayer(entries, num_stages=num_stages, loss_fn=loss_fn,

@@ -672,3 +672,65 @@ def test_fleet_utils_timers_and_broadcast():
      ** 2).mean().backward()
     fused_allreduce_gradients(list(model.parameters()))
     assert model.weight._grad._value.sharding.is_fully_replicated
+
+
+def test_moe_ep_matches_replicated_and_uses_all_to_all():
+    """VERDICT r3 item 6: (a) the ep-sharded MoELayer output must equal the
+    replicated run; (b) the compiled HLO must contain all-to-all for the
+    dispatch (the global_scatter analog), NOT an all-gather of the
+    dispatched tensor."""
+    import re
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed.fleet import MoELayer, StackedExpertsFFN
+
+    d, E, T = 16, 8, 64
+    mesh = dist.ProcessMesh(np.arange(8), ["ep"])
+    # generous capacity: no token drops, so EP (per-rank capacity) routes
+    # identically to the replicated run (global capacity)
+    paddle.seed(0)
+    ep_stacked = StackedExpertsFFN(E, d, 32, mesh=mesh)
+    ep_moe = MoELayer(d_model=d, experts=ep_stacked, gate={"top_k": 2},
+                      capacity_factor=8.0)
+    paddle.seed(0)
+    rep_stacked = StackedExpertsFFN(E, d, 32)  # same seed -> same weights
+    rep_moe = MoELayer(d_model=d, experts=rep_stacked, gate={"top_k": 2},
+                       capacity_factor=8.0)
+
+    x_np = np.random.RandomState(0).rand(4, T // 4, d).astype(np.float32)
+    y_ep = ep_moe(paddle.to_tensor(x_np))
+    y_rep = rep_moe(paddle.to_tensor(x_np))
+    np.testing.assert_allclose(np.asarray(y_ep._value),
+                               np.asarray(y_rep._value),
+                               rtol=1e-5, atol=1e-5)
+    # aux is a mean of per-rank load-balance products — close to, but not
+    # identical with, the global product (same as the reference's per-rank
+    # aux averaging)
+    np.testing.assert_allclose(float(ep_moe.aux_loss),
+                               float(rep_moe.aux_loss), rtol=0.05)
+
+    # grads flow through the all_to_all exchange
+    loss = (y_ep ** 2).mean() + 0.01 * ep_moe.aux_loss
+    loss.backward()
+    assert ep_stacked.w_in.grad is not None
+    assert np.isfinite(np.asarray(ep_stacked.w_in.grad._value)).all()
+
+    # (b) compiled-HLO collective audit
+    from paddle_tpu.jit import _FunctionalModel
+
+    fm = _FunctionalModel(ep_moe)
+    params = {k: p._value for k, p in ep_moe.named_parameters()}
+    buffers = {k: b._value for k, b in ep_moe.named_buffers()}
+    key = jax.random.key_data(jax.random.PRNGKey(0))
+
+    def fwd(params, x):
+        out, _ = fm(params, buffers, (x,), {}, key)
+        return out
+
+    txt = jax.jit(fwd).lower(
+        params, jnp.asarray(x_np.reshape(T, d))).compile().as_text()
+    assert re.search("all-to-all", txt), "EP dispatch must lower to all-to-all"
+    assert not re.search("all-gather", txt), \
+        "dispatch must not all-gather the dispatched tensor"

@@ -88,6 +88,200 @@ WORKER = textwrap.dedent("""
 """)
 
 
+WORKER2 = textwrap.dedent("""
+    import os
+    import sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    # cross-host activation transfers (the DCN path a real pod uses)
+    jax.config.update("jax_cross_host_transfer_socket_address", "127.0.0.1:0")
+
+    import faulthandler
+    faulthandler.dump_traceback_later(150, exit=True)
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    try:
+        main_ok = False
+        dist.init_parallel_env()
+        rank = dist.get_rank()
+        n_dev = len(jax.devices())
+        assert n_dev == 16 and len(jax.local_devices()) == 8
+
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # ------------- (1) cross-mesh 1F1B: stage s owned by process s
+        from paddle_tpu.distributed.fleet import CrossMeshPipelineParallel
+        from paddle_tpu.models import llama_pipeline_module, llama_tiny_config
+
+        mesh = dist.ProcessMesh(np.arange(16).reshape(2, 8), ["pp", "mp"])
+        paddle.seed(0)
+        cfg = llama_tiny_config()
+        pipe_model = llama_pipeline_module(cfg, num_stages=2)
+        pipe = CrossMeshPipelineParallel(pipe_model, mesh=mesh,
+                                         accumulate_steps=2)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=pipe.parameters())
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            0, cfg.vocab_size, (4, 16)).astype(np.int32))
+        losses = []
+        for _ in range(2):
+            loss = pipe.train_batch((ids, ids), opt)
+            # loss lives on the LAST stage's sub-mesh (process 1); move it
+            # to stage 0's sub-mesh with the pipeline's own cross-process
+            # transport so each rank reads its own addressable copy
+            copy0 = pipe._transfer(loss._value, 0)
+            mine = copy0 if rank == 0 else loss._value
+            losses.append(float(np.asarray(mine.addressable_shards[0].data)))
+        assert losses[1] < losses[0], losses
+        print(f"rank={rank} PIPE l1={losses[0]:.6f} l2={losses[1]:.6f}",
+              flush=True)
+
+        # ---------------- (2) ZeRO-2: live grads sharded in THIS process
+        import paddle_tpu.nn as nn
+        from paddle_tpu.distributed.fleet import group_sharded_parallel
+
+        dmesh = dist.ProcessMesh(np.arange(16), ["dp"])
+        paddle.seed(1)
+        m2 = nn.Linear(32, 32)
+        opt2 = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                      parameters=m2.parameters())
+        m2s, opt2s, _ = group_sharded_parallel(m2, opt2, level="os_g",
+                                               mesh=dmesh)
+        x = dist.shard_tensor(
+            paddle.to_tensor(np.random.RandomState(1).rand(16, 32)
+                             .astype(np.float32)), dmesh, [dist.Shard(0)])
+        loss2 = (m2s(x) ** 2).mean()
+        loss2.backward()
+        g = m2.weight.grad._value
+        shards = g.addressable_shards
+        # 16-way Shard(0) of (32, 32): this process holds 8 shards of (2, 32)
+        assert len(shards) == 8, len(shards)
+        assert tuple(shards[0].data.shape) == (2, 32), shards[0].data.shape
+        opt2s.step()
+        opt2s.clear_grad()
+        print(f"rank={rank} ZERO2 ok", flush=True)
+
+        # ---------------- (3) elastic: one re-rendezvous cycle, both procs
+        import time
+
+        from paddle_tpu.distributed.fleet.elastic import (
+            ElasticManager, ElasticStatus,
+        )
+        from paddle_tpu.distributed.store import TCPStore
+
+        host, port = os.environ["ELASTIC_STORE"].split(":")
+        store = TCPStore(host=host, port=int(port), is_master=(rank == 0))
+        mgr = ElasticManager(store=store, rank=rank, world_size=2,
+                             heartbeat_interval=0.05, lease=2.0,
+                             np_range=(2, 4))
+        mgr.start()
+        time.sleep(0.3)
+        status, world = mgr.scale_plan()
+        assert status == ElasticStatus.HOLD and world == 2, (status, world)
+        if rank == 0:
+            # a new host volunteers; the lead commits the scale-out
+            joiner = ElasticManager(store=store, rank=99, world_size=2,
+                                    np_range=(2, 4))
+            joiner.announce_join()
+            status, world = mgr.scale_plan()
+            assert status == ElasticStatus.RESTART and world == 3, (status, world)
+            gen = mgr.re_rendezvous(world)
+            assert gen == 1 and mgr.world_size == 3
+            joiner.stop()
+        else:
+            # followers observe the generation bump and adopt the new world
+            deadline = time.time() + 10
+            while mgr.current_generation() < 1:
+                assert time.time() < deadline, "never saw generation bump"
+                time.sleep(0.05)
+        assert mgr.current_generation() == 1
+        print(f"rank={rank} ELASTIC gen={mgr.current_generation()} ok",
+              flush=True)
+        # exit barrier: rank 0 hosts the coordination service AND the
+        # elastic master store — leaving early would kill the peer's jax
+        # client (and store) mid-poll
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("elastic_done")
+        mgr.stop()
+        store.close()
+        sys.stdout.flush()
+        main_ok = True
+    except BaseException:
+        import traceback
+
+        traceback.print_exc()
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(1)
+    sys.stdout.flush()
+    os._exit(0)  # the cross-host transfer server thread outlives main
+""")
+
+
+def test_two_process_cross_mesh_pp_zero2_elastic(tmp_path):
+    """VERDICT r3 item 5: cross-mesh 1F1B, ZeRO-2 sharded live grads, and
+    an elastic re-rendezvous cycle inside the REAL 2-process
+    jax.distributed harness (reference: test/collective/fleet/
+    hybrid_parallel_pp_alexnet.py et al.)."""
+    from paddle_tpu.distributed.launch import launch
+    from paddle_tpu.distributed.store import TCPStore
+
+    script = tmp_path / "worker2.py"
+    script.write_text(WORKER2)
+    probe = TCPStore(is_master=True)
+    port = probe.port
+    probe.close()
+    probe2 = TCPStore(is_master=True)
+    eport = probe2.port
+    probe2.close()
+    os.environ["ELASTIC_STORE"] = f"127.0.0.1:{eport}"
+    try:
+        rc = launch(str(script), nproc_per_node=2,
+                    master=f"127.0.0.1:{port}",
+                    log_dir=str(tmp_path / "logs"))
+    finally:
+        os.environ.pop("ELASTIC_STORE", None)
+    logs = "".join(
+        (tmp_path / "logs" / f"worker.{r}.log").read_text() for r in (0, 1))
+    assert rc == 0, logs
+    for r in (0, 1):
+        assert f"rank={r} PIPE" in logs, logs
+        assert f"rank={r} ZERO2 ok" in logs, logs
+        assert f"rank={r} ELASTIC gen=1 ok" in logs, logs
+
+    # the 2-process cross-mesh loss must match the same model trained on
+    # THIS process's single-controller virtual mesh (same seed, same math)
+    import re
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet import CrossMeshPipelineParallel
+    from paddle_tpu.models import llama_pipeline_module, llama_tiny_config
+
+    got = re.search(r"rank=0 PIPE l1=([\d.]+) l2=([\d.]+)", logs)
+    mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["pp", "mp"])
+    paddle.seed(0)
+    cfg = llama_tiny_config()
+    pipe = CrossMeshPipelineParallel(
+        llama_pipeline_module(cfg, num_stages=2), mesh=mesh,
+        accumulate_steps=2)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=pipe.parameters())
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (4, 16)).astype(np.int32))
+    ref = [float(pipe.train_batch((ids, ids), opt)) for _ in range(2)]
+    np.testing.assert_allclose(
+        [float(got.group(1)), float(got.group(2))], ref, rtol=1e-4)
+
+
 def test_two_process_global_mesh(tmp_path):
     from paddle_tpu.distributed.launch import launch
     from paddle_tpu.distributed.store import TCPStore
