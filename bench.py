@@ -255,17 +255,26 @@ dlens = jnp.full((DB,), DKV, jnp.int32)
 cache_bytes = 2 * DB * DKV * DKVH * DD * 2  # bf16, read once per step
 
 # (d.1) calibration: what does a plain XLA streaming read of the same
-# bytes cost in this process right now?
-@jax.jit
-def stream_reduce(k, v, s):
-    return (k.astype(jnp.float32) * s).sum() + (v.astype(jnp.float32) * s).sum()
+# bytes cost in this process right now? Scanned device-side (CAL_ITERS
+# full passes per dispatch) so the measurement resolves even when the
+# read is far below the sync RTT jitter.
+CAL_ITERS = 2 if SMOKE else 20
 
-sync_fetch(stream_reduce(k_pages, v_pages, 1.0))
+@jax.jit
+def stream_reduce(k, v, s0):
+    def body(s, _):
+        r = (k.astype(jnp.float32) * s).sum() + (v.astype(jnp.float32) * s).sum()
+        return s + r * 1e-30, None
+
+    s, _ = jax.lax.scan(body, s0, None, length=CAL_ITERS)
+    return s
+
+sync_fetch(stream_reduce(k_pages, v_pages, jnp.float32(1.0)))
 floor_samples = []
 for rep in range(3):
     t = time.time()
-    sync_fetch(stream_reduce(k_pages, v_pages, 2.0 + rep))
-    floor_samples.append(max(time.time() - t - RTT, 1e-9))
+    sync_fetch(stream_reduce(k_pages, v_pages, jnp.float32(2.0 + rep)))
+    floor_samples.append(max(time.time() - t - RTT, 1e-9) / CAL_ITERS)
 floor_dt = sorted(floor_samples)[len(floor_samples) // 2]
 floor_gbs = cache_bytes / floor_dt / 1e9
 log(f"streaming-read calibration: {floor_dt*1e3:.1f}ms for "
@@ -342,6 +351,16 @@ model_decode_tok_s = GB * GNEW / gen_dt
 log(f"model decode: {gen_dt*1e3:.0f}ms for {GNEW} tokens x batch {GB} -> "
     f"{model_decode_tok_s:,.0f} tok/s ({gen_dt/GNEW*1e3:.1f}ms/token-step)")
 
+# ------------------------------------------------------- (f) op microbench
+# Per-op regression gate (reference: tools/ci_op_benchmark.sh relative
+# check): ~20 hot ops + eager dispatch overhead, compared against the
+# in-repo OPBENCH_BASELINE.json recorded round-over-round.
+from bench_ops import run_op_bench  # noqa: E402
+
+log("op microbench (~20 ops, median of 3)...")
+op_results, op_vs_baseline, op_regressions = run_op_bench(
+    SMOKE, RTT, sync_fetch, log)
+
 result = {
     "metric": "llama_train_mfu",
     "value": round(100 * mfu, 2),
@@ -363,6 +382,9 @@ result = {
     "decode_vs_streaming_floor": round(dec_gbs / floor_gbs, 2),
     "model_decode_tokens_per_sec": round(model_decode_tok_s, 1),
     "model_decode_ms_per_token_step": round(gen_dt / GNEW * 1e3, 2),
+    "op_bench_us": op_results,
+    "op_bench_vs_baseline": op_vs_baseline,
+    "op_bench_regressions": op_regressions,
     "n_params_m": round(n_params / 1e6, 1),
     "device": kind,
     "platform": platform,

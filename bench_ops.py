@@ -1,0 +1,162 @@
+"""Per-op microbenchmark — the CI op-regression gate's measurement half.
+
+Analog of the reference's op benchmark CI (/root/reference/tools/
+ci_op_benchmark.sh + check_op_benchmark_result.py, which rebuilds each PR
+and fails on RELATIVE per-op regressions). Here: ~20 hot ops (XLA +
+Pallas kernels) each timed as a device-side dependency-chained scan
+(loop-carried epsilon defeats loop-invariant hoisting; a .ravel()[0]
+carry defeats dead-code elimination), median of 3 repeats with the sync
+RTT subtracted, plus the host-side eager-dispatch overhead. Results are
+compared against the in-repo OPBENCH_BASELINE.json (recorded
+round-over-round); regressions beyond 1.5x are reported in the bench
+JSON for the driver's record.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "OPBENCH_BASELINE.json")
+REGRESSION_FACTOR = 1.5
+
+
+def _op_suite(smoke):
+    """[(name, fn(*args) -> array, args)] — shapes MXU/VPU-aligned."""
+    f = 0.25 if smoke else 1.0
+    d = lambda n: max(int(n * f) // 128 * 128, 128)  # keep lane alignment
+    big = (d(1024), d(1024))
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, big, jnp.float32)
+    b = jax.random.normal(key, big, jnp.float32)
+    abf = a.astype(jnp.bfloat16)
+    bbf = b.astype(jnp.bfloat16)
+    mm_n = d(4096)
+    ambf = jax.random.normal(key, (mm_n, mm_n), jnp.bfloat16)
+    sm = jax.random.normal(key, (d(256), d(4096)), jnp.float32)
+    emb_w = jax.random.normal(key, (d(32000), d(512)), jnp.float32)
+    emb_i = jax.random.randint(key, (d(1024),), 0, d(32000))
+    ln_x = jax.random.normal(key, (d(256), d(1024)), jnp.float32)
+    ln_g = jnp.ones((d(1024),), jnp.float32)
+    ce_x = jax.random.normal(key, (d(256), d(32000)), jnp.float32)
+    ce_y = jax.random.randint(key, (d(256),), 0, d(32000))
+    p1m = jax.random.normal(key, (d(1024) * d(1024),), jnp.float32)
+    ch = 32 if smoke else 128
+    conv_x = jax.random.normal(key, (8, ch, 28, 28), jnp.float32)
+    conv_w = jax.random.normal(key, (ch, ch, 3, 3), jnp.float32)
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    from paddle_tpu.ops.pallas.rms_norm import rms_norm
+
+    fa_q = jax.random.normal(key, (2, d(512), 8, 128), jnp.bfloat16)
+
+    suite = [
+        ("add_f32", lambda x, y: x + y, (a, b)),
+        ("mul_f32", lambda x, y: x * y, (a, b)),
+        ("exp_f32", jnp.exp, (a,)),
+        ("tanh_f32", jnp.tanh, (a,)),
+        ("gelu_f32", jax.nn.gelu, (a,)),
+        ("softmax_f32", lambda x: jax.nn.softmax(x, axis=-1), (sm,)),
+        ("reduce_sum_f32", lambda x: jnp.sum(x, axis=-1), (a,)),
+        ("transpose_f32", lambda x: x.T @ jnp.ones_like(x[:, :1]), (a,)),
+        ("concat_f32", lambda x, y: jnp.concatenate([x, y], 0), (a, b)),
+        ("matmul_1k_bf16", lambda x, y: x @ y, (abf, bbf)),
+        ("matmul_4k_bf16", lambda x: x @ x, (ambf,)),
+        ("embedding_gather", lambda w, i: w[i], (emb_w, emb_i)),
+        ("layer_norm", lambda x, g: g * (x - x.mean(-1, keepdims=True))
+         / jnp.sqrt(x.var(-1, keepdims=True) + 1e-5), (ln_x, ln_g)),
+        ("pallas_rms_norm", lambda x, g: rms_norm(x, g, g, 1e-6, False),
+         (ln_x, ln_g)),
+        ("pallas_flash_attn",
+         lambda q: flash_attention(q, q, q, is_causal=True), (fa_q,)),
+        ("cross_entropy", lambda x, y: -jnp.take_along_axis(
+            jax.nn.log_softmax(x, -1), y[:, None], 1).mean(), (ce_x, ce_y)),
+        ("adamw_update", lambda p, g: p - 1e-3 * (0.9 * g)
+         / (jnp.sqrt(0.999 * g * g) + 1e-8) - 1e-2 * 1e-3 * p, (p1m, p1m)),
+        ("conv2d_3x3", lambda x, w: jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=("NCHW", "OIHW", "NCHW")),
+         (conv_x, conv_w)),
+    ]
+    return suite
+
+
+def _bench_one(fn, args, iters, reps, rtt, sync_fetch):
+    float_pos = [i for i, v in enumerate(args)
+                 if jnp.issubdtype(v.dtype, jnp.inexact)]
+    perturb = float_pos[0] if float_pos else None
+
+    def loop(eps0, *a):
+        def body(eps, _):
+            a2 = list(a)
+            if perturb is not None:
+                a2[perturb] = a2[perturb] + eps.astype(a2[perturb].dtype)
+            out = fn(*a2)
+            # FULL-output reduction as the carry: a single-element carry
+            # lets XLA dead-code-eliminate everything but one lane (r4 run
+            # 1 measured 0.0us for mul/exp/softmax that way); the sum
+            # fuses into the op loop, so it bounds, not distorts
+            return out.sum().astype(jnp.float32) * 1e-20, None
+
+        eps, _ = jax.lax.scan(body, eps0, None, length=iters)
+        return eps
+
+    run = jax.jit(loop).lower(jnp.float32(0.0), *args).compile()
+    sync_fetch(run(jnp.float32(0.0), *args))  # warm
+    samples = []
+    for r in range(reps):
+        t = time.time()
+        sync_fetch(run(jnp.float32(1e-6 * (r + 1)), *args))
+        samples.append(max(time.time() - t - rtt, 1e-9) / iters)
+    return sorted(samples)[len(samples) // 2]
+
+
+def run_op_bench(smoke, rtt, sync_fetch, log):
+    iters = 4 if smoke else 50
+    reps = 2 if smoke else 3
+    results = {}
+    for name, fn, args in _op_suite(smoke):
+        try:
+            us = _bench_one(fn, args, iters, reps, rtt, sync_fetch) * 1e6
+            results[name] = round(us, 2)
+            log(f"  op {name}: {us:,.1f} us")
+        except Exception as e:  # one op must not sink the whole bench
+            log(f"  op {name}: FAILED {type(e).__name__}: {e}")
+            results[name] = None
+
+    # host-side eager dispatch overhead (cached-executable path)
+    import paddle_tpu as paddle
+
+    xs = paddle.to_tensor(np.ones((8,), np.float32))
+    ys = paddle.to_tensor(np.ones((8,), np.float32))
+    _ = xs + ys  # warm the per-op executable cache
+    n = 20 if smoke else 300
+    t = time.time()
+    acc = xs
+    for _ in range(n):
+        acc = acc + ys
+    dispatch_us = (time.time() - t) / n * 1e6
+    sync_fetch(acc._value)
+    results["eager_dispatch_us"] = round(dispatch_us, 1)
+    log(f"  eager dispatch: {dispatch_us:.1f} us/op (host-side)")
+
+    comparison, regressions = {}, []
+    if os.path.exists(BASELINE_PATH):
+        base = json.load(open(BASELINE_PATH))
+        for k, v in results.items():
+            bv = base.get(k)
+            if v and bv:
+                comparison[k] = round(v / bv, 3)
+                if v / bv > REGRESSION_FACTOR:
+                    regressions.append(k)
+        if regressions:
+            log(f"  REGRESSIONS vs {BASELINE_PATH}: {regressions}")
+        else:
+            log("  no per-op regressions vs recorded baseline")
+    else:
+        log(f"  no baseline at {BASELINE_PATH} (record this run to create)")
+    return results, comparison, regressions

@@ -1,0 +1,120 @@
+"""Guarded speculation — the SOT/graph-break machinery for ``to_static``.
+
+The reference's SOT (/root/reference/python/paddle/jit/sot/) splits a
+function at untraceable bytecode and keeps the surrounding segments
+compiled, guarding each compiled region with checks on the values the
+break consumed. The TPU-native translation works at the VALUE level: a
+mid-function concretization (``bool(t)``/``float(t)``/``t.numpy()`` on a
+traced tensor — the data-dependent Python branch) is handled by
+
+1. running the call EAGERLY once while RECORDING every concretization
+   outcome in order (ground truth),
+2. re-tracing with the outcomes REPLAYED — each traced concretization is
+   baked as a constant and its source tensor is collected as a guard
+   *predicate* output of the compiled program,
+3. on later calls, running the compiled specialization and VALIDATING the
+   returned predicate values against the baked outcomes: a match means
+   the whole function (matmul prefix, branch, suffix) ran from one
+   compiled program; a mismatch re-runs eagerly (correct by
+   construction) and records a new specialization.
+
+Net effect: a stable data-dependent branch costs one compiled dispatch
+plus a scalar guard fetch — both the prefix and suffix stay compiled —
+while an unstable branch degrades gracefully to eager per novel outcome.
+Python side effects inside the region (prints, logging) execute at trace
+time only, like the reference's constant-folded SOT guards.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+__all__ = ["recording", "replaying", "on_concretize", "freeze_outcomes"]
+
+
+class _State(threading.local):
+    def __init__(self):
+        self.mode = None        # None | "record" | "replay"
+        self.recorded = None    # record: list of np.ndarray outcomes
+        self.queue = None       # replay: outcomes to bake, consumed in order
+        self.preds = None       # replay: traced predicate values (jnp tracers)
+
+
+_state = _State()
+
+
+@contextlib.contextmanager
+def recording():
+    """Eager ground-truth phase: log every concretization outcome."""
+    holder = type("Recorded", (), {"recorded": None})()
+    saved = (_state.mode, _state.recorded)
+    _state.mode, _state.recorded = "record", []
+    try:
+        yield holder
+    finally:
+        holder.recorded = _state.recorded
+        _state.mode, _state.recorded = saved
+
+
+@contextlib.contextmanager
+def replaying(outcomes):
+    """Trace phase: bake recorded outcomes; collect guard predicates."""
+    saved = (_state.mode, _state.queue, _state.preds)
+    _state.mode, _state.queue, _state.preds = "replay", list(outcomes), []
+    try:
+        yield _state
+    finally:
+        _state.mode, _state.queue, _state.preds = saved
+
+
+def on_concretize(tensor, traced):
+    """Hook called from ``Tensor.numpy()``. Returns the ndarray to hand to
+    the caller, or None to follow the normal path (raise if traced)."""
+    st = _state
+    if st.mode == "record":
+        if traced:
+            return None  # recording happens eagerly; a tracer here is a bug
+        val = np.asarray(tensor._value)
+        st.recorded.append(val)
+        return val
+    if st.mode == "replay":
+        if not st.queue:
+            return None  # novel concretization -> genuine graph break
+        val = st.queue.pop(0)
+        if traced:
+            st.preds.append(tensor._value)
+            return np.asarray(val)
+        # concrete even under the trace (e.g. derived from constants):
+        # consume the slot AND contribute the live value as a (trivially
+        # matching) predicate so pred/outcome alignment is preserved
+        st.preds.append(tensor._value)
+        return np.asarray(tensor._value)
+    return None
+
+
+def freeze_outcomes(outcomes):
+    """Hashable cache key for a recorded outcome sequence."""
+    return tuple((o.shape, o.dtype.str, o.tobytes()) for o in outcomes)
+
+
+def outcomes_match(pred_values, outcomes):
+    """Guard validation: compiled-program predicate values vs the baked
+    outcomes. EXACT equality, floats included: a tolerance could pass a
+    predicate that crossed the Python branch's decision boundary and
+    silently run the wrong compiled branch. If per-op vs fused rounding
+    makes a float guard flap, the caller's mis-speculation counter
+    retires the signature to eager — a perf cost, never a wrong answer."""
+    if len(pred_values) != len(outcomes):
+        return False
+    for p, o in zip(pred_values, outcomes):
+        p = np.asarray(p)
+        if p.shape != o.shape:
+            return False
+        if np.issubdtype(o.dtype, np.inexact):
+            if not np.array_equal(p.astype(o.dtype), o, equal_nan=True):
+                return False
+        elif not np.array_equal(p.astype(o.dtype), o):
+            return False
+    return True

@@ -16,6 +16,7 @@ import jax
 import jax.numpy as jnp
 
 from . import autograd
+from . import speculation as _spec
 from .autograd import AccumulationNode
 from .dtype import convert_dtype, to_jax_dtype
 
@@ -264,7 +265,12 @@ class Tensor:
     # ---------------- materialization ----------------
 
     def numpy(self) -> np.ndarray:
-        if _is_tracer(self._value):
+        traced = _is_tracer(self._value)
+        if _spec._state.mode is not None:  # SOT-style guarded speculation
+            out = _spec.on_concretize(self, traced)
+            if out is not None:
+                return out
+        if traced:
             raise TracedConcretizationError(
                 "Cannot call .numpy() inside a traced (to_static) region")
         return np.asarray(self._value)

@@ -92,6 +92,21 @@ def save_state_dict(state_dict, path, process_group=None,
                 })
             if entry["shards"]:
                 meta["tensors"][key] = entry
+        elif _is_jax_array(v):
+            # 0-d mesh-placed scalar (loss scale, step counter): under
+            # true multi-host the global array is not fully addressable,
+            # so never np.asarray it — the lowest-rank owner reads its
+            # local replica shard and writes
+            owners = {d.process_index for d in v.sharding.device_set}
+            if rank == min(owners):
+                arr = np.asarray(v.addressable_shards[0].data)
+                skey = f"{key}@{rank}.0"
+                local[skey] = arr
+                meta["tensors"][key] = {
+                    "shape": list(arr.shape), "dtype": arr.dtype.name,
+                    "shards": [{"key": skey, "file": fname,
+                                "offsets": [[0, s] for s in arr.shape]}],
+                }
         elif rank == coordinator_rank:
             # host scalars / plain arrays: identical on every rank, the
             # coordinator writes them

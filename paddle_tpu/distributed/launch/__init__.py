@@ -184,6 +184,9 @@ def _pending_scale_out(store):
         return 0
     n = store.add("launch/scale_out", 0)
     if n:
+        # subtract EXACTLY the value read: the store's add is atomic, so a
+        # request_scale_out racing in between survives (counter ends at
+        # its posted value) and is consumed by the next generation
         store.add("launch/scale_out", -n)
     return n
 

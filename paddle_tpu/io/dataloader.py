@@ -3,14 +3,19 @@
 Analog of /root/reference/python/paddle/io/reader.py:262 (``DataLoader``)
 and dataloader/dataloader_iter.py. The reference forks worker *processes*
 feeding a shared-memory blocking queue because CUDA work and Python
-decode contend for the GIL. The TPU-native tradeoff differs: device work is
-dispatched async by jax and the heavy decode is numpy (GIL-releasing), so a
-small *thread* pool with a bounded prefetch queue gives the same overlap
-without fork/shared-memory machinery. ``num_workers`` sizes the pool;
-``prefetch_factor`` bounds in-flight batches.
+decode contend for the GIL. The TPU-native default differs: device work
+is dispatched async by jax and most decode is numpy (GIL-releasing), so a
+*thread* pool with a bounded prefetch queue gives the same overlap
+without fork machinery. For genuinely Python-heavy datasets (pure-python
+parsing, PIL decode pipelines) ``use_process_workers=True`` forks real
+worker processes (the reference's dataloader_iter.py model): children run
+``dataset[i]`` only — never jax — and ship raw samples back over the
+multiprocessing pipe; the parent collates. ``num_workers`` sizes either
+pool; ``prefetch_factor`` bounds in-flight batches.
 """
 from __future__ import annotations
 
+import time
 import queue
 import threading
 
@@ -70,10 +75,11 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=False, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, use_process_workers=False):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
+        self.use_process_workers = bool(use_process_workers)
         self.prefetch_factor = max(int(prefetch_factor), 1)
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
@@ -125,6 +131,9 @@ class DataLoader:
         if self.num_workers <= 0:
             for indices in self.batch_sampler:
                 yield self._load_batch(indices)
+            return
+        if self.use_process_workers:
+            yield from self._process_prefetch_iter()
             return
         yield from self._prefetch_iter()
 
@@ -186,3 +195,78 @@ class DataLoader:
             stop.set()
             for _ in threads:
                 task_q.put(None)
+
+    def _process_prefetch_iter(self):
+        """Real worker PROCESSES (reference dataloader_iter.py multiprocess
+        mode): forked children evaluate ``dataset[i]`` for each index list
+        and pipe the raw samples back; the parent collates, preserving
+        sampler order. Children never touch jax (fork safety)."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        batches = list(self.batch_sampler)
+        n_workers = min(self.num_workers, max(len(batches), 1))
+        task_q = ctx.Queue()
+        out_q = ctx.Queue()
+        dataset = self.dataset
+        init_fn = self.worker_init_fn
+
+        def child(wid):
+            _worker_info.info = WorkerInfo(wid, n_workers, dataset)
+            if init_fn is not None:
+                init_fn(wid)
+            while True:
+                item = task_q.get()
+                if item is None:
+                    return
+                i, idxs = item
+                try:
+                    out_q.put((i, [dataset[j] for j in idxs], None))
+                except Exception as e:
+                    out_q.put((i, None, repr(e)))
+
+        procs = [ctx.Process(target=child, args=(w,), daemon=True)
+                 for w in range(n_workers)]
+        for p in procs:
+            p.start()
+        capacity = self.prefetch_factor * n_workers
+        for i, idxs in enumerate(batches[:capacity]):
+            task_q.put((i, idxs))
+        next_to_submit = min(capacity, len(batches))
+
+        pending = {}
+        next_to_yield = 0
+        deadline = (time.time() + self.timeout) if self.timeout else None
+        try:
+            while next_to_yield < len(batches):
+                while next_to_yield not in pending:
+                    try:
+                        # poll so a worker killed mid-decode (OOM/segfault)
+                        # raises instead of hanging the training loop
+                        i, samples, err = out_q.get(timeout=1.0)
+                    except queue.Empty:
+                        dead = [p.pid for p in procs if not p.is_alive()]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker process(es) {dead} died "
+                                "unexpectedly (killed/crashed)")
+                        if deadline is not None and time.time() > deadline:
+                            raise RuntimeError(
+                                "DataLoader timed out waiting for workers")
+                        continue
+                    if err is not None:
+                        raise RuntimeError(
+                            f"DataLoader worker failed: {err}")
+                    pending[i] = samples
+                yield self.collate_fn(pending.pop(next_to_yield))
+                next_to_yield += 1
+                if next_to_submit < len(batches):
+                    task_q.put((next_to_submit, batches[next_to_submit]))
+                    next_to_submit += 1
+        finally:
+            for _ in procs:
+                task_q.put(None)
+            for p in procs:
+                p.join(timeout=2)
+                if p.is_alive():
+                    p.terminate()

@@ -229,19 +229,27 @@ class StaticFunction:
         # One compiled executable per (training mode, arg tree, static leaves);
         # jax.jit adds shape/dtype specialization beneath this.
         self._compiled: dict = {}
-        # full_graph=False: the reference's SOT route tolerates graph breaks
-        # by falling back to eager for untraceable code; here untraceable
-        # means data-dependent Python control flow inside the trace. The
-        # fallback is PER CALL SIGNATURE (training mode, arg tree, static
-        # leaves): a signature that breaks runs eager from then on, while
-        # signatures that trace keep their compiled programs — the
-        # jit-level analog of SOT's per-code-path guard sets
-        # (python/paddle/jit/sot/, opcode_translator guards).
+        # full_graph=False: the reference's SOT route splits at untraceable
+        # points and keeps the surrounding segments compiled
+        # (python/paddle/jit/sot/). Value-level translation = guarded
+        # speculation (core/speculation.py): a signature that breaks is
+        # ground-truthed eagerly ONCE (concretization outcomes recorded),
+        # then recompiled with the outcomes baked + guard predicates as
+        # extra outputs; later calls run the compiled specialization and
+        # validate the guards, re-recording on mismatch. The matmul
+        # prefix AND suffix around a data-dependent Python branch both run
+        # from the compiled program.
         self._full_graph = bool(full_graph)
-        self._eager_keys: set = set()
+        self._guarded: dict = {}   # sig key -> {"last": [outcomes] | None}
+        self._eager_keys: set = set()  # legacy introspection (now unused)
 
-    def _get_compiled(self, key, tree, static_leaves, n_leaves):
-        fn = self._compiled.get(key)
+    def _get_compiled(self, key, tree, static_leaves, n_leaves,
+                      outcomes=None):
+        from ..core import speculation as _spec
+
+        cache_key = (key if outcomes is None
+                     else (key, _spec.freeze_outcomes(outcomes)))
+        fn = self._compiled.get(cache_key)
         if fn is not None:
             return fn
         functional = self._functional
@@ -251,10 +259,20 @@ class StaticFunction:
                 dyn[i] if i in dyn else static_leaves[i] for i in range(n_leaves)
             ]
             a, kw = jax.tree_util.tree_unflatten(tree, flat)
-            return functional(params, buffers, a, kw, rng_key)
+            if outcomes is None:
+                out, new_bufs = functional(params, buffers, a, kw, rng_key)
+                return out, new_bufs, []
+            # speculation replay: concretizations bake the recorded
+            # outcomes; their source tensors ride out as guard predicates
+            # (f32 so the vjp cotangent story stays uniform)
+            with _spec.replaying(outcomes) as rs:
+                out, new_bufs = functional(params, buffers, a, kw, rng_key)
+                preds = [jnp.asarray(p).astype(jnp.float32)
+                         for p in rs.preds]
+            return out, new_bufs, preds
 
         fn = jax.jit(pure)
-        self._compiled[key] = fn
+        self._compiled[cache_key] = fn
         return fn
 
     def __call__(self, *args, **kwargs):
@@ -272,16 +290,66 @@ class StaticFunction:
 
             warnings.warn(
                 f"to_static: graph break ({type(e).__name__}); this call "
-                "signature runs eagerly (other signatures stay compiled)")
-            self._eager_keys.add(gb.key)
-            return self._run_eager(args, kwargs)
+                "signature switches to guarded speculation (compiled "
+                "program + guard validation; other signatures stay fully "
+                "compiled)")
+            self._guarded.setdefault(gb.key, {"last": None})
+            return self._record_and_run(gb.key, args, kwargs)
 
     def _run_eager(self, args, kwargs):
         if self._layer is not None:
             return self._layer(*args, **kwargs)
         return self._fn(*args, **kwargs)
 
-    def _call_traced(self, args, kwargs):
+    def _record_and_run(self, key, args, kwargs):
+        """Ground-truth phase: run eagerly, recording every concretization
+        outcome; the next call compiles the guarded specialization."""
+        from ..core import speculation as _spec
+
+        with _spec.recording() as rec:
+            result = self._run_eager(args, kwargs)
+        self._guarded[key]["last"] = list(rec.recorded)
+        return result
+
+    # consecutive mis-speculations before a signature retires to eager
+    # (an unstable or rounding-flapping guard would otherwise pay compiled
+    # + eager on every call)
+    _MAX_MISSPECULATIONS = 3
+
+    def _call_guarded(self, key, args, kwargs):
+        """Run the compiled specialization for this signature's last
+        recorded outcomes and validate its guard predicates; on mismatch
+        (or a novel break) re-ground-truth eagerly. Side effects (buffer
+        writes) are deferred until the guards validate, so a
+        mis-speculated run leaves no state behind."""
+        from ..core import speculation as _spec
+
+        st = self._guarded[key]
+        if st.get("retired"):
+            return self._run_eager(args, kwargs)
+        outcomes = st["last"]
+        if outcomes is not None:
+            try:
+                result, pred_vals, new_buffers = self._call_traced(
+                    args, kwargs, outcomes=outcomes)
+            except _GraphBreak:
+                return self._record_and_run(key, args, kwargs)
+            if _spec.outcomes_match(pred_vals, outcomes):
+                st["misses"] = 0
+                self._write_buffers(new_buffers)
+                return result
+            st["misses"] = st.get("misses", 0) + 1
+            if st["misses"] >= self._MAX_MISSPECULATIONS:
+                import warnings
+
+                warnings.warn(
+                    "to_static: speculation guards flapped "
+                    f"{st['misses']}x for one call signature; retiring it "
+                    "to eager execution")
+                st["retired"] = True
+        return self._record_and_run(key, args, kwargs)
+
+    def _call_traced(self, args, kwargs, outcomes=None):
         layer = self._layer
         if layer is not None:
             param_objs = dict(layer.named_parameters())
@@ -325,9 +393,10 @@ class StaticFunction:
                 static_leaves[i] = v
 
         key = (training, tree, _freeze(static_leaves))
-        if key in self._eager_keys:
-            return self._run_eager(args, kwargs)
-        compiled = self._get_compiled(key, tree, static_leaves, len(flat))
+        if outcomes is None and key in self._guarded:
+            return self._call_guarded(key, args, kwargs)
+        compiled = self._get_compiled(key, tree, static_leaves, len(flat),
+                                      outcomes=outcomes)
         rng_key = jax.random.key_data(_random.next_key())
 
         diff_params = {
@@ -338,9 +407,16 @@ class StaticFunction:
 
         try:
             if not needs_grad:
-                out, new_buffers = compiled(params, buffers, dyn, rng_key)
+                out, new_buffers, preds = compiled(params, buffers, dyn,
+                                                   rng_key)
+                result = _as_tensor_tree(out)
+                if outcomes is not None:
+                    # buffer writes deferred: _call_guarded applies them
+                    # only after the guards validate
+                    return (result, [np.asarray(p) for p in preds],
+                            new_buffers)
                 self._write_buffers(new_buffers)
-                return _as_tensor_tree(out)
+                return result
 
             frozen = {k: v for k, v in params.items() if k not in diff_params}
 
@@ -352,15 +428,20 @@ class StaticFunction:
                     dyn2[pos] = val
                 return compiled(full, buffers, dyn2, rng_key)
 
-            (out, new_buffers), vjp_fn = jax.vjp(
+            (out, new_buffers, preds), vjp_fn = jax.vjp(
                 fwd,
                 {k: p._value for k, p in diff_params.items()},
                 [t._value for t in diff_tensors],
             )
         except _TRACE_BREAKS as e:
-            self._compiled.pop(key, None)  # drop the half-traced program
+            from ..core import speculation as _spec
+
+            cache_key = (key if outcomes is None
+                         else (key, _spec.freeze_outcomes(outcomes)))
+            self._compiled.pop(cache_key, None)  # drop half-traced program
             raise _GraphBreak(key, e) from e
-        self._write_buffers(new_buffers)
+        if outcomes is None:  # speculative runs defer until guards validate
+            self._write_buffers(new_buffers)
 
         out_flat, out_tree = jax.tree_util.tree_flatten(out)
         edge_tensors = list(diff_params.values()) + diff_tensors
@@ -368,6 +449,7 @@ class StaticFunction:
         param_names = list(diff_params)
         out_shapes = [(v.shape, v.dtype) for v in out_flat]
         zero_buf_cot = jax.tree_util.tree_map(jnp.zeros_like, new_buffers)
+        zero_pred_cot = [jnp.zeros_like(p) for p in preds]
 
         def backward_fn(grad_outputs, _vjp=vjp_fn):
             gflat = [
@@ -375,7 +457,7 @@ class StaticFunction:
                 for g, (s, d) in zip(grad_outputs, out_shapes)
             ]
             gout = jax.tree_util.tree_unflatten(out_tree, gflat)
-            gp, gt = _vjp((gout, zero_buf_cot))
+            gp, gt = _vjp((gout, zero_buf_cot, zero_pred_cot))
             return tuple([gp[k] for k in param_names] + list(gt))
 
         node = GradNode("to_static", backward_fn, edges, len(out_flat),
@@ -388,7 +470,10 @@ class StaticFunction:
                 t._grad_node = node
                 t._grad_slot = i
             out_tensors.append(t)
-        return jax.tree_util.tree_unflatten(out_tree, out_tensors)
+        result = jax.tree_util.tree_unflatten(out_tree, out_tensors)
+        if outcomes is not None:
+            return result, [np.asarray(p) for p in preds], new_buffers
+        return result
 
     def _write_buffers(self, new_buffers):
         if not new_buffers:

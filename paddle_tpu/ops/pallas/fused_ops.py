@@ -219,7 +219,11 @@ def bias_dropout_residual_ln(x, residual, bias=None, ln_scale=None,
            else ln_bias.reshape(1, h))
     if training and dropout_rate > 0.0:
         if rng_key is None:
-            rng_key = jax.random.PRNGKey(0)
+            # framework RNG stream — a fixed PRNGKey(0) here would hand
+            # every direct caller the identical mask on every call/layer
+            from ...core import random as _random
+
+            rng_key = _random.next_key()
         mask = jax.random.bernoulli(
             rng_key, 1.0 - dropout_rate, x2.shape).astype(jnp.float32)
     else:

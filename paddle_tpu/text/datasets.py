@@ -18,7 +18,7 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imikolov", "Imdb", "UCIHousing", "Movielens"]
+__all__ = ["Imikolov", "Imdb", "UCIHousing", "Movielens", "Conll05st"]
 
 
 def _no_download(download):
@@ -283,3 +283,135 @@ class Movielens(Dataset):
 
     def __len__(self):
         return len(self.items)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test set (reference
+    python/paddle/text/datasets/conll05.py): ``data_file`` is the release
+    tar (words + props .gz streams), with word/predicate/label dictionaries
+    from their own files. One sample per (sentence, predicate) pair:
+    9 int arrays — word ids, the five predicate context windows broadcast
+    over the sentence, predicate id, the +-2 context mark, and BIO label
+    ids derived from the props bracket syntax."""
+
+    UNK_IDX = 0
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=False):
+        for f in (data_file, word_dict_file, verb_dict_file,
+                  target_dict_file):
+            if f is None:
+                if download:
+                    raise RuntimeError(
+                        "this environment has no network egress; place the "
+                        "conll05st files locally and pass explicit paths "
+                        "(download=False)")
+                raise ValueError("data/word/verb/target files are required")
+        self.word_dict = self._load_dict(word_dict_file)
+        self.predicate_dict = self._load_dict(verb_dict_file)
+        self.label_dict = self._load_dict(target_dict_file)
+        self._emb_file = emb_file
+        self.sentences, self.predicates, self.labels = [], [], []
+        self._parse(data_file)
+
+    @staticmethod
+    def _load_dict(path):
+        with open(path) as f:
+            return {ln.strip(): i for i, ln in enumerate(f) if ln.strip()}
+
+    @staticmethod
+    def _bio(col):
+        """Bracket tags ('(A0*', '*', '*)') -> BIO sequence."""
+        out, cur, inside = [], "O", False
+        for tag in col:
+            if tag == "*":
+                out.append("I-" + cur if inside else "O")
+            elif tag == "*)":
+                out.append("I-" + cur)
+                inside = False
+            elif "(" in tag:
+                cur = tag[1:tag.index("*")]
+                out.append("B-" + cur)
+                inside = ")" not in tag
+            else:
+                raise RuntimeError(f"unexpected props tag {tag!r}")
+        return out
+
+    def _parse(self, data_file):
+        import gzip
+        import tarfile
+
+        with tarfile.open(data_file) as tf:
+            words_raw = gzip.decompress(tf.extractfile(
+                "conll05st-release/test.wsj/words/test.wsj.words.gz").read())
+            props_raw = gzip.decompress(tf.extractfile(
+                "conll05st-release/test.wsj/props/test.wsj.props.gz").read())
+        sentence, columns = [], []
+        for wline, pline in zip(words_raw.decode().splitlines(),
+                                props_raw.decode().splitlines()):
+            word = wline.strip()
+            cols = pline.split()
+            if not cols:  # blank line = sentence boundary
+                self._emit(sentence, columns)
+                sentence, columns = [], []
+                continue
+            sentence.append(word)
+            columns.append(cols)
+        self._emit(sentence, columns)
+
+    def _emit(self, sentence, columns):
+        if not sentence:
+            return
+        verbs = [c[0] for c in columns if c[0] != "-"]
+        n_targets = len(columns[0]) - 1
+        for t in range(n_targets):
+            col = [c[t + 1] for c in columns]
+            self.sentences.append(list(sentence))
+            self.predicates.append(verbs[t])
+            self.labels.append(self._bio(col))
+
+    def __getitem__(self, idx):
+        sentence = self.sentences[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        v = labels.index("B-V")
+
+        def ctx(off, pad):
+            i = v + off
+            return sentence[i] if 0 <= i < n else pad
+
+        mark = [0] * n
+        for i in range(max(v - 2, 0), min(v + 3, n)):
+            mark[i] = 1
+        wd = self.word_dict
+        word_idx = [wd.get(w, self.UNK_IDX) for w in sentence]
+        ctx_ids = [[wd.get(ctx(off, "bos" if off < 0 else "eos"),
+                           self.UNK_IDX)] * n
+                   for off in (-2, -1, 0, 1, 2)]
+        pred = self.predicates[idx]
+        if pred not in self.predicate_dict:
+            raise KeyError(
+                f"predicate {pred!r} (sample {idx}) missing from the verb "
+                "dictionary — words fall back to UNK, predicates/labels "
+                "must be covered")
+        pred_idx = [self.predicate_dict[pred]] * n
+        try:
+            label_idx = [self.label_dict[l] for l in labels]
+        except KeyError as e:
+            raise KeyError(
+                f"SRL label {e.args[0]!r} (sample {idx}) missing from the "
+                "target dictionary") from None
+        return tuple(np.asarray(a) for a in
+                     [word_idx, *ctx_ids, pred_idx, mark, label_idx])
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        if self._emb_file is None:
+            raise ValueError("emb_file was not provided")
+        return np.loadtxt(self._emb_file)

@@ -19,7 +19,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData"]
+__all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData",
+           "Flowers", "VOC2012"]
 
 
 def _no_download(download):
@@ -157,3 +158,131 @@ class FakeData(Dataset):
 
     def __len__(self):
         return self.num_samples
+
+
+
+class _TarReader:
+    """Per-(process, thread) tarfile handles: a single shared handle's
+    seek offsets race under the DataLoader's thread or fork workers."""
+
+    def __init__(self, path):
+        import tarfile
+        import threading
+
+        self._path = path
+        self._local = threading.local()
+        with tarfile.open(path) as t:
+            self.members = {m.name: m for m in t.getmembers()}
+
+    def read(self, name):
+        import os
+        import tarfile
+        import threading
+
+        key = os.getpid()
+        tar = getattr(self._local, "tar", None)
+        if tar is None or getattr(self._local, "pid", None) != key:
+            tar = tarfile.open(self._path)
+            self._local.tar = tar
+            self._local.pid = key
+        return tar.extractfile(self.members[name]).read()
+
+    def __getstate__(self):  # fork/spawn-safe: reopen lazily in the child
+        return {"_path": self._path, "members": self.members}
+
+    def __setstate__(self, state):
+        import threading
+
+        self._path = state["_path"]
+        self.members = state["members"]
+        self._local = threading.local()
+
+
+class Flowers(Dataset):
+    """Oxford Flowers-102 (reference
+    python/paddle/vision/datasets/flowers.py): ``data_file`` is the jpg tgz,
+    ``label_file``/``setid_file`` the imagelabels/setid .mat files; the
+    train/valid/test split comes from setid's trnid/valid/tstid vectors.
+    Items are (image, label[1]) with labels as stored (1-based)."""
+
+    _MODE_KEYS = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend="pil"):
+        if mode not in self._MODE_KEYS:
+            raise AssertionError(
+                f"mode should be 'train', 'valid' or 'test', but got {mode}")
+        _no_download(download and data_file is None)
+        if backend not in ("pil", "cv2"):
+            raise ValueError(f"backend must be pil or cv2, got {backend}")
+        import scipy.io as scio
+
+        self.backend = backend
+        self.transform = transform
+        self._tar = _TarReader(data_file)
+        self.labels = scio.loadmat(label_file)["labels"][0]
+        self.indexes = scio.loadmat(setid_file)[self._MODE_KEYS[mode]][0]
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+
+        index = int(self.indexes[idx])
+        name = "jpg/image_%05d.jpg" % index
+        image = Image.open(_io.BytesIO(self._tar.read(name)))
+        if self.backend == "cv2":
+            image = np.asarray(image)
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, np.asarray([self.labels[index - 1]], np.int64)
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference
+    python/paddle/vision/datasets/voc2012.py): ``data_file`` is the VOC tar;
+    the split list comes from ImageSets/Segmentation/{mode}.txt; items are
+    (image, segmentation-mask) decoded from JPEGImages / SegmentationClass.
+    """
+
+    _SET = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+    _DATA = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+    _LABEL = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+    _MODES = {"train": "train", "valid": "val", "test": "trainval"}
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="pil"):
+        if mode not in self._MODES:
+            raise AssertionError(
+                f"mode should be 'train', 'valid' or 'test', but got {mode}")
+        _no_download(download and data_file is None)
+        if backend not in ("pil", "cv2"):
+            raise ValueError(f"backend must be pil or cv2, got {backend}")
+        self.backend = backend
+        self.transform = transform
+        self._tar = _TarReader(data_file)
+        listing = self._tar.read(self._SET.format(self._MODES[mode]))
+        self.names = [ln.strip().decode() for ln in listing.splitlines()
+                      if ln.strip()]
+
+    def __getitem__(self, idx):
+        import io as _io
+
+        from PIL import Image
+
+        name = self.names[idx]
+        img = Image.open(_io.BytesIO(self._tar.read(self._DATA.format(name))))
+        mask = Image.open(
+            _io.BytesIO(self._tar.read(self._LABEL.format(name))))
+        if self.backend == "cv2":
+            img = np.asarray(img)
+        mask = np.asarray(mask)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+    def __len__(self):
+        return len(self.names)

@@ -1,0 +1,209 @@
+"""Dataset long-tail (Flowers102 / VOC2012 / Conll05st) + multiprocess
+DataLoader (VERDICT r3 item 10). Fixtures are synthesized in the exact
+archive formats the reference parses (flowers.py / voc2012.py /
+conll05.py), so the parsers are exercised for real without network."""
+import gzip
+import io
+import os
+import tarfile
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.io import DataLoader, Dataset
+
+
+def _jpg_bytes(w=16, h=16, seed=0):
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    img = Image.fromarray(rng.randint(0, 255, (h, w, 3), dtype=np.uint8))
+    buf = io.BytesIO()
+    img.save(buf, format="JPEG")
+    return buf.getvalue()
+
+
+def _png_bytes(w=16, h=16, seed=0):
+    from PIL import Image
+
+    rng = np.random.RandomState(seed)
+    img = Image.fromarray(rng.randint(0, 21, (h, w), dtype=np.uint8))
+    buf = io.BytesIO()
+    img.save(buf, format="PNG")
+    return buf.getvalue()
+
+
+def _add_bytes(tar, name, data):
+    info = tarfile.TarInfo(name)
+    info.size = len(data)
+    tar.addfile(info, io.BytesIO(data))
+
+
+def test_flowers_dataset(tmp_path):
+    import scipy.io as scio
+
+    from paddle_tpu.vision.datasets import Flowers
+
+    n = 6
+    data_file = tmp_path / "102flowers.tgz"
+    with tarfile.open(data_file, "w:gz") as tar:
+        for i in range(1, n + 1):
+            _add_bytes(tar, "jpg/image_%05d.jpg" % i, _jpg_bytes(seed=i))
+    label_file = tmp_path / "imagelabels.mat"
+    scio.savemat(label_file, {"labels": np.arange(1, n + 1)[None, :]})
+    setid_file = tmp_path / "setid.mat"
+    scio.savemat(setid_file, {"trnid": np.asarray([[1, 3, 5]]),
+                              "valid": np.asarray([[2]]),
+                              "tstid": np.asarray([[4, 6]])})
+
+    ds = Flowers(data_file=str(data_file), label_file=str(label_file),
+                 setid_file=str(setid_file), mode="train")
+    assert len(ds) == 3
+    img, label = ds[1]  # second train id = image 3
+    assert int(label[0]) == 3
+    assert np.asarray(img).shape == (16, 16, 3)
+    ds_t = Flowers(data_file=str(data_file), label_file=str(label_file),
+                   setid_file=str(setid_file), mode="test", backend="cv2")
+    assert len(ds_t) == 2 and isinstance(ds_t[0][0], np.ndarray)
+    with pytest.raises(AssertionError):
+        Flowers(data_file=str(data_file), label_file=str(label_file),
+                setid_file=str(setid_file), mode="bogus")
+
+
+def test_voc2012_dataset(tmp_path):
+    from paddle_tpu.vision.datasets import VOC2012
+
+    data_file = tmp_path / "VOCtrainval.tar"
+    names = ["2007_000032", "2007_000061", "2007_000123"]
+    with tarfile.open(data_file, "w") as tar:
+        for i, nm in enumerate(names):
+            _add_bytes(tar, f"VOCdevkit/VOC2012/JPEGImages/{nm}.jpg",
+                       _jpg_bytes(seed=i))
+            _add_bytes(tar, f"VOCdevkit/VOC2012/SegmentationClass/{nm}.png",
+                       _png_bytes(seed=i))
+        _add_bytes(tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/train.txt",
+                   "\n".join(names[:2]).encode())
+        _add_bytes(tar, "VOCdevkit/VOC2012/ImageSets/Segmentation/val.txt",
+                   names[2].encode())
+        _add_bytes(tar,
+                   "VOCdevkit/VOC2012/ImageSets/Segmentation/trainval.txt",
+                   "\n".join(names).encode())
+
+    tr = VOC2012(data_file=str(data_file), mode="train")
+    assert len(tr) == 2
+    img, mask = tr[0]
+    assert mask.shape == (16, 16) and mask.max() <= 21
+    assert len(VOC2012(data_file=str(data_file), mode="valid")) == 1
+    assert len(VOC2012(data_file=str(data_file), mode="test")) == 3
+    # loader integration: decode through worker processes
+    loader = DataLoader(VOC2012(data_file=str(data_file), mode="test",
+                                backend="cv2",
+                                transform=lambda im: np.asarray(
+                                    im, np.float32).mean()),
+                        batch_size=3)
+    batch = next(iter(loader))
+    assert batch[0].shape == [3]
+
+
+def test_conll05st_dataset(tmp_path):
+    from paddle_tpu.text.datasets import Conll05st
+
+    # two sentences; first has 2 predicates (cat, sat — target columns in
+    # verb-row order), second 1
+    words = "The\ncat\nsat\n\nDogs\nbark\n\n"
+    props = ("-\t(A0*)\t(A0*\n"
+             "cat\t(V*)\t*)\n"
+             "sat\t*\t(V*)\n"
+             "\n"
+             "-\t(A0*)\n"
+             "bark\t(V*)\n"
+             "\n")
+    data_file = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(data_file, "w:gz") as tar:
+        _add_bytes(tar, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                   gzip.compress(words.encode()))
+        _add_bytes(tar, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                   gzip.compress(props.encode()))
+    wd = tmp_path / "words.dict"
+    wd.write_text("\n".join(["<unk>", "the", "The", "cat", "sat", "Dogs",
+                             "bark", "bos", "eos"]))
+    vd = tmp_path / "verbs.dict"
+    vd.write_text("cat\nsat\nbark")
+    td = tmp_path / "targets.dict"
+    td.write_text("\n".join(["O", "B-A0", "I-A0", "B-V", "I-V"]))
+
+    ds = Conll05st(data_file=str(data_file), word_dict_file=str(wd),
+                   verb_dict_file=str(vd), target_dict_file=str(td))
+    assert len(ds) == 3  # 2 predicates + 1
+    sample = ds[0]
+    assert len(sample) == 9
+    word_idx, *ctxs, pred_idx, mark, label_idx = sample
+    assert word_idx.shape == (3,) and label_idx.shape == (3,)
+    names = ["O", "B-A0", "I-A0", "B-V", "I-V"]
+    assert [names[i] for i in label_idx] == ["B-A0", "B-V", "O"]
+    assert list(mark) == [1, 1, 1]
+    assert pred_idx[0] == 0  # "cat"
+    s1 = ds[1]  # second target: predicate "sat", A0 spans rows 1-2
+    assert [names[i] for i in s1[8]] == ["B-A0", "I-A0", "B-V"]
+    assert s1[6][0] == 1  # "sat"
+    s2 = ds[2]  # second sentence, predicate "bark"
+    assert s2[0].shape == (2,) and s2[6][0] == 2
+    with pytest.raises(RuntimeError):
+        Conll05st(download=True)
+
+
+class _CpuBoundDataset(Dataset):
+    """Pure-python compute in __getitem__: holds the GIL, so thread workers
+    cannot parallelize it but process workers can."""
+
+    def __init__(self, n=32, work=12000):
+        self.n = n
+        self.work = work
+
+    def __getitem__(self, idx):
+        acc = idx
+        for i in range(self.work):
+            acc = (acc * 1103515245 + 12345) % (2 ** 31)
+        return np.asarray([acc], np.float32), np.int64(idx)
+
+    def __len__(self):
+        return self.n
+
+
+@pytest.mark.skipif(len(os.sched_getaffinity(0)) < 2,
+                    reason="parallel speedup needs >1 CPU core "
+                           "(this CI container exposes 1)")
+def test_process_workers_speed_up_python_heavy_dataset():
+    """VERDICT r3 item 10: num_workers>0 with REAL processes must beat the
+    serial loader on a GIL-bound dataset (the reference's multiprocess
+    dataloader_iter rationale)."""
+    ds = _CpuBoundDataset()
+
+    def run(**kw):
+        t = time.time()
+        seen = [np.asarray(b[1]._value) for b in DataLoader(
+            ds, batch_size=4, **kw)]
+        return time.time() - t, np.concatenate(seen)
+
+    t_serial, order_serial = run(num_workers=0)
+    t_proc, order_proc = run(num_workers=4, use_process_workers=True)
+    # order preserved, real speedup (generous margin for loaded CI)
+    np.testing.assert_array_equal(order_serial, order_proc)
+    assert t_proc < t_serial * 0.75, (t_serial, t_proc)
+
+
+def test_process_workers_propagate_errors():
+    class Boom(Dataset):
+        def __getitem__(self, idx):
+            if idx == 5:
+                raise ValueError("bad sample")
+            return np.float32(idx)
+
+        def __len__(self):
+            return 8
+
+    loader = DataLoader(Boom(), batch_size=2, num_workers=2,
+                        use_process_workers=True)
+    with pytest.raises(RuntimeError, match="bad sample"):
+        list(loader)

@@ -226,7 +226,7 @@ def test_full_graph_false_graph_break_fallback():
         out = sf(x)
         assert any("graph break" in str(i.message) for i in w)
     np.testing.assert_allclose(np.asarray(out._value), [2.0, 4.0])
-    # sticky eager: the other branch now works too
+    # guard mismatch re-specializes: the other branch works too
     out2 = sf(paddle.to_tensor(np.float32([-5.0, 1.0])))
     np.testing.assert_allclose(np.asarray(out2._value), [-6.0, 0.0])
     # full_graph=True raises with guidance
@@ -239,7 +239,124 @@ def test_full_graph_false_graph_break_fallback():
     # traceable functions still compile under full_graph=False
     g = to_static(lambda a: a * 3, full_graph=False)
     np.testing.assert_allclose(np.asarray(g(x)._value), [3.0, 6.0])
-    assert len(g._compiled) == 1 and not g._eager_keys
+    assert len(g._compiled) == 1 and not g._guarded
+
+
+def test_graph_break_speculation_keeps_segments_compiled():
+    """SOT-style subgraph handling (VERDICT r3 item 7): a mid-function
+    data-dependent Python branch leaves the surrounding matmul segments
+    running from a compiled program — proven by the Python-side-effect
+    counter staying flat once the guarded specialization is compiled."""
+    import warnings
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import to_static
+
+    calls = {"py": 0}
+
+    @to_static(full_graph=False)
+    def f(x, w1, w2):
+        h = x @ w1                 # compiled prefix (matmul)
+        calls["py"] += 1
+        if float(h.sum()) > 0:     # data-dependent python branch
+            h = h * 2.0
+        else:
+            h = h - 1.0
+        return h @ w2              # compiled suffix (matmul)
+
+    rng = np.random.RandomState(0)
+    w1 = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+    w2 = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+    xp = paddle.to_tensor(rng.rand(2, 4).astype(np.float32))  # sum > 0
+
+    def oracle(xv):
+        h = np.asarray(xv._value) @ np.asarray(w1._value)
+        h = h * 2.0 if h.sum() > 0 else h - 1.0
+        return h @ np.asarray(w2._value)
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        # the aborted trace runs the prefix (py=1) before breaking; the
+        # eager ground-truth run follows (py=2)
+        out1 = f(xp, w1, w2)
+    np.testing.assert_allclose(np.asarray(out1._value), oracle(xp),
+                               rtol=1e-5)
+    out2 = f(xp, w1, w2)           # compiles the specialization (py=3)
+    np.testing.assert_allclose(np.asarray(out2._value), oracle(xp),
+                               rtol=1e-5)
+    out3 = f(xp, w1, w2)           # pure compiled dispatch: NO python run
+    np.testing.assert_allclose(np.asarray(out3._value), oracle(xp),
+                               rtol=1e-5)
+    assert calls["py"] == 3, calls  # the branch ran compiled on call 3
+
+    # branch flip: guard mismatch -> eager re-ground-truth -> new variant
+    xn = paddle.to_tensor((-rng.rand(2, 4)).astype(np.float32))
+    outn = f(xn, w1, w2)           # mismatch + record (py=4)
+    np.testing.assert_allclose(np.asarray(outn._value), oracle(xn),
+                               rtol=1e-5)
+    outn2 = f(xn, w1, w2)          # new specialization traced (py=5)
+    outn3 = f(xn, w1, w2)          # compiled again: flat counter
+    np.testing.assert_allclose(np.asarray(outn3._value), oracle(xn),
+                               rtol=1e-5)
+    assert calls["py"] == 5, calls
+
+    # gradients flow through the speculative compiled program
+    xg = paddle.to_tensor(rng.rand(2, 4).astype(np.float32),
+                          stop_gradient=False)
+    out = f(xg, w1, w2)
+    out.sum().backward()
+    expect = (2.0 * np.asarray(w1._value) @ np.asarray(w2._value)).sum(1)
+    np.testing.assert_allclose(np.asarray(xg.grad._value),
+                               np.broadcast_to(expect, (2, 4)), rtol=1e-5)
+
+
+def test_speculation_mismatch_does_not_corrupt_buffers():
+    """A mis-speculated compiled run must leave NO buffer state behind
+    (code-review r4): running stats must track the pure-eager twin exactly
+    through branch flips."""
+    import warnings
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.jit import to_static
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.bn = nn.BatchNorm1D(4)
+
+        def forward(self, x):
+            h = self.bn(x)
+            if float(h.sum()) > 0:  # data-dependent branch
+                return h * 2.0
+            return h - 1.0
+
+    paddle.seed(0)
+    guarded_net = Net()
+    eager_net = Net()
+    eager_net.set_state_dict(guarded_net.state_dict())
+    guarded = to_static(guarded_net, full_graph=False)
+
+    rng = np.random.RandomState(0)
+    xs = [rng.rand(8, 4).astype(np.float32) + 2.0,     # branch True
+          rng.rand(8, 4).astype(np.float32) + 2.0,     # compiles variant
+          -rng.rand(8, 4).astype(np.float32) - 2.0,    # flip: mis-speculate
+          -rng.rand(8, 4).astype(np.float32) - 2.0]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for x in xs:
+            o1 = guarded(paddle.to_tensor(x))
+            o2 = eager_net(paddle.to_tensor(x))
+            np.testing.assert_allclose(np.asarray(o1._value),
+                                       np.asarray(o2._value), rtol=1e-5)
+            np.testing.assert_allclose(
+                np.asarray(guarded_net.bn._mean._value),
+                np.asarray(eager_net.bn._mean._value), rtol=1e-6,
+                err_msg="running mean diverged from the eager twin")
 
 
 def test_fn_mode_trace_does_not_leak_tracers_into_buffers():
@@ -326,13 +443,13 @@ def test_graph_break_is_per_signature():
     np.testing.assert_allclose(np.asarray(out_b._value), 2.0 * np.ones((2, 2)))
     out_t = f(x, "plain")                  # different signature: compiled
     np.testing.assert_allclose(np.asarray(out_t._value), 2.0 * np.ones((2, 2)))
-    # the broken signature stays eager; the good one stays compiled
+    # the broken signature goes guarded; the good one stays plain-compiled
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         f(x, "branchy")
     assert calls["eager"] >= 2
-    assert len(f._eager_keys) == 1
-    assert len(f._compiled) == 1
+    assert len(f._guarded) == 1
+    assert len(f._compiled) >= 1  # the plain signature kept its program
 
 
 def test_function_mode_to_static_trains_closure_layers():

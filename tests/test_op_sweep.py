@@ -1072,6 +1072,8 @@ EXEMPT = {
     "_rnn_scan": "internal RNN kernel (tests/test_nn_layers.py)",
     "moe_dispatch": "MoE kernel (tests/test_fleet.py)",
     "moe_combine": "MoE kernel (tests/test_fleet.py)",
+    "moe_ep_forward": "shard_map EP exchange, needs a mesh "
+                      "(tests/test_fleet.py ep==replicated + HLO audit)",
     "_moe_expert_mm": "MoE kernel (tests/test_fleet.py)",
 }
 
