@@ -19,6 +19,7 @@ from .mp_layers import (  # noqa: F401
 from .pipeline import (  # noqa: F401
     CrossMeshPipelineParallel,
     ZeroBubblePipelineParallel,
+    interleaved_1f1b_schedule,
     one_f_one_b_schedule,
     zero_bubble_schedule,
     LayerDesc,
@@ -42,6 +43,7 @@ __all__ = [
     "LayerDesc", "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
     "spmd_pipeline", "spmd_pipeline_vpp", "ZeroBubblePipelineParallel",
     "CrossMeshPipelineParallel", "one_f_one_b_schedule",
+    "interleaved_1f1b_schedule",
     "zero_bubble_schedule", "group_sharded_parallel", "ShardedOptimizer",
     "MoELayer", "NaiveGate", "SwitchGate", "StackedExpertsFFN",
 ]

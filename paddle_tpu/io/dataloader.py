@@ -236,8 +236,8 @@ class DataLoader:
 
         pending = {}
         next_to_yield = 0
-        deadline = (time.time() + self.timeout) if self.timeout else None
-        try:
+        last_progress = time.time()  # per-BATCH wait clock, like the
+        try:                         # thread path's out_q.get(timeout=...)
             while next_to_yield < len(batches):
                 while next_to_yield not in pending:
                     try:
@@ -250,7 +250,8 @@ class DataLoader:
                             raise RuntimeError(
                                 f"DataLoader worker process(es) {dead} died "
                                 "unexpectedly (killed/crashed)")
-                        if deadline is not None and time.time() > deadline:
+                        if (self.timeout
+                                and time.time() - last_progress > self.timeout):
                             raise RuntimeError(
                                 "DataLoader timed out waiting for workers")
                         continue
@@ -258,6 +259,7 @@ class DataLoader:
                         raise RuntimeError(
                             f"DataLoader worker failed: {err}")
                     pending[i] = samples
+                    last_progress = time.time()
                 yield self.collate_fn(pending.pop(next_to_yield))
                 next_to_yield += 1
                 if next_to_submit < len(batches):

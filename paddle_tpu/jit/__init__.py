@@ -241,7 +241,6 @@ class StaticFunction:
         # from the compiled program.
         self._full_graph = bool(full_graph)
         self._guarded: dict = {}   # sig key -> {"last": [outcomes] | None}
-        self._eager_keys: set = set()  # legacy introspection (now unused)
 
     def _get_compiled(self, key, tree, static_leaves, n_leaves,
                       outcomes=None):
@@ -264,11 +263,11 @@ class StaticFunction:
                 return out, new_bufs, []
             # speculation replay: concretizations bake the recorded
             # outcomes; their source tensors ride out as guard predicates
-            # (f32 so the vjp cotangent story stays uniform)
+            # in their ORIGINAL dtypes (an f32 round-trip would alias
+            # integer guards >= 2^24)
             with _spec.replaying(outcomes) as rs:
                 out, new_bufs = functional(params, buffers, a, kw, rng_key)
-                preds = [jnp.asarray(p).astype(jnp.float32)
-                         for p in rs.preds]
+                preds = [jnp.asarray(p) for p in rs.preds]
             return out, new_bufs, preds
 
         fn = jax.jit(pure)
@@ -449,7 +448,12 @@ class StaticFunction:
         param_names = list(diff_params)
         out_shapes = [(v.shape, v.dtype) for v in out_flat]
         zero_buf_cot = jax.tree_util.tree_map(jnp.zeros_like, new_buffers)
-        zero_pred_cot = [jnp.zeros_like(p) for p in preds]
+        # integer/bool predicates take float0 cotangents (jax's symbolic
+        # zero for non-differentiable outputs)
+        zero_pred_cot = [
+            jnp.zeros_like(p) if jnp.issubdtype(p.dtype, jnp.inexact)
+            else np.zeros(p.shape, jax.dtypes.float0) for p in preds
+        ]
 
         def backward_fn(grad_outputs, _vjp=vjp_fn):
             gflat = [

@@ -1,11 +1,11 @@
-"""text.datasets — Imikolov, Imdb, UCIHousing, Movielens.
+"""text.datasets — Imikolov, Imdb, UCIHousing, Movielens, Conll05st,
+WMT14, WMT16.
 
-Analogs of /root/reference/python/paddle/text/datasets/{imikolov,imdb,
-uci_housing,movielens}.py. Zero network egress here, so ``download=True``
-raises and the parsers read the reference's standard on-disk formats from
-``data_file`` (PTB tarball / aclImdb tarball / housing data / ml-1m zip
-or extracted dirs). Conll05 and WMT14/16 (licensed corpora behind
-download endpoints) are not shipped.
+Analogs of /root/reference/python/paddle/text/datasets/. Zero network
+egress here, so ``download=True`` raises and the parsers read the
+reference's standard on-disk formats from ``data_file`` (PTB tarball /
+aclImdb tarball / housing data / ml-1m zip / conll05st release tar /
+wmt14 tgz / wmt16 tar, or extracted dirs where noted).
 """
 from __future__ import annotations
 
@@ -18,7 +18,8 @@ import numpy as np
 
 from ..io import Dataset
 
-__all__ = ["Imikolov", "Imdb", "UCIHousing", "Movielens", "Conll05st"]
+__all__ = ["Imikolov", "Imdb", "UCIHousing", "Movielens", "Conll05st",
+           "WMT14", "WMT16"]
 
 
 def _no_download(download):
@@ -27,6 +28,16 @@ def _no_download(download):
             "this environment has no network egress; place the dataset "
             "archive locally and pass data_file=/path (download=False)"
         )
+
+
+def _require_file(value, download, what="data_file"):
+    """These corpora are never auto-downloadable here: raise the no-egress
+    error for download=True, else demand the explicit path."""
+    if value is None:
+        if download:
+            _no_download(True)
+        raise ValueError(f"{what} is required")
+    return value
 
 
 class Imikolov(Dataset):
@@ -299,15 +310,11 @@ class Conll05st(Dataset):
     def __init__(self, data_file=None, word_dict_file=None,
                  verb_dict_file=None, target_dict_file=None, emb_file=None,
                  download=False):
-        for f in (data_file, word_dict_file, verb_dict_file,
-                  target_dict_file):
-            if f is None:
-                if download:
-                    raise RuntimeError(
-                        "this environment has no network egress; place the "
-                        "conll05st files locally and pass explicit paths "
-                        "(download=False)")
-                raise ValueError("data/word/verb/target files are required")
+        for name, f in (("data_file", data_file),
+                        ("word_dict_file", word_dict_file),
+                        ("verb_dict_file", verb_dict_file),
+                        ("target_dict_file", target_dict_file)):
+            _require_file(f, download, name)
         self.word_dict = self._load_dict(word_dict_file)
         self.predicate_dict = self._load_dict(verb_dict_file)
         self.label_dict = self._load_dict(target_dict_file)
@@ -415,3 +422,149 @@ class Conll05st(Dataset):
         if self._emb_file is None:
             raise ValueError("emb_file was not provided")
         return np.loadtxt(self._emb_file)
+
+
+class WMT14(Dataset):
+    """WMT14 en→fr subset (reference python/paddle/text/datasets/wmt14.py):
+    ``data_file`` is the wmt14 tgz holding ``*src.dict``/``*trg.dict``
+    (one token per line, first ``dict_size`` kept) and ``{mode}/{mode}``
+    tab-separated parallel text. Items are (src_ids, trg_ids,
+    trg_ids_next) with <s>/<e> framing; pairs longer than 80 tokens are
+    dropped, like the reference."""
+
+    START, END, UNK = "<s>", "<e>", "<unk>"
+    UNK_IDX = 2
+    MAX_LEN = 80
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=False):
+        if mode not in ("train", "test", "gen"):
+            raise AssertionError(
+                f"mode should be 'train', 'test' or 'gen', but got {mode}")
+        _require_file(data_file, download)
+        self.mode = mode
+        self.dict_size = dict_size if dict_size > 0 else 2 ** 31
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        self._load(data_file)
+
+    def _read_dict(self, tf, suffix):
+        names = [m.name for m in tf.getmembers()
+                 if m.name.endswith(suffix)]
+        assert len(names) == 1, (suffix, names)
+        out = {}
+        for i, ln in enumerate(tf.extractfile(names[0])):
+            if i >= self.dict_size:
+                break
+            out[ln.strip().decode()] = i
+        return out
+
+    def _load(self, data_file):
+        with tarfile.open(data_file) as tf:
+            self.src_dict = self._read_dict(tf, "src.dict")
+            self.trg_dict = self._read_dict(tf, "trg.dict")
+            wanted = f"{self.mode}/{self.mode}"
+            for m in tf.getmembers():
+                if not m.name.endswith(wanted):
+                    continue
+                for line in tf.extractfile(m):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, self.UNK_IDX)
+                           for w in [self.START, *parts[0].split(),
+                                     self.END]]
+                    trg_words = parts[1].split()
+                    trg = [self.trg_dict.get(w, self.UNK_IDX)
+                           for w in trg_words]
+                    if len(src) > self.MAX_LEN or len(trg) > self.MAX_LEN:
+                        continue
+                    self.src_ids.append(src)
+                    self.trg_ids.append([self.trg_dict[self.START], *trg])
+                    self.trg_ids_next.append([*trg, self.trg_dict[self.END]])
+
+    def __getitem__(self, idx):
+        return (np.asarray(self.src_ids[idx]),
+                np.asarray(self.trg_ids[idx]),
+                np.asarray(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, reverse=False):
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict
+
+
+class WMT16(Dataset):
+    """WMT16 en↔de (reference python/paddle/text/datasets/wmt16.py):
+    ``data_file`` is the wmt16 tar with ``wmt16/{train,test,val}``
+    tab-separated ``en\\tde`` pairs. Vocabularies are built from the
+    train split by frequency (top ``*_dict_size`` incl. <s>/<e>/<unk>),
+    as the reference does on first use. ``lang`` picks the source side."""
+
+    START, END, UNK = "<s>", "<e>", "<unk>"
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=False):
+        if mode not in ("train", "test", "val"):
+            raise AssertionError(
+                f"mode should be 'train', 'test' or 'val', but got {mode}")
+        assert lang in ("en", "de")
+        assert src_dict_size > 0 and trg_dict_size > 0, \
+            "dict_size should be set as positive number"
+        _require_file(data_file, download)
+        self._data_file = data_file
+        self.lang = lang
+        # ONE archive scan serves both vocabularies (and the train split
+        # itself when mode == "train")
+        train_pairs = list(self._pairs("train"))
+        self.src_dict = self._build_dict(train_pairs, src_dict_size,
+                                         src=True)
+        self.trg_dict = self._build_dict(train_pairs, trg_dict_size,
+                                         src=False)
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        self._load(train_pairs if mode == "train" else self._pairs(mode))
+
+    def _pairs(self, split):
+        with tarfile.open(self._data_file) as tf:
+            for line in tf.extractfile(f"wmt16/{split}"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) == 2:
+                    en, de = parts
+                    yield (en, de) if self.lang == "en" else (de, en)
+
+    def _build_dict(self, train_pairs, size, src):
+        from collections import Counter
+
+        counts = Counter()
+        for s, t in train_pairs:
+            counts.update((s if src else t).split())
+        words = [self.START, self.END, self.UNK]
+        words += [w for w, _ in counts.most_common(max(size - 3, 0))]
+        return {w: i for i, w in enumerate(words)}
+
+    def _load(self, pairs):
+        unk_s = self.src_dict[self.UNK]
+        unk_t = self.trg_dict[self.UNK]
+        for s, t in pairs:
+            src = [self.src_dict.get(w, unk_s)
+                   for w in [self.START, *s.split(), self.END]]
+            trg_words = t.split()
+            trg = [self.trg_dict.get(w, unk_t) for w in trg_words]
+            self.src_ids.append(src)
+            self.trg_ids.append([self.trg_dict[self.START], *trg])
+            self.trg_ids_next.append([*trg, self.trg_dict[self.END]])
+
+    def __getitem__(self, idx):
+        return (np.asarray(self.src_ids[idx]),
+                np.asarray(self.trg_ids[idx]),
+                np.asarray(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+    def get_dict(self, lang=None, reverse=False):
+        d = self.src_dict if (lang or self.lang) == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d

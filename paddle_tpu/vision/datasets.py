@@ -213,6 +213,11 @@ class Flowers(Dataset):
             raise AssertionError(
                 f"mode should be 'train', 'valid' or 'test', but got {mode}")
         _no_download(download and data_file is None)
+        for name, f in (("data_file", data_file), ("label_file", label_file),
+                        ("setid_file", setid_file)):
+            if f is None:
+                raise ValueError(f"{name} is required (download=True is "
+                                 "unavailable: no network egress)")
         if backend not in ("pil", "cv2"):
             raise ValueError(f"backend must be pil or cv2, got {backend}")
         import scipy.io as scio
@@ -259,6 +264,9 @@ class VOC2012(Dataset):
             raise AssertionError(
                 f"mode should be 'train', 'valid' or 'test', but got {mode}")
         _no_download(download and data_file is None)
+        if data_file is None:
+            raise ValueError("data_file is required (download=True is "
+                             "unavailable: no network egress)")
         if backend not in ("pil", "cv2"):
             raise ValueError(f"backend must be pil or cv2, got {backend}")
         self.backend = backend

