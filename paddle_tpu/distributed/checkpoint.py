@@ -92,11 +92,14 @@ def save_state_dict(state_dict, path, process_group=None,
                 })
             if entry["shards"]:
                 meta["tensors"][key] = entry
-        elif _is_jax_array(v):
-            # 0-d mesh-placed scalar (loss scale, step counter): under
-            # true multi-host the global array is not fully addressable,
-            # so never np.asarray it — the lowest-rank owner reads its
-            # local replica shard and writes
+        elif _is_jax_array(v) and getattr(v, "committed", False):
+            # 0-d scalar COMMITTED to a mesh (loss scale, step counter):
+            # np.asarray could throw under multi-host — the lowest-rank
+            # owner reads its local replica shard and writes. The
+            # `committed` flag is the same on every rank (SPMD placement
+            # code), unlike is_fully_addressable, so all ranks agree on
+            # the branch; host-created scalars (committed=False) take the
+            # coordinator branch below. Exactly one writer either way.
             owners = {d.process_index for d in v.sharding.device_set}
             if rank == min(owners):
                 arr = np.asarray(v.addressable_shards[0].data)

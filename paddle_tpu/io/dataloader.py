@@ -236,9 +236,12 @@ class DataLoader:
 
         pending = {}
         next_to_yield = 0
-        last_progress = time.time()  # per-BATCH wait clock, like the
-        try:                         # thread path's out_q.get(timeout=...)
+        try:
             while next_to_yield < len(batches):
+                # per-WAIT clock (the thread path's fresh
+                # out_q.get(timeout=...)): consumer time between yields
+                # must not count against the workers
+                last_progress = time.time()
                 while next_to_yield not in pending:
                     try:
                         # poll so a worker killed mid-decode (OOM/segfault)

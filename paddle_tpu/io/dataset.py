@@ -148,3 +148,24 @@ def random_split(dataset, lengths, generator=None):
         out.append(Subset(dataset, perm[off:off + l]))
         off += l
     return out
+
+
+def _no_download(download):
+    """Shared no-egress guard for dataset auto-download requests."""
+    if download:
+        raise RuntimeError(
+            "this environment has no network egress; place the dataset "
+            "archive locally and pass data_file=/path (download=False)"
+        )
+
+
+def _require_file(value, download, what="data_file"):
+    """Datasets that can never auto-download here: raise the no-egress
+    error for download=True, else demand the explicit path."""
+    if value is None:
+        if download:
+            _no_download(True)
+        raise ValueError(
+            f"{what} is required (download=True is unavailable: no "
+            "network egress)")
+    return value

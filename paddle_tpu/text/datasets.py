@@ -22,22 +22,7 @@ __all__ = ["Imikolov", "Imdb", "UCIHousing", "Movielens", "Conll05st",
            "WMT14", "WMT16"]
 
 
-def _no_download(download):
-    if download:
-        raise RuntimeError(
-            "this environment has no network egress; place the dataset "
-            "archive locally and pass data_file=/path (download=False)"
-        )
-
-
-def _require_file(value, download, what="data_file"):
-    """These corpora are never auto-downloadable here: raise the no-egress
-    error for download=True, else demand the explicit path."""
-    if value is None:
-        if download:
-            _no_download(True)
-        raise ValueError(f"{what} is required")
-    return value
+from ..io.dataset import _no_download, _require_file  # shared guards
 
 
 class Imikolov(Dataset):

@@ -212,12 +212,11 @@ class Flowers(Dataset):
         if mode not in self._MODE_KEYS:
             raise AssertionError(
                 f"mode should be 'train', 'valid' or 'test', but got {mode}")
-        _no_download(download and data_file is None)
+        from ..io.dataset import _require_file
+
         for name, f in (("data_file", data_file), ("label_file", label_file),
                         ("setid_file", setid_file)):
-            if f is None:
-                raise ValueError(f"{name} is required (download=True is "
-                                 "unavailable: no network egress)")
+            _require_file(f, download, name)
         if backend not in ("pil", "cv2"):
             raise ValueError(f"backend must be pil or cv2, got {backend}")
         import scipy.io as scio
@@ -263,10 +262,9 @@ class VOC2012(Dataset):
         if mode not in self._MODES:
             raise AssertionError(
                 f"mode should be 'train', 'valid' or 'test', but got {mode}")
-        _no_download(download and data_file is None)
-        if data_file is None:
-            raise ValueError("data_file is required (download=True is "
-                             "unavailable: no network egress)")
+        from ..io.dataset import _require_file
+
+        _require_file(data_file, download)
         if backend not in ("pil", "cv2"):
             raise ValueError(f"backend must be pil or cv2, got {backend}")
         self.backend = backend

@@ -67,8 +67,13 @@ WORKER = textwrap.dedent("""
         paddle.to_tensor(
             np.arange(n_dev * 16, dtype=np.float32).reshape(n_dev, 16)),
         mesh, [dist.Shard(0)])
-    dist.save_state_dict({"w": w, "step": paddle.to_tensor(np.int64(7))},
-                         ckpt)
+    # a 0-d scalar COMMITTED to the global mesh (loss scale): on a real
+    # pod np.asarray would throw — the owner's replica shard is written
+    scale = dist.shard_tensor(paddle.to_tensor(np.float32(2.5)), mesh,
+                              [dist.Replicate()])
+    assert not scale._value.is_fully_addressable
+    dist.save_state_dict({"w": w, "step": paddle.to_tensor(np.int64(7)),
+                          "scale": scale}, ckpt)
     # barrier via the jax collective runtime: both ranks' files must exist
     from jax.experimental import multihost_utils
 
@@ -77,11 +82,13 @@ WORKER = textwrap.dedent("""
         paddle.to_tensor(np.zeros((n_dev, 16), np.float32)), mesh,
         [dist.Shard(1)])  # different placement than saved
     got = dist.load_state_dict(
-        {"w": target, "step": paddle.to_tensor(np.int64(0))}, ckpt)
+        {"w": target, "step": paddle.to_tensor(np.int64(0)),
+         "scale": paddle.to_tensor(np.float32(0.0))}, ckpt)
     expect = np.arange(n_dev * 16, dtype=np.float32).reshape(n_dev, 16)
     for sh in target._value.addressable_shards:  # global fetch would throw
         np.testing.assert_array_equal(np.asarray(sh.data), expect[sh.index])
     assert int(got["step"]._value) == 7
+    assert float(got["scale"]._value) == 2.5
 
     print(f"rank={rank}/{world} ndev={n_dev} ok loss {l0:.4f}->{l1:.4f}",
           flush=True)
@@ -183,6 +190,11 @@ WORKER2 = textwrap.dedent("""
         time.sleep(0.3)
         status, world = mgr.scale_plan()
         assert status == ElasticStatus.HOLD and world == 2, (status, world)
+        # both ranks must finish the HOLD check before rank 0 announces a
+        # joiner (otherwise the follower can observe the scale-out early)
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("elastic_hold_checked")
         if rank == 0:
             # a new host volunteers; the lead commits the scale-out
             joiner = ElasticManager(store=store, rank=99, world_size=2,
@@ -205,8 +217,6 @@ WORKER2 = textwrap.dedent("""
         # exit barrier: rank 0 hosts the coordination service AND the
         # elastic master store — leaving early would kill the peer's jax
         # client (and store) mid-poll
-        from jax.experimental import multihost_utils
-
         multihost_utils.sync_global_devices("elastic_done")
         mgr.stop()
         store.close()
