@@ -20,7 +20,7 @@ import numpy as np
 from ..io import Dataset
 
 __all__ = ["Cifar10", "Cifar100", "MNIST", "FashionMNIST", "FakeData",
-           "Flowers", "VOC2012"]
+           "Flowers", "VOC2012", "DatasetFolder", "ImageFolder"]
 
 
 def _no_download(download):
@@ -292,3 +292,105 @@ class VOC2012(Dataset):
 
     def __len__(self):
         return len(self.names)
+
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp")
+
+
+def pil_loader(path):
+    from PIL import Image
+
+    with open(path, "rb") as f:
+        return Image.open(f).convert("RGB")
+
+
+def cv2_loader(path):
+    # no cv2 in this environment: decode via PIL, return the ndarray in
+    # the cv2 BGR channel convention this loader emulates
+    return np.asarray(pil_loader(path))[:, :, ::-1]
+
+
+def default_loader(path):
+    return pil_loader(path)
+
+
+def _valid_predicate(extensions, is_valid_file):
+    if extensions is not None and is_valid_file is not None:
+        raise ValueError("extensions and is_valid_file cannot both be passed")
+    if is_valid_file is not None:
+        return is_valid_file
+    exts = tuple(e.lower() for e in (extensions or IMG_EXTENSIONS))
+    return lambda p: p.lower().endswith(exts)
+
+
+def _walk_files(root, valid):
+    """Deterministic recursive file listing (symlinked dirs followed,
+    reference folder.py make_dataset semantics)."""
+    out = []
+    for base, _, files in sorted(os.walk(root, followlinks=True)):
+        for fname in sorted(files):
+            path = os.path.join(base, fname)
+            if valid(path):
+                out.append(path)
+    return out
+
+
+class DatasetFolder(Dataset):
+    """Generic ``root/class_x/*.ext`` classification loader (reference
+    python/paddle/vision/datasets/folder.py DatasetFolder): classes =
+    sorted subdirectory names, items are (sample, class_index)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or default_loader
+        self.transform = transform
+        valid = _valid_predicate(extensions, is_valid_file)
+        self.classes = sorted(
+            d for d in os.listdir(root)
+            if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.samples = [
+            (path, self.class_to_idx[c])
+            for c in self.classes
+            for path in _walk_files(os.path.join(root, c), valid)
+        ]
+        if not self.samples:
+            raise RuntimeError(
+                f"found 0 valid files in subfolders of {root}")
+        self.targets = [t for _, t in self.samples]
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Unlabeled recursive image loader (reference folder.py ImageFolder):
+    items are [sample] lists, every image under ``root`` in walk order."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or default_loader
+        self.transform = transform
+        self.samples = _walk_files(
+            root, _valid_predicate(extensions, is_valid_file))
+        if not self.samples:
+            raise RuntimeError(f"found 0 valid files in {root}")
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

@@ -263,3 +263,44 @@ def test_process_workers_propagate_errors():
                         use_process_workers=True)
     with pytest.raises(RuntimeError, match="bad sample"):
         list(loader)
+
+
+def test_dataset_and_image_folder(tmp_path):
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+
+    for cls, n in (("cats", 3), ("dogs", 2)):
+        d = tmp_path / "root" / cls
+        d.mkdir(parents=True)
+        for i in range(n):
+            (d / f"{i}.jpg").write_bytes(_jpg_bytes(seed=i))
+        (d / "notes.txt").write_text("not an image")
+
+    ds = DatasetFolder(str(tmp_path / "root"))
+    assert ds.classes == ["cats", "dogs"]
+    assert len(ds) == 5 and ds.targets.count(0) == 3
+    img, target = ds[0]
+    assert target == 0 and np.asarray(img).shape == (16, 16, 3)
+    # custom valid-file predicate
+    only_txt = DatasetFolder(str(tmp_path / "root"),
+                             loader=lambda p: open(p).read(),
+                             is_valid_file=lambda p: p.endswith(".txt"))
+    assert len(only_txt) == 2
+
+    flat = ImageFolder(str(tmp_path / "root"),
+                       transform=lambda im: np.asarray(im).mean())
+    assert len(flat) == 5
+    assert isinstance(flat[0], list) and np.isscalar(flat[0][0])
+
+
+def test_dataset_namespace_parity_with_reference():
+    """The vision/text dataset namespaces now cover the reference's
+    __all__ (FakeData is a deliberate extra)."""
+    import paddle_tpu.text.datasets as td
+    import paddle_tpu.vision.datasets as vd
+
+    ref_vision = {"DatasetFolder", "ImageFolder", "MNIST", "FashionMNIST",
+                  "Flowers", "Cifar10", "Cifar100", "VOC2012"}
+    ref_text = {"Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+                "WMT14", "WMT16"}
+    assert ref_vision <= set(vd.__all__), ref_vision - set(vd.__all__)
+    assert ref_text <= set(td.__all__), ref_text - set(td.__all__)
