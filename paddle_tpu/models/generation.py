@@ -202,6 +202,13 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     cfg = model.config
     kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
     max_len = s + max_new_tokens
+    maxp = getattr(cfg, "max_position_embeddings", None)
+    if maxp is not None and max_len > maxp:
+        # beyond the position table the gather would silently clamp
+        # (repeating the last learned position / rope row) — refuse loudly
+        raise ValueError(
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) = {max_len} "
+            f"exceeds max_position_embeddings ({maxp})")
     from .llama import PagedKVCache, StaticCache
 
     # cache in the model's compute dtype (bf16 models keep a bf16 KV cache)

@@ -63,10 +63,18 @@ class GPTAttention(Layer):
         self.head_dim = d
         self.dropout_p = config.attention_probs_dropout_prob
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
         b, s, _ = x.shape
         qkv = reshape(self.qkv_proj(x), [b, s, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cache is not None:
+            # Static/Paged decode cache (shared with the LLaMA path and
+            # the compiled generate() decode loop)
+            from .llama import cached_attention
+
+            out = cached_attention(q, k, v, cache, cache.length, s)
+            return (self.out_proj(reshape(
+                out, [b, s, self.num_heads * self.head_dim])), cache)
         out = scaled_dot_product_attention(
             q, k, v, is_causal=True,
             dropout_p=self.dropout_p if self.training else 0.0,
@@ -87,9 +95,15 @@ class GPTBlock(Layer):
                              weight_attr=init)
         self.dropout = Dropout(config.hidden_dropout_prob)
 
-    def forward(self, x):
-        x = x + self.dropout(self.attn(self.ln_1(x)))
-        return x + self.dropout(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
+    def forward(self, x, cache=None):
+        attn_out = self.attn(self.ln_1(x), cache=cache)
+        if cache is not None:
+            attn_out, cache = attn_out
+        x = x + self.dropout(attn_out)
+        x = x + self.dropout(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
+        if cache is not None:
+            return x, cache
+        return x
 
 
 class GPTModel(Layer):
@@ -107,14 +121,24 @@ class GPTModel(Layer):
         self.ln_f = LayerNorm(config.hidden_size,
                               epsilon=config.layer_norm_epsilon)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None):
         b, s = input_ids.shape
         import jax.numpy as jnp
 
-        pos = Tensor._from_value(jnp.arange(s)[None, :])
+        # decode offset from the cache fill level; may be a traced scalar
+        # under the compiled decode loop
+        offset = caches[0].length if caches is not None else 0
+        pos = Tensor._from_value(jnp.arange(s)[None, :] + offset)
         x = self.drop(self.wte(input_ids) + self.wpe(pos))
-        for block in self.h:
-            x = block(x)
+        new_caches = [] if caches is not None else None
+        for i, block in enumerate(self.h):
+            if caches is not None:
+                x, c = block(x, cache=caches[i])
+                new_caches.append(c)
+            else:
+                x = block(x)
+        if caches is not None:
+            return self.ln_f(x), new_caches
         return self.ln_f(x)
 
 
@@ -130,11 +154,16 @@ class GPTForCausalLM(Layer):
                                   weight_attr=I.Normal(0.0, config.initializer_range),
                                   bias_attr=False)
 
-    def forward(self, input_ids):
-        hidden = self.gpt(input_ids)
+    def forward(self, input_ids, caches=None):
+        out = self.gpt(input_ids, caches=caches)
+        hidden = out[0] if caches is not None else out
         if self.lm_head is None:
-            return matmul(hidden, self.gpt.wte.weight, transpose_y=True)
-        return self.lm_head(hidden)
+            logits = matmul(hidden, self.gpt.wte.weight, transpose_y=True)
+        else:
+            logits = self.lm_head(hidden)
+        if caches is not None:
+            return logits, out[1]
+        return logits
 
 
 class GPTPretrainingCriterion(Layer):

@@ -39,7 +39,7 @@ from ..ops import (
 )
 
 __all__ = [
-    "StaticCache", "PagedKVCache",
+    "StaticCache", "PagedKVCache", "cached_attention",
     "LlamaConfig", "LlamaAttention", "LlamaMLP", "LlamaDecoderLayer",
     "LlamaModel", "LlamaForCausalLM", "LlamaPretrainingCriterion",
     "LlamaEmbeddingPipe", "LlamaHeadPipe", "llama_pipeline_module",
@@ -171,6 +171,57 @@ class PagedKVCache:
         self.length += s
 
 
+def cached_attention(q, k, v, cache, offset, s):
+    """Attention over a pre-allocated Static/Paged cache — shared by the
+    LLaMA and GPT decode paths. Decode steps (s=1) run the Pallas
+    paged/masked decode kernel (ops/pallas/decode_attention.py — the
+    analogs of block_multi_head_attention / masked_multihead_attention);
+    prefill and the CPU fallback use the masked XLA composition. ``offset``
+    may be a traced scalar (the compiled decode loop)."""
+    from ..core.flags import flag as _flag
+    from ..ops.pallas.decode_attention import (
+        masked_decode_attention, paged_attention,
+        paged_attention_supported,
+    )
+
+    paged = isinstance(cache, PagedKVCache)
+    cache.update(k._value, v._value)
+    use_kernel = (s == 1 and _flag("FLAGS_use_pallas_kernels")
+                  and paged_attention_supported(
+                      q._value[:, 0],
+                      cache.k_pages if paged else cache.k))
+    lengths = jnp.full((q.shape[0],), cache.length, jnp.int32)
+    if paged:
+        if s == 1 and use_kernel:
+            out = paged_attention(
+                q._value[:, 0], cache.k_pages, cache.v_pages,
+                cache.tables, lengths)
+            return Tensor._from_value(out[:, None])
+        if s > 1 and offset == 0:  # static s first: offset may be traced
+            # prefill: the new tokens attend only among themselves —
+            # plain causal attention while the pages fill
+            return scaled_dot_product_attention(q, k, v, is_causal=True)
+        # jnp fallback (kernel off/unsupported): gather the pages back
+        # into the contiguous layout and run the masked composition
+        k_all = cache.k_pages[cache.tables].reshape(
+            q.shape[0], -1, *cache.k_pages.shape[2:])
+        v_all = cache.v_pages[cache.tables].reshape(
+            q.shape[0], -1, *cache.v_pages.shape[2:])
+    else:
+        k_all, v_all = cache.k, cache.v
+    if not paged and s == 1 and use_kernel:
+        out = masked_decode_attention(
+            q._value[:, 0], k_all, v_all, lengths)
+        return Tensor._from_value(out[:, None])
+    max_len = k_all.shape[1]
+    rows = jnp.arange(s)[:, None] + offset
+    cols = jnp.arange(max_len)[None, :]
+    mask = (cols <= rows)[None, None, :, :]  # causal over valid cells
+    return scaled_dot_product_attention(
+        q, Tensor._from_value(k_all), Tensor._from_value(v_all),
+        attn_mask=Tensor._from_value(mask))
+
+
 def _rope_tables(head_dim, max_pos, theta, dtype=jnp.float32):
     inv_freq = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
     t = np.arange(max_pos, dtype=np.float64)
@@ -243,53 +294,7 @@ class LlamaAttention(Layer):
         return out
 
     def _cached_attention(self, q, k, v, cache, offset, s):
-        """Attention over a pre-allocated cache. Decode steps (s=1) run the
-        Pallas paged/masked decode kernel
-        (ops/pallas/decode_attention.py — the analogs of
-        block_multi_head_attention / masked_multihead_attention); prefill
-        and the CPU fallback use the masked XLA composition."""
-        from ..core.flags import flag as _flag
-        from ..ops.pallas.decode_attention import (
-            masked_decode_attention, paged_attention,
-            paged_attention_supported,
-        )
-
-        paged = isinstance(cache, PagedKVCache)
-        cache.update(k._value, v._value)
-        use_kernel = (s == 1 and _flag("FLAGS_use_pallas_kernels")
-                      and paged_attention_supported(
-                          q._value[:, 0],
-                          cache.k_pages if paged else cache.k))
-        lengths = jnp.full((q.shape[0],), cache.length, jnp.int32)
-        if paged:
-            if s == 1 and use_kernel:
-                out = paged_attention(
-                    q._value[:, 0], cache.k_pages, cache.v_pages,
-                    cache.tables, lengths)
-                return Tensor._from_value(out[:, None])
-            if s > 1 and offset == 0:  # static s first: offset may be traced
-                # prefill: the new tokens attend only among themselves —
-                # plain causal attention while the pages fill
-                return scaled_dot_product_attention(q, k, v, is_causal=True)
-            # jnp fallback (kernel off/unsupported): gather the pages back
-            # into the contiguous layout and run the masked composition
-            k_all = cache.k_pages[cache.tables].reshape(
-                q.shape[0], -1, *cache.k_pages.shape[2:])
-            v_all = cache.v_pages[cache.tables].reshape(
-                q.shape[0], -1, *cache.v_pages.shape[2:])
-        else:
-            k_all, v_all = cache.k, cache.v
-        if not paged and s == 1 and use_kernel:
-            out = masked_decode_attention(
-                q._value[:, 0], k_all, v_all, lengths)
-            return Tensor._from_value(out[:, None])
-        max_len = k_all.shape[1]
-        rows = jnp.arange(s)[:, None] + offset
-        cols = jnp.arange(max_len)[None, :]
-        mask = (cols <= rows)[None, None, :, :]  # causal over valid cells
-        return scaled_dot_product_attention(
-            q, Tensor._from_value(k_all), Tensor._from_value(v_all),
-            attn_mask=Tensor._from_value(mask))
+        return cached_attention(q, k, v, cache, offset, s)
 
 
 class LlamaMLP(Layer):

@@ -105,3 +105,38 @@ def test_generate_sampling_and_eos():
     assert out.shape[1] == 10
     out2 = generate(model, ids, max_new_tokens=6, do_sample=True, top_p=0.9)
     assert out2.shape[1] == 10
+
+
+def test_gpt_generate_matches_full_forward():
+    """GPT decode caches (round 4): compiled generate() on GPTForCausalLM
+    must pick the same tokens as full-sequence recompute, static AND paged
+    caches."""
+    from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny_config
+
+    paddle.seed(0)
+    model = GPTForCausalLM(gpt_tiny_config()).eval()
+    ids = np.random.randint(0, 256, (2, 8))
+
+    cur = ids.copy()
+    for _ in range(5):
+        logits = model(paddle.to_tensor(cur))
+        nxt = np.asarray(logits._value)[:, -1, :].argmax(-1)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+
+    for kind in ("static", "paged"):
+        out = generate(model, paddle.to_tensor(ids), max_new_tokens=5,
+                       cache=kind)
+        np.testing.assert_array_equal(np.asarray(out._value), cur,
+                                      err_msg=f"cache={kind}")
+        eager = generate(model, paddle.to_tensor(ids), max_new_tokens=5,
+                         cache=kind, use_jit=False)
+        np.testing.assert_array_equal(np.asarray(eager._value), cur,
+                                      err_msg=f"eager cache={kind}")
+
+
+def test_generate_rejects_overflow_past_position_table():
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny_config()).eval()  # max_pos=128
+    ids = paddle.to_tensor(np.random.randint(0, 256, (1, 100)))
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        generate(model, ids, max_new_tokens=40)
