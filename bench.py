@@ -262,8 +262,12 @@ CAL_ITERS = 2 if SMOKE else 20
 
 @jax.jit
 def stream_reduce(k, v, s0):
+    # abs(x + s) is NOT algebraically factorable (sum(k*s) = s*sum(k)
+    # would let XLA hoist the whole read out of the loop — observed as a
+    # >HBM-peak "floor"), so every iteration must stream the full arrays
     def body(s, _):
-        r = (k.astype(jnp.float32) * s).sum() + (v.astype(jnp.float32) * s).sum()
+        r = (jnp.abs(k.astype(jnp.float32) + s).sum()
+             + jnp.abs(v.astype(jnp.float32) + s).sum())
         return s + r * 1e-30, None
 
     s, _ = jax.lax.scan(body, s0, None, length=CAL_ITERS)

@@ -23,7 +23,9 @@ import numpy as np
 
 BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                              "OPBENCH_BASELINE.json")
-REGRESSION_FACTOR = 1.5
+# run-to-run spread on this tunneled chip measures up to ~2x for
+# bandwidth-bound ops (congestion windows); flag only beyond that
+REGRESSION_FACTOR = 2.5
 
 
 def _op_suite(smoke):

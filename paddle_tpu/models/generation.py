@@ -196,19 +196,21 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     """
     ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
     b, s = ids.shape
-    was_training = getattr(model, "training", False)
-    model.eval()
-
     cfg = model.config
     kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
     max_len = s + max_new_tokens
     maxp = getattr(cfg, "max_position_embeddings", None)
-    if maxp is not None and max_len > maxp:
-        # beyond the position table the gather would silently clamp
-        # (repeating the last learned position / rope row) — refuse loudly
+    # the FINAL sampled token is appended but never fed back, so the
+    # highest embedded position is max_len - 2; beyond the position table
+    # the gather would silently clamp (repeating the last learned
+    # position / rope row) — refuse loudly, BEFORE touching train mode
+    if maxp is not None and max_len - 1 > maxp:
         raise ValueError(
-            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) = {max_len} "
-            f"exceeds max_position_embeddings ({maxp})")
+            f"prompt ({s}) + max_new_tokens ({max_new_tokens}) would embed "
+            f"position {max_len - 2} beyond max_position_embeddings "
+            f"({maxp})")
+    was_training = getattr(model, "training", False)
+    model.eval()
     from .llama import PagedKVCache, StaticCache
 
     # cache in the model's compute dtype (bf16 models keep a bf16 KV cache)

@@ -136,7 +136,15 @@ def test_gpt_generate_matches_full_forward():
 
 def test_generate_rejects_overflow_past_position_table():
     paddle.seed(0)
-    model = LlamaForCausalLM(llama_tiny_config()).eval()  # max_pos=128
+    model = LlamaForCausalLM(llama_tiny_config())  # max_pos=128
+    model.train()
     ids = paddle.to_tensor(np.random.randint(0, 256, (1, 100)))
     with pytest.raises(ValueError, match="max_position_embeddings"):
         generate(model, ids, max_new_tokens=40)
+    assert model.training  # refusal must not leak eval mode
+    model.eval()
+    # prompt exactly at the limit with ONE new token embeds only valid
+    # positions (the sampled token is never fed back) — allowed
+    full = paddle.to_tensor(np.random.randint(0, 256, (1, 128)))
+    out = generate(model, full, max_new_tokens=1)
+    assert out.shape == [1, 129]
