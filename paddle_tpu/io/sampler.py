@@ -17,6 +17,21 @@ __all__ = [
 ]
 
 
+def _framework_rng():
+    """A numpy Generator seeded from the framework RNG stream, so shuffle
+    order follows ``paddle.seed`` (the reference samples its shuffles from
+    the global generator too) instead of fresh OS entropy per epoch.
+    Derived from (root_seed, counter) WITHOUT materializing a jax key —
+    the data pipeline must never initialize the XLA backend (fork safety,
+    multi-controller init ordering; same pattern as geometric's
+    sample_neighbors)."""
+    from ..core import random as _random
+
+    root, counter = _random.get_rng_state()
+    _random._rng.counter += 1
+    return np.random.default_rng((root, counter))
+
+
 class Sampler:
     def __init__(self, data_source=None):
         self.data_source = data_source
@@ -50,7 +65,8 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
-        rng = np.random.default_rng(self.generator)
+        rng = (np.random.default_rng(self.generator)
+               if self.generator is not None else _framework_rng())
         if self.replacement:
             yield from rng.integers(0, n, self.num_samples).tolist()
         else:
@@ -74,7 +90,7 @@ class WeightedRandomSampler(Sampler):
 
     def __iter__(self):
         p = self.weights / self.weights.sum()
-        idx = np.random.choice(
+        idx = _framework_rng().choice(
             len(self.weights), self.num_samples, replace=self.replacement, p=p)
         yield from idx.tolist()
 
@@ -88,7 +104,7 @@ class SubsetRandomSampler(Sampler):
         self.indices = list(indices)
 
     def __iter__(self):
-        yield from np.random.permutation(self.indices).tolist()
+        yield from _framework_rng().permutation(self.indices).tolist()
 
     def __len__(self):
         return len(self.indices)

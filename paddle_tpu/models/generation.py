@@ -201,14 +201,15 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     max_len = s + max_new_tokens
     maxp = getattr(cfg, "max_position_embeddings", None)
     # the FINAL sampled token is appended but never fed back, so the
-    # highest embedded position is max_len - 2; beyond the position table
-    # the gather would silently clamp (repeating the last learned
-    # position / rope row) — refuse loudly, BEFORE touching train mode
-    if maxp is not None and max_len - 1 > maxp:
+    # highest embedded position is max(s, max_len - 1) - 1 (prefill embeds
+    # 0..s-1 even when max_new_tokens == 0); beyond the position table the
+    # gather would silently clamp (repeating the last learned position /
+    # rope row) — refuse loudly, BEFORE touching train mode
+    if maxp is not None and max(s, max_len - 1) > maxp:
         raise ValueError(
             f"prompt ({s}) + max_new_tokens ({max_new_tokens}) would embed "
-            f"position {max_len - 2} beyond max_position_embeddings "
-            f"({maxp})")
+            f"position {max(s, max_len - 1) - 1} beyond "
+            f"max_position_embeddings ({maxp})")
     was_training = getattr(model, "training", False)
     model.eval()
     from .llama import PagedKVCache, StaticCache
