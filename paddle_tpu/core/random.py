@@ -132,3 +132,15 @@ _tracker = RNGStatesTracker()
 
 def get_rng_state_tracker() -> RNGStatesTracker:
     return _tracker
+
+
+def numpy_rng():
+    """A numpy Generator deterministically derived from the framework RNG
+    stream (root seed + per-draw counter) WITHOUT materializing a jax key
+    — safe for data-pipeline / pre-distributed-init call sites. Each call
+    consumes one counter slot, like ``next_key``."""
+    import numpy as np
+
+    state = (_rng.root_seed, _rng.counter)
+    _rng.counter += 1
+    return np.random.default_rng(state)

@@ -467,7 +467,7 @@ def one_f_one_b_schedule(n_stages, n_micro):
     return _build_pipeline_schedule(n_stages, n_micro, split_w=False)
 
 
-def interleaved_1f1b_schedule(n_dev, vpp, n_micro):
+def interleaved_1f1b_schedule(n_dev, vpp, n_micro, split_w=False):
     """Interleaved-VPP 1F1B table over ``n_dev * vpp`` VIRTUAL stages,
     where virtual stage ``s`` runs on device ``s % n_dev`` (the
     round-robin chunk placement of the reference's
@@ -484,11 +484,18 @@ def interleaved_1f1b_schedule(n_dev, vpp, n_micro):
     :func:`one_f_one_b_schedule` over the deep virtual pipeline (asserted
     in tests/test_cross_mesh_pipeline.py), instead of only placing
     chunks.
+
+    ``split_w=True`` emits the ZBH1 dX/dW split
+    (pipeline_zero_bubble.py semantics): 'B' is activation-grad only —
+    unblocking the upstream chunk immediately — and the weight-grad 'W'
+    is a separate lowest-priority op that soaks otherwise-idle device
+    slots.
     """
     n_virt = n_dev * vpp
     sched = [[] for _ in range(n_virt)]
-    done_f = set()   # (s, m) forwards completed in PREVIOUS ticks
+    done_f = set()   # (s, m) completed in PREVIOUS ticks
     done_b = set()
+    done_w = set()
     inflight = [0] * n_virt
 
     def f_ready(s, m):
@@ -499,9 +506,13 @@ def interleaved_1f1b_schedule(n_dev, vpp, n_micro):
         return ((s, m) in done_f and (s, m) not in done_b
                 and (s == n_virt - 1 or (s + 1, m) in done_b))
 
-    emitted_f, emitted_b = set(), set()
-    max_ticks = 4 * n_virt * n_micro + 8  # progress guard
-    while len(done_b) < n_virt * n_micro:
+    def w_ready(s, m):
+        return (s, m) in done_b and (s, m) not in done_w
+
+    total = n_virt * n_micro * (3 if split_w else 2)
+    emitted = {"F": set(), "B": set(), "W": set()}
+    max_ticks = 6 * n_virt * n_micro + 8  # progress guard
+    while len(done_f) + len(done_b) + len(done_w) < total:
         if len(sched[0]) > max_ticks:
             raise RuntimeError("interleaved schedule failed to make "
                                "progress (scheduler bug)")
@@ -511,7 +522,7 @@ def interleaved_1f1b_schedule(n_dev, vpp, n_micro):
             for k in range(vpp):
                 s = k * n_dev + d
                 for m in range(n_micro):
-                    if (s, m) not in emitted_b and b_ready(s, m):
+                    if (s, m) not in emitted["B"] and b_ready(s, m):
                         # deepest-chunk backward first (drains memory)
                         cand = (0, m // n_dev, -k, m, ("B", s, m))
                         if best is None or cand < best:
@@ -519,15 +530,24 @@ def interleaved_1f1b_schedule(n_dev, vpp, n_micro):
                 if best is not None and best[0] == 0:
                     continue  # a backward is already chosen for this device
                 for m in range(n_micro):
-                    if (s, m) not in emitted_f and f_ready(s, m):
+                    if (s, m) not in emitted["F"] and f_ready(s, m):
                         # interleave: micro-batch GROUPS of n_dev, then chunk
                         cand = (1, m // n_dev, k, m, ("F", s, m))
                         if best is None or cand < best:
                             best = cand
+            if split_w and (best is None or best[0] > 1):
+                # weight-grads fill slots no F/B could use (bubble work)
+                for k in range(vpp):
+                    s = k * n_dev + d
+                    for m in range(n_micro):
+                        if (s, m) not in emitted["W"] and w_ready(s, m):
+                            cand = (2, m // n_dev, k, m, ("W", s, m))
+                            if best is None or cand < best:
+                                best = cand
             if best is not None:
                 kind, s, m = best[4]
                 tick_ops[s] = (kind, m)
-                (emitted_b if kind == "B" else emitted_f).add((s, m))
+                emitted[kind].add((s, m))
         for s in range(n_virt):
             sched[s].append(tick_ops.get(s))
         for s, op in tick_ops.items():
@@ -535,8 +555,12 @@ def interleaved_1f1b_schedule(n_dev, vpp, n_micro):
             if kind == "F":
                 done_f.add((s, m))
                 inflight[s] += 1
-            else:
+            elif kind == "B":
                 done_b.add((s, m))
+                if not split_w:
+                    inflight[s] -= 1
+            else:
+                done_w.add((s, m))
                 inflight[s] -= 1
     return sched
 
@@ -860,13 +884,14 @@ class CrossMeshPipelineParallel(PipelineParallel):
         states = [s.raw_state() for s in self._stages]
         self._patch_tied(states)
         zbh1 = self.schedule_mode == "ZBH1"
-        if zbh1:
-            sched = zero_bubble_schedule(n_stages, n_micro)
-        elif self.vpp > 1:
-            # interleaved-VPP: fewer idle ticks than deep-1F1B over the
+        if self.vpp > 1:
+            # interleaved-VPP: fewer idle ticks than a deep table over the
             # virtual chain, with <=1 op per PHYSICAL device per tick
+            # (ZBH1 additionally soaks bubbles with split-off dW work)
             sched = interleaved_1f1b_schedule(
-                n_stages // self.vpp, self.vpp, n_micro)
+                n_stages // self.vpp, self.vpp, n_micro, split_w=zbh1)
+        elif zbh1:
+            sched = zero_bubble_schedule(n_stages, n_micro)
         else:
             sched = one_f_one_b_schedule(n_stages, n_micro)
         self.last_schedule = sched

@@ -119,11 +119,9 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
     nodes = np.asarray(_v(input_nodes))
     eids_np = np.asarray(_v(eids)) if eids is not None else None
     # reproducible under paddle.seed: derive from the framework RNG stream
-    from ..core import random as _random
+    from ..core.random import numpy_rng
 
-    root, counter = _random.get_rng_state()
-    _random._rng.counter += 1
-    rng = np.random.default_rng((root, counter))
+    rng = numpy_rng()
 
     out_n, out_c, out_e = [], [], []
     for n in nodes:

@@ -18,18 +18,13 @@ __all__ = [
 
 
 def _framework_rng():
-    """A numpy Generator seeded from the framework RNG stream, so shuffle
-    order follows ``paddle.seed`` (the reference samples its shuffles from
-    the global generator too) instead of fresh OS entropy per epoch.
-    Derived from (root_seed, counter) WITHOUT materializing a jax key —
-    the data pipeline must never initialize the XLA backend (fork safety,
-    multi-controller init ordering; same pattern as geometric's
-    sample_neighbors)."""
-    from ..core import random as _random
+    """Shuffle order follows ``paddle.seed`` (the reference samples its
+    shuffles from the global generator too) instead of fresh OS entropy
+    per epoch; jax-free so the data pipeline never initializes the XLA
+    backend."""
+    from ..core.random import numpy_rng
 
-    root, counter = _random.get_rng_state()
-    _random._rng.counter += 1
-    return np.random.default_rng((root, counter))
+    return numpy_rng()
 
 
 class Sampler:
