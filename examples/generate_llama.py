@@ -1,0 +1,67 @@
+"""Serving example: compiled whole-decode-loop generation.
+
+``generate()`` compiles TWO programs per (model, shapes) — a prefill
+program and ONE scanned decode program (model forward over donated
+paged/static KV caches with sampling inside the executable, the
+fused_multi_transformer decoder-loop shape) — so a whole generate() call
+costs two dispatches instead of hundreds per token. On the bench chip the
+438M-parameter model decodes at the parameter-bandwidth roofline
+(~4.3k tok/s at batch 8).
+
+Run: python examples/generate_llama.py [--cpu]
+"""
+import sys
+import time
+
+if "--cpu" in sys.argv:
+    import os
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, generate, llama_tiny_config
+
+
+def main():
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny_config()).eval()
+    batch, prompt_len, new_tokens = 2, 8, 16
+    prompt = paddle.to_tensor(
+        np.random.randint(0, 256, (batch, prompt_len)).astype(np.int32))
+
+    # greedy, paged KV cache (block_multi_head_attention layout, served by
+    # the Pallas paged_attention kernel on TPU)
+    t = time.time()
+    out = generate(model, prompt, max_new_tokens=new_tokens, cache="paged")
+    compile_s = time.time() - t
+    t = time.time()
+    out = generate(model, prompt, max_new_tokens=new_tokens, cache="paged")
+    run_s = time.time() - t
+    print(f"greedy paged decode: {out.shape} "
+          f"(compile+run {compile_s:.1f}s, cached run {run_s:.2f}s)")
+
+    # sampled continuation, static cache; RNG follows paddle.seed
+    paddle.seed(7)
+    sampled = generate(model, prompt, max_new_tokens=new_tokens,
+                       do_sample=True, temperature=0.8, top_k=20,
+                       cache="static")
+    print("sampled tokens (row 0):",
+          np.asarray(sampled._value)[0, prompt_len:].tolist())
+
+    # eos-padded semantics: finished rows pad to full width under jit
+    eos = int(np.asarray(out._value)[0, prompt_len])
+    padded = generate(model, prompt, max_new_tokens=new_tokens,
+                      eos_token_id=eos)
+    assert padded.shape == [batch, prompt_len + new_tokens]
+    print("eos-padded decode ok")
+    print("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -535,7 +535,7 @@ def interleaved_1f1b_schedule(n_dev, vpp, n_micro, split_w=False):
                         cand = (1, m // n_dev, k, m, ("F", s, m))
                         if best is None or cand < best:
                             best = cand
-            if split_w and (best is None or best[0] > 1):
+            if split_w and best is None:  # no F/B fit: soak the slot with dW
                 # weight-grads fill slots no F/B could use (bubble work)
                 for k in range(vpp):
                     s = k * n_dev + d

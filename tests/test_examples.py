@@ -9,6 +9,7 @@ import pytest
     "examples/train_llama_distributed.py",
     "examples/export_and_serve.py",
     "examples/train_ctr_ps.py",
+    "examples/generate_llama.py",
 ])
 def test_example_runs(script):
     import os
