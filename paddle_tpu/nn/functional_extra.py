@@ -814,17 +814,52 @@ def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
                                 training=True):
     """Varlen packed flash attention: sequences concatenated along dim 0
     with cu_seqlens boundaries (reference flash_attn_varlen_qkvpacked).
-    Each segment attends within itself."""
-    from . import functional as F
+    Each segment attends within itself.
+
+    Served as ONE fused call: the packed buffer runs as a batch-1
+    attention with per-token segment ids derived from cu_seqlens (the
+    round-4 masked Pallas kernel path — within a segment, global causal
+    equals local causal since positions are monotonic). Dropout, or a
+    packed buffer extending past cu_seqlens[-1], falls back to the
+    per-segment loop."""
+    import math
 
     import numpy as np
 
+    from ..core.tensor import Tensor
+    from ..ops import scaled_dot_product_attention
+
     cu = np.asarray(_v(cu_seqlens_q)).astype(int)
+    total = qkv.shape[0]
+    d = qkv.shape[-1]
+    # attention hard-codes 1/sqrt(d); a custom softmax scale folds into q
+    # so logits come out scale * (q.k)
+    q_all = (qkv[:, 0] * (float(scale) * math.sqrt(d))
+             if scale is not None else qkv[:, 0])
+    k_all = qkv[:, 1]
+    v_all = qkv[:, 2]
+    dropout_inert = dropout == 0.0 or not training
+    # the fused path only pays off when the Pallas kernel serves it; an
+    # unaligned total would fall to the dense XLA composition with
+    # O(total^2) cross-segment logits — worse than the per-segment loop
+    aligned = total % 128 == 0
+    if (dropout_inert and aligned and len(cu) >= 2 and cu[0] == 0
+            and cu[-1] == total):
+        seg = np.zeros((1, total), np.int32)
+        for i in range(len(cu) - 1):
+            seg[0, cu[i]:cu[i + 1]] = i
+        out = scaled_dot_product_attention(
+            q_all.unsqueeze(0), k_all.unsqueeze(0), v_all.unsqueeze(0),
+            is_causal=causal, segment_ids=Tensor._from_value(seg))
+        return out.squeeze(0), None
+
     outs = []
     for i in range(len(cu) - 1):
-        seg = qkv[cu[i]:cu[i + 1]]
-        out, _ = flash_attn_qkvpacked(seg.unsqueeze(0), dropout=dropout,
-                                      causal=causal, training=training)
+        lo, hi = int(cu[i]), int(cu[i + 1])
+        out = scaled_dot_product_attention(
+            q_all[lo:hi].unsqueeze(0), k_all[lo:hi].unsqueeze(0),
+            v_all[lo:hi].unsqueeze(0), is_causal=causal,
+            dropout_p=dropout, training=training)
         outs.append(out.squeeze(0))
     from ..ops import concat
 

@@ -256,3 +256,63 @@ def test_conv_transpose_functional_matches_layer():
     got = _np(F.conv1d_transpose(x, layer.weight, layer.bias, stride=2))
     want = _np(layer(x))
     np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_flash_attn_varlen_qkvpacked_fused_matches_per_segment():
+    """The varlen packed surface now runs as ONE fused segment-masked call
+    (round-4 kernel masking); it must equal per-segment attention for both
+    a kernel-aligned and an unaligned packed length, causal and not."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.functional_extra import flash_attn_varlen_qkvpacked
+    from paddle_tpu.ops import scaled_dot_product_attention
+
+    rng = np.random.RandomState(0)
+    for total, bounds in ((256, [0, 96, 224, 256]), (100, [0, 40, 100])):
+        qkv = paddle.to_tensor(
+            rng.randn(total, 3, 2, 32).astype(np.float32))
+        cu = paddle.to_tensor(np.asarray(bounds, np.int32))
+        for causal in (False, True):
+            out, _ = flash_attn_varlen_qkvpacked(
+                qkv, cu, cu, max(np.diff(bounds)), max(np.diff(bounds)),
+                causal=causal)
+            # oracle: independent per-segment attention
+            expect = []
+            for i in range(len(bounds) - 1):
+                seg = qkv[bounds[i]:bounds[i + 1]]
+                o = scaled_dot_product_attention(
+                    seg[:, 0].unsqueeze(0), seg[:, 1].unsqueeze(0),
+                    seg[:, 2].unsqueeze(0), is_causal=causal)
+                expect.append(np.asarray(o._value)[0])
+            np.testing.assert_allclose(
+                np.asarray(out._value), np.concatenate(expect, 0),
+                atol=2e-5, rtol=2e-5,
+                err_msg=f"total={total} causal={causal}")
+
+
+def test_flash_attn_varlen_scale_honored():
+    """A custom softmax scale must change the result by exactly the folded
+    factor (reference API takes an explicit scale)."""
+    import math
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.functional_extra import flash_attn_varlen_qkvpacked
+    from paddle_tpu.ops import scaled_dot_product_attention
+
+    rng = np.random.RandomState(2)
+    qkv = paddle.to_tensor(rng.randn(128, 3, 2, 32).astype(np.float32))
+    cu = paddle.to_tensor(np.asarray([0, 128], np.int32))
+    out, _ = flash_attn_varlen_qkvpacked(qkv, cu, cu, 128, 128, scale=1.0)
+    # oracle: logits at scale 1.0 == sdpa on q pre-scaled by sqrt(d)
+    ref = scaled_dot_product_attention(
+        (qkv[:, 0] * math.sqrt(32)).unsqueeze(0),
+        qkv[:, 1].unsqueeze(0), qkv[:, 2].unsqueeze(0))
+    np.testing.assert_allclose(np.asarray(out._value),
+                               np.asarray(ref._value)[0],
+                               atol=2e-5, rtol=2e-5)
+    default, _ = flash_attn_varlen_qkvpacked(qkv, cu, cu, 128, 128)
+    assert not np.allclose(np.asarray(out._value),
+                           np.asarray(default._value))
