@@ -584,6 +584,12 @@ def _host_p2p_transfer(value, tgt_sharding, tag, timeout_ms=120_000):
 
     from jax._src import distributed
 
+    if not value.sharding.is_fully_replicated:
+        raise ValueError(
+            "_host_p2p_transfer only moves fully-replicated values (it "
+            "publishes one addressable shard as the global array); got "
+            f"sharding {value.sharding}. For sharded cross-host hops "
+            "enable FLAGS_cross_host_device_put (native device transfer).")
     client = distributed.global_state.client
     me = jax.process_index()
     src = {d.process_index for d in value.sharding.device_set}

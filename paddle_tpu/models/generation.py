@@ -195,20 +195,24 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
     eagerly and stops early once every row has finished.
     """
     ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got {max_new_tokens}")
+    if max_new_tokens == 0:  # nothing to generate: (B, S + 0) = the input
+        return Tensor._from_value(ids)
     b, s = ids.shape
     cfg = model.config
     kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
     max_len = s + max_new_tokens
     maxp = getattr(cfg, "max_position_embeddings", None)
-    # the FINAL sampled token is appended but never fed back, so the
-    # highest embedded position is max(s, max_len - 1) - 1 (prefill embeds
-    # 0..s-1 even when max_new_tokens == 0); beyond the position table the
-    # gather would silently clamp (repeating the last learned position /
-    # rope row) — refuse loudly, BEFORE touching train mode
-    if maxp is not None and max(s, max_len - 1) > maxp:
+    # the FINAL sampled token is appended but never fed back, so with
+    # max_new_tokens >= 1 (the 0 case returned above) the highest embedded
+    # position is max_len - 2; beyond the position table the gather would
+    # silently clamp (repeating the last learned position / rope row) —
+    # refuse loudly, BEFORE touching train mode
+    if maxp is not None and max_len - 1 > maxp:
         raise ValueError(
             f"prompt ({s}) + max_new_tokens ({max_new_tokens}) would embed "
-            f"position {max(s, max_len - 1) - 1} beyond "
+            f"position {max_len - 2} beyond "
             f"max_position_embeddings ({maxp})")
     was_training = getattr(model, "training", False)
     model.eval()
@@ -240,28 +244,30 @@ def generate(model, input_ids, max_new_tokens=20, do_sample=False,
             if was_training:
                 model.train()
 
-    with autograd.no_grad():
-        logits, caches = model(Tensor._from_value(ids), caches=empty)
-        next_tok = _sample(logits._value[:, -1, :], temperature, top_k,
-                           top_p, not do_sample)
-        finished = jnp.zeros((b,), bool)
-        if eos_token_id is not None:
-            finished = finished | (next_tok == eos_token_id)
-        out = [ids, next_tok[:, None]]
-        for step in range(max_new_tokens - 1):
-            # static cache: every decode step has identical shapes -> the
-            # per-op executable cache serves each op from one compiled
-            # program (masked_multihead_attention decode-loop behavior)
-            logits, caches = model(
-                Tensor._from_value(next_tok[:, None]), caches=caches)
+    try:
+        with autograd.no_grad():
+            logits, caches = model(Tensor._from_value(ids), caches=empty)
             next_tok = _sample(logits._value[:, -1, :], temperature, top_k,
                                top_p, not do_sample)
+            finished = jnp.zeros((b,), bool)
             if eos_token_id is not None:
                 finished = finished | (next_tok == eos_token_id)
-                next_tok = jnp.where(finished, eos_token_id, next_tok)
-            out.append(next_tok[:, None])
-            if eos_token_id is not None and bool(finished.all()):
-                break
+            out = [ids, next_tok[:, None]]
+            for step in range(max_new_tokens - 1):
+                # static cache: every decode step has identical shapes -> the
+                # per-op executable cache serves each op from one compiled
+                # program (masked_multihead_attention decode-loop behavior)
+                logits, caches = model(
+                    Tensor._from_value(next_tok[:, None]), caches=caches)
+                next_tok = _sample(logits._value[:, -1, :], temperature,
+                                   top_k, top_p, not do_sample)
+                if eos_token_id is not None:
+                    finished = finished | (next_tok == eos_token_id)
+                    next_tok = jnp.where(finished, eos_token_id, next_tok)
+                out.append(next_tok[:, None])
+                if eos_token_id is not None and bool(finished.all()):
+                    break
+            return Tensor._from_value(jnp.concatenate(out, axis=1))
+    finally:
         if was_training:
             model.train()
-        return Tensor._from_value(jnp.concatenate(out, axis=1))

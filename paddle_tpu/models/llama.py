@@ -197,7 +197,9 @@ def cached_attention(q, k, v, cache, offset, s):
                 q._value[:, 0], cache.k_pages, cache.v_pages,
                 cache.tables, lengths)
             return Tensor._from_value(out[:, None])
-        if s > 1 and offset == 0:  # static s first: offset may be traced
+        # offset may be a traced scalar (chunked prefill / compiled decode
+        # loop) — only take the fast prefill path when it is a STATIC zero
+        if s > 1 and isinstance(offset, int) and offset == 0:
             # prefill: the new tokens attend only among themselves —
             # plain causal attention while the pages fill
             return scaled_dot_product_attention(q, k, v, is_causal=True)

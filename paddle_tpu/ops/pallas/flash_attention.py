@@ -409,6 +409,11 @@ def build_segments(b, sq, sk, seq_lens=None, segment_ids=None):
             q_seg = jnp.asarray(segment_ids[0], jnp.int32)
             k_seg = jnp.asarray(segment_ids[1], jnp.int32)
         else:
+            if sq != sk:
+                raise ValueError(
+                    f"a single shared segment_ids array requires sq == sk "
+                    f"(got sq={sq}, sk={sk}); pass a (q_ids, k_ids) pair "
+                    f"for cross-attention")
             ids = jnp.asarray(segment_ids, jnp.int32)
             q_seg = k_seg = ids
     else:

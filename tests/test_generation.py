@@ -148,3 +148,24 @@ def test_generate_rejects_overflow_past_position_table():
     full = paddle.to_tensor(np.random.randint(0, 256, (1, 128)))
     out = generate(model, full, max_new_tokens=1)
     assert out.shape == [1, 129]
+
+
+def test_generate_zero_new_tokens_returns_input_unchanged():
+    """max_new_tokens=0 is a no-op: (B, S + 0) = the input ids, no sample
+    appended, no mode flip (advisor r4)."""
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny_config())
+    model.train()
+    ids = np.random.randint(0, 256, (2, 8))
+    for kw in ({"use_jit": True}, {"use_jit": False}, {"cache": "paged"}):
+        out = generate(model, paddle.to_tensor(ids), max_new_tokens=0, **kw)
+        np.testing.assert_array_equal(np.asarray(out._value), ids)
+    assert model.training  # no-op path must not leak eval mode
+
+
+def test_generate_rejects_negative_new_tokens():
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny_config())
+    ids = paddle.to_tensor(np.random.randint(0, 256, (1, 4)))
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        generate(model, ids, max_new_tokens=-1)

@@ -264,3 +264,21 @@ def test_flash_attention_gqa_native():
                                    atol=2e-4, err_msg=n)
     # dk/dv keep the GROUPED shape: the memory win is structural
     assert g1[1].shape == (B, S, KVH, D)
+
+
+def test_build_segments_rejects_shared_ids_cross_attention():
+    """One shared (B, S) segment_ids array only makes sense for self
+    attention; a clear ValueError beats a shape mismatch deep in the
+    kernel (advisor r4)."""
+    import pytest
+
+    from paddle_tpu.ops.pallas import flash_attention as fa
+
+    ids = np.zeros((2, 16), np.int32)
+    with pytest.raises(ValueError, match="sq == sk"):
+        fa.build_segments(2, 16, 32, segment_ids=ids)
+    # the pair form is the cross-attention spelling — accepted
+    q_seg, k_seg = fa.build_segments(
+        2, 16, 32, segment_ids=(np.zeros((2, 16), np.int32),
+                                np.zeros((2, 32), np.int32)))
+    assert q_seg.shape == (2, 16) and k_seg.shape == (2, 32)
