@@ -115,12 +115,20 @@ amp_guard = auto_cast  # legacy alias (reference amp_guard)
 
 
 def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
-             master_weight=None, save_dtype=None):
+             master_weight=None, save_dtype=None, master_grad=False):
     """O2 decoration (reference python/paddle/amp/auto_cast.py ``decorate``):
     cast model parameters to the low dtype; enable fp32 master weights in the
-    optimizer (multi_precision), which our optimizers maintain natively."""
+    optimizer (multi_precision), which our optimizers maintain natively.
+
+    ``master_grad=True`` additionally accumulates GRADIENTS in fp32
+    (reference mix_precision_utils.MixPrecisionLayer/MixPrecisionOptimizer +
+    the master_grad pass): every cotangent reaching a decorated parameter is
+    upcast before the ``+=``, so long grad-accumulation runs (pipeline
+    micro-batches, accumulate_steps) don't lose bf16/fp16 mantissa bits."""
     if level not in ("O1", "O2"):
         raise ValueError("decorate level must be O1 or O2")
+    if master_grad and level != "O2":
+        raise ValueError("master_grad requires level='O2'")
     single_model = not isinstance(models, (list, tuple))
     single_opt = optimizers is not None and not isinstance(optimizers, (list, tuple))
     model_list = [models] if single_model else list(models)
@@ -129,8 +137,12 @@ def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
     if level == "O2":
         for m in model_list:
             m.to(dtype=dtype)
+            if master_grad:
+                for p in m.parameters():
+                    p.main_grad = True
         for opt in opt_list:
             opt._multi_precision = True if master_weight is None else bool(master_weight)
+            opt._master_grad = bool(master_grad)
 
     if optimizers is None:
         return models if single_model else model_list

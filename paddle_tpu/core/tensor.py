@@ -108,6 +108,20 @@ class Tensor:
     def _accumulate_grad(self, value):
         from .selected_rows import SelectedRows
 
+        if getattr(self, "main_grad", False) and not isinstance(
+                value, SelectedRows):
+            # fp32 gradient accumulation (reference master_grad:
+            # fleet/utils/mix_precision_utils.py MixPrecisionLayer._param_hook
+            # + the master_grad static pass): upcast each incoming bf16/fp16
+            # cotangent BEFORE the += so long micro-batch accumulations keep
+            # full mantissa precision
+            if isinstance(value, Tensor):
+                if value._value.dtype != jnp.float32:
+                    # .astype is a recorded cast op, so a create_graph
+                    # cotangent keeps its graph through the upcast
+                    value = value.astype("float32")
+            elif value.dtype != jnp.float32:
+                value = value.astype(jnp.float32)
         if isinstance(value, SelectedRows):
             # row-sparse grad (sparse embedding): keep sparse while possible
             if self._grad is None:
@@ -422,7 +436,8 @@ def _unwrap_opt(x):
 class Parameter(Tensor):
     """Trainable tensor: stop_gradient=False, persistable=True."""
 
-    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed", "need_clip")
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed",
+                 "need_clip", "main_grad")
 
     def __init__(self, value, name=None, trainable=True):
         super().__init__(value, stop_gradient=not trainable, name=name)
@@ -432,6 +447,7 @@ class Parameter(Tensor):
         self.regularizer = None
         self.is_distributed = False
         self.need_clip = True
+        self.main_grad = False
 
     @classmethod
     def from_tensor(cls, t: Tensor, name=None, trainable=True):
@@ -443,6 +459,7 @@ class Parameter(Tensor):
         p.regularizer = None
         p.is_distributed = False
         p.need_clip = True
+        p.main_grad = False
         return p
 
     def __repr__(self):

@@ -581,8 +581,21 @@ class TrainStep:
         optimizer = self.optimizer
         loss_fn = self.loss_fn
         trainable = self._trainable
-        grad_clip = optimizer._grad_clip
         clip_attrs = self._clip_attrs
+        has_clip = (optimizer._grad_clip is not None
+                    or bool(optimizer._group_clip))
+
+        def clip_grads(grads):
+            # partition by EFFECTIVE clip (param groups may override the
+            # optimizer clip); each clip sees only its own grads, so a
+            # group-local global norm stays group-local
+            out = dict(grads)
+            for c, names in optimizer._partition_by_clip(
+                    list(grads), optimizer._clip_by_name):
+                clipped = c._clip_arrays(
+                    [grads[k] for k in names], [clip_attrs[k] for k in names])
+                out.update(zip(names, clipped))
+            return out
 
         def one_step(params, buffers, accs, masters, lr, t, rng_key, args,
                      kwargs, labels):
@@ -614,12 +627,11 @@ class TrainStep:
                 loss_of, has_aux=True
             )(p_train)
 
-            if grad_clip is not None:
-                names = list(grads)
-                clipped = grad_clip._clip_arrays(
-                    [grads[k] for k in names], [clip_attrs[k] for k in names]
-                )
-                grads = dict(zip(names, clipped))
+            if getattr(optimizer, "_master_grad", False):
+                # fp32 grads before clip/update (amp master_grad semantics)
+                grads = {k: g.astype(jnp.float32) for k, g in grads.items()}
+            if has_clip:
+                grads = clip_grads(grads)
 
             new_p, new_accs, new_masters = optimizer.functional_update(
                 p_train, grads, accs, masters, lr, t

@@ -31,18 +31,71 @@ class Optimizer:
     # names of per-param accumulator slots, e.g. ("moment1", "moment2")
     _accumulator_names: tuple = ()
 
+    # keys accepted in a parameter-group dict (reference optimizer.py:127 —
+    # list-of-dict ``parameters`` with per-group options; ``learning_rate``
+    # is a MULTIPLIER on the optimizer LR, reference _add_param_group)
+    _group_keys = frozenset(
+        {"params", "learning_rate", "weight_decay", "grad_clip", "name"})
+
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=False, name=None):
         if parameters is None:
             raise ValueError("parameters must be provided (eager mode, reference semantics)")
-        self._parameter_list = list(parameters)
-        for p in self._parameter_list:
-            if isinstance(p, dict):
-                raise NotImplementedError("parameter groups not yet supported")
+        parameters = list(parameters)
+        self._param_groups: list[dict] = []
+        self._group_wd: dict[int, object] = {}    # id(param) -> group wd
+        self._group_clip: dict[int, object] = {}  # id(param) -> group clip
+        self._group_lr: dict[int, float] = {}     # id(param) -> lr multiplier
+        if parameters and isinstance(parameters[0], dict):
+            self._parameter_list = []
+            seen = set()
+            for group in parameters:
+                if not isinstance(group, dict) or "params" not in group:
+                    raise ValueError(
+                        "each parameter group must be a dict with a 'params' "
+                        f"key, got {group!r}")
+                unknown = set(group) - self._group_keys
+                if unknown:
+                    raise ValueError(
+                        f"unsupported parameter-group keys {sorted(unknown)}; "
+                        f"supported: {sorted(self._group_keys)}")
+                g = dict(group)
+                ps = g["params"]
+                g["params"] = [ps] if isinstance(ps, Parameter) else list(ps)
+                for p in g["params"]:
+                    if id(p) in seen:
+                        raise ValueError("some parameters appear in more "
+                                         "than one parameter group")
+                    seen.add(id(p))
+                    # group lr is a multiplier on the optimizer LR (reference
+                    # _add_param_group: optimize_attr['learning_rate']);
+                    # plain trainable Tensors have no optimize_attr slot, so
+                    # the override lives on the optimizer and, when the param
+                    # supports it, on the param too for reference parity
+                    if "learning_rate" in g:
+                        mult = float(g["learning_rate"])
+                        self._group_lr[id(p)] = mult
+                        attrs = getattr(p, "optimize_attr", None)
+                        if attrs is not None:
+                            attrs["learning_rate"] = mult
+                    if "weight_decay" in g:
+                        self._group_wd[id(p)] = g["weight_decay"]
+                    if "grad_clip" in g:
+                        self._group_clip[id(p)] = g["grad_clip"]
+                self._param_groups.append(g)
+                self._parameter_list.extend(g["params"])
+        else:
+            self._parameter_list = parameters
+            for p in self._parameter_list:
+                if isinstance(p, dict):
+                    raise ValueError(
+                        "parameters mixes plain tensors and dict groups; "
+                        "pass either a flat list or a list of group dicts")
         self._learning_rate = learning_rate
         self._weight_decay = weight_decay
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
+        self._master_grad = False  # set by amp.decorate(master_grad=True)
         # Accumulator keys are positional ("slot@<index in parameter list>")
         # so optimizer state_dicts restore across processes regardless of the
         # auto-generated tensor names' global counter.
@@ -90,20 +143,33 @@ class Optimizer:
 
     # ------------------------------------------------ the update rule (override)
 
-    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
         """Pure function: (param, grad, accumulator dict, lr scalar, step t)
         -> (new_param, new accumulator dict). Runs inside jit.
         ``apply_decay`` carries the per-param weight-decay exemption for
-        decoupled-decay optimizers (AdamW/Lamb)."""
+        decoupled-decay optimizers (AdamW/Lamb); ``wd`` the per-param group
+        weight_decay override (None = optimizer default) — coupled-decay
+        optimizers receive it pre-applied via ``_decay_grad`` and ignore it
+        here."""
         raise NotImplementedError
 
-    def _decay_grad(self, p, g):
-        """L2 regularization folded into the gradient (reference: L2Decay for
-        non-decoupled optimizers). AdamW overrides with decoupled decay."""
-        wd = self._weight_decay
+    @staticmethod
+    def _wd_to_coeff(wd):
+        """Raw weight_decay (float | L2Decay-like | None | str) -> float."""
         if wd is None or isinstance(wd, str):
-            return g
-        coeff = float(wd.coeff) if hasattr(wd, "coeff") else float(wd)
+            return 0.0
+        return float(wd.coeff) if hasattr(wd, "coeff") else float(wd)
+
+    def _group_wd_value(self, p):
+        """This param's group weight_decay override, or None (use the
+        optimizer default). Static per param — baked into compiled updates."""
+        return self._group_wd.get(id(p))
+
+    def _decay_grad(self, p, g, wd=None):
+        """L2 regularization folded into the gradient (reference: L2Decay for
+        non-decoupled optimizers). AdamW overrides with decoupled decay.
+        ``wd``: per-param group override; None means the optimizer default."""
+        coeff = self._wd_to_coeff(self._weight_decay if wd is None else wd)
         if coeff == 0.0:
             return g
         return g + coeff * p.astype(g.dtype)
@@ -131,20 +197,42 @@ class Optimizer:
             }
         return self._lr_scale_name_cache.get(name, 1.0)
 
+    def _wd_by_name(self, name):
+        """Group weight_decay override by param name (functional path)."""
+        if self.__dict__.get("_wd_name_cache") is None:
+            self._wd_name_cache = {
+                p.name: self._group_wd_value(p) for p in self._parameter_list
+            }
+        return self._wd_name_cache.get(name)
+
+    def _clip_by_name(self, name):
+        """Effective grad clip for this param name (functional path)."""
+        if self.__dict__.get("_clip_name_cache") is None:
+            self._clip_name_cache = {
+                p.name: self._effective_clip(p) for p in self._parameter_list
+            }
+        return self._clip_name_cache.get(name, self._grad_clip)
+
     def register_param_names(self, mapping: dict):
         """Register alternative names (e.g. Layer state_dict keys) for the
         functional path: ``{alt_name: Parameter}``. Compiled train steps that
-        key arrays by structured names call this so per-param decay exemptions
-        and LR multipliers still resolve."""
+        key arrays by structured names call this so per-param decay exemptions,
+        LR multipliers, and group wd/clip overrides still resolve."""
         self._decay_flag_by_name("")  # build caches
         self._lr_scale_by_name("")
+        self._wd_by_name("")
+        self._clip_by_name("")
         for alt, p in mapping.items():
             self._decay_flag_name_cache[alt] = self._decay_flag(p)
             self._lr_scale_name_cache[alt] = self._lr_scale(p)
+            self._wd_name_cache[alt] = self._group_wd_value(p)
+            self._clip_name_cache[alt] = self._effective_clip(p)
 
     def _lr_scale(self, p) -> float:
-        """Per-parameter LR multiplier (ParamAttr.learning_rate, reference:
-        optimizer.py _create_param_lr)."""
+        """Per-parameter LR multiplier (ParamAttr.learning_rate or a
+        parameter group's learning_rate; reference: _create_param_lr)."""
+        if id(p) in self._group_lr:
+            return self._group_lr[id(p)]
         return float(getattr(p, "optimize_attr", {}).get("learning_rate", 1.0))
 
     # ------------------------------------------------ step
@@ -156,6 +244,7 @@ class Optimizer:
         as compile-time constants for this exact parameter list."""
         decay_flags = [self_ref._decay_flag(p) for p in params]
         lr_scales = [self_ref._lr_scale(p) for p in params]
+        wd_overrides = [self_ref._group_wd_value(p) for p in params]
 
         def update(param_vals, grad_vals, master_vals, acc_vals, lr, t):
             new_params, new_masters, new_accs = [], [], []
@@ -163,11 +252,12 @@ class Optimizer:
                 master = master_vals[i]
                 work = master if master is not None else p
                 g = g.astype(work.dtype)
-                g = self_ref._decay_grad(work, g)
+                g = self_ref._decay_grad(work, g, wd_overrides[i])
                 accs = {name: acc_vals[i][j] for j, name in enumerate(self_ref._accumulator_names)}
                 lr_i = lr * lr_scales[i] if lr_scales[i] != 1.0 else lr
                 new_p, accs_out = self_ref._rule(work, g, accs, lr_i, t,
-                                                 apply_decay=decay_flags[i])
+                                                 apply_decay=decay_flags[i],
+                                                 wd=wd_overrides[i])
                 if master is not None:
                     new_masters.append(new_p)
                     new_params.append(new_p.astype(p.dtype))
@@ -199,6 +289,8 @@ class Optimizer:
             self._ensure_state([p])
             handled = False
             if (self._grad_clip is None and self._weight_decay is None
+                    and id(p) not in self._group_wd
+                    and id(p) not in self._group_clip
                     and self._master_key(p) not in self._master_weights):
                 lr = jnp.asarray(self.get_lr() * self._lr_scale(p),
                                  jnp.float32)
@@ -221,6 +313,25 @@ class Optimizer:
         except AttributeError:
             return ()
 
+    def _effective_clip(self, p):
+        """This param's grad clip: its group's override, else the
+        optimizer-level clip (reference: per-group grad_clip defaulting to
+        the constructor's, _add_param_group + _default_dict)."""
+        return self._group_clip.get(id(p), self._grad_clip)
+
+    @staticmethod
+    def _partition_by_clip(items, clip_of):
+        """[(clip, [item, ...])] grouping items by the IDENTITY of their
+        effective clip (items whose clip is None are dropped) — the one
+        definition of group-local clipping, shared by eager ``step`` and the
+        compiled TrainStep path so the two cannot diverge."""
+        parts: dict[int, tuple] = {}
+        for it in items:
+            c = clip_of(it)
+            if c is not None:
+                parts.setdefault(id(c), (c, []))[1].append(it)
+        return list(parts.values())
+
     def step(self):
         self._apply_sparse_grads()
         params = [p for p in self._parameter_list
@@ -234,22 +345,28 @@ class Optimizer:
         groups = list(by_devices.values())
 
         grads = {id(p): p._grad._value for p in params}
-        if self._grad_clip is not None:
-            self._clip_groups(groups, grads)
+        # clip per EFFECTIVE clip object: each param group's clip sees only
+        # that group's grads (a group-local global norm, reference
+        # semantics); params sharing a clip are still reduced together
+        # across device groups
+        for c, plist in self._partition_by_clip(params, self._effective_clip):
+            by_dev: dict[tuple, list] = {}
+            for p in plist:
+                by_dev.setdefault(self._device_group_key(p), []).append(p)
+            self._clip_groups(c, list(by_dev.values()), grads)
         self._ensure_state(params)
         self._step_count += 1
         for group in groups:
             self._step_group(group, [grads[id(p)] for p in group])
 
-    def _clip_groups(self, groups, grads):
+    def _clip_groups(self, clip, groups, grads):
         from ..nn.clip import ClipGradByGlobalNorm, _need_clip_mask
 
-        if len(groups) == 1 or not isinstance(self._grad_clip,
-                                              ClipGradByGlobalNorm):
+        if len(groups) == 1 or not isinstance(clip, ClipGradByGlobalNorm):
             # per-tensor clips (ByNorm/ByValue) are group-local; a global
             # norm over one group is the plain fused path
             for group in groups:
-                clipped = self._grad_clip._clip_arrays(
+                clipped = clip._clip_arrays(
                     [grads[id(p)] for p in group], group)
                 for p, g in zip(group, clipped):
                     grads[id(p)] = g
@@ -265,10 +382,10 @@ class Optimizer:
             masks.append(mask)
             sel = [g for g, m in zip(garr, mask) if m]
             if sel:
-                partials.append(self._grad_clip.global_norm(sel) ** 2)
+                partials.append(clip.global_norm(sel) ** 2)
         gnorm = math.sqrt(sum(float(v) for v in partials))
-        clip = self._grad_clip.clip_norm
-        scale = clip / max(gnorm, clip)
+        clip_norm = clip.clip_norm
+        scale = clip_norm / max(gnorm, clip_norm)
         if scale >= 1.0:
             return
         for group, mask in zip(groups, masks):
@@ -350,12 +467,14 @@ class Optimizer:
             master = masters.get(name)
             work = master if master is not None else p
             g = g.astype(work.dtype)
-            g = self._decay_grad(work, g)
+            wd_over = self._wd_by_name(name)
+            g = self._decay_grad(work, g, wd_over)
             slot_vals = {slot: accs[f"{slot}@{name}"] for slot in self._accumulator_names}
             scale = self._lr_scale_by_name(name)
             lr_i = lr * scale if scale != 1.0 else lr
             new_p, slots_out = self._rule(work, g, slot_vals, lr_i, t,
-                                          apply_decay=self._decay_flag_by_name(name))
+                                          apply_decay=self._decay_flag_by_name(name),
+                                          wd=wd_over)
             if master is not None:
                 new_masters[name] = new_p
                 new_params[name] = new_p.astype(p.dtype)
@@ -411,7 +530,7 @@ class Optimizer:
 
 
 class SGD(Optimizer):
-    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
         return p - lr.astype(p.dtype) * g, accs
 
     def _sparse_rule(self, p, sr, lr, t):
@@ -431,7 +550,7 @@ class Momentum(Optimizer):
         self._momentum = momentum
         self._use_nesterov = use_nesterov
 
-    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
         v = self._momentum * accs["velocity"].astype(p.dtype) + g
         if self._use_nesterov:
             step = g + self._momentum * v
@@ -464,7 +583,7 @@ class Adagrad(Optimizer):
     def _init_slot_value(self, slot, value):
         return jnp.full_like(value, self._initial)
 
-    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
         m = accs["moment"] + g * g
         return p - lr.astype(p.dtype) * g / (jnp.sqrt(m) + self._epsilon), {"moment": m}
 
@@ -479,7 +598,7 @@ class RMSProp(Optimizer):
         self._epsilon = epsilon
         self._momentum = momentum
 
-    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
         ms = self._rho * accs["mean_square"] + (1 - self._rho) * g * g
         mom = self._momentum * accs["moment"] + lr.astype(p.dtype) * g / jnp.sqrt(ms + self._epsilon)
         return p - mom, {"mean_square": ms, "moment": mom}
@@ -497,7 +616,7 @@ class Adam(Optimizer):
         self._epsilon = epsilon
         self._lazy_mode = bool(lazy_mode)
 
-    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
         dt = p.dtype
         b1 = jnp.asarray(self._beta1, dt)
         b2 = jnp.asarray(self._beta2, dt)
@@ -551,7 +670,7 @@ class AdamW(Adam):
         self._coeff = float(weight_decay) if not hasattr(weight_decay, "coeff") else float(weight_decay.coeff)
         self._apply_decay_param_fun = apply_decay_param_fun
 
-    def _decay_grad(self, p, g):
+    def _decay_grad(self, p, g, wd=None):
         return g  # decoupled: decay applied in _rule
 
     def _decay_flag(self, p):
@@ -559,10 +678,12 @@ class AdamW(Adam):
             return bool(self._apply_decay_param_fun(p.name))
         return True
 
-    def _rule(self, p, g, accs, lr, t, apply_decay=True):
-        # p *= (1 - lr*coeff) before the adam update (reference adamw kernel)
-        if apply_decay:
-            p = p * (1.0 - lr.astype(p.dtype) * self._coeff)
+    def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
+        # p *= (1 - lr*coeff) before the adam update (reference adamw kernel);
+        # a param group's weight_decay overrides the constructor coeff
+        coeff = self._coeff if wd is None else self._wd_to_coeff(wd)
+        if apply_decay and coeff:
+            p = p * (1.0 - lr.astype(p.dtype) * coeff)
         return super()._rule(p, g, accs, lr, t)
 
 
@@ -576,7 +697,7 @@ class Adamax(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
 
-    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
         m = self._beta1 * accs["moment"] + (1 - self._beta1) * g
         inf = jnp.maximum(self._beta2 * accs["inf_norm"], jnp.abs(g))
         tf = t.astype(p.dtype)
@@ -602,7 +723,7 @@ class Lamb(Optimizer):
             return not bool(self._exclude_fn(p))
         return True
 
-    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
         dt = p.dtype
         b1 = jnp.asarray(self._beta1, dt)
         b2 = jnp.asarray(self._beta2, dt)
@@ -611,8 +732,9 @@ class Lamb(Optimizer):
         tf = t.astype(dt)
         mhat = m / (1 - jnp.power(b1, tf))
         vhat = v / (1 - jnp.power(b2, tf))
-        wd = self._lamb_wd if apply_decay else 0.0
-        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + wd * p
+        coeff = self._lamb_wd if wd is None else self._wd_to_coeff(wd)
+        wd_eff = coeff if apply_decay else 0.0
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + wd_eff * p
         w_norm = jnp.linalg.norm(p.reshape(-1).astype(jnp.float32))
         r_norm = jnp.linalg.norm(r.reshape(-1).astype(jnp.float32))
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0).astype(dt)
@@ -634,7 +756,7 @@ class Adadelta(Optimizer):
         self._epsilon = epsilon
         self._rho = rho
 
-    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
         dt = p.dtype
         rho = jnp.asarray(self._rho, dt)
         eg = rho * accs["avg_squared_grad"].astype(dt) + (1 - rho) * g * g
@@ -668,7 +790,7 @@ class ASGD(Optimizer):
             return jnp.broadcast_to(base, (self._n,) + base.shape).copy()
         return base
 
-    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
         dt = p.dtype
         i = (t - 1) % self._n
         y_i = jax.lax.dynamic_index_in_dim(accs["ys"], i, 0,
@@ -703,7 +825,7 @@ class NAdam(Optimizer):
             return jnp.ones((), jnp.float32)
         return super()._init_slot_value(slot, value)
 
-    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
         dt = p.dtype
         b1 = jnp.asarray(self._beta1, dt)
         b2 = jnp.asarray(self._beta2, dt)
@@ -736,7 +858,7 @@ class RAdam(Optimizer):
         self._beta2 = beta2
         self._epsilon = epsilon
 
-    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
         dt = p.dtype
         b1 = jnp.asarray(self._beta1, dt)
         b2 = jnp.asarray(self._beta2, dt)
@@ -777,7 +899,7 @@ class Rprop(Optimizer):
             return base + jnp.asarray(float(self.get_lr()), base.dtype)
         return base
 
-    def _rule(self, p, g, accs, lr, t, apply_decay=True):
+    def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
         dt = p.dtype
         prev = accs["prev_grad"].astype(dt)
         step = accs["step_size"].astype(dt)
@@ -801,6 +923,12 @@ class LBFGS(Optimizer):
                  tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
                  line_search_fn=None, parameters=None, weight_decay=None,
                  grad_clip=None, multi_precision=False, name=None):
+        parameters = list(parameters) if parameters is not None else None
+        if parameters and isinstance(parameters[0], dict):
+            # the closure-driven flat-gradient path has no per-group
+            # machinery; silently dropping group options would be worse
+            raise ValueError("LBFGS does not support parameter groups; "
+                             "pass a flat parameter list")
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          multi_precision, name)
         self._max_iter = int(max_iter)
