@@ -108,14 +108,17 @@ class Tensor:
     def _accumulate_grad(self, value):
         from .selected_rows import SelectedRows
 
-        if getattr(self, "main_grad", False) and not isinstance(
-                value, SelectedRows):
+        if getattr(self, "main_grad", False):
             # fp32 gradient accumulation (reference master_grad:
             # fleet/utils/mix_precision_utils.py MixPrecisionLayer._param_hook
             # + the master_grad static pass): upcast each incoming bf16/fp16
             # cotangent BEFORE the += so long micro-batch accumulations keep
-            # full mantissa precision
-            if isinstance(value, Tensor):
+            # full mantissa precision — row-sparse grads included (their
+            # per-row values accumulate across micro-batches the same way)
+            if isinstance(value, SelectedRows):
+                if value.dtype != jnp.float32:
+                    value = value.astype(jnp.float32)
+            elif isinstance(value, Tensor):
                 if value._value.dtype != jnp.float32:
                     # .astype is a recorded cast op, so a create_graph
                     # cotangent keeps its graph through the upcast

@@ -149,17 +149,17 @@ class RowParallelLinear(Layer):
 
 class ParallelCrossEntropy(Layer):
     """Vocab-parallel softmax cross-entropy (mp_layers.py:744, kernel
-    c_softmax_with_cross_entropy). The reference's kernel computes local
-    max/sum then all-reduces; under GSPMD the same reduction pattern is
-    derived from the sharded logits, so this wraps the stock op with the
-    logits' sharding preserved."""
+    c_softmax_with_cross_entropy): local max/sum-exp/masked-pick with the
+    cross-shard all-reduces derived by GSPMD from the logits' sharding —
+    the full logits row is never gathered onto one shard (HLO-audited in
+    tests/test_fleet.py)."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        from ...ops import softmax_with_cross_entropy
+        from ...ops import c_softmax_with_cross_entropy
 
-        return softmax_with_cross_entropy(
+        return c_softmax_with_cross_entropy(
             input, label, ignore_index=self.ignore_index)

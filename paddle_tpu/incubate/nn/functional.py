@@ -4,6 +4,7 @@ Analog of /root/reference/python/paddle/incubate/nn/functional/ — thin
 names over the already-fused implementations (Pallas flash attention +
 XLA-fused compositions).
 """
+from ...ops import fused_linear_cross_entropy  # noqa: F401
 from ...ops import rms_norm as fused_rms_norm  # noqa: F401
 from ...ops import (  # noqa: F401
     rotary_position_embedding as fused_rotary_position_embedding,

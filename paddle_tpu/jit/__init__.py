@@ -591,7 +591,8 @@ class TrainStep:
             # group-local global norm stays group-local
             out = dict(grads)
             for c, names in optimizer._partition_by_clip(
-                    list(grads), optimizer._clip_by_name):
+                    list(grads), optimizer._clip_by_name,
+                    optimizer._group_of_by_name):
                 clipped = c._clip_arrays(
                     [grads[k] for k in names], [clip_attrs[k] for k in names])
                 out.update(zip(names, clipped))

@@ -850,6 +850,11 @@ def rotary_position_embedding(q, k, cos, sin, position_ids=None, use_neox_rotary
 
 # ============================================================ losses
 
+from .fused_ce import (  # noqa: E402,F401
+    c_softmax_with_cross_entropy,
+    fused_linear_cross_entropy,
+)
+
 
 def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100, axis=-1):
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
@@ -859,11 +864,14 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
         lab = label
         if lab.ndim == logits.ndim and lab.shape[axis] == 1:
             lab = jnp.squeeze(lab, axis=axis)
-        picked = jnp.take_along_axis(logp, lab[..., None].astype(jnp.int32), axis=axis)
-        loss = -picked
-        if ignore_index >= 0 or ignore_index != -100:
-            mask = (lab != ignore_index)[..., None]
-            loss = jnp.where(mask, loss, 0.0)
+        lab = lab.astype(jnp.int32)
+        # ignore_index rows (any value, incl. the -100 default) are masked
+        # AND gathered at a safe index — an out-of-range label must not
+        # feed take_along_axis (clamps under jit -> garbage -logp[0])
+        mask = (lab != ignore_index)[..., None]
+        safe = jnp.where(lab == ignore_index, 0, lab)
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=axis)
+        loss = jnp.where(mask, -picked, 0.0)
     return loss
 
 

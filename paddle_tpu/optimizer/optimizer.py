@@ -46,10 +46,11 @@ class Optimizer:
         self._group_wd: dict[int, object] = {}    # id(param) -> group wd
         self._group_clip: dict[int, object] = {}  # id(param) -> group clip
         self._group_lr: dict[int, float] = {}     # id(param) -> lr multiplier
+        self._group_index: dict[int, int] = {}    # id(param) -> group ordinal
         if parameters and isinstance(parameters[0], dict):
             self._parameter_list = []
             seen = set()
-            for group in parameters:
+            for gi, group in enumerate(parameters):
                 if not isinstance(group, dict) or "params" not in group:
                     raise ValueError(
                         "each parameter group must be a dict with a 'params' "
@@ -82,6 +83,7 @@ class Optimizer:
                         self._group_wd[id(p)] = g["weight_decay"]
                     if "grad_clip" in g:
                         self._group_clip[id(p)] = g["grad_clip"]
+                    self._group_index[id(p)] = gi
                 self._param_groups.append(g)
                 self._parameter_list.extend(g["params"])
         else:
@@ -222,11 +224,13 @@ class Optimizer:
         self._lr_scale_by_name("")
         self._wd_by_name("")
         self._clip_by_name("")
+        self._group_of_by_name("")
         for alt, p in mapping.items():
             self._decay_flag_name_cache[alt] = self._decay_flag(p)
             self._lr_scale_name_cache[alt] = self._lr_scale(p)
             self._wd_name_cache[alt] = self._group_wd_value(p)
             self._clip_name_cache[alt] = self._effective_clip(p)
+            self._group_index_name_cache[alt] = self._group_of(p)
 
     def _lr_scale(self, p) -> float:
         """Per-parameter LR multiplier (ParamAttr.learning_rate or a
@@ -319,17 +323,32 @@ class Optimizer:
         the constructor's, _add_param_group + _default_dict)."""
         return self._group_clip.get(id(p), self._grad_clip)
 
-    @staticmethod
-    def _partition_by_clip(items, clip_of):
-        """[(clip, [item, ...])] grouping items by the IDENTITY of their
-        effective clip (items whose clip is None are dropped) — the one
-        definition of group-local clipping, shared by eager ``step`` and the
-        compiled TrainStep path so the two cannot diverge."""
-        parts: dict[int, tuple] = {}
+    def _group_of(self, p) -> int:
+        """Parameter-group ordinal (flat optimizers: everything is group 0)."""
+        return self._group_index.get(id(p), 0)
+
+    def _group_of_by_name(self, name) -> int:
+        """Group ordinal by param name (functional path)."""
+        if self.__dict__.get("_group_index_name_cache") is None:
+            self._group_index_name_cache = {
+                p.name: self._group_of(p) for p in self._parameter_list
+            }
+        return self._group_index_name_cache.get(name, 0)
+
+    def _partition_by_clip(self, items, clip_of, group_of):
+        """[(clip, [item, ...])] partitioning items by (parameter group,
+        effective clip); items whose clip is None are dropped. Keyed by the
+        GROUP ordinal, not just clip identity: the reference clips each
+        parameter group separately even when groups share one clip object
+        (optimizer.py:127 _add_param_group setdefaults the constructor clip
+        into every group, then _apply_optimize clips per group). Shared by
+        eager ``step`` and the compiled TrainStep path so the two cannot
+        diverge."""
+        parts: dict[tuple, tuple] = {}
         for it in items:
             c = clip_of(it)
             if c is not None:
-                parts.setdefault(id(c), (c, []))[1].append(it)
+                parts.setdefault((group_of(it), id(c)), (c, []))[1].append(it)
         return list(parts.values())
 
     def step(self):
@@ -349,7 +368,8 @@ class Optimizer:
         # that group's grads (a group-local global norm, reference
         # semantics); params sharing a clip are still reduced together
         # across device groups
-        for c, plist in self._partition_by_clip(params, self._effective_clip):
+        for c, plist in self._partition_by_clip(
+                params, self._effective_clip, self._group_of):
             by_dev: dict[tuple, list] = {}
             for p in plist:
                 by_dev.setdefault(self._device_group_key(p), []).append(p)

@@ -213,6 +213,26 @@ def test_master_grad_fp32_accumulation_beats_bf16():
     opt.step()
 
 
+def test_master_grad_upcasts_sparse_rows():
+    """Row-sparse (SelectedRows) grads from a sparse Embedding accumulate
+    their per-row values in fp32 under master_grad, same as dense grads."""
+    from paddle_tpu.core.selected_rows import SelectedRows
+
+    paddle.seed(11)
+    m = nn.Embedding(50, 8, sparse=True)
+    opt = paddle.optimizer.SGD(learning_rate=0.0, parameters=m.parameters())
+    m, opt = paddle.amp.decorate(m, opt, level="O2", dtype="bfloat16",
+                                 master_grad=True)
+    ids = paddle.to_tensor(np.array([[1, 2, 3]], np.int64))
+    for _ in range(3):
+        m(ids).sum().backward()
+    import jax.numpy as _jnp
+
+    g = m.weight.grad
+    assert isinstance(g, SelectedRows)
+    assert g.value.dtype == _jnp.float32
+
+
 def test_master_grad_requires_o2():
     m = nn.Linear(2, 2)
     opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=m.parameters())

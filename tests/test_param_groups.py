@@ -135,6 +135,51 @@ def test_per_group_grad_clip_is_group_local():
                 assert delta < 1e-5, (compiled, k, delta)
 
 
+def test_shared_clip_object_is_still_per_group():
+    """Reference semantics: _add_param_group setdefaults the CONSTRUCTOR
+    clip into every group and each group is clipped with its OWN global
+    norm — one clip object shared by two groups must not produce a joint
+    norm over their union. Oracle: two split optimizers, each with its own
+    clip of the same threshold."""
+    for compiled in (False, True):
+        c = 1e-2
+        m1, m2 = _mlp(), _mlp()
+        for p1, p2 in zip(m1.parameters(), m2.parameters()):
+            p2.set_value(paddle.to_tensor(np.asarray(p1._value).copy()))
+        x, y = _data()
+        crit = nn.MSELoss()
+
+        d1, nd1 = _split(m1)
+        grouped = paddle.optimizer.SGD(
+            learning_rate=0.5, grad_clip=nn.ClipGradByGlobalNorm(c),
+            parameters=[{"params": d1}, {"params": nd1}])
+        d2, nd2 = _split(m2)
+        split_a = paddle.optimizer.SGD(
+            learning_rate=0.5, grad_clip=nn.ClipGradByGlobalNorm(c),
+            parameters=d2)
+        split_b = paddle.optimizer.SGD(
+            learning_rate=0.5, grad_clip=nn.ClipGradByGlobalNorm(c),
+            parameters=nd2)
+
+        if compiled:
+            step = paddle.jit.TrainStep(m1, lambda out: crit(out, y), grouped)
+            step(x)
+        else:
+            crit(m1(x), y).backward()
+            grouped.step()
+            grouped.clear_grad()
+        crit(m2(x), y).backward()
+        split_a.step()
+        split_b.step()
+        m2.clear_gradients()
+        for (k, p1), (_, p2) in zip(m1.named_parameters(),
+                                    m2.named_parameters()):
+            np.testing.assert_allclose(
+                np.asarray(p1._value), np.asarray(p2._value),
+                rtol=1e-5, atol=1e-6,
+                err_msg=f"compiled={compiled} param={k}")
+
+
 def test_momentum_group_decay_matches_split():
     """Coupled (L2-folded-into-grad) decay honors group overrides too."""
     m1 = _mlp()
