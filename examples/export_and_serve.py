@@ -1,4 +1,7 @@
-"""Export a trained model to a StableHLO artifact and serve it.
+"""Export trained models to StableHLO artifacts and serve them through the
+Predictor — including the REAL serving artifact: a LLaMA compiled decode
+loop (prefill + scanned decode + sampling in one program, paged KV caches)
+exported bf16 and driven through the inference.Config/Predictor surface.
 
 Run: python examples/export_and_serve.py [--cpu]
 """
@@ -20,6 +23,7 @@ import paddle_tpu.nn as nn
 from paddle_tpu import inference
 from paddle_tpu.static import InputSpec
 
+# ---- 1. plain layer artifact -------------------------------------------
 paddle.seed(0)
 model = nn.Sequential(nn.Linear(16, 64), nn.GELU(), nn.Linear(64, 4))
 path = tempfile.mkdtemp() + "/model"
@@ -31,3 +35,35 @@ x = np.random.rand(1, 16).astype(np.float32)
 predictor.get_input_handle(predictor.get_input_names()[0]).copy_from_cpu(x)
 (out,) = predictor.run()
 print("served output:", out)
+
+# ---- 2. LLaMA compiled-decode artifact, served bf16 --------------------
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+B, S, NEW = 2, 8, 12
+cfg = LlamaConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                  num_hidden_layers=2, num_attention_heads=4,
+                  max_position_embeddings=64, tie_word_embeddings=True)
+paddle.seed(0)
+llama = LlamaForCausalLM(cfg)
+llama.to(dtype="bfloat16")  # export a true-bf16 program (TPU serving dtype)
+lpath = tempfile.mkdtemp() + "/llama_decode"
+paddle.jit.save_generate(llama, lpath, batch=B, prompt_len=S,
+                         max_new_tokens=NEW, do_sample=True, temperature=0.8,
+                         top_k=20, cache="paged")
+print("exported compiled-decode artifact:", lpath + ".pdmodel")
+
+config = inference.Config(lpath)
+config.precision("bfloat16")
+serve = inference.create_predictor(config)
+prompt = np.random.randint(0, cfg.vocab_size, (B, S)).astype(np.int32)
+serve.get_input_handle("input_ids").copy_from_cpu(prompt)
+import jax as _jax
+
+keys = np.stack([_jax.random.key_data(_jax.random.PRNGKey(i))
+                 for i in range(NEW)])
+serve.get_input_handle("rng_keys").copy_from_cpu(keys)
+(ids_out,) = serve.run()
+print("served generation:", np.asarray(ids_out))
+assert np.asarray(ids_out).shape == (B, S + NEW)
+print(f"OK: Predictor generated {NEW} tokens per row via the exported "
+      "decode loop")

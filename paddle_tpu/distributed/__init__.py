@@ -18,6 +18,7 @@ from . import ps  # noqa: F401
 from . import rpc  # noqa: F401
 from .auto_parallel import DistModel, Strategy, to_static  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .spawn import MultiprocessContext, spawn  # noqa: F401
 from .api import (  # noqa: F401
     ShardDataloader,
     dtensor_from_fn,
@@ -76,4 +77,5 @@ __all__ = [
     "all_to_all", "reduce_scatter", "send", "recv",
     "DataParallel", "ParallelEnv", "comm_ops",
     "Strategy", "DistModel", "to_static",
+    "spawn", "MultiprocessContext",
 ]

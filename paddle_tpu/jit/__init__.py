@@ -787,6 +787,11 @@ def not_to_static(fn):
     fn.__jit_not_to_static__ = True
     return fn
 
-from .serialization import TranslatedLayer, load, save  # noqa: E402,F401
+from .serialization import (  # noqa: E402,F401
+    TranslatedLayer,
+    load,
+    save,
+    save_generate,
+)
 
-__all__ += ["save", "load", "TranslatedLayer"]
+__all__ += ["save", "load", "save_generate", "TranslatedLayer"]

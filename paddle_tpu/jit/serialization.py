@@ -22,7 +22,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 
-__all__ = ["save", "load", "TranslatedLayer"]
+__all__ = ["save", "load", "save_generate", "TranslatedLayer"]
 
 
 def _resolve_avals(layer, input_spec, example_inputs):
@@ -146,6 +146,65 @@ def save(layer, path, input_spec=None, example_inputs=None, **configs):
             inner.train()
 
 
+def save_generate(model, path, batch, prompt_len, max_new_tokens,
+                  do_sample=False, temperature=1.0, top_k=None, top_p=None,
+                  eos_token_id=None, cache="paged", seed_input=True):
+    """Export the COMPILED DECODE LOOP as a deployment artifact: prefill +
+    scanned decode + sampling in one StableHLO program with internal KV
+    caches (models.generation.build_serve_fn). The Predictor serves it like
+    any jit.save artifact — inputs ``input_ids`` (batch, prompt_len) int32
+    and ``rng_keys`` (the per-token PRNG key stack; pass zeros for greedy).
+    Reference: the frozen inference program AnalysisPredictor loads
+    (analysis_predictor.h:105) built from fused_multi_transformer's
+    decode-loop semantics."""
+    import jax
+    from jax import export as jexport
+
+    from ..framework import io as fio
+    from ..models.generation import build_serve_fn
+
+    was_training = getattr(model, "training", False)
+    model.eval()
+    try:
+        serve = build_serve_fn(model, max_new_tokens, do_sample=do_sample,
+                               temperature=temperature, top_k=top_k,
+                               top_p=top_p, eos_token_id=eos_token_id,
+                               cache=cache)
+        params = {k: p._value for k, p in model.named_parameters()}
+        buffers = {k: b._value for k, b in model.named_buffers()}
+        zero_key = jax.random.key_data(jax.random.PRNGKey(0))
+        params_avals = jax.tree_util.tree_map(
+            lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), params)
+        ids_aval = jax.ShapeDtypeStruct((batch, prompt_len), np.int32)
+        keys_aval = jax.ShapeDtypeStruct(
+            (max_new_tokens,) + tuple(zero_key.shape), zero_key.dtype)
+        exported = jexport.export(jax.jit(serve))(
+            params_avals, ids_aval, keys_aval)
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path + ".pdmodel", "wb") as f:
+            f.write(exported.serialize())
+        fio.save({"params": params, "buffers": buffers}, path + ".pdiparams")
+        meta = {
+            "bundle": "generate",
+            "n_inputs": 2,
+            "input_names": ["input_ids", "rng_keys"],
+            "input_shapes": [[batch, prompt_len],
+                             [max_new_tokens] + list(zero_key.shape)],
+            "input_dtypes": ["int32", str(zero_key.dtype)],
+            "output_names": ["output_ids"],
+            "max_new_tokens": max_new_tokens,
+            "do_sample": bool(do_sample),
+            "cache": cache,
+        }
+        with open(path + ".pdmodel.json", "w") as f:
+            json.dump(meta, f)
+    finally:
+        if was_training:
+            model.train()
+
+
 class TranslatedLayer:
     """Loaded artifact (reference translated_layer.py TranslatedLayer):
     callable; parameters are data, not code."""
@@ -155,13 +214,18 @@ class TranslatedLayer:
         self._params = params
         self._buffers = buffers
         self._meta = meta
+        self._call_fn = None  # optional jit wrapper (Predictor precision)
         self.training = False
 
     def __call__(self, *inputs):
-        vals = [x._value if isinstance(x, Tensor) else x for x in inputs]
-        out = self._exported.call(self._params, *vals)
         import jax
+        import jax.numpy as jnp
 
+        vals = [x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in inputs]
+        fn = getattr(self, "_call_fn", None)  # Predictor precision wrapper
+        out = (fn(self._params, *vals) if fn is not None
+               else self._exported.call(self._params, *vals))
         return jax.tree_util.tree_map(Tensor._from_value, out)
 
     forward = __call__

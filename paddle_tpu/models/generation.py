@@ -20,7 +20,7 @@ import numpy as np
 from ..core import autograd, random as _random
 from ..core.tensor import Tensor
 
-__all__ = ["generate"]
+__all__ = ["generate", "build_serve_fn"]
 
 
 def _sample_with_key(logits, key, temperature, top_k, top_p, greedy):
@@ -174,6 +174,101 @@ def _generate_jit(model, ids, max_new_tokens, do_sample, temperature,
     else:
         out = jnp.concatenate([ids, tok0[:, None]], axis=1)
     return Tensor._from_value(out)
+
+
+def build_serve_fn(model, max_new_tokens, do_sample=False, temperature=1.0,
+                   top_k=None, top_p=None, eos_token_id=None, cache="paged"):
+    """Pure ``serve(params, ids, keys) -> (B, S + max_new_tokens) ids`` for
+    EXPORT (jit.save_generate): prefill + the scanned decode loop + sampling
+    in ONE program, with the KV caches allocated inside so the artifact has
+    no cross-call state (the deployment shape of the reference's
+    fused_multi_transformer serving path; analysis_predictor.h:105 loads
+    the equivalent frozen program). ``keys`` is a (max_new_tokens, ...)
+    stack of PRNG key data — ignored (but still an operand, so one artifact
+    serves any seed) when sampling is off."""
+    from ..jit import _FunctionalModel
+    from .llama import PagedKVCache, StaticCache
+
+    cfg = model.config
+    kv_heads = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
+    n_layers = cfg.num_hidden_layers
+    functional = _FunctionalModel(model)
+    buffers = {k: bu._value for k, bu in model.named_buffers()}
+    zero_key = jax.random.key_data(jax.random.PRNGKey(0))
+    paged = cache == "paged"
+    try:
+        cache_dtype = next(iter(model.parameters()))._value.dtype
+    except StopIteration:
+        cache_dtype = jnp.float32
+
+    def serve(params, ids, keys):
+        b, s = ids.shape
+        max_len = s + max_new_tokens
+        if paged:
+            page = 128
+            padded = ((max_len + page - 1) // page) * page
+            empty = [PagedKVCache(b, padded, kv_heads, cfg.head_dim,
+                                  page_size=page, dtype=cache_dtype)
+                     for _ in range(n_layers)]
+            tables = empty[0].tables
+            page_size = empty[0].page_size
+
+            def rebuild(ks, vs, length):
+                return [_make_paged_cache(ks[i], vs[i], tables, page_size,
+                                          length) for i in range(n_layers)]
+
+            ks0 = [c.k_pages for c in empty]
+            vs0 = [c.v_pages for c in empty]
+        else:
+            empty = [StaticCache(b, max_len, kv_heads, cfg.head_dim,
+                                 dtype=cache_dtype) for _ in range(n_layers)]
+
+            def rebuild(ks, vs, length):
+                return [_make_static_cache(ks[i], vs[i], length)
+                        for i in range(n_layers)]
+
+            ks0 = [c.k for c in empty]
+            vs0 = [c.v for c in empty]
+
+        def unpack(caches):
+            if paged:
+                return ([c.k_pages for c in caches],
+                        [c.v_pages for c in caches])
+            return [c.k for c in caches], [c.v for c in caches]
+
+        (logits, caches), _ = functional(
+            params, buffers, (ids,), {"caches": rebuild(ks0, vs0, 0)},
+            zero_key)
+        ks, vs = unpack(caches)
+        tok0 = _sample_with_key(
+            logits[:, -1, :], jax.random.wrap_key_data(keys[0]),
+            temperature, top_k, top_p, not do_sample).astype(ids.dtype)
+        fin0 = jnp.zeros((b,), bool)
+        if eos_token_id is not None:
+            fin0 = fin0 | (tok0 == eos_token_id)
+
+        def body(carry, key_i):
+            tok, ks, vs, length, fin = carry
+            (logits, caches2), _ = functional(
+                params, buffers, (tok[:, None],),
+                {"caches": rebuild(ks, vs, length)}, zero_key)
+            nxt = _sample_with_key(
+                logits[:, -1, :], jax.random.wrap_key_data(key_i),
+                temperature, top_k, top_p, not do_sample).astype(tok.dtype)
+            if eos_token_id is not None:
+                nxt = jnp.where(fin, eos_token_id, nxt)
+                fin = fin | (nxt == eos_token_id)
+            ks2, vs2 = unpack(caches2)
+            return (nxt, ks2, vs2, caches2[0].length, fin), nxt
+
+        if max_new_tokens > 1:
+            _, toks = jax.lax.scan(
+                body, (tok0, ks, vs, jnp.asarray(s, jnp.int32), fin0),
+                keys[1:])
+            return jnp.concatenate([ids, tok0[:, None], toks.T], axis=1)
+        return jnp.concatenate([ids, tok0[:, None]], axis=1)
+
+    return serve
 
 
 def generate(model, input_ids, max_new_tokens=20, do_sample=False,

@@ -98,6 +98,12 @@ class Optimizer:
         self._grad_clip = grad_clip
         self._multi_precision = multi_precision
         self._master_grad = False  # set by amp.decorate(master_grad=True)
+        # Optional low-precision accumulator STORAGE (optax mu_dtype analog,
+        # the standard 16GB-chip trick for fitting >1B-param Adam state):
+        # moments are kept in this dtype between steps but every update
+        # computes in the work dtype (f32 under multi_precision) — set by
+        # optimizers that accept acc_dtype=.
+        self._acc_dtype = None
         # Accumulator keys are positional ("slot@<index in parameter list>")
         # so optimizer state_dicts restore across processes regardless of the
         # auto-generated tensor names' global counter.
@@ -268,7 +274,12 @@ class Optimizer:
                 else:
                     new_masters.append(None)
                     new_params.append(new_p)
-                new_accs.append([accs_out[name] for name in self_ref._accumulator_names])
+                # store each slot back in its STORAGE dtype (acc_dtype may be
+                # narrower than the compute dtype; donated carries must keep
+                # a stable dtype across steps)
+                new_accs.append([
+                    accs_out[name].astype(accs[name].dtype)
+                    for name in self_ref._accumulator_names])
             return new_params, new_masters, new_accs
 
         # No donation here: freshly-initialized accumulators can alias (XLA
@@ -501,7 +512,8 @@ class Optimizer:
             else:
                 new_params[name] = new_p
             for slot in self._accumulator_names:
-                new_accs[f"{slot}@{name}"] = slots_out[slot]
+                key = f"{slot}@{name}"
+                new_accs[key] = slots_out[slot].astype(accs[key].dtype)
         return new_params, new_accs, new_masters
 
     def init_functional_state(self, named_params: dict):
@@ -517,9 +529,10 @@ class Optimizer:
     def _init_slot_value(self, slot, value):
         """Slot init on a raw array — shared by eager _init_slot and the
         functional path so e.g. Adagrad's initial_accumulator_value matches."""
-        return jnp.zeros_like(
-            value, dtype=jnp.float32 if self._multi_precision else value.dtype
-        )
+        dtype = jnp.float32 if self._multi_precision else value.dtype
+        if self._acc_dtype is not None:
+            dtype = self._acc_dtype
+        return jnp.zeros_like(value, dtype=dtype)
 
     # ------------------------------------------------ state dict
 
@@ -629,12 +642,19 @@ class Adam(Optimizer):
 
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=None, grad_clip=None,
-                 lazy_mode=False, multi_precision=False, name=None):
+                 lazy_mode=False, multi_precision=False, acc_dtype=None,
+                 name=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip, multi_precision, name)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
         self._lazy_mode = bool(lazy_mode)
+        if acc_dtype is not None:
+            # bf16 moment STORAGE (compute stays f32 under multi_precision) —
+            # optax mu_dtype analog; halves Adam state for >1B params/chip
+            from ..core.dtype import to_jax_dtype
+
+            self._acc_dtype = to_jax_dtype(acc_dtype)
 
     def _rule(self, p, g, accs, lr, t, apply_decay=True, wd=None):
         dt = p.dtype
@@ -684,9 +704,10 @@ class AdamW(Adam):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
                  parameters=None, weight_decay=0.01, lr_ratio=None,
                  apply_decay_param_fun=None, grad_clip=None, lazy_mode=False,
-                 multi_precision=False, name=None):
+                 multi_precision=False, acc_dtype=None, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
-                         None, grad_clip, lazy_mode, multi_precision, name)
+                         None, grad_clip, lazy_mode, multi_precision,
+                         acc_dtype, name)
         self._coeff = float(weight_decay) if not hasattr(weight_decay, "coeff") else float(weight_decay.coeff)
         self._apply_decay_param_fun = apply_decay_param_fun
 

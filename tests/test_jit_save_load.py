@@ -184,3 +184,108 @@ def test_save_duplicate_explicit_names_fail_before_writing(tmp_path):
         InputSpec([2, 8], "float32", name="x"),
         ])
     assert not os.path.exists(path + ".pdmodel")  # no partial artifact
+
+
+# ---------------- compiled-decode artifact + serving precision (r5) ------
+
+
+def _tiny_llama(tie=True, dtype=None):
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=211, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=64, tie_word_embeddings=tie)
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg)
+    if dtype:
+        m.to(dtype=dtype)
+    return cfg, m
+
+
+def test_save_generate_matches_generate(tmp_path):
+    """The exported one-program decode artifact (save_generate) must emit
+    the SAME tokens as the in-process compiled generate() for greedy
+    decoding on the same weights."""
+    import jax
+
+    from paddle_tpu import inference
+    from paddle_tpu.models.generation import generate
+
+    cfg, m = _tiny_llama()
+    B, S, NEW = 2, 6, 8
+    prompt = np.random.RandomState(0).randint(0, 211, (B, S)).astype(np.int32)
+    want = np.asarray(
+        generate(m, paddle.to_tensor(prompt), max_new_tokens=NEW,
+                 cache="paged")._value)
+
+    path = str(tmp_path / "decode")
+    paddle.jit.save_generate(m, path, batch=B, prompt_len=S,
+                             max_new_tokens=NEW, cache="paged")
+    pred = inference.create_predictor(inference.Config(path))
+    assert pred.get_input_names() == ["input_ids", "rng_keys"]
+    pred.get_input_handle("input_ids").copy_from_cpu(prompt)
+    zero = jax.random.key_data(jax.random.PRNGKey(0))
+    pred.get_input_handle("rng_keys").copy_from_cpu(
+        np.zeros((NEW,) + zero.shape, zero.dtype))
+    (got,) = pred.run()
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_save_generate_static_cache_and_sampling(tmp_path):
+    """Static-cache bundle; sampling path consumes the key stack and is
+    reproducible for a fixed key stack."""
+    import jax
+
+    from paddle_tpu import inference
+
+    cfg, m = _tiny_llama(tie=False)
+    B, S, NEW = 2, 5, 6
+    path = str(tmp_path / "decode_s")
+    paddle.jit.save_generate(m, path, batch=B, prompt_len=S,
+                             max_new_tokens=NEW, do_sample=True,
+                             temperature=0.9, top_k=17, cache="static")
+    pred = inference.create_predictor(inference.Config(path))
+    prompt = np.random.RandomState(1).randint(0, 211, (B, S)).astype(np.int32)
+    keys = np.stack([jax.random.key_data(jax.random.PRNGKey(i))
+                     for i in range(NEW)])
+    pred.get_input_handle("input_ids").copy_from_cpu(prompt)
+    pred.get_input_handle("rng_keys").copy_from_cpu(keys)
+    (a,) = pred.run()
+    pred.get_input_handle("input_ids").copy_from_cpu(prompt)
+    pred.get_input_handle("rng_keys").copy_from_cpu(keys)
+    (b,) = pred.run()
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert np.asarray(a).shape == (B, S + NEW)
+    # prompt rides through unchanged
+    np.testing.assert_array_equal(np.asarray(a)[:, :S], prompt)
+
+
+def test_predictor_precision_bfloat16(tmp_path):
+    """Config.precision('bfloat16') ACTS: params at rest are bf16 (half the
+    HBM) and the served output stays close to the f32 run."""
+    import jax.numpy as jnp
+
+    from paddle_tpu import inference
+
+    model = _mlp()
+    path = str(tmp_path / "prec")
+    x = np.random.rand(2, 8).astype(np.float32)
+    paddle.jit.save(model, path, input_spec=[InputSpec([2, 8], "float32")])
+
+    cfg32 = inference.Config(path)
+    p32 = inference.create_predictor(cfg32)
+    p32.get_input_handle(p32.get_input_names()[0]).copy_from_cpu(x)
+    (ref,) = p32.run()
+
+    cfg16 = inference.Config(path)
+    cfg16.precision("bfloat16")
+    p16 = inference.create_predictor(cfg16)
+    for v in p16._layer._params.values():
+        if jnp.issubdtype(np.asarray(ref).dtype, jnp.floating):
+            assert v.dtype == jnp.bfloat16, v.dtype
+    # bf16 inputs are accepted too (IO cast happens in the wrapper program)
+    p16.get_input_handle(p16.get_input_names()[0]).copy_from_cpu(
+        jnp.asarray(x, jnp.bfloat16))
+    (out,) = p16.run()
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=0.05, atol=0.05)

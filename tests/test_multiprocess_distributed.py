@@ -334,3 +334,77 @@ def test_two_process_global_mesh(tmp_path):
         np.arange(256, dtype=np.float32).reshape(16, 16))
     assert int(got["step"]) == 7
     assert target._value.addressable_shards[0].data.shape == (4, 8)
+
+
+# ------------------------- paddle.distributed.spawn (r5, VERDICT item 8) --
+
+
+def _spawn_worker_global_mesh(out_dir):
+    """Module-level (picklable) worker: spawn has already set the PADDLE_*
+    env and run init_parallel_env, so the function body starts with the
+    global multi-controller view (reference spawn.py _func_wrapper)."""
+    import os
+
+    import jax
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    rank = dist.get_rank()
+    world = dist.get_world_size()
+    assert world == 2, world
+    n_dev = len(jax.devices())
+    assert n_dev > len(jax.local_devices())  # genuinely spans processes
+    mesh = dist.ProcessMesh(np.arange(n_dev), ["dp"])
+    x = dist.shard_tensor(
+        paddle.to_tensor(np.arange(2 * n_dev, dtype=np.float32)), mesh,
+        [dist.Shard(0)])
+    total = float(jax.jit(lambda v: v.sum())(x._value))
+    assert total == (2 * n_dev - 1) * n_dev
+    with open(os.path.join(out_dir, f"rank{rank}.ok"), "w") as f:
+        f.write(f"{rank}/{world} ndev={n_dev}")
+
+
+def _spawn_worker_boom():
+    raise RuntimeError("intentional worker failure")
+
+
+def test_spawn_two_process_global_mesh(tmp_path):
+    """dist.spawn runs a picklable function as 2 ranked jax controllers
+    over a fresh TCPStore rendezvous (reference spawn.py:463)."""
+    import paddle_tpu.distributed as dist
+
+    dist.spawn(_spawn_worker_global_mesh, args=(str(tmp_path),), nprocs=2,
+               env={"JAX_PLATFORMS": "cpu"})
+    for r in (0, 1):
+        assert (tmp_path / f"rank{r}.ok").exists()
+    ok0 = (tmp_path / "rank0.ok").read_text()
+    assert ok0.startswith("0/2"), ok0
+
+
+def test_spawn_propagates_worker_failure():
+    import pytest as _pytest
+
+    import paddle_tpu.distributed as dist
+
+    with _pytest.raises(RuntimeError, match="worker"):
+        dist.spawn(_spawn_worker_boom, nprocs=1,
+                   env={"JAX_PLATFORMS": "cpu"}, init_env=False)
+
+
+def test_spawn_join_false_returns_context():
+    import paddle_tpu.distributed as dist
+
+    ctx = dist.spawn(_spawn_worker_noop, nprocs=2, join=False,
+                     env={"JAX_PLATFORMS": "cpu"}, init_env=False)
+    assert isinstance(ctx, dist.MultiprocessContext)
+    assert len(ctx.processes) == 2
+    assert ctx.join() is True
+
+
+def _spawn_worker_noop():
+    import os
+
+    assert os.environ["PADDLE_TRAINERS_NUM"] == "2"
+    assert os.environ["PADDLE_MASTER"]
