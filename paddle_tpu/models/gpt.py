@@ -154,9 +154,23 @@ class GPTForCausalLM(Layer):
                                   weight_attr=I.Normal(0.0, config.initializer_range),
                                   bias_attr=False)
 
-    def forward(self, input_ids, caches=None):
+    def forward(self, input_ids, caches=None, labels=None):
         out = self.gpt(input_ids, caches=caches)
         hidden = out[0] if caches is not None else out
+        if labels is not None:
+            # fused blockwise lm-head + CE training path (llama.py
+            # LlamaForCausalLM.forward labels= semantics, shared TP
+            # fallback routing)
+            if caches is not None:
+                raise ValueError("labels= is a training-path argument; "
+                                 "decode caches don't apply")
+            from .llama import causal_lm_loss
+
+            if self.lm_head is None:
+                w, t_y = self.gpt.wte.weight, True  # (V, H)
+            else:
+                w, t_y = self.lm_head.weight, False  # (H, V)
+            return causal_lm_loss(hidden, w, labels, t_y)
         if self.lm_head is None:
             logits = matmul(hidden, self.gpt.wte.weight, transpose_y=True)
         else:

@@ -128,6 +128,12 @@ class StaticCache:
         return self.k, self.v
 
 
+def _per_seq_lengths(length):
+    """True when a cache ``length`` is a per-sequence (B,) array
+    (continuous batching) rather than a uniform python/traced scalar."""
+    return not isinstance(length, int) and getattr(length, "ndim", 0) == 1
+
+
 class PagedKVCache:
     """Paged KV cache for one attention layer — the analog of the
     reference's blocked cache
@@ -159,8 +165,26 @@ class PagedKVCache:
     def update(self, k_new, v_new):
         """Write (B, S, KVH, D) new keys/values at positions
         [length, length+S). Decode (S=1) is one scatter; prefill unrolls
-        per token (a bulk page-copy path is the serving optimization)."""
+        per token (a bulk page-copy path is the serving optimization).
+        ``length`` may be a PER-SEQUENCE (B,) array (continuous batching:
+        each slot decodes at its own depth) — decode steps then scatter at
+        per-slot positions."""
         b, s = k_new.shape[0], k_new.shape[1]
+        if _per_seq_lengths(self.length):
+            if s != 1:
+                raise ValueError(
+                    "per-sequence cache lengths support only single-token "
+                    "decode steps (prefill each slot separately)")
+            pos = self.length  # (B,)
+            page_ids = jnp.take_along_axis(
+                self.tables, (pos // self.page_size)[:, None], axis=1)[:, 0]
+            off = pos % self.page_size
+            self.k_pages = self.k_pages.at[page_ids, off].set(
+                k_new[:, 0].astype(self.k_pages.dtype))
+            self.v_pages = self.v_pages.at[page_ids, off].set(
+                v_new[:, 0].astype(self.v_pages.dtype))
+            self.length = self.length + 1
+            return
         for i in range(s):
             pos = self.length + i
             page_ids = self.tables[:, pos // self.page_size]
@@ -191,7 +215,10 @@ def cached_attention(q, k, v, cache, offset, s):
                   and paged_attention_supported(
                       q._value[:, 0],
                       cache.k_pages if paged else cache.k))
-    lengths = jnp.full((q.shape[0],), cache.length, jnp.int32)
+    clen = cache.length  # post-update: includes the new tokens
+    per_seq = _per_seq_lengths(clen)
+    lengths = (clen.astype(jnp.int32) if per_seq
+               else jnp.full((q.shape[0],), clen, jnp.int32))
     if paged:
         if s == 1 and use_kernel:
             out = paged_attention(
@@ -217,9 +244,13 @@ def cached_attention(q, k, v, cache, offset, s):
             q._value[:, 0], k_all, v_all, lengths)
         return Tensor._from_value(out[:, None])
     max_len = k_all.shape[1]
-    rows = jnp.arange(s)[:, None] + offset
-    cols = jnp.arange(max_len)[None, :]
-    mask = (cols <= rows)[None, None, :, :]  # causal over valid cells
+    cols = jnp.arange(max_len)
+    if per_seq:  # per-slot depths: (B, 1, s, max_len) causal mask
+        rows = jnp.arange(s)[None, :] + offset[:, None]  # (B, s)
+        mask = cols[None, None, None, :] <= rows[:, None, :, None]
+    else:
+        rows = jnp.arange(s)[:, None] + offset
+        mask = (cols[None, :] <= rows)[None, None, :, :]
     return scaled_dot_product_attention(
         q, Tensor._from_value(k_all), Tensor._from_value(v_all),
         attn_mask=Tensor._from_value(mask))
@@ -268,7 +299,12 @@ class LlamaAttention(Layer):
             # carries it through lax.scan), so positions are computed as
             # static-arange + offset rather than branching on its value.
             offset = cache.length
-            if not isinstance(offset, int) or offset > 0:
+            if _per_seq_lengths(offset):
+                # per-slot decode depths (continuous batching): (B, s)
+                # position ids select each slot's own rope rows
+                position_ids = Tensor._from_value(
+                    jnp.arange(s)[None, :] + offset[:, None])
+            elif not isinstance(offset, int) or offset > 0:
                 position_ids = Tensor._from_value(
                     jnp.arange(s) + offset)
             q, k = rotary_position_embedding(
@@ -374,6 +410,25 @@ class LlamaModel(Layer):
         return hidden
 
 
+def causal_lm_loss(hidden, w, labels, transpose_y):
+    """Shifted next-token CE from HIDDEN states + the lm-head weight —
+    the shared labels= training path (LLaMA and GPT): the fused blockwise
+    kernel when the weight is replicated, sharded logits +
+    c_softmax_with_cross_entropy when the vocab axis is TP-sharded (the
+    blockwise dynamic-slice walk would make GSPMD all-gather the
+    weight)."""
+    if _vocab_dim_sharded(w, 0 if transpose_y else 1):
+        from ..ops import c_softmax_with_cross_entropy
+
+        logits = matmul(hidden, w, transpose_y=transpose_y)
+        lab = labels[..., 0] if (labels.ndim == 3
+                                 and labels.shape[-1] == 1) else labels
+        return c_softmax_with_cross_entropy(
+            logits[:, :-1, :], lab[:, 1:]).mean()
+    return LlamaPretrainingCriterion.fused(
+        hidden, w, labels, transpose_y=transpose_y)
+
+
 def _vocab_dim_sharded(w, vocab_dim):
     """True when the lm-head weight's vocab axis is sharded (TP). Works
     under trace via the `_placements_hint` shard_tensor stamps; falls back
@@ -422,21 +477,7 @@ class LlamaForCausalLM(Layer):
                 w, t_y = self.model.embed_tokens.weight, True  # (V, H)
             else:
                 w, t_y = self.lm_head.weight, False  # (H, V)
-            if _vocab_dim_sharded(w, 0 if t_y else 1):
-                # TP vocab-sharded head: the blockwise dynamic-slice walk
-                # would make GSPMD all-gather the weight every block — take
-                # sharded logits + the c_softmax local-reduce path instead
-                # (the reference kernel's own TP story)
-                from ..ops import c_softmax_with_cross_entropy
-
-                logits = matmul(hidden, w, transpose_y=t_y)
-                lab = labels[..., 0] if (labels.ndim == 3
-                                         and labels.shape[-1] == 1) else labels
-                loss = c_softmax_with_cross_entropy(
-                    logits[:, :-1, :], lab[:, 1:])
-                return loss.mean()
-            return LlamaPretrainingCriterion.fused(
-                hidden, w, labels, transpose_y=t_y)
+            return causal_lm_loss(hidden, w, labels, t_y)
         if self.lm_head is None:
             logits = matmul(hidden, self.model.embed_tokens.weight,
                             transpose_y=True)

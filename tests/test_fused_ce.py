@@ -176,6 +176,27 @@ def test_llama_labels_path_tp_fallback():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_gpt_labels_path_matches_criterion():
+    """GPT shares the causal_lm_loss labels= path: fused loss == unfused
+    criterion loss (tied embeddings)."""
+    from paddle_tpu.models.gpt import (
+        GPTConfig, GPTForCausalLM, GPTPretrainingCriterion,
+    )
+
+    cfg = GPTConfig(vocab_size=197, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=64,
+                    max_position_embeddings=64,
+                    hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    paddle.seed(0)
+    m = GPTForCausalLM(cfg)
+    ids = paddle.to_tensor(
+        np.random.RandomState(2).randint(0, 197, (2, 13)).astype(np.int32))
+    loss_f = m(ids, labels=ids)
+    loss_u = GPTPretrainingCriterion()(m(ids), ids)
+    np.testing.assert_allclose(float(loss_f), float(loss_u), rtol=1e-5)
+
+
 def test_llama_labels_path_compiled_trainstep():
     """Fused loss through TrainStep.run: losses must track the unfused
     TrainStep step-for-step."""

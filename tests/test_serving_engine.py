@@ -1,0 +1,76 @@
+"""Continuous batching over PagedKVCache (VERDICT r4 item 9, stretch).
+
+The engine must be a pure scheduler: greedy outputs are token-identical to
+per-request generate(), across mixed prompt lengths, slot retirement and
+readmission. Reference kernel-level anchor:
+block_multi_head_attention_kernel.cu (the paged cache the slots live in).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.generation import generate
+from paddle_tpu.models.serving import ContinuousBatchingEngine
+
+
+def _model(vocab=211):
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=256, tie_word_embeddings=True)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+def test_continuous_batching_matches_per_request_generate():
+    m = _model()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 211, (n,)).astype(np.int32)
+               for n in (5, 11, 3, 9, 14, 7)]
+    eng = ContinuousBatchingEngine(m, max_slots=3, max_len=128,
+                                   page_size=32, prompt_buckets=(16,))
+    outs, stats = eng.run(prompts, max_new_tokens=10, segment=4)
+    assert stats["useful_tokens"] == 6 * 10
+    assert stats["mean_occupancy"] > 0.5
+    for i, p in enumerate(prompts):
+        want = np.asarray(
+            generate(m, paddle.to_tensor(p[None, :]), max_new_tokens=10,
+                     cache="paged")._value)[0, p.size:]
+        np.testing.assert_array_equal(outs[i], want, err_msg=f"request {i}")
+
+
+def test_continuous_batching_eos_retires_and_readmits():
+    m = _model()
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 211, (n,)).astype(np.int32)
+               for n in (4, 6, 5, 8)]
+    # find a token the model actually emits greedily, use it as eos
+    probe = np.asarray(
+        generate(m, paddle.to_tensor(prompts[0][None, :]),
+                 max_new_tokens=6, cache="paged")._value)[0, 4:]
+    eos = int(probe[2])  # stops request 0 after <= 3 tokens
+    eng = ContinuousBatchingEngine(m, max_slots=2, max_len=64,
+                                   page_size=32, prompt_buckets=(8, 16),
+                                   eos_token_id=eos)
+    outs, stats = eng.run(prompts, max_new_tokens=12, segment=4)
+    assert all(o is not None for o in outs)
+    for i, p in enumerate(prompts):
+        want = np.asarray(
+            generate(m, paddle.to_tensor(p[None, :]), max_new_tokens=12,
+                     cache="paged", eos_token_id=eos)._value)[0, p.size:]
+        got = outs[i]
+        # engine truncates at eos; generate() eos-pads to full width
+        np.testing.assert_array_equal(got, want[:len(got)],
+                                      err_msg=f"request {i}")
+        if eos in want.tolist():
+            assert got[-1] == eos
+
+
+def test_continuous_batching_validates_capacity():
+    m = _model()
+    eng = ContinuousBatchingEngine(m, max_slots=2, max_len=64,
+                                   page_size=32, prompt_buckets=(32,))
+    with pytest.raises(ValueError, match="exceeds slot capacity"):
+        eng.run([np.arange(60, dtype=np.int32) % 211], max_new_tokens=10)
+    with pytest.raises(ValueError, match="exceeds largest bucket"):
+        eng.run([np.arange(40, dtype=np.int32) % 211], max_new_tokens=1)
