@@ -532,15 +532,18 @@ try:
         CB_SLOTS, CB_LEN, CB_REQ, CB_NEW, CB_SEG = 8, 512, 24, 64, 32
     log(f"continuous batching: {CB_REQ} mixed-length requests, "
         f"{CB_SLOTS} slots, segment={CB_SEG}...")
+    # two buckets: each bucket costs one fixed-shape prefill compile
+    # (~1 min at 438M through the remote compiler) — 32/128 still covers
+    # the 8..119 mixed-length draw below
     eng = ContinuousBatchingEngine(model, max_slots=CB_SLOTS,
                                    max_len=CB_LEN, page_size=128,
-                                   prompt_buckets=(16, 32, 64, 128))
+                                   prompt_buckets=(32, 128))
     rng_cb = np.random.RandomState(7)
     # warm one request per bucket AT the real segment length: compiles
     # every prefill variant + the exact segment program outside the
     # timed run
     warm_reqs = [rng_cb.randint(0, cfg.vocab_size, (n,)).astype(np.int32)
-                 for n in ((5, 20, 40) if SMOKE else (12, 28, 60, 120))]
+                 for n in ((5, 40) if SMOKE else (12, 60))]
     eng.run(warm_reqs, max_new_tokens=2, segment=CB_SEG)
     lens = rng_cb.randint(8, 64 if SMOKE else 120, CB_REQ)
     reqs = [rng_cb.randint(0, cfg.vocab_size, (int(n),)).astype(np.int32)

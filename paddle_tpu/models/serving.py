@@ -76,15 +76,21 @@ class ContinuousBatchingEngine:
         except StopIteration:
             dtype = jnp.float32
         per_seq = self.max_len // self.page_size
-        n_pages = self.max_slots * per_seq
+        # +1 slot row of SCRATCH pages: admission groups are padded to a
+        # fixed batch (one compiled prefill shape per bucket, not one per
+        # group size) and padding rows write into scratch, never into a
+        # live slot's pages
+        n_pages = (self.max_slots + 1) * per_seq
         self._nl = cfg.num_hidden_layers
         self._ks = [jnp.zeros((n_pages, self.page_size, kv, cfg.head_dim),
                               dtype) for _ in range(self._nl)]
         self._vs = [jnp.zeros_like(k) for k in self._ks]
-        # interleaved slot->page map (PagedKVCache layout)
+        # interleaved slot->page map (PagedKVCache layout); row
+        # ``max_slots`` is the scratch row
+        rows = self.max_slots + 1
         self._tables = (jnp.arange(per_seq, dtype=jnp.int32)[None, :]
-                        * self.max_slots
-                        + jnp.arange(self.max_slots, dtype=jnp.int32)[:, None])
+                        * rows
+                        + jnp.arange(rows, dtype=jnp.int32)[:, None])
         self._functional = _FunctionalModel(model)
         self._buffers = {k: b._value for k, b in model.named_buffers()}
         self._zero_key = jax.random.key_data(jax.random.PRNGKey(0))
@@ -111,23 +117,27 @@ class ContinuousBatchingEngine:
         greedy = not self.do_sample
         eos = self.eos_token_id
 
-        def prefill(params, ks, vs, prompt, table_row, true_len, key):
-            # batch-1 prompt (padded to its bucket); causal prefill writes
-            # the slot's pages; the first token samples from the logits at
-            # the TRUE last position (padding rows are never read)
-            caches = self._caches(ks, vs, table_row, 0)
+        def prefill(params, ks, vs, prompts, table_rows, true_lens, key):
+            # N same-bucket admissions in ONE dispatch: (N, L) padded
+            # prompts, each row writing its own slot's pages; first tokens
+            # sample from the logits at each row's TRUE last position
+            # (padding rows are never read — causal)
+            caches = self._caches(ks, vs, table_rows, 0)
             (logits, caches2), _ = functional(
-                params, buffers, (prompt,), {"caches": caches}, zero_key)
+                params, buffers, (prompts,), {"caches": caches}, zero_key)
+            idx = (true_lens - 1).astype(jnp.int32)[:, None, None]
             last = jnp.take_along_axis(
-                logits, (true_len - 1)[None, None, None].astype(jnp.int32)
-                .repeat(logits.shape[-1], -1), axis=1)[:, 0]
+                logits, jnp.broadcast_to(
+                    idx, (logits.shape[0], 1, logits.shape[-1])),
+                axis=1)[:, 0]
             tok0 = _sample_with_key(last, jax.random.wrap_key_data(key),
                                     temperature, top_k, top_p, greedy)
             return (tok0.astype(jnp.int32),
                     [c.k_pages for c in caches2],
                     [c.v_pages for c in caches2])
 
-        def segment(params, ks, vs, tables, lengths, toks, active, keys):
+        def segment(params, ks, vs, tables, lengths, toks, active, limits,
+                    keys):
             def body(carry, key):
                 tok, ks, vs, lengths, active = carry
                 caches = self._caches(ks, vs, tables, lengths)
@@ -138,10 +148,14 @@ class ContinuousBatchingEngine:
                     logits[:, -1, :], jax.random.wrap_key_data(key),
                     temperature, top_k, top_p, greedy).astype(jnp.int32)
                 nxt = jnp.where(active, nxt, tok)  # frozen slots emit noise
-                new_active = active
+                new_lengths = jnp.where(active, lengths + 1, lengths)
+                # deactivate at the per-slot token budget: a slot must
+                # never advance past its validated capacity mid-segment
+                # (the paged kernel's lengths contract; frozen slots
+                # re-write their own frozen cell, never another slot's)
+                new_active = active & (new_lengths < limits)
                 if eos is not None:
                     new_active = new_active & (nxt != eos)
-                new_lengths = jnp.where(active, lengths + 1, lengths)
                 ks2 = [c.k_pages for c in caches2]
                 vs2 = [c.v_pages for c in caches2]
                 return ((nxt, ks2, vs2, new_lengths, new_active),
@@ -177,39 +191,75 @@ class ContinuousBatchingEngine:
                 raise ValueError(
                     f"prompt ({p.size}) + max_new_tokens ({max_new_tokens}) "
                     f"exceeds slot capacity {self.max_len}")
+            # validate the bucket UP FRONT too: prefill writes the whole
+            # padded bucket into the slot's pages, and an oversized or
+            # missing bucket must not surface mid-run after other
+            # requests' work
+            b = _bucket(p.size, self.prompt_buckets)
+            if b > self.max_len:
+                raise ValueError(
+                    f"prompt bucket {b} (for a {p.size}-token prompt) "
+                    f"exceeds slot capacity {self.max_len}; add a smaller "
+                    f"bucket or raise max_len")
         outputs = [None] * len(prompts)
         collected = {}          # request id -> list of token ids
         slot_req = [None] * self.max_slots
         lengths = np.ones((self.max_slots,), np.int32)  # empty slots: len 1
         cur_tok = np.zeros((self.max_slots,), np.int32)
+        # per-slot length budget: prompt + max_new - 1 is the final length
+        # the last needed emission reaches; the segment program deactivates
+        # a slot there so it never advances past validated capacity
+        limits = np.full((self.max_slots,), self.max_len, np.int32)
         t0 = time.time()
         useful = 0
         seg_runs = 0
         occupancy = []
 
         while queue or any(r is not None for r in slot_req):
-            # admit into free slots (one compiled prefill per admission)
+            # admit into free slots — same-bucket admissions share ONE
+            # compiled prefill dispatch (batched rows, each writing its
+            # own slot's pages)
+            admitting = []  # (slot, rid, prompt, bucket)
             for slot in range(self.max_slots):
                 if slot_req[slot] is not None or not queue:
                     continue
                 rid, prompt = queue.popleft()
-                bucket = _bucket(prompt.size, self.prompt_buckets)
-                padded = np.zeros((1, bucket), np.int32)
-                padded[0, :prompt.size] = prompt
+                admitting.append(
+                    (slot, rid, prompt,
+                     _bucket(prompt.size, self.prompt_buckets)))
+            by_bucket: dict[int, list] = {}
+            for item in admitting:
+                by_bucket.setdefault(item[3], []).append(item)
+            for bucket, group in by_bucket.items():
+                # FIXED admission batch (max_slots rows): one compiled
+                # prefill shape per bucket; padding rows write scratch
+                n = len(group)
+                g = self.max_slots
+                padded = np.zeros((g, bucket), np.int32)
+                true_lens = np.ones((g,), np.int32)
+                rows = np.full((g,), self.max_slots, np.int64)  # scratch
+                for i, (slot, _, prompt, _) in enumerate(group):
+                    padded[i, :prompt.size] = prompt
+                    true_lens[i] = prompt.size
+                    rows[i] = slot
                 tok0, self._ks, self._vs = self._prefill_p(
                     params, self._ks, self._vs, jnp.asarray(padded),
-                    self._tables[slot:slot + 1],
-                    jnp.asarray(prompt.size, jnp.int32),
+                    self._tables[rows], jnp.asarray(true_lens),
                     self._next_keys(1)[0])
-                slot_req[slot] = rid
-                collected[rid] = [int(tok0[0])]
-                useful += 1  # the prefill-sampled first token
-                lengths[slot] = prompt.size
-                cur_tok[slot] = int(tok0[0])
-                if self.eos_token_id is not None and \
-                        collected[rid][0] == self.eos_token_id:
-                    outputs[rid] = np.asarray(collected.pop(rid), np.int32)
-                    slot_req[slot] = None
+                tok0 = np.asarray(tok0)
+                for i, (slot, rid, prompt, _) in enumerate(group):
+                    slot_req[slot] = rid
+                    collected[rid] = [int(tok0[i])]
+                    useful += 1  # the prefill-sampled first token
+                    lengths[slot] = prompt.size
+                    cur_tok[slot] = int(tok0[i])
+                    limits[slot] = prompt.size + max_new_tokens - 1
+                    if len(collected[rid]) >= max_new_tokens or (
+                            self.eos_token_id is not None
+                            and collected[rid][0] == self.eos_token_id):
+                        outputs[rid] = np.asarray(
+                            collected.pop(rid)[:max_new_tokens], np.int32)
+                        slot_req[slot] = None
 
             active_np = np.array([r is not None for r in slot_req])
             if not active_np.any():
@@ -218,13 +268,17 @@ class ContinuousBatchingEngine:
             keys = self._next_keys(segment)
             emitted, was_active, tok, new_lengths, still_active, \
                 self._ks, self._vs = self._segment_p(
-                    params, self._ks, self._vs, self._tables,
+                    params, self._ks, self._vs,
+                    self._tables[:self.max_slots],
                     jnp.asarray(lengths), jnp.asarray(cur_tok),
-                    jnp.asarray(active_np), keys)
-            emitted = np.asarray(emitted)          # (segment, slots)
-            was_active = np.asarray(was_active)
-            lengths = np.asarray(new_lengths).copy()
-            cur_tok = np.asarray(tok).copy()
+                    jnp.asarray(active_np), jnp.asarray(limits), keys)
+            # ONE host round trip for every segment output (separate
+            # np.asarray calls each pay the transfer latency)
+            emitted, was_active, cur_tok, lengths, still_active = \
+                jax.device_get(
+                    (emitted, was_active, tok, new_lengths, still_active))
+            lengths = lengths.copy()
+            cur_tok = cur_tok.copy()
             seg_runs += 1
 
             for slot in range(self.max_slots):
@@ -241,7 +295,7 @@ class ContinuousBatchingEngine:
                 done = (len(toks) >= max_new_tokens
                         or (self.eos_token_id is not None
                             and toks and toks[-1] == self.eos_token_id)
-                        or not bool(np.asarray(still_active)[slot]))
+                        or not bool(still_active[slot]))
                 if done:
                     outputs[rid] = np.asarray(toks[:max_new_tokens],
                                               np.int32)

@@ -66,6 +66,21 @@ def test_continuous_batching_eos_retires_and_readmits():
             assert got[-1] == eos
 
 
+def test_slot_never_advances_past_capacity():
+    """A slot at exactly prompt+max_new == max_len must freeze at its
+    budget mid-segment (the paged kernel's lengths contract) and still
+    emit the full, correct token stream."""
+    m = _model()
+    p = np.random.RandomState(3).randint(0, 211, (54,)).astype(np.int32)
+    eng = ContinuousBatchingEngine(m, max_slots=2, max_len=64,
+                                   page_size=32, prompt_buckets=(64,))
+    outs, _ = eng.run([p], max_new_tokens=10, segment=4)
+    want = np.asarray(
+        generate(m, paddle.to_tensor(p[None, :]), max_new_tokens=10,
+                 cache="paged")._value)[0, 54:]
+    np.testing.assert_array_equal(outs[0], want)
+
+
 def test_continuous_batching_validates_capacity():
     m = _model()
     eng = ContinuousBatchingEngine(m, max_slots=2, max_len=64,
@@ -74,3 +89,9 @@ def test_continuous_batching_validates_capacity():
         eng.run([np.arange(60, dtype=np.int32) % 211], max_new_tokens=10)
     with pytest.raises(ValueError, match="exceeds largest bucket"):
         eng.run([np.arange(40, dtype=np.int32) % 211], max_new_tokens=1)
+    # a bucket larger than the slot capacity is refused UP FRONT (prefill
+    # writes the whole padded bucket into the slot's pages)
+    eng2 = ContinuousBatchingEngine(m, max_slots=2, max_len=32,
+                                    page_size=32, prompt_buckets=(64,))
+    with pytest.raises(ValueError, match="bucket 64"):
+        eng2.run([np.arange(10, dtype=np.int32)], max_new_tokens=4)
