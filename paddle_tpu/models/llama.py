@@ -167,23 +167,60 @@ class PagedKVCache:
         [length, length+S). Decode (S=1) is one scatter; prefill unrolls
         per token (a bulk page-copy path is the serving optimization).
         ``length`` may be a PER-SEQUENCE (B,) array (continuous batching:
-        each slot decodes at its own depth) — decode steps then scatter at
-        per-slot positions."""
+        each slot decodes at its own depth) — decode steps scatter at
+        per-slot positions; a page-multiple S takes the whole-page bulk
+        write, which REQUIRES every per-slot base to be page-aligned (the
+        serving engine's chunked prefill guarantees it: chunk width and
+        bases are page multiples)."""
         b, s = k_new.shape[0], k_new.shape[1]
         if _per_seq_lengths(self.length):
-            if s != 1:
-                raise ValueError(
-                    "per-sequence cache lengths support only single-token "
-                    "decode steps (prefill each slot separately)")
-            pos = self.length  # (B,)
-            page_ids = jnp.take_along_axis(
-                self.tables, (pos // self.page_size)[:, None], axis=1)[:, 0]
-            off = pos % self.page_size
-            self.k_pages = self.k_pages.at[page_ids, off].set(
-                k_new[:, 0].astype(self.k_pages.dtype))
-            self.v_pages = self.v_pages.at[page_ids, off].set(
-                v_new[:, 0].astype(self.v_pages.dtype))
-            self.length = self.length + 1
+            if s > 1 and s % self.page_size == 0:
+                # page-aligned bulk write (chunked prefill: bases are
+                # chunk-width multiples and the chunk width is a page
+                # multiple, so each chunk covers WHOLE pages): one
+                # scatter of (B, s/page) full pages instead of s
+                # per-token scatters
+                npw = s // self.page_size
+                cols = ((self.length // self.page_size)[:, None]
+                        + jnp.arange(npw, dtype=jnp.int32)[None, :])
+                page_ids = jnp.take_along_axis(self.tables, cols, axis=1)
+                k_r = k_new.reshape(b, npw, self.page_size,
+                                    *k_new.shape[2:])
+                v_r = v_new.reshape(b, npw, self.page_size,
+                                    *v_new.shape[2:])
+                self.k_pages = self.k_pages.at[page_ids].set(
+                    k_r.astype(self.k_pages.dtype))
+                self.v_pages = self.v_pages.at[page_ids].set(
+                    v_r.astype(self.v_pages.dtype))
+            else:
+                # per-slot base positions, row-by-row (decode s=1, or a
+                # non-page-aligned chunk width)
+                for i in range(s):
+                    pos = self.length + i  # (B,)
+                    page_ids = jnp.take_along_axis(
+                        self.tables, (pos // self.page_size)[:, None],
+                        axis=1)[:, 0]
+                    off = pos % self.page_size
+                    self.k_pages = self.k_pages.at[page_ids, off].set(
+                        k_new[:, i].astype(self.k_pages.dtype))
+                    self.v_pages = self.v_pages.at[page_ids, off].set(
+                        v_new[:, i].astype(self.v_pages.dtype))
+            self.length = self.length + s
+            return
+        if (s > 1 and s % self.page_size == 0
+                and isinstance(self.length, int)
+                and self.length % self.page_size == 0):
+            # uniform page-aligned prefill: bulk-write whole pages
+            start = self.length // self.page_size
+            npw = s // self.page_size
+            page_ids = self.tables[:, start:start + npw]
+            self.k_pages = self.k_pages.at[page_ids].set(
+                k_new.reshape(b, npw, self.page_size, *k_new.shape[2:])
+                .astype(self.k_pages.dtype))
+            self.v_pages = self.v_pages.at[page_ids].set(
+                v_new.reshape(b, npw, self.page_size, *v_new.shape[2:])
+                .astype(self.v_pages.dtype))
+            self.length += s
             return
         for i in range(s):
             pos = self.length + i

@@ -42,6 +42,12 @@ def _bucket(n, buckets):
 class ContinuousBatchingEngine:
     """Mixed-length generation over ``max_slots`` concurrent sequences.
 
+    Prompts up to the largest bucket admit in one padded prefill; LONGER
+    prompts admit via CHUNKED PREFILL — full largest-bucket-wide chunks
+    written at per-slot offsets (requires ``max_len`` to be a multiple of
+    the largest bucket), so long-context requests stream in without a
+    dedicated compiled shape per length.
+
     Usage::
 
         eng = ContinuousBatchingEngine(model, max_slots=8, max_len=512)
@@ -76,21 +82,26 @@ class ContinuousBatchingEngine:
         except StopIteration:
             dtype = jnp.float32
         per_seq = self.max_len // self.page_size
-        # +1 slot row of SCRATCH pages: admission groups are padded to a
-        # fixed batch (one compiled prefill shape per bucket, not one per
-        # group size) and padding rows write into scratch, never into a
-        # live slot's pages
-        n_pages = (self.max_slots + 1) * per_seq
+        # + a SCRATCH page row: admission groups are padded to a fixed
+        # batch (one compiled prefill shape per bucket, not one per group
+        # size) and padding rows write into scratch, never into a live
+        # slot's pages. Padding rows write at most chunk_w tokens (base
+        # 0), so scratch holds chunk_w/page pages; the row's remaining
+        # table columns alias the last scratch page (never read — masked)
+        scratch_np = max(self.prompt_buckets[-1] // self.page_size, 1)
+        n_pages = self.max_slots * per_seq + scratch_np
         self._nl = cfg.num_hidden_layers
         self._ks = [jnp.zeros((n_pages, self.page_size, kv, cfg.head_dim),
                               dtype) for _ in range(self._nl)]
         self._vs = [jnp.zeros_like(k) for k in self._ks]
         # interleaved slot->page map (PagedKVCache layout); row
         # ``max_slots`` is the scratch row
-        rows = self.max_slots + 1
-        self._tables = (jnp.arange(per_seq, dtype=jnp.int32)[None, :]
-                        * rows
-                        + jnp.arange(rows, dtype=jnp.int32)[:, None])
+        real = (np.arange(per_seq, dtype=np.int32)[None, :] * self.max_slots
+                + np.arange(self.max_slots, dtype=np.int32)[:, None])
+        scratch_ids = self.max_slots * per_seq + np.minimum(
+            np.arange(per_seq, dtype=np.int32), scratch_np - 1)
+        self._tables = jnp.asarray(
+            np.concatenate([real, scratch_ids[None, :]], axis=0))
         self._functional = _FunctionalModel(model)
         self._buffers = {k: b._value for k, b in model.named_buffers()}
         self._zero_key = jax.random.key_data(jax.random.PRNGKey(0))
@@ -117,24 +128,49 @@ class ContinuousBatchingEngine:
         greedy = not self.do_sample
         eos = self.eos_token_id
 
-        def prefill(params, ks, vs, prompts, table_rows, true_lens, key):
-            # N same-bucket admissions in ONE dispatch: (N, L) padded
-            # prompts, each row writing its own slot's pages; first tokens
-            # sample from the logits at each row's TRUE last position
-            # (padding rows are never read — causal)
-            caches = self._caches(ks, vs, table_rows, 0)
-            (logits, caches2), _ = functional(
-                params, buffers, (prompts,), {"caches": caches}, zero_key)
+        def sample_true_last(logits, true_lens, key):
+            # first token from each row's TRUE last position (padding
+            # rows are never read — causal)
             idx = (true_lens - 1).astype(jnp.int32)[:, None, None]
             last = jnp.take_along_axis(
                 logits, jnp.broadcast_to(
                     idx, (logits.shape[0], 1, logits.shape[-1])),
                 axis=1)[:, 0]
-            tok0 = _sample_with_key(last, jax.random.wrap_key_data(key),
-                                    temperature, top_k, top_p, greedy)
-            return (tok0.astype(jnp.int32),
-                    [c.k_pages for c in caches2],
+            return _sample_with_key(
+                last, jax.random.wrap_key_data(key),
+                temperature, top_k, top_p, greedy).astype(jnp.int32)
+
+        def write_prompts(params, ks, vs, prompts, table_rows, base):
+            # run the model over (N, L) prompt rows writing each row's
+            # slot pages at ``base`` (0 = fresh slots, (N,) array =
+            # chunked-prefill offsets); returns (logits, pools)
+            caches = self._caches(ks, vs, table_rows, base)
+            (logits, caches2), _ = functional(
+                params, buffers, (prompts,), {"caches": caches}, zero_key)
+            return (logits, [c.k_pages for c in caches2],
                     [c.v_pages for c in caches2])
+
+        def prefill(params, ks, vs, prompts, table_rows, true_lens, key):
+            # N same-bucket admissions in ONE dispatch (static zero base:
+            # the fast causal prefill path)
+            logits, ks2, vs2 = write_prompts(
+                params, ks, vs, prompts, table_rows, 0)
+            return sample_true_last(logits, true_lens, key), ks2, vs2
+
+        def chunk_step(params, ks, vs, chunk, table_rows, bases):
+            # CHUNKED PREFILL body: write one full chunk of a long prompt
+            # at per-row base offsets (rows attend causally to everything
+            # already in their slot) — no sampling, pools out
+            _, ks2, vs2 = write_prompts(
+                params, ks, vs, chunk, table_rows, bases)
+            return ks2, vs2
+
+        def final_chunk(params, ks, vs, chunk, table_rows, bases, true_lens,
+                        key):
+            # last (padded) chunk of a long prompt: write + sample
+            logits, ks2, vs2 = write_prompts(
+                params, ks, vs, chunk, table_rows, bases)
+            return sample_true_last(logits, true_lens, key), ks2, vs2
 
         def segment(params, ks, vs, tables, lengths, toks, active, limits,
                     keys):
@@ -166,6 +202,8 @@ class ContinuousBatchingEngine:
             return emitted, was_active, tok, lengths, active, ks, vs
 
         self._prefill_p = jax.jit(prefill, donate_argnums=(1, 2))
+        self._chunk_p = jax.jit(chunk_step, donate_argnums=(1, 2))
+        self._final_chunk_p = jax.jit(final_chunk, donate_argnums=(1, 2))
         self._segment_p = jax.jit(segment, donate_argnums=(1, 2))
 
     def _next_keys(self, n):
@@ -186,21 +224,30 @@ class ContinuousBatchingEngine:
         params = {k: p._value for k, p in self.model.named_parameters()}
         queue = deque((i, np.asarray(p).astype(np.int32).ravel())
                       for i, p in enumerate(prompts))
+        chunk_w = self.prompt_buckets[-1]
         for _, p in queue:
             if p.size + max_new_tokens > self.max_len:
                 raise ValueError(
                     f"prompt ({p.size}) + max_new_tokens ({max_new_tokens}) "
                     f"exceeds slot capacity {self.max_len}")
-            # validate the bucket UP FRONT too: prefill writes the whole
-            # padded bucket into the slot's pages, and an oversized or
-            # missing bucket must not surface mid-run after other
-            # requests' work
-            b = _bucket(p.size, self.prompt_buckets)
-            if b > self.max_len:
+            # validate buckets UP FRONT: prefill writes the whole padded
+            # bucket/chunk into the slot's pages, and an oversized bucket
+            # must not surface mid-run after other requests' work
+            if p.size <= chunk_w:
+                b = _bucket(p.size, self.prompt_buckets)
+                if b > self.max_len:
+                    raise ValueError(
+                        f"prompt bucket {b} (for a {p.size}-token prompt) "
+                        f"exceeds slot capacity {self.max_len}; add a "
+                        f"smaller bucket or raise max_len")
+            elif self.max_len % chunk_w:
+                # chunked prefill pads the final chunk to chunk_w; the
+                # write stays inside the slot's pages iff chunk_w divides
+                # the capacity
                 raise ValueError(
-                    f"prompt bucket {b} (for a {p.size}-token prompt) "
-                    f"exceeds slot capacity {self.max_len}; add a smaller "
-                    f"bucket or raise max_len")
+                    f"chunked prefill (prompt {p.size} > largest bucket "
+                    f"{chunk_w}) requires max_len ({self.max_len}) to be "
+                    f"a multiple of the largest bucket")
         outputs = [None] * len(prompts)
         collected = {}          # request id -> list of token ids
         slot_req = [None] * self.max_slots
@@ -215,25 +262,46 @@ class ContinuousBatchingEngine:
         seg_runs = 0
         occupancy = []
 
+        def finish_admit(slot, rid, prompt, tok):
+            """Shared post-prefill bookkeeping (short AND chunked paths):
+            register the slot, count the sampled first token, set the
+            per-slot budget, and retire immediately on eos / max_new=1."""
+            nonlocal useful
+            slot_req[slot] = rid
+            collected[rid] = [int(tok)]
+            useful += 1  # the prefill-sampled first token
+            lengths[slot] = prompt.size
+            cur_tok[slot] = int(tok)
+            limits[slot] = prompt.size + max_new_tokens - 1
+            if len(collected[rid]) >= max_new_tokens or (
+                    self.eos_token_id is not None
+                    and collected[rid][0] == self.eos_token_id):
+                outputs[rid] = np.asarray(
+                    collected.pop(rid)[:max_new_tokens], np.int32)
+                slot_req[slot] = None
+
         while queue or any(r is not None for r in slot_req):
             # admit into free slots — same-bucket admissions share ONE
             # compiled prefill dispatch (batched rows, each writing its
             # own slot's pages)
-            admitting = []  # (slot, rid, prompt, bucket)
+            admitting = []   # short prompts: (slot, rid, prompt, bucket)
+            long_adm = []    # beyond the largest bucket: chunked prefill
             for slot in range(self.max_slots):
                 if slot_req[slot] is not None or not queue:
                     continue
                 rid, prompt = queue.popleft()
-                admitting.append(
-                    (slot, rid, prompt,
-                     _bucket(prompt.size, self.prompt_buckets)))
+                if prompt.size > chunk_w:
+                    long_adm.append((slot, rid, prompt))
+                else:
+                    admitting.append(
+                        (slot, rid, prompt,
+                         _bucket(prompt.size, self.prompt_buckets)))
             by_bucket: dict[int, list] = {}
             for item in admitting:
                 by_bucket.setdefault(item[3], []).append(item)
             for bucket, group in by_bucket.items():
                 # FIXED admission batch (max_slots rows): one compiled
                 # prefill shape per bucket; padding rows write scratch
-                n = len(group)
                 g = self.max_slots
                 padded = np.zeros((g, bucket), np.int32)
                 true_lens = np.ones((g,), np.int32)
@@ -248,18 +316,48 @@ class ContinuousBatchingEngine:
                     self._next_keys(1)[0])
                 tok0 = np.asarray(tok0)
                 for i, (slot, rid, prompt, _) in enumerate(group):
-                    slot_req[slot] = rid
-                    collected[rid] = [int(tok0[i])]
-                    useful += 1  # the prefill-sampled first token
-                    lengths[slot] = prompt.size
-                    cur_tok[slot] = int(tok0[i])
-                    limits[slot] = prompt.size + max_new_tokens - 1
-                    if len(collected[rid]) >= max_new_tokens or (
-                            self.eos_token_id is not None
-                            and collected[rid][0] == self.eos_token_id):
-                        outputs[rid] = np.asarray(
-                            collected.pop(rid)[:max_new_tokens], np.int32)
-                        slot_req[slot] = None
+                    finish_admit(slot, rid, prompt, tok0[i])
+
+            if long_adm:
+                # CHUNKED PREFILL (long-context admission): full
+                # ``chunk_w``-token chunks at per-row base offsets, then
+                # one padded final chunk that also samples the first
+                # token. Rows are aligned by chunk index; rows already
+                # past their full chunks ride the scratch page row.
+                g = self.max_slots
+                scratch = self.max_slots
+                n_full = {rid: (p.size - 1) // chunk_w
+                          for _, rid, p in long_adm}
+                for c in range(max(n_full.values())):
+                    chunk_arr = np.zeros((g, chunk_w), np.int32)
+                    bases = np.zeros((g,), np.int32)
+                    rows = np.full((g,), scratch, np.int64)
+                    for i, (slot, rid, p) in enumerate(long_adm):
+                        if c < n_full[rid]:
+                            chunk_arr[i] = p[c * chunk_w:(c + 1) * chunk_w]
+                            bases[i] = c * chunk_w
+                            rows[i] = slot
+                    self._ks, self._vs = self._chunk_p(
+                        params, self._ks, self._vs, jnp.asarray(chunk_arr),
+                        self._tables[rows], jnp.asarray(bases))
+                final_arr = np.zeros((g, chunk_w), np.int32)
+                bases = np.zeros((g,), np.int32)
+                true_rem = np.ones((g,), np.int32)
+                rows = np.full((g,), scratch, np.int64)
+                for i, (slot, rid, p) in enumerate(long_adm):
+                    done = n_full[rid] * chunk_w
+                    rem = p.size - done
+                    final_arr[i, :rem] = p[done:]
+                    bases[i] = done
+                    true_rem[i] = rem
+                    rows[i] = slot
+                tok0, self._ks, self._vs = self._final_chunk_p(
+                    params, self._ks, self._vs, jnp.asarray(final_arr),
+                    self._tables[rows], jnp.asarray(bases),
+                    jnp.asarray(true_rem), self._next_keys(1)[0])
+                tok0 = np.asarray(tok0)
+                for i, (slot, rid, p) in enumerate(long_adm):
+                    finish_admit(slot, rid, p, tok0[i])
 
             active_np = np.array([r is not None for r in slot_req])
             if not active_np.any():

@@ -87,11 +87,34 @@ def test_continuous_batching_validates_capacity():
                                    page_size=32, prompt_buckets=(32,))
     with pytest.raises(ValueError, match="exceeds slot capacity"):
         eng.run([np.arange(60, dtype=np.int32) % 211], max_new_tokens=10)
-    with pytest.raises(ValueError, match="exceeds largest bucket"):
-        eng.run([np.arange(40, dtype=np.int32) % 211], max_new_tokens=1)
     # a bucket larger than the slot capacity is refused UP FRONT (prefill
     # writes the whole padded bucket into the slot's pages)
     eng2 = ContinuousBatchingEngine(m, max_slots=2, max_len=32,
                                     page_size=32, prompt_buckets=(64,))
     with pytest.raises(ValueError, match="bucket 64"):
         eng2.run([np.arange(10, dtype=np.int32)], max_new_tokens=4)
+    # chunked prefill needs max_len to be a multiple of the chunk width
+    eng3 = ContinuousBatchingEngine(m, max_slots=2, max_len=96,
+                                    page_size=32, prompt_buckets=(64,))
+    with pytest.raises(ValueError, match="multiple of the largest bucket"):
+        eng3.run([np.arange(70, dtype=np.int32) % 211], max_new_tokens=4)
+
+
+def test_chunked_prefill_long_prompts_match_generate():
+    """Prompts beyond the largest bucket admit via chunked prefill (full
+    chunks at per-slot offsets + padded final chunk) and must emit the
+    same greedy tokens as per-request generate() — mixed with short
+    requests in the same run."""
+    m = _model()
+    rng = np.random.RandomState(4)
+    prompts = [rng.randint(0, 211, (n,)).astype(np.int32)
+               for n in (100, 9, 70, 33, 15)]  # 100/70/33 are chunked
+    eng = ContinuousBatchingEngine(m, max_slots=2, max_len=128,
+                                   page_size=32, prompt_buckets=(32,))
+    outs, stats = eng.run(prompts, max_new_tokens=8, segment=4)
+    assert stats["useful_tokens"] == 5 * 8
+    for i, p in enumerate(prompts):
+        want = np.asarray(
+            generate(m, paddle.to_tensor(p[None, :]), max_new_tokens=8,
+                     cache="paged")._value)[0, p.size:]
+        np.testing.assert_array_equal(outs[i], want, err_msg=f"request {i}")
