@@ -48,7 +48,9 @@ from .collective import (  # noqa: F401
     get_rank,
     get_world_size,
     init_parallel_env,
+    irecv,
     is_initialized,
+    isend,
     new_group,
     recv,
     reduce,
@@ -74,7 +76,7 @@ __all__ = [
     "Group", "ReduceOp", "new_group", "get_rank", "get_world_size",
     "init_parallel_env", "is_initialized", "barrier",
     "all_reduce", "all_gather", "broadcast", "reduce", "scatter",
-    "all_to_all", "reduce_scatter", "send", "recv",
+    "all_to_all", "reduce_scatter", "send", "recv", "isend", "irecv",
     "DataParallel", "ParallelEnv", "comm_ops",
     "Strategy", "DistModel", "to_static",
     "spawn", "MultiprocessContext",

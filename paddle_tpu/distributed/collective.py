@@ -33,6 +33,7 @@ __all__ = [
     "init_parallel_env", "is_initialized", "barrier",
     "all_reduce", "all_gather", "all_gather_object", "broadcast",
     "reduce", "scatter", "all_to_all", "reduce_scatter", "send", "recv",
+    "isend", "irecv",
     "ReduceOp", "P2POp", "batch_isend_irecv", "destroy_process_group",
     "in_dynamic_mode_collectives",
 ]
@@ -265,18 +266,105 @@ def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None,
     return _wrap_like(tensor, parts[min(r, len(parts) - 1)])
 
 
+# Eager host-level p2p (reference ProcessGroup send/recv,
+# python/paddle/distributed/communication/send.py / recv.py): in the
+# multi-controller world each (src, dst) pair keeps an implicit message
+# sequence; the payload moves over the jax coordination-service KV — the
+# host/DCN control-plane path (inside compiled programs use
+# paddle_tpu.distributed.comm_ops.ppermute, which rides ICI).
+_p2p_seq: dict = {}
+
+
+def _p2p_client():
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "dist.send/recv need a multi-controller run "
+            "(dist.init_parallel_env under launch/spawn); inside compiled "
+            "programs use paddle_tpu.distributed.comm_ops.ppermute")
+    return client
+
+
+def _p2p_key(src, dst):
+    seq = _p2p_seq.get((src, dst), 0)
+    _p2p_seq[(src, dst)] = seq + 1
+    return f"p2p/{src}->{dst}/{seq}"
+
+
+class _DoneTask:
+    """Already-completed p2p task (publishing never blocks)."""
+
+    def __init__(self, tensor):
+        self._tensor = tensor
+
+    def wait(self):
+        return self._tensor
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "host-level p2p send/recv requires multi-controller transfer; inside "
-        "compiled programs use paddle_tpu.distributed.comm_ops.ppermute"
-    )
+    """Send ``tensor`` to process ``dst`` (pairwise-ordered with the
+    peer's ``recv``). Publishing is non-blocking; the key is consumed by
+    the receiver."""
+    import base64
+
+    client = _p2p_client()
+    key = _p2p_key(jax.process_index(), int(dst))
+    val = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    data = np.asarray(val)
+    client.key_value_set(key, base64.b64encode(data.tobytes()).decode())
+    return None if sync_op else _DoneTask(tensor)
 
 
-def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "host-level p2p send/recv requires multi-controller transfer; inside "
-        "compiled programs use paddle_tpu.distributed.comm_ops.ppermute"
-    )
+class _RecvTask:
+    def __init__(self, tensor, key, timeout_ms):
+        self._tensor, self._key, self._timeout = tensor, key, timeout_ms
+        self._done = False
+
+    def wait(self):
+        if self._done:
+            return self._tensor
+        import base64
+
+        client = _p2p_client()
+        raw = client.blocking_key_value_get(self._key, self._timeout)
+        t = self._tensor
+        is_tensor = isinstance(t, Tensor)  # raw jax arrays also expose a
+        val = t._value if is_tensor else t  # _value property — be explicit
+        arr = np.frombuffer(base64.b64decode(raw),
+                            dtype=np.dtype(val.dtype)).reshape(val.shape)
+        new = jnp.asarray(arr)
+        if is_tensor:
+            t._value = new  # reference recv fills the passed tensor
+        else:
+            self._tensor = new
+        try:  # consume: keep the coordination KV from growing unbounded
+            client.key_value_delete(self._key)
+        except Exception:
+            pass
+        self._done = True
+        return self._tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True, timeout_ms=120_000):
+    """Receive into ``tensor`` (shape/dtype contract, reference
+    semantics) from process ``src``; blocks when ``sync_op``."""
+    task = _RecvTask(tensor, _p2p_key(int(src), jax.process_index()),
+                     timeout_ms)
+    if sync_op:
+        # wait() returns the FILLED value — for raw-array buffers (no
+        # in-place _value) the original object cannot carry the payload
+        return task.wait()
+    return task
+
+
+def isend(tensor, dst=0, group=None):
+    return send(tensor, dst, group, sync_op=False)
+
+
+def irecv(tensor, src=0, group=None):
+    return recv(tensor, src, group, sync_op=False)
 
 
 class P2POp:
@@ -285,10 +373,22 @@ class P2POp:
 
 
 def batch_isend_irecv(p2p_op_list):
-    raise NotImplementedError(
-        "batched p2p maps to collective-permute inside compiled pipeline "
-        "schedules (paddle_tpu.distributed.pipeline)"
-    )
+    """Post every send first (publishing never blocks), then return recv
+    tasks — the symmetric neighbor-exchange pattern completes without
+    deadlock regardless of call order (reference batch_isend_irecv over
+    ProcessGroup::Send/Recv)."""
+    for op in p2p_op_list:
+        if op.op not in (send, isend, recv, irecv):
+            raise ValueError(
+                f"P2POp.op must be dist.send/isend/recv/irecv, got {op.op!r}")
+    tasks = []
+    for op in p2p_op_list:
+        if op.op in (send, isend):
+            send(op.tensor, op.peer, op.group)
+    for op in p2p_op_list:
+        if op.op in (recv, irecv):
+            tasks.append(recv(op.tensor, op.peer, op.group, sync_op=False))
+    return tasks
 
 
 def in_dynamic_mode_collectives():

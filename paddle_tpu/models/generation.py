@@ -55,12 +55,14 @@ def _make_static_cache(k, v, length):
     return c
 
 
-def _make_paged_cache(kp, vp, tables, page_size, length):
+def _make_paged_cache(kp, vp, tables, page_size, length,
+                      aligned_bases=False):
     from .llama import PagedKVCache
 
     c = PagedKVCache.__new__(PagedKVCache)
     c.k_pages, c.v_pages, c.tables = kp, vp, tables
     c.page_size, c.length = page_size, length
+    c.aligned_bases = aligned_bases
     return c
 
 

@@ -144,7 +144,8 @@ class PagedKVCache:
     the block-table indirection is genuinely exercised. Decode attention
     over this layout runs the Pallas ``paged_attention`` kernel."""
 
-    __slots__ = ("k_pages", "v_pages", "tables", "page_size", "length")
+    __slots__ = ("k_pages", "v_pages", "tables", "page_size", "length",
+                 "aligned_bases")
 
     def __init__(self, batch, max_len, kv_heads, head_dim, page_size=128,
                  dtype=jnp.float32):
@@ -161,6 +162,11 @@ class PagedKVCache:
                        + jnp.arange(batch, dtype=jnp.int32)[:, None])
         self.page_size = page_size
         self.length = 0  # python int: static under per-step jit
+        # opt-in for the per-seq bulk page write: the CALLER asserts every
+        # per-slot base is page-aligned (the serving engine's chunked
+        # prefill); without it, per-seq multi-token updates take the
+        # always-correct per-row loop
+        self.aligned_bases = False
 
     def update(self, k_new, v_new):
         """Write (B, S, KVH, D) new keys/values at positions
@@ -174,7 +180,8 @@ class PagedKVCache:
         bases are page multiples)."""
         b, s = k_new.shape[0], k_new.shape[1]
         if _per_seq_lengths(self.length):
-            if s > 1 and s % self.page_size == 0:
+            if (s > 1 and s % self.page_size == 0
+                    and getattr(self, "aligned_bases", False)):
                 # page-aligned bulk write (chunked prefill: bases are
                 # chunk-width multiples and the chunk width is a page
                 # multiple, so each chunk covers WHOLE pages): one

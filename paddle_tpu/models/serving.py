@@ -117,8 +117,12 @@ class ContinuousBatchingEngine:
     # ------------------------------------------------------------ programs
 
     def _caches(self, ks, vs, tables, length):
+        # chunked-prefill bases are chunk_w multiples: page-aligned (the
+        # bulk-write opt-in) exactly when chunk_w is a page multiple
+        aligned = self.prompt_buckets[-1] % self.page_size == 0
         return [_make_paged_cache(ks[i], vs[i], tables, self.page_size,
-                                  length) for i in range(self._nl)]
+                                  length, aligned_bases=aligned)
+                for i in range(self._nl)]
 
     def _build_programs(self):
         functional = self._functional

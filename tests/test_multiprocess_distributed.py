@@ -408,3 +408,56 @@ def _spawn_worker_noop():
 
     assert os.environ["PADDLE_TRAINERS_NUM"] == "2"
     assert os.environ["PADDLE_MASTER"]
+
+
+# ----------------------- eager host p2p send/recv (r5) --------------------
+
+
+def _spawn_worker_p2p(out_dir):
+    """Pairwise eager send/recv + batch_isend_irecv neighbor exchange over
+    the coordination-service KV (the NCCL-send control-plane analog)."""
+    import os
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    rank = dist.get_rank()
+    peer = 1 - rank
+    if rank == 0:
+        payload = paddle.to_tensor(np.arange(12, dtype=np.float32) * 2)
+        dist.send(payload, dst=1)
+        # ordered second message on the same pair
+        dist.send(paddle.to_tensor(np.float32(7.5)), dst=1)
+    else:
+        buf = paddle.to_tensor(np.zeros(12, np.float32))
+        dist.recv(buf, src=0)
+        np.testing.assert_array_equal(
+            np.asarray(buf._value), np.arange(12, dtype=np.float32) * 2)
+        scalar = paddle.to_tensor(np.float32(0.0))
+        dist.recv(scalar, src=0)
+        assert float(scalar) == 7.5
+
+    # symmetric neighbor exchange through batch_isend_irecv
+    mine = paddle.to_tensor(np.full(4, rank + 1, np.float32))
+    theirs = paddle.to_tensor(np.zeros(4, np.float32))
+    tasks = dist.batch_isend_irecv([
+        dist.P2POp(dist.isend, mine, peer),
+        dist.P2POp(dist.irecv, theirs, peer),
+    ])
+    for t in tasks:
+        t.wait()
+    np.testing.assert_array_equal(
+        np.asarray(theirs._value), np.full(4, peer + 1, np.float32))
+    with open(os.path.join(out_dir, f"p2p{rank}.ok"), "w") as f:
+        f.write("ok")
+
+
+def test_spawn_p2p_send_recv(tmp_path):
+    import paddle_tpu.distributed as dist
+
+    dist.spawn(_spawn_worker_p2p, args=(str(tmp_path),), nprocs=2,
+               env={"JAX_PLATFORMS": "cpu"})
+    assert (tmp_path / "p2p0.ok").exists()
+    assert (tmp_path / "p2p1.ok").exists()

@@ -506,6 +506,11 @@ case("cross_entropy", lambda: ((T(P((3, 4))), T(np.array([[0], [1], [2]])),
                                 None), {}), None)
 case("softmax_with_cross_entropy",
      lambda: ((T(P((3, 4))), T(np.array([[0], [1], [2]]))), {}), None)
+case("c_softmax_with_cross_entropy",
+     lambda: ((T(P((3, 4))), T(np.array([[0], [1], [2]]))), {}), None)
+case("fused_linear_cross_entropy",
+     lambda: ((T(P((3, 8))), T(P((20, 8))),
+               T(np.array([0, 5, 19]))), {}), None)
 case("binary_cross_entropy", lambda: ((T(PP((3,)) * 0.8),
                                        T((rng.rand(3) > 0.5).astype(np.float32)),
                                        None), {}), None)

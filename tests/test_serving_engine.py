@@ -98,6 +98,12 @@ def test_continuous_batching_validates_capacity():
                                     page_size=32, prompt_buckets=(64,))
     with pytest.raises(ValueError, match="multiple of the largest bucket"):
         eng3.run([np.arange(70, dtype=np.int32) % 211], max_new_tokens=4)
+    # the bucket helper's own contract (run() pre-validates, so the raise
+    # is only reachable through direct use)
+    from paddle_tpu.models.serving import _bucket
+
+    with pytest.raises(ValueError, match="exceeds largest bucket"):
+        _bucket(100, (32, 64))
 
 
 def test_chunked_prefill_long_prompts_match_generate():
