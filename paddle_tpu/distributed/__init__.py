@@ -14,8 +14,35 @@ from . import auto_tuner  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import comm_ops  # noqa: F401
 from . import fleet  # noqa: F401
+from . import io  # noqa: F401
+from . import launch  # noqa: F401
 from . import ps  # noqa: F401
 from . import rpc  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
+from .extras import (  # noqa: F401
+    CountFilterEntry,
+    DistAttr,
+    ParallelMode,
+    ProbabilityEntry,
+    ReduceType,
+    ShardingStage1,
+    ShardingStage2,
+    ShardingStage3,
+    ShowClickEntry,
+    alltoall,
+    alltoall_single,
+    broadcast_object_list,
+    gather,
+    get_backend,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    is_available,
+    scatter_object_list,
+    shard_scaler,
+    split,
+    wait,
+)
 from .auto_parallel import DistModel, Strategy, to_static  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
 from .spawn import MultiprocessContext, spawn  # noqa: F401
@@ -80,4 +107,12 @@ __all__ = [
     "DataParallel", "ParallelEnv", "comm_ops",
     "Strategy", "DistModel", "to_static",
     "spawn", "MultiprocessContext",
+    "ParallelMode", "ReduceType", "DistAttr",
+    "alltoall", "alltoall_single", "gather",
+    "broadcast_object_list", "scatter_object_list",
+    "get_backend", "is_available", "wait", "split", "shard_scaler",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+    "InMemoryDataset", "QueueDataset", "launch", "io",
 ]

@@ -293,6 +293,29 @@ def _p2p_key(src, dst):
     return f"p2p/{src}->{dst}/{seq}"
 
 
+def _kv_publish(key, payload: bytes):
+    """Publish raw bytes on the coordination-service KV (shared by eager
+    p2p and the object collectives)."""
+    import base64
+
+    _p2p_client().key_value_set(key, base64.b64encode(payload).decode())
+
+
+def _kv_fetch(key, timeout_ms=120_000, consume=True) -> bytes:
+    """Blocking fetch; ``consume`` deletes the key afterwards so per-call
+    channels never grow the coordinator's store."""
+    import base64
+
+    client = _p2p_client()
+    raw = client.blocking_key_value_get(key, timeout_ms)
+    if consume:
+        try:
+            client.key_value_delete(key)
+        except Exception:
+            pass
+    return base64.b64decode(raw)
+
+
 class _DoneTask:
     """Already-completed p2p task (publishing never blocks)."""
 
@@ -307,13 +330,9 @@ def send(tensor, dst=0, group=None, sync_op=True):
     """Send ``tensor`` to process ``dst`` (pairwise-ordered with the
     peer's ``recv``). Publishing is non-blocking; the key is consumed by
     the receiver."""
-    import base64
-
-    client = _p2p_client()
     key = _p2p_key(jax.process_index(), int(dst))
     val = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
-    data = np.asarray(val)
-    client.key_value_set(key, base64.b64encode(data.tobytes()).decode())
+    _kv_publish(key, np.asarray(val).tobytes())
     return None if sync_op else _DoneTask(tensor)
 
 
@@ -325,24 +344,17 @@ class _RecvTask:
     def wait(self):
         if self._done:
             return self._tensor
-        import base64
-
-        client = _p2p_client()
-        raw = client.blocking_key_value_get(self._key, self._timeout)
+        raw = _kv_fetch(self._key, self._timeout)  # consumed on read
         t = self._tensor
         is_tensor = isinstance(t, Tensor)  # raw jax arrays also expose a
         val = t._value if is_tensor else t  # _value property — be explicit
-        arr = np.frombuffer(base64.b64decode(raw),
+        arr = np.frombuffer(raw,
                             dtype=np.dtype(val.dtype)).reshape(val.shape)
         new = jnp.asarray(arr)
         if is_tensor:
             t._value = new  # reference recv fills the passed tensor
         else:
             self._tensor = new
-        try:  # consume: keep the coordination KV from growing unbounded
-            client.key_value_delete(self._key)
-        except Exception:
-            pass
         self._done = True
         return self._tensor
 
@@ -381,13 +393,17 @@ def batch_isend_irecv(p2p_op_list):
         if op.op not in (send, isend, recv, irecv):
             raise ValueError(
                 f"P2POp.op must be dist.send/isend/recv/irecv, got {op.op!r}")
-    tasks = []
-    for op in p2p_op_list:
+    # one task PER OP in list order (reference contract): sends post first
+    # (publishing never blocks) so the symmetric neighbor exchange
+    # completes regardless of call order, recvs return blocking tasks
+    tasks: list = [None] * len(p2p_op_list)
+    for i, op in enumerate(p2p_op_list):
         if op.op in (send, isend):
-            send(op.tensor, op.peer, op.group)
-    for op in p2p_op_list:
+            tasks[i] = send(op.tensor, op.peer, op.group,
+                            sync_op=False)
+    for i, op in enumerate(p2p_op_list):
         if op.op in (recv, irecv):
-            tasks.append(recv(op.tensor, op.peer, op.group, sync_op=False))
+            tasks[i] = recv(op.tensor, op.peer, op.group, sync_op=False)
     return tasks
 
 

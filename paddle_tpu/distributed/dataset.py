@@ -1,0 +1,126 @@
+"""InMemoryDataset / QueueDataset — the PS training data feeds.
+
+Analog of /root/reference/python/paddle/distributed/fleet/dataset/
+dataset.py (InMemoryDataset:247, QueueDataset) over the classic slot-data
+text format the reference's data_feed parses
+(paddle/fluid/framework/data_feed.cc MultiSlotDataFeed): each line is
+whitespace-separated tokens; ``slot:feasign`` tokens are sparse features
+grouped per slot, bare leading numerics are dense label fields (show/
+click/label). TPU-natively there is no pipe_command trainer process —
+the dataset parses in-process and yields numpy batches for the PS worker
+loop (see examples/train_ctr_ps.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["InMemoryDataset", "QueueDataset"]
+
+
+def _parse_line(line):
+    dense, sparse = [], {}
+    for tok in line.split():
+        if ":" in tok:
+            slot, feasign = tok.split(":", 1)
+            sparse.setdefault(slot, []).append(int(feasign))
+        else:
+            dense.append(float(tok))
+    return dense, sparse
+
+
+class _SlotDatasetBase:
+    def __init__(self):
+        self._filelist: list[str] = []
+        self._batch_size = 1
+        self._use_var: list[str] = []
+        self._shuffle_seed = 0
+
+    def init(self, batch_size=1, use_var=None, **kwargs):
+        """Reference .init(batch_size=, use_var=[Variable|name, ...]):
+        ``use_var`` fixes the slot order of emitted batches; extra
+        reference knobs (pipe_command, thread_num, fs config) have no
+        in-process equivalent and are accepted/ignored."""
+        self._batch_size = int(batch_size)
+        self._use_var = [getattr(v, "name", v) for v in (use_var or [])]
+        return self
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def _read_files(self, files):
+        for path in files:
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield _parse_line(line)
+
+    def _batches(self, sample_iter):
+        """Group parsed samples into batches. The dense width and slot
+        set are fixed ONCE from the first sample (+ use_var tail for slot
+        order) — every batch carries the same keys and dense shape, and
+        the grouping streams (no materialization of sample_iter)."""
+        from itertools import chain, islice
+
+        it = iter(sample_iter)
+        first = next(it, None)
+        if first is None:
+            return
+        n_dense = len(first[0])
+        slots = (self._use_var[n_dense:] if self._use_var
+                 else sorted(first[1]))
+        it = chain([first], it)
+        while True:
+            chunk = list(islice(it, self._batch_size))
+            if not chunk:
+                return
+            dense = np.asarray(
+                [(d + [0.0] * n_dense)[:n_dense] for d, _ in chunk],
+                np.float32)
+            batch = {"dense": dense}
+            for s in slots:
+                batch[s] = [sp.get(s, []) for _, sp in chunk]
+            yield batch
+
+
+class InMemoryDataset(_SlotDatasetBase):
+    """Load the whole filelist into host memory, then shuffle/iterate
+    (reference InMemoryDataset: load_into_memory + local_shuffle +
+    release_memory)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples = None
+
+    def load_into_memory(self):
+        self._samples = list(self._read_files(self._filelist))
+
+    def get_memory_data_size(self):
+        return len(self._samples or [])
+
+    def local_shuffle(self):
+        if self._samples is None:
+            raise RuntimeError("call load_into_memory() before "
+                               "local_shuffle()")
+        rng = np.random.RandomState(self._shuffle_seed)
+        self._shuffle_seed += 1
+        rng.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # single-host: global == local (multi-host exchange rides the PS)
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._samples = None
+
+    def __iter__(self):
+        if self._samples is None:
+            raise RuntimeError("call load_into_memory() first")
+        return self._batches(iter(self._samples))
+
+
+class QueueDataset(_SlotDatasetBase):
+    """Streaming variant: iterate the filelist without materializing it
+    (reference QueueDataset semantics — one pass, no shuffle)."""
+
+    def __iter__(self):
+        return self._batches(self._read_files(self._filelist))

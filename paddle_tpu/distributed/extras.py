@@ -1,0 +1,317 @@
+"""Long-tail `paddle.distributed` surface: enums, object collectives,
+alltoall aliases, megatron `split`, sharding-stage markers, PS entry
+configs, and gloo shims.
+
+Analog of the reference's distributed `__all__` tail
+(/root/reference/python/paddle/distributed/__init__.py): every name a
+reference user can import resolves here to a TPU-native implementation or
+an honest absorption shim. Collective semantics follow collective.py's
+convention — single-controller arrays are already globally consistent;
+multi-controller object movement rides the coordination-service KV (the
+same host/DCN path as dist.send/recv).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from .collective import (
+    all_gather,
+    all_to_all,
+    barrier,
+    get_rank,
+    get_world_size,
+)
+
+__all__ = [
+    "ParallelMode", "ReduceType", "DistAttr",
+    "alltoall", "alltoall_single", "gather",
+    "broadcast_object_list", "scatter_object_list",
+    "get_backend", "is_available", "wait", "split", "shard_scaler",
+    "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
+    "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+]
+
+
+class ParallelMode:
+    """Reference paddle.distributed.ParallelMode (parallel.py)."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+class ReduceType:
+    """Semi-auto reduce types (reference auto_parallel ReduceType)."""
+
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """Lean TensorDistAttr surface (reference paddle.distributed.DistAttr:
+    a (process_mesh, sharding_specs) pair). The TPU-native layout story is
+    placements; this adapter converts specs ("x"/None per tensor dim) to
+    them for APIs written against the reference type."""
+
+    def __init__(self, mesh, sharding_specs):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs)
+
+    def placements(self):
+        from .placement import Replicate, Shard
+
+        pl = [Replicate() for _ in range(self.process_mesh.ndim)]
+        for tensor_dim, spec in enumerate(self.sharding_specs):
+            if spec is None:
+                continue
+            pl[self.process_mesh.dim_names.index(spec)] = Shard(tensor_dim)
+        return pl
+
+
+# --------------------------------------------------------- collectives
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    """List-of-tensors all_to_all (reference communication/all_to_all.py
+    alltoall): rank r's out[i] is rank i's in[r] — the exact alias of
+    collective.all_to_all's surface."""
+    return all_to_all(out_tensor_list, in_tensor_list, group=group,
+                      sync_op=sync_op)
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all_to_all: split dim 0 into world equal (or given)
+    chunks, exchange, concatenate."""
+    n = max(get_world_size(group), 1)
+    val = in_tensor._value if isinstance(in_tensor, Tensor) else in_tensor
+    if in_split_sizes:
+        idx = np.cumsum(in_split_sizes)[:-1]
+        chunks = jnp.split(val, idx, axis=0)
+    else:
+        chunks = jnp.split(val, n, axis=0)
+    outs: list = []
+    all_to_all(outs, [Tensor._from_value(c) for c in chunks], group=group)
+    result = jnp.concatenate([o._value for o in outs], axis=0)
+    if out_tensor is not None and isinstance(out_tensor, Tensor):
+        out_tensor._value = result
+        return out_tensor
+    return Tensor._from_value(result)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather to ``dst`` (reference communication/gather.py): implemented
+    as all_gather with non-dst ranks discarding — on TPU the all-gather
+    rides the same ring the rooted gather would."""
+    gathered = []
+    all_gather(gathered, tensor, group=group)
+    if gather_list is not None and get_rank(group) == dst:
+        gather_list.clear()
+        gather_list.extend(gathered)
+    return gather_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    """Broadcast picklable objects (reference broadcast_object_list).
+    Single-controller: already consistent. Multi-controller: the src
+    publishes pickled payloads on the coordination-service KV (same
+    transport as dist.send/recv; broadcast keys are read by many ranks so
+    they are NOT consumed — they stay for the coordinator's lifetime,
+    like the pipeline transport's)."""
+    if jax.process_count() <= 1:
+        return object_list
+    import pickle
+
+    from .collective import _kv_fetch, _kv_publish
+
+    key = f"bcast_obj/{src}/{_obj_seq('b', src)}"
+    if jax.process_index() == src:
+        _kv_publish(key, pickle.dumps(object_list))
+    else:
+        got = pickle.loads(_kv_fetch(key, consume=False))
+        object_list.clear()
+        object_list.extend(got)
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Scatter one picklable object per rank from ``src``; each rank
+    consumes exactly its own key."""
+    if jax.process_count() <= 1:
+        out_object_list.clear()
+        if in_object_list:
+            out_object_list.append(in_object_list[0])
+        return out_object_list
+    import pickle
+
+    from .collective import _kv_fetch, _kv_publish
+
+    me = jax.process_index()
+    seq = _obj_seq("s", src)
+    if me == src:
+        for r in range(jax.process_count()):
+            _kv_publish(f"scatter_obj/{src}/{seq}/{r}",
+                        pickle.dumps(in_object_list[r]))
+    raw = _kv_fetch(f"scatter_obj/{src}/{seq}/{me}")
+    out_object_list.clear()
+    out_object_list.append(pickle.loads(raw))
+    return out_object_list
+
+
+_obj_seqs: dict = {}
+
+
+def _obj_seq(kind, src):
+    k = (kind, src)
+    _obj_seqs[k] = _obj_seqs.get(k, 0) + 1
+    return _obj_seqs[k] - 1
+
+
+# --------------------------------------------------------- misc surface
+
+def get_backend(group=None):
+    """Reference get_backend() → the communication backend name; here the
+    XLA collective runtime over the default jax platform."""
+    return f"xla:{jax.default_backend()}"
+
+
+def is_available():
+    return True
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    """Reference wait(): stream synchronization. XLA's execution model has
+    no user-visible streams — block on the value instead."""
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    try:
+        v.block_until_ready()
+    except AttributeError:
+        pass
+    return tensor
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style distributed fc/embedding (reference
+    fleet/layers/mpu/mp_ops.py `paddle.distributed.split`): build the
+    matching TP layer over the current mesh and apply it. ``operation``:
+    "linear" (axis=0 row-parallel / axis=1 column-parallel) or
+    "embedding" (vocab-parallel)."""
+    from .fleet.mp_layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        VocabParallelEmbedding,
+    )
+
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+        return layer(x)
+    if operation != "linear":
+        raise ValueError(f"split: unknown operation {operation!r}")
+    if axis == 0:
+        layer = RowParallelLinear(size[0], size[1], weight_attr=weight_attr,
+                                  has_bias=bias_attr is not False)
+    elif axis == 1:
+        layer = ColumnParallelLinear(size[0], size[1],
+                                     weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False,
+                                     gather_output=gather_out)
+    else:
+        raise ValueError("split: axis must be 0 or 1 for linear")
+    return layer(x)
+
+
+def shard_scaler(scaler):
+    """Reference shard_scaler(GradScaler): make unscale/found-inf work
+    over sharded grads. Our GradScaler already reduces found-inf across
+    the global mesh (XLA collectives), so this is the identity — kept for
+    API parity."""
+    return scaler
+
+
+class _ShardingStage:
+    def __init__(self, stage):
+        self.stage = stage
+
+    def __repr__(self):
+        return f"ShardingStage{self.stage}"
+
+
+ShardingStage1 = _ShardingStage(1)
+ShardingStage2 = _ShardingStage(2)
+ShardingStage3 = _ShardingStage(3)
+
+
+# ------------------------------------------------ PS sparse-table entries
+
+class _Entry:
+    """Sparse-table admission policy config (reference
+    distributed/entry_attr.py; consumed by the PS accessor)."""
+
+    def _to_attr(self):
+        raise NotImplementedError
+
+
+class CountFilterEntry(_Entry):
+    """Admit a sparse feature after ``count_filter`` occurrences."""
+
+    def __init__(self, count_filter):
+        if count_filter < 0:
+            raise ValueError("count_filter must be >= 0")
+        self.count_filter = int(count_filter)
+
+    def _to_attr(self):
+        return f"count_filter_entry:{self.count_filter}"
+
+
+class ProbabilityEntry(_Entry):
+    """Admit a sparse feature with the given probability."""
+
+    def __init__(self, probability):
+        if not 0.0 <= probability <= 1.0:
+            raise ValueError("probability must be in [0, 1]")
+        self.probability = float(probability)
+
+    def _to_attr(self):
+        return f"probability_entry:{self.probability}"
+
+
+class ShowClickEntry(_Entry):
+    """Weight features by show/click statistics (CTR accessors)."""
+
+    def __init__(self, show_name, click_name):
+        self.show_name = str(show_name)
+        self.click_name = str(click_name)
+
+    def _to_attr(self):
+        return f"show_click_entry:{self.show_name}:{self.click_name}"
+
+
+# --------------------------------------------------------- gloo shims
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """Reference gloo CPU-barrier bootstrap. The TPU build's host control
+    plane is the TCPStore + jax.distributed coordination service;
+    init_parallel_env covers it — kept as a compatible entry point."""
+    from .collective import init_parallel_env
+
+    return init_parallel_env()
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    return None

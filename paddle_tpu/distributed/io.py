@@ -1,0 +1,52 @@
+"""paddle.distributed.io — persistable save/load for distributed training.
+
+Analog of /root/reference/python/paddle/distributed/io.py
+(save_persistables / load_persistables / is_persistable over static-graph
+programs and PS endpoints). TPU-natively persistable state is a Layer /
+optimizer state-dict, and multi-host-safe sharded checkpoints live in
+``paddle.distributed.save_state_dict`` (checkpoint.py); these wrappers
+keep the reference entry points working for single-artifact flows."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["is_persistable", "save_persistables", "load_persistables"]
+
+
+def is_persistable(var):
+    """Reference predicate (io.py:35): feed/fetch/RAW vars are not
+    persistable. Tensor-backed state here is persistable unless marked."""
+    return bool(getattr(var, "persistable", True))
+
+
+def _state(obj):
+    if hasattr(obj, "state_dict"):
+        return obj.state_dict()
+    return obj
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Save a Layer/optimizer's persistable state under ``dirname``.
+    The reference signature passes an Executor; here the FIRST argument is
+    the stateful object (Layer/Optimizer/dict) — Executor is absorbed by
+    XLA (SURVEY.md §2.4) — and extra args keep positional compatibility."""
+    from ..framework import io as fio
+
+    target = main_program if main_program is not None else executor
+    os.makedirs(dirname, exist_ok=True)
+    fio.save(_state(target), os.path.join(dirname,
+                                          filename or "persistables"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    """Load state saved by :func:`save_persistables`; when the first/third
+    argument has ``set_state_dict`` the state is applied in place, else
+    the raw state dict is returned."""
+    from ..framework import io as fio
+
+    target = main_program if main_program is not None else executor
+    state = fio.load(os.path.join(dirname, filename or "persistables"))
+    if hasattr(target, "set_state_dict"):
+        target.set_state_dict(state)
+        return target
+    return state

@@ -1,0 +1,137 @@
+"""Long-tail distributed surface (r5): full reference `__all__` parity,
+object collectives, alltoall aliases, megatron split, PS data feeds,
+distributed io."""
+import re
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+REF_INIT = "/root/reference/python/paddle/distributed/__init__.py"
+
+
+def test_distributed_all_parity():
+    """Every name in the reference's paddle.distributed.__all__ resolves
+    here (implementation or documented absorption shim)."""
+    src = open(REF_INIT).read()
+    m = re.search(r"__all__\s*=\s*\[(.*?)\]", src, re.S)
+    ref = set(re.findall(r'"([^"]+)"', m.group(1)))
+    missing = sorted(n for n in ref if not hasattr(dist, n))
+    assert not missing, f"missing distributed API names: {missing}"
+
+
+def test_alltoall_and_single():
+    xs = [paddle.to_tensor(np.full((2, 3), i, np.float32)) for i in range(2)]
+    out = []
+    dist.alltoall(out, xs)
+    assert len(out) == 2
+    big = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(8, 1))
+    got = dist.alltoall_single(big)
+    assert got.shape == [8, 1]
+    buf = paddle.to_tensor(np.zeros((8, 1), np.float32))
+    got2 = dist.alltoall_single(big, out_tensor=buf,
+                                in_split_sizes=[4, 4])
+    assert got2 is buf
+
+
+def test_gather_and_object_collectives():
+    t = paddle.to_tensor(np.ones(3, np.float32))
+    out = []
+    dist.gather(t, out, dst=0)
+    assert len(out) >= 1
+    objs = [{"a": 1}, "x"]
+    assert dist.broadcast_object_list(objs, src=0) is objs
+    received = []
+    dist.scatter_object_list(received, [["mine"]], src=0)
+    assert received == [["mine"]]
+
+
+def test_misc_surface():
+    assert dist.is_available()
+    assert dist.get_backend().startswith("xla:")
+    t = paddle.to_tensor(np.ones(2, np.float32))
+    assert dist.wait(t) is t
+    assert repr(dist.ShardingStage2) == "ShardingStage2"
+    s = paddle.amp.GradScaler(init_loss_scaling=8.0)
+    assert dist.shard_scaler(s) is s
+    assert dist.ParallelMode.TENSOR_PARALLEL == 1
+    assert dist.ReduceType.kRedSum == 0
+    with pytest.raises(ValueError):
+        dist.CountFilterEntry(-1)
+    with pytest.raises(ValueError):
+        dist.ProbabilityEntry(1.5)
+    e = dist.ShowClickEntry("show", "click")
+    assert "show_click_entry" in e._to_attr()
+
+
+def test_dist_attr_placements():
+    mesh = dist.ProcessMesh(np.arange(8).reshape(4, 2), ["dp", "mp"])
+    attr = dist.DistAttr(mesh, ["dp", None])
+    pl = attr.placements()
+    assert isinstance(pl[0], dist.Shard) and pl[0].dim == 0
+    assert isinstance(pl[1], dist.Replicate)
+
+
+def test_split_linear_and_embedding():
+    mesh = dist.ProcessMesh(np.arange(8).reshape(4, 2), ["dp", "mp"])
+    dist.set_mesh(mesh)
+    try:
+        x = paddle.to_tensor(np.random.rand(4, 16).astype(np.float32))
+        y = dist.split(x, (16, 8), "linear", axis=1, num_partitions=2)
+        assert y.shape == [4, 8]
+        y2 = dist.split(x, (16, 8), "linear", axis=0, num_partitions=2)
+        assert y2.shape == [4, 8]
+        ids = paddle.to_tensor(np.random.randint(0, 32, (4, 5)))
+        e = dist.split(ids, (32, 8), "embedding", num_partitions=2)
+        assert e.shape == [4, 5, 8]
+        with pytest.raises(ValueError, match="unknown operation"):
+            dist.split(x, (16, 8), "conv")
+    finally:
+        dist.process_mesh._global_mesh = None
+
+
+def test_inmemory_and_queue_dataset(tmp_path):
+    f = tmp_path / "slots.txt"
+    f.write_text(
+        "1 0 s1:3 s1:7 s2:11\n"
+        "0 1 s1:2 s2:12 s2:13\n"
+        "1 1 s2:14\n")
+    ds = dist.InMemoryDataset()
+    ds.init(batch_size=2, use_var=["show", "click", "s1", "s2"])
+    ds.set_filelist([str(f)])
+    with pytest.raises(RuntimeError):
+        iter(ds)
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 3
+    ds.local_shuffle()
+    batches = list(ds)
+    assert len(batches) == 2  # 2 + 1
+    b0 = batches[0]
+    assert b0["dense"].shape == (2, 2)
+    assert set(b0) == {"dense", "s1", "s2"}
+    total_ids = sum(len(ids) for b in batches for s in ("s1", "s2")
+                    for ids in b[s])
+    assert total_ids == 7  # 3 + 3 + 1 feasigns across the three lines
+    ds.release_memory()
+    assert ds.get_memory_data_size() == 0
+
+    q = dist.QueueDataset()
+    q.init(batch_size=3)
+    q.set_filelist([str(f)])
+    (qb,) = list(q)
+    assert qb["dense"].shape == (3, 2)
+
+
+def test_distributed_io_roundtrip(tmp_path):
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    m = nn.Linear(4, 3)
+    w = np.asarray(m.weight._value).copy()
+    dist.io.save_persistables(m, str(tmp_path / "ckpt"))
+    m2 = nn.Linear(4, 3)
+    dist.io.load_persistables(m2, str(tmp_path / "ckpt"))
+    np.testing.assert_array_equal(np.asarray(m2.weight._value), w)
+    assert dist.io.is_persistable(m.weight)
