@@ -54,6 +54,8 @@ from .ops import __all__ as _ops_all
 from . import amp  # noqa: F401
 from . import audio  # noqa: F401
 from . import autograd  # noqa: F401
+from . import hub  # noqa: F401
+from . import sysconfig  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import quantization  # noqa: F401

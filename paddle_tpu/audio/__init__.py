@@ -19,6 +19,7 @@ __all__ = [
     "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC",
     "hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
     "compute_fbank_matrix", "get_window", "create_dct",
+    "backends", "features", "functional", "load", "save", "info",
 ]
 
 
@@ -182,3 +183,11 @@ class MFCC(Layer):
 
 
 from . import datasets  # noqa: E402,F401
+
+
+# namespace parity: submodules + top-level WAV IO (reference audio exposes
+# backends/features/functional and load/save/info at the package root)
+from . import backends  # noqa: E402,F401
+from . import features  # noqa: E402,F401
+from . import functional  # noqa: E402,F401
+from .backends import info, load, save  # noqa: E402,F401

@@ -1,0 +1,94 @@
+"""paddle.audio.backends — WAV IO (reference wave_backend).
+
+Analog of /root/reference/python/paddle/audio/backends/wave_backend.py:
+PCM WAV load/save/info over the stdlib ``wave`` module (the reference's
+default backend when paddleaudio is absent). Only the wave backend exists
+in this build; ``set_backend`` accepts it for API parity."""
+from __future__ import annotations
+
+import wave as _wave
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save",
+           "list_available_backends", "get_current_backend", "set_backend"]
+
+
+class AudioInfo:
+    """Reference backends.backend.AudioInfo."""
+
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name):
+    if backend_name not in list_available_backends():
+        raise NotImplementedError(
+            f"audio backend {backend_name!r} unavailable; this build ships "
+            f"{list_available_backends()}")
+
+
+def info(filepath):
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8,
+                         f"PCM_{'S' if f.getsampwidth() > 1 else 'U'}")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    """Returns (waveform Tensor, sample_rate). ``normalize`` scales PCM to
+    [-1, 1] float32 (reference wave_backend.load semantics)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        width = f.getsampwidth()
+        channels = f.getnchannels()
+        f.setpos(min(frame_offset, f.getnframes()))
+        n = f.getnframes() - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(max(n, 0))
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}.get(width)
+    if dtype is None:
+        raise ValueError(f"unsupported PCM sample width {width}")
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, channels)
+    if normalize:
+        if width == 1:
+            data = (data.astype(np.float32) - 128.0) / 128.0
+        else:
+            data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    wav = data.T if channels_first else data
+    return Tensor(np.ascontiguousarray(wav)), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16):
+    """Write float waveform in [-1, 1] (or int16) as PCM16 WAV."""
+    data = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if channels_first:
+        data = data.T
+    if data.ndim == 1:
+        data = data[:, None]
+    if np.issubdtype(data.dtype, np.floating):
+        data = np.clip(data, -1.0, 1.0)
+        data = (data * (2 ** 15 - 1)).astype(np.int16)
+    else:
+        data = data.astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(int(sample_rate))
+        f.writeframes(np.ascontiguousarray(data).tobytes())

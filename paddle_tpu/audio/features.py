@@ -1,0 +1,6 @@
+"""paddle.audio.features — feature-extraction layers (reference
+python/paddle/audio/features/layers.py). Implemented in audio/__init__;
+re-exported here for namespace parity."""
+from . import LogMelSpectrogram, MFCC, MelSpectrogram, Spectrogram  # noqa: F401
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

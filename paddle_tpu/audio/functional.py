@@ -1,0 +1,32 @@
+"""paddle.audio.functional — functional feature helpers (reference
+python/paddle/audio/functional/: window/mel/dct math). Implemented in
+audio/__init__; re-exported here for namespace parity."""
+from . import (  # noqa: F401
+    compute_fbank_matrix,
+    create_dct,
+    fft_frequencies,
+    get_window,
+    hz_to_mel,
+    mel_frequencies,
+    mel_to_hz,
+)
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "get_window", "create_dct"]
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    """Reference functional.power_to_db: 10*log10 with floor + top_db."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    x = spect._value if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor._from_value(log_spec)
+
+
+__all__.append("power_to_db")

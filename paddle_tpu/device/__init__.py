@@ -212,3 +212,74 @@ class _DeviceNamespace:
 
 cuda = _DeviceNamespace()  # reference-compat alias: paddle.device.cuda.*
 tpu = _DeviceNamespace()
+
+
+# ---- namespace parity tail (reference python/paddle/device/__init__.py)
+
+from ..core.place import CustomPlace as _CustomPlace
+
+
+class IPUPlace:
+    """Reference IPUPlace — no IPU backend in the TPU build; constructing
+    one raises like the reference does without an IPU wheel."""
+
+    def __init__(self, *a):
+        raise RuntimeError("IPU backend is not compiled into this build "
+                           "(TPU-native; use paddle.TPUPlace())")
+
+
+class XPUPlace:
+    """Reference XPUPlace — no XPU backend in the TPU build."""
+
+    def __init__(self, *a):
+        raise RuntimeError("XPU backend is not compiled into this build "
+                           "(TPU-native; use paddle.TPUPlace())")
+
+
+def get_all_device_type():
+    """Reference get_all_device_type: device types visible to this build."""
+    import jax
+
+    return sorted({d.platform for d in jax.devices()} | {"cpu"})
+
+
+def get_all_custom_device_type():
+    return []  # PJRT plugins register as first-class platforms, not custom
+
+
+def get_cudnn_version():
+    return None  # no cuDNN in the TPU build (reference returns None too)
+
+
+def is_compiled_with_cinn():
+    return False  # XLA is the compiler (SURVEY.md: CINN absorbed)
+
+
+def is_compiled_with_custom_device(device_type):
+    return False
+
+
+def is_compiled_with_distribute():
+    return True  # jax.distributed multi-controller is built in
+
+
+def is_compiled_with_ipu():
+    return False
+
+
+def is_compiled_with_xpu():
+    return False
+
+
+def set_stream(stream=None):
+    """Reference set_stream: XLA owns scheduling; accepted for parity."""
+    return stream
+
+
+__all__ += [
+    "IPUPlace", "XPUPlace", "get_all_device_type",
+    "get_all_custom_device_type", "get_cudnn_version",
+    "is_compiled_with_cinn", "is_compiled_with_custom_device",
+    "is_compiled_with_distribute", "is_compiled_with_ipu",
+    "is_compiled_with_xpu", "set_stream",
+]

@@ -1,0 +1,56 @@
+"""paddle.hub — load models/entrypoints from a hubconf.py (reference
+python/paddle/hapi/hub.py). Zero-egress build: the ``github`` source
+cannot fetch; ``local`` sources (a directory containing hubconf.py) are
+fully supported, which is also the reference's offline path."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {_HUBCONF} under {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["hubconf"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+def _resolve(repo_dir, source):
+    if source != "local":
+        raise NotImplementedError(
+            "this build has no network egress; use source='local' with a "
+            "directory containing hubconf.py (the reference's offline path)")
+    return repo_dir
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entrypoint names exported by the repo's hubconf."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    return [n for n, v in vars(mod).items()
+            if callable(v) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A002
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise RuntimeError(f"hubconf has no entrypoint {model!r}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, *args, source="local", force_reload=False,
+         **kwargs):
+    """Instantiate entrypoint ``model`` from the repo's hubconf."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise RuntimeError(f"hubconf has no entrypoint {model!r}")
+    return fn(*args, **kwargs)

@@ -3,3 +3,99 @@ from . import nn  # noqa: F401
 from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+# ---- namespace parity tail (reference python/paddle/incubate/__init__.py)
+
+from .. import inference  # noqa: F401  (reference re-exports it here)
+from ..geometric import (  # noqa: F401  (legacy incubate graph names)
+    reindex_graph as graph_reindex,
+    sample_neighbors as graph_sample_neighbors,
+    segment_max,
+    segment_mean,
+    segment_min,
+    segment_sum,
+)
+from ..geometric import send_u_recv as _send_u_recv
+
+
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None):
+    """Legacy incubate name for geometric.send_u_recv (reference
+    incubate/operators/graph_send_recv.py)."""
+    return _send_u_recv(x, src_index, dst_index, reduce_op=pool_type,
+                        out_size=out_size)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False):
+    """Multi-hop neighbor sampling (reference incubate/operators/
+    graph_khop_sampler.py): one sample_neighbors pass per hop, frontier =
+    previous hop's unique neighbors; edges reindexed against the union of
+    visited nodes. Returns (edge_src, edge_dst, sample_index,
+    reindex_nodes[, edge_eids])."""
+    import numpy as np
+
+    from ..core.tensor import Tensor
+    from ..geometric import reindex_graph, sample_neighbors
+
+    frontier = input_nodes
+    all_neighbors, all_counts, seeds_per_hop = [], [], []
+    for size in sample_sizes:
+        nbrs, cnts = sample_neighbors(row, colptr, frontier,
+                                      sample_size=size)
+        all_neighbors.append(np.asarray(nbrs._value))
+        all_counts.append(np.asarray(cnts._value))
+        seeds_per_hop.append(np.asarray(
+            frontier._value if isinstance(frontier, Tensor) else frontier))
+        frontier = Tensor(np.unique(np.asarray(nbrs._value)))
+    seeds = np.concatenate(seeds_per_hop)
+    nbrs = np.concatenate(all_neighbors) if all_neighbors else np.zeros(0)
+    cnts = np.concatenate(all_counts) if all_counts else np.zeros(0)
+    src, dst, nodes = reindex_graph(Tensor(seeds), Tensor(nbrs),
+                                    Tensor(cnts))
+    if return_eids:
+        raise NotImplementedError(
+            "graph_khop_sampler(return_eids=True): track eids via "
+            "geometric.sample_neighbors(eids=..., return_eids=True)")
+    return src, dst, Tensor(np.asarray(nodes._value)), nodes
+
+
+def identity_loss(x, reduction="none"):
+    """Reference incubate.identity_loss (the IPU loss marker op): identity
+    with an optional mean/sum reduction."""
+    if reduction in ("mean", 0):
+        return x.mean()
+    if reduction in ("sum", 1):
+        return x.sum()
+    if reduction in ("none", 2):
+        return x
+    raise ValueError(f"unknown reduction {reduction!r}")
+
+
+def softmax_mask_fuse(x, mask):
+    """softmax(x + mask) — the reference's fused_softmax_mask kernel
+    (incubate/operators/softmax_mask_fuse.py); XLA fuses the composition."""
+    from ..ops import softmax
+
+    return softmax(x + mask, axis=-1)
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Causal (upper-triangle masked) softmax — the reference's
+    fused_softmax_mask_upper_triangle kernel."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+    from ..ops import softmax
+
+    v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    s, k = v.shape[-2], v.shape[-1]
+    mask = jnp.triu(jnp.full((s, k), -1e30, v.dtype), k=1)
+    return softmax((Tensor._from_value(v + mask)
+                    if isinstance(x, Tensor) else v + mask), axis=-1)
+
+
+__all__ = ["nn", "asp", "optimizer", "LookAhead", "ModelAverage",
+           "inference", "graph_khop_sampler", "graph_reindex",
+           "graph_sample_neighbors", "graph_send_recv", "identity_loss",
+           "segment_max", "segment_mean", "segment_min", "segment_sum",
+           "softmax_mask_fuse", "softmax_mask_fuse_upper_triangle"]

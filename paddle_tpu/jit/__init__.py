@@ -795,3 +795,37 @@ from .serialization import (  # noqa: E402,F401
 )
 
 __all__ += ["save", "load", "save_generate", "TranslatedLayer"]
+
+
+# ---- namespace parity tail (reference python/paddle/jit/__init__.py)
+
+_to_static_enabled = True
+
+
+def enable_to_static(enable):
+    """Reference jit.enable_to_static: globally toggle to_static tracing
+    (StaticFunction falls back to eager when disabled)."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference sot/dy2static transformed-code logging. The TPU build's
+    trace artifact is the jaxpr/StableHLO, inspectable via
+    jax.make_jaxpr / serialization.save — this knob is accepted and
+    recorded for parity."""
+    import logging
+
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level else logging.WARNING)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Reference jit.set_verbosity over the dy2static logger."""
+    import logging
+
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level else logging.WARNING)
+
+
+__all__ += ["enable_to_static", "set_code_level", "set_verbosity"]

@@ -51,3 +51,220 @@ def matrix_rank(x, tol=None, hermitian=False):
 
     v = x._value if isinstance(x, Tensor) else jnp.asarray(x)
     return Tensor._from_value(jnp.linalg.matrix_rank(v, tol))
+
+
+# ---- namespace parity tail (reference paddle.linalg __all__)
+
+def _v(x):
+    import jax.numpy as jnp
+
+    from .core.tensor import Tensor
+
+    return x._value if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _t(v):
+    from .core.tensor import Tensor
+
+    return Tensor._from_value(v)
+
+
+def cholesky_solve(x, y, upper=False):
+    """Solve A @ out = x given A's Cholesky factor ``y`` (reference
+    cholesky_solve_kernel)."""
+    from jax.scipy.linalg import cho_solve
+
+    return _t(cho_solve((_v(y), not upper), _v(x)))
+
+
+def cholesky_inverse(x, upper=False):
+    """inv(A) from A's Cholesky factor (reference cholesky_inverse)."""
+    import jax.numpy as jnp
+    from jax.scipy.linalg import cho_solve
+
+    f = _v(x)
+    eye = jnp.eye(f.shape[-1], dtype=f.dtype)
+    return _t(cho_solve((f, not upper), eye))
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    import jax.numpy as jnp
+
+    return _t(jnp.cov(_v(x), rowvar=rowvar, ddof=1 if ddof else 0,
+                      fweights=None if fweights is None else _v(fweights),
+                      aweights=None if aweights is None else _v(aweights)))
+
+
+def corrcoef(x, rowvar=True, name=None):
+    import jax.numpy as jnp
+
+    return _t(jnp.corrcoef(_v(x), rowvar=rowvar))
+
+
+def eigvals(x, name=None):
+    import jax.numpy as jnp
+
+    return _t(jnp.linalg.eigvals(_v(x)))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    import jax.numpy as jnp
+
+    return _t(jnp.linalg.eigvalsh(_v(x), UPLO=UPLO))
+
+
+def matrix_exp(x, name=None):
+    from jax.scipy.linalg import expm
+
+    return _t(expm(_v(x)))
+
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    import jax.numpy as jnp
+
+    v = _v(x)
+    if axis is None:
+        v = v.ravel()
+        axis = 0
+    return _t(jnp.linalg.norm(v, ord=p, axis=axis, keepdims=keepdim))
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    """LU factorization (reference lu_kernel): returns (LU, pivots) with
+    1-BASED int32 pivots (the reference convention), plus infos when
+    asked."""
+    import jax.numpy as jnp
+    from jax.scipy.linalg import lu_factor
+
+    luf, piv = lu_factor(_v(x))
+    piv = (piv + 1).astype(jnp.int32)
+    if get_infos:
+        infos = jnp.zeros(luf.shape[:-2], jnp.int32)
+        return _t(luf), _t(piv), _t(infos)
+    return _t(luf), _t(piv)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """(P, L, U) from lu()'s combined output + 1-based pivots."""
+    import jax.numpy as jnp
+
+    luf = _v(x)
+    piv = _v(y) - 1  # back to 0-based row swaps
+    m = luf.shape[-2]
+    n = luf.shape[-1]
+    k = min(m, n)
+    L = jnp.tril(luf[..., :, :k], -1) + jnp.eye(m, k, dtype=luf.dtype)
+    U = jnp.triu(luf[..., :k, :])
+    perm = jnp.arange(m)
+    for i in range(piv.shape[-1]):  # sequential row swaps (LAPACK ipiv)
+        j = piv[..., i]
+        pi, pj = perm[i], perm[j]
+        perm = perm.at[i].set(pj).at[j].set(pi)
+    P = jnp.eye(m, dtype=luf.dtype)[perm].T
+    out = []
+    if unpack_pivots:
+        out.append(_t(P))
+    if unpack_ludata:
+        out.extend([_t(L), _t(U)])
+    return tuple(out)
+
+
+def householder_product(x, tau, name=None):
+    """Assemble Q from Householder reflectors (reference orgqr /
+    householder_product_kernel): Q = H_1 H_2 ... H_k with
+    H_i = I - tau_i v_i v_i^H."""
+    import jax.numpy as jnp
+
+    a = _v(x)
+    t = _v(tau)
+    m, k = a.shape[-2], t.shape[-1]
+    q = jnp.eye(m, a.shape[-1], dtype=a.dtype)
+    for i in range(k - 1, -1, -1):
+        v = a[..., :, i]
+        v = jnp.where(jnp.arange(m) < i, 0.0, v)
+        v = v.at[..., i].set(1.0)
+        q = q - t[..., i] * jnp.einsum("...i,...j,...jk->...ik", v, v, q)
+    return _t(q)
+
+
+def ormqr(x, tau, y, left=True, transpose=False, name=None):
+    """Multiply ``y`` by Q (from qr's reflectors) — reference ormqr;
+    composed from householder_product + matmul (the explicit-Q path)."""
+    import jax.numpy as jnp
+
+    q = _v(householder_product(x, tau))
+    if transpose:
+        q = jnp.swapaxes(q, -1, -2)
+    out = q @ _v(y) if left else _v(y) @ q
+    return _t(out)
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Rank-q PCA (reference pca_lowrank): exact truncated SVD (the
+    randomized iteration is a GPU-memory optimization; on TPU the dense
+    SVD is the fast path). Returns (U, S, V)."""
+    import jax.numpy as jnp
+
+    a = _v(x)
+    if q is None:
+        q = min(6, a.shape[-2], a.shape[-1])
+    if center:
+        a = a - a.mean(axis=-2, keepdims=True)
+    u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+    return _t(u[..., :q]), _t(s[..., :q]), _t(jnp.swapaxes(vh, -1, -2)[..., :q])
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Rank-q SVD (reference svd_lowrank); exact truncated SVD."""
+    import jax.numpy as jnp
+
+    a = _v(x)
+    if M is not None:
+        a = a - _v(M)
+    q = min(q, a.shape[-2], a.shape[-1])
+    u, s, vh = jnp.linalg.svd(a, full_matrices=False)
+    return _t(u[..., :q]), _t(s[..., :q]), _t(jnp.swapaxes(vh, -1, -2)[..., :q])
+
+
+def fp8_fp8_half_gemm_fused(x, y, bias=None, transpose_x=False,
+                            transpose_y=False, scale=1.0,
+                            output_dtype="bfloat16", act="identity",
+                            name=None):
+    """FP8 x FP8 -> half GEMM (reference incubate fp8 cutlass gemm,
+    exported via paddle.linalg). TPU-natively: float8_e4m3 operands feed
+    lax.dot_general with a half-precision accumulator/output dtype — on
+    fp8-capable TPUs XLA lowers to native fp8 MXU passes, elsewhere it
+    upcasts."""
+    import jax
+    import jax.numpy as jnp
+
+    from .core.dtype import to_jax_dtype
+
+    a, b = _v(x), _v(y)
+    f8 = jnp.float8_e4m3fn
+    a = a.astype(f8) if a.dtype != f8 else a
+    b = b.astype(f8) if b.dtype != f8 else b
+    if transpose_x:
+        a = jnp.swapaxes(a, -1, -2)
+    if transpose_y:
+        b = jnp.swapaxes(b, -1, -2)
+    out_dt = to_jax_dtype(output_dtype)
+    out = jax.lax.dot_general(
+        a, b, (((a.ndim - 1,), (b.ndim - 2,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    out = out * scale
+    if bias is not None:
+        out = out + _v(bias).astype(out.dtype)
+    if act == "gelu":
+        out = jax.nn.gelu(out)
+    elif act == "relu":
+        out = jax.nn.relu(out)
+    return _t(out.astype(out_dt))
+
+
+__all__ += [
+    "cholesky_solve", "cholesky_inverse", "cov", "corrcoef", "eigvals",
+    "eigvalsh", "matrix_exp", "vector_norm", "lu", "lu_unpack",
+    "householder_product", "ormqr", "pca_lowrank", "svd_lowrank",
+    "fp8_fp8_half_gemm_fused",
+]

@@ -181,3 +181,63 @@ class QAT:
 
     def quantize(self, model, inplace=False):
         return _wrap_model(model, self.config)
+
+
+# ---- namespace parity tail (reference python/paddle/quantization/)
+
+class BaseObserver(Layer):
+    """Reference quantization/base_observer.py: the abstract range
+    observer — subclasses implement forward (collect) and scale()."""
+
+    def __init__(self, quant_bits=8):
+        super().__init__()
+        self.quant_bits = quant_bits
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def scale(self):
+        raise NotImplementedError
+
+    def _instance(self, layer):
+        return type(self)(self.quant_bits)
+
+
+class BaseQuanter(Layer):
+    """Reference quantization/base_quanter.py: the abstract fake-quant
+    node QAT inserts; subclasses implement forward (quant-dequant)."""
+
+    def forward(self, x):
+        raise NotImplementedError
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return None
+
+
+def quanter(class_name):
+    """Reference quantization/factory.py @quanter decorator: register a
+    quanter config factory under ``class_name`` so QuantConfig can refer
+    to it by name."""
+    registry = globals().setdefault("_QUANTER_REGISTRY", {})
+
+    def wrap(cls):
+        registry[class_name] = cls
+
+        class _Factory:
+            def __init__(self, *args, **kwargs):
+                self._args, self._kwargs = args, kwargs
+
+            def _instance(self, layer):
+                return cls(*self._args, **self._kwargs)
+
+        _Factory.__name__ = class_name
+        globals()[class_name] = _Factory
+        return cls
+
+    return wrap
+
+
+__all__ += ["BaseObserver", "BaseQuanter", "quanter"]

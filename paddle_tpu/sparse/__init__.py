@@ -493,3 +493,84 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
         lambda pv, vv: jax.ops.segment_sum(
             pv[:, None] * vv[cols], rows, num_segments=s))(p, vr)
     return Tensor._from_value(out.reshape(b, h, s, d))
+
+
+# ---- namespace parity tail (reference paddle.sparse __all__)
+
+def neg(x):
+    return _unary(x, jnp.negative)
+
+
+def deg2rad(x):
+    return _unary(x, jnp.deg2rad)
+
+
+def rad2deg(x):
+    return _unary(x, jnp.rad2deg)
+
+
+def mv(x, vec, name=None):
+    """Sparse matrix @ dense vector (reference sparse mv_kernel)."""
+    v = _val(vec)
+    return Tensor._from_value(x._bcoo @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) with sparse x (reference sparse addmm:
+    the sparse GEMM epilogue)."""
+    prod = x._bcoo @ _val(y)
+    return Tensor._from_value(beta * _val(input) + alpha * prod)
+
+
+def mask_as(x, mask, name=None):
+    """Project dense ``x`` onto ``mask``'s sparsity pattern (reference
+    sparse mask_as_kernel): keeps mask's indices, takes x's values."""
+    dense = _val(x)
+    if mask.is_sparse_csr():
+        coo = mask.to_sparse_coo()
+        idx = coo._bcoo.indices
+    else:
+        idx = mask._bcoo.indices
+    vals = dense[tuple(idx[:, d] for d in range(idx.shape[1]))]
+    out = jsparse.BCOO((vals, idx), shape=dense.shape)
+    st = SparseTensor(out, "coo")
+    return st.to_sparse_csr() if mask.is_sparse_csr() else st
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    """Slice a sparse tensor along ``axes`` (reference sparse
+    slice_kernel): filter indices inside the window, shift them."""
+    import numpy as np
+
+    coo = x.to_sparse_coo() if x.is_sparse_csr() else x
+    idx = np.asarray(coo._bcoo.indices)
+    vals = np.asarray(coo._bcoo.data)
+    shape = list(x.shape)
+    keep = np.ones(idx.shape[0], bool)
+    for ax, s, e in zip(axes, starts, ends):
+        s = s + shape[ax] if s < 0 else s
+        e = e + shape[ax] if e < 0 else min(e, shape[ax])
+        keep &= (idx[:, ax] >= s) & (idx[:, ax] < e)
+        shape[ax] = max(e - s, 0)
+    idx = idx[keep].copy()
+    vals = vals[keep]
+    for ax, s, _ in zip(axes, starts, [None] * len(axes)):
+        s = s + x.shape[ax] if s < 0 else s
+        idx[:, ax] -= s
+    out = jsparse.BCOO((jnp.asarray(vals), jnp.asarray(idx)),
+                       shape=tuple(shape))
+    st = SparseTensor(out, "coo")
+    return st.to_sparse_csr() if x.is_sparse_csr() else st
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Reference sparse pca_lowrank: densify (TPU SVD is the fast path)
+    and reuse linalg.pca_lowrank."""
+    from ..linalg import pca_lowrank as _dense_pca
+
+    return _dense_pca(Tensor._from_value(x.to_dense()), q=q, center=center,
+                      niter=niter)
+
+
+__all__ += ["neg", "deg2rad", "rad2deg", "mv", "addmm", "mask_as", "slice",
+            "pca_lowrank"]

@@ -1,0 +1,17 @@
+"""paddle.sysconfig — include/lib directories (reference
+python/paddle/sysconfig.py). The TPU build's native pieces live under
+paddle_tpu/native; headers for custom C++ ops come from
+utils.cpp_extension."""
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    return os.path.join(_ROOT, "include")
+
+
+def get_lib():
+    return os.path.join(_ROOT, "native")

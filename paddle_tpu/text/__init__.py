@@ -16,8 +16,19 @@ from ..core.tensor import Tensor
 from ..nn.layer_base import Layer
 
 from . import datasets  # noqa: E402,F401
+from .datasets import (  # noqa: E402,F401  (reference re-exports them here)
+    Conll05st,
+    Imdb,
+    Imikolov,
+    Movielens,
+    UCIHousing,
+    WMT14,
+    WMT16,
+)
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "datasets",
+           "Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,

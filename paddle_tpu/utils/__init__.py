@@ -89,3 +89,38 @@ def flatten(nested):
 
     walk(nested)
     return out
+
+
+def require_version(min_version, max_version=None):
+    """Reference utils.require_version over the installed framework
+    version (version.py full_version)."""
+    from .. import version as _v
+
+    def parse(s):
+        return tuple(int(p) for p in str(s).split(".")[:3] if p.isdigit())
+
+    cur = parse(getattr(_v, "full_version", "0.0.0"))
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {getattr(_v, 'full_version', '?')} < "
+            f"required minimum {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {getattr(_v, 'full_version', '?')} > "
+            f"required maximum {max_version}")
+
+
+def run_check():
+    """Reference paddle.utils.run_check: verify the install can compute on
+    its accelerator — one small jitted matmul on the default backend."""
+    import jax
+    import jax.numpy as jnp
+
+    out = jax.jit(lambda a, b: a @ b)(jnp.ones((4, 4)), jnp.ones((4, 4)))
+    assert float(out.sum()) == 64.0
+    n = jax.device_count()
+    print(f"PaddlePaddle (paddle_tpu) works on {n} "
+          f"{jax.default_backend()} device{'s' if n != 1 else ''}.")
+
+
+__all__ += ["require_version", "run_check"]

@@ -7,4 +7,39 @@ from . import models  # noqa: F401
 from . import ops  # noqa: F401
 from . import transforms  # noqa: F401
 
-__all__ = ["datasets", "models", "ops", "transforms"]
+__all__ = ["datasets", "models", "ops", "transforms",
+           "get_image_backend", "set_image_backend", "image_load"]
+
+_image_backend = "pil"
+
+
+def get_image_backend():
+    """Reference vision.image.get_image_backend."""
+    return _image_backend
+
+
+def set_image_backend(backend):
+    """Reference set_image_backend: 'pil' or 'cv2' (cv2 is not shipped;
+    selecting it raises like the reference does for missing backends)."""
+    global _image_backend
+    if backend not in ("pil", "cv2"):
+        raise ValueError(f"expected 'pil' or 'cv2', got {backend!r}")
+    if backend == "cv2":
+        try:
+            import cv2  # noqa: F401
+        except ImportError as e:
+            raise ValueError("cv2 backend requested but OpenCV is not "
+                             "installed in this build") from e
+    _image_backend = backend
+
+
+def image_load(path, backend=None):
+    """Reference vision.image_load: returns a PIL.Image (pil backend)."""
+    backend = backend or _image_backend
+    if backend == "pil":
+        from PIL import Image
+
+        return Image.open(path)
+    import cv2
+
+    return cv2.imread(path)
