@@ -1,0 +1,270 @@
+"""Resilience primitives: retry/backoff/deadline budgets + fault injection.
+
+The reference hardens its distributed runtime in C++ (gloo/NCCL retry
+loops, comm_task_manager watchdogs, TCPStore reconnect logic spread across
+paddle/phi/core/distributed/). Here that machinery is one host-side module
+shared by every layer that talks over a wire or a filesystem: the
+coordination-KV p2p transport, TCPStore clients, distributed checkpoints,
+RPC, and the serving engine.
+
+Three pieces:
+
+* ``RetryPolicy`` — exponential backoff with jitter, bounded by BOTH a
+  max-attempt budget and an optional ``Deadline``. A retry never sleeps
+  past the deadline; the last failure is re-raised (chained) when the
+  budget runs out.
+* ``Deadline`` — an absolute point in time that propagates through call
+  chains (``remaining()`` / ``remaining_ms()`` / ``expired()``), so nested
+  retries share one wall-clock budget instead of multiplying timeouts.
+* **Deterministic fault injection** — ``inject(site)`` points compiled
+  into the transport/checkpoint/store paths, toggled by
+  ``FLAGS_fault_injection`` (e.g. ``kv_drop:2`` = fail the first two
+  fetches at site ``kv_drop``; ``store_set:*`` = fail every one). Faults
+  raise ``InjectedFault`` (a ``ConnectionError``), which every retry
+  policy here treats as transient — so tests and chaos drills exercise
+  the REAL recovery paths, not mocks.
+
+Observability: module-level counters (``bump_counter``/``counters``)
+record retries, injected faults, and swallowed-but-counted failures such
+as leaked coordinator keys.
+"""
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+
+from .flags import define_flag, flag
+
+__all__ = [
+    "RetryPolicy", "Deadline",
+    "CommTimeoutError", "InjectedFault", "CheckpointCorruptionError",
+    "inject", "fault_remaining", "reset_faults",
+    "bump_counter", "get_counter", "counters", "reset_counters",
+]
+
+logger = logging.getLogger("paddle_tpu.resilience")
+
+define_flag("FLAGS_fault_injection", "",
+            "Deterministic fault-injection spec 'site:N[,site:N...]': the "
+            "first N inject(site) calls raise InjectedFault ('site:*' = "
+            "every call, bare 'site' = once). Empty disables injection.")
+define_flag("FLAGS_retry_max_attempts", 5,
+            "Default RetryPolicy attempt budget (total tries, not retries)")
+define_flag("FLAGS_retry_base_delay", 0.05,
+            "Default RetryPolicy first backoff delay in seconds")
+define_flag("FLAGS_retry_max_delay", 2.0,
+            "Default RetryPolicy backoff ceiling in seconds")
+define_flag("FLAGS_comm_timeout_ms", 120_000,
+            "Default deadline for coordination-KV p2p fetches (ms)")
+define_flag("FLAGS_heartbeat_ttl", 6.0,
+            "Seconds without a store heartbeat before a rank counts dead")
+
+
+# ------------------------------------------------------------------ errors
+
+class InjectedFault(ConnectionError):
+    """Raised by ``inject(site)`` — a ConnectionError so every transport
+    retry policy classifies it as transient."""
+
+
+class CommTimeoutError(TimeoutError):
+    """A point-to-point transfer exhausted its deadline/retry budget.
+    Carries the coordination key and the (src, dst) pair so a wedged
+    pipeline names the exact edge instead of hanging."""
+
+    def __init__(self, message, key=None, src=None, dst=None):
+        super().__init__(message)
+        self.key = key
+        self.src = src
+        self.dst = dst
+
+
+class CheckpointCorruptionError(RuntimeError):
+    """A checkpoint shard failed its recorded CRC32 on load."""
+
+
+# ------------------------------------------------------------------ deadline
+
+class Deadline:
+    """An absolute time budget. ``Deadline(None)`` never expires. Built on
+    the MONOTONIC clock: deadlines are purely process-local, and an NTP
+    step must not expire every in-flight budget (or stall a watchdog)."""
+
+    def __init__(self, seconds=None):
+        self.expires_at = (None if seconds is None
+                           else time.monotonic() + seconds)
+
+    @classmethod
+    def after(cls, seconds):
+        return cls(seconds)
+
+    @classmethod
+    def from_ms(cls, ms):
+        return cls(None if ms is None else ms / 1000.0)
+
+    @classmethod
+    def never(cls):
+        return cls(None)
+
+    def remaining(self) -> float:
+        if self.expires_at is None:
+            return float("inf")
+        return self.expires_at - time.monotonic()
+
+    def remaining_ms(self) -> float:
+        return self.remaining() * 1000.0
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+    def __repr__(self):
+        if self.expires_at is None:
+            return "Deadline(never)"
+        return f"Deadline({self.remaining():.3f}s left)"
+
+
+# ------------------------------------------------------------------ retry
+
+class RetryPolicy:
+    """Exponential backoff + jitter under attempt AND deadline budgets.
+
+    ``call(fn, deadline=...)`` runs ``fn`` up to ``max_attempts`` times,
+    sleeping ``min(base * 2**i, max_delay) * (1 + jitter*u)`` between
+    tries, never past the deadline. Only ``retry_on`` exceptions are
+    retried; anything else propagates immediately. Defaults come from
+    FLAGS at construction time so chaos drills can retune globally.
+    """
+
+    def __init__(self, max_attempts=None, base_delay=None, max_delay=None,
+                 jitter=0.5, retry_on=(ConnectionError, TimeoutError, OSError),
+                 sleep=time.sleep, rng=None):
+        self.max_attempts = int(max_attempts if max_attempts is not None
+                                else flag("FLAGS_retry_max_attempts"))
+        self.base_delay = float(base_delay if base_delay is not None
+                                else flag("FLAGS_retry_base_delay"))
+        self.max_delay = float(max_delay if max_delay is not None
+                               else flag("FLAGS_retry_max_delay"))
+        self.jitter = float(jitter)
+        self.retry_on = tuple(retry_on)
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+
+    def delay(self, attempt: int) -> float:
+        base = min(self.base_delay * (2 ** attempt), self.max_delay)
+        return base * (1.0 + self.jitter * self._rng.random())
+
+    def call(self, fn, *args, deadline: Deadline | None = None,
+             describe: str = None, on_retry=None, **kwargs):
+        deadline = deadline or Deadline.never()
+        last_exc = None
+        for attempt in range(max(self.max_attempts, 1)):
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as e:
+                last_exc = e
+                bump_counter("retries" if attempt + 1 < self.max_attempts
+                             else "retry_budget_exhausted")
+                if attempt + 1 >= self.max_attempts:
+                    break
+                pause = self.delay(attempt)
+                if deadline.remaining() <= pause:
+                    bump_counter("retry_deadline_exhausted")
+                    break
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                logger.warning("retrying %s after %s (attempt %d/%d, "
+                               "backoff %.3fs)", describe or fn, e,
+                               attempt + 1, self.max_attempts, pause)
+                self._sleep(pause)
+        raise last_exc
+
+
+# ------------------------------------------------------- fault injection
+
+_fault_lock = threading.RLock()
+_fault_raw: str | None = None
+_fault_remaining: dict[str, float] = {}
+
+
+def _parse_spec(raw: str) -> dict[str, float]:
+    out: dict[str, float] = {}
+    for part in str(raw).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        site, _, n = part.partition(":")
+        n = n.strip()
+        out[site.strip()] = (float("inf") if n in ("*", "inf")
+                             else int(n) if n else 1)
+    return out
+
+
+def _sync_faults():
+    global _fault_raw
+    raw = flag("FLAGS_fault_injection")
+    if raw != _fault_raw:
+        _fault_raw = raw
+        _fault_remaining.clear()
+        _fault_remaining.update(_parse_spec(raw))
+
+
+def inject(site: str):
+    """Fault-injection point: raise ``InjectedFault`` while the site's
+    FLAGS_fault_injection budget lasts, else no-op. Re-arming requires the
+    flag VALUE to change (set it to '' between drills)."""
+    with _fault_lock:
+        _sync_faults()
+        left = _fault_remaining.get(site, 0)
+        if left <= 0:
+            return
+        _fault_remaining[site] = left - 1
+        bump_counter(f"fault_injected:{site}")
+        msg = (f"injected fault at site {site!r} "
+               f"({_fault_remaining[site]} remaining)")
+    raise InjectedFault(msg)
+
+
+def fault_remaining(site: str) -> float:
+    with _fault_lock:
+        _sync_faults()
+        return _fault_remaining.get(site, 0)
+
+
+def reset_faults():
+    """Disarm injection and forget consumed budgets (test teardown)."""
+    from .flags import set_flags
+
+    global _fault_raw
+    with _fault_lock:
+        set_flags({"FLAGS_fault_injection": ""})
+        _fault_raw = None
+        _fault_remaining.clear()
+
+
+# ------------------------------------------------------------- counters
+
+_counter_lock = threading.Lock()
+_counters: dict[str, int] = {}
+
+
+def bump_counter(name: str, n: int = 1) -> int:
+    with _counter_lock:
+        _counters[name] = _counters.get(name, 0) + n
+        return _counters[name]
+
+
+def get_counter(name: str) -> int:
+    with _counter_lock:
+        return _counters.get(name, 0)
+
+
+def counters() -> dict[str, int]:
+    with _counter_lock:
+        return dict(_counters)
+
+
+def reset_counters():
+    with _counter_lock:
+        _counters.clear()

@@ -44,7 +44,14 @@ from .extras import (  # noqa: F401
     wait,
 )
 from .auto_parallel import DistModel, Strategy, to_static  # noqa: F401
-from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    CheckpointCorruptionError,
+    latest_complete_snapshot,
+    load_latest_snapshot,
+    load_state_dict,
+    save_snapshot,
+    save_state_dict,
+)
 from .spawn import MultiprocessContext, spawn  # noqa: F401
 from .api import (  # noqa: F401
     ShardDataloader,
@@ -60,6 +67,7 @@ from .api import (  # noqa: F401
     unshard_dtensor,
 )
 from .collective import (  # noqa: F401
+    CommTimeoutError,
     Group,
     P2POp,
     ReduceOp,
@@ -104,6 +112,7 @@ __all__ = [
     "init_parallel_env", "is_initialized", "barrier",
     "all_reduce", "all_gather", "broadcast", "reduce", "scatter",
     "all_to_all", "reduce_scatter", "send", "recv", "isend", "irecv",
+    "CommTimeoutError",
     "DataParallel", "ParallelEnv", "comm_ops",
     "Strategy", "DistModel", "to_static",
     "spawn", "MultiprocessContext",
@@ -115,4 +124,6 @@ __all__ = [
     "CountFilterEntry", "ProbabilityEntry", "ShowClickEntry",
     "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
     "InMemoryDataset", "QueueDataset", "launch", "io",
+    "CheckpointCorruptionError", "save_snapshot", "load_latest_snapshot",
+    "latest_complete_snapshot",
 ]

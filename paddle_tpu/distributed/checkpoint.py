@@ -28,13 +28,30 @@ from __future__ import annotations
 
 import json
 import os
+import re
+import zlib
 
 import numpy as np
 
+from ..core.resilience import CheckpointCorruptionError, inject, logger
 from ..core.tensor import Tensor
 from ..framework.io import save_arrays
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = [
+    "save_state_dict", "load_state_dict", "CheckpointCorruptionError",
+    "save_snapshot", "load_latest_snapshot", "latest_complete_snapshot",
+]
+
+
+def _crc32(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
+def _atomic_json(obj, path):
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
 
 
 def _index_to_offsets(index, shape):
@@ -89,6 +106,7 @@ def save_state_dict(state_dict, path, process_group=None,
                 entry["shards"].append({
                     "key": skey, "file": fname,
                     "offsets": _index_to_offsets(sh.index, v.shape),
+                    "crc32": _crc32(data),
                 })
             if entry["shards"]:
                 meta["tensors"][key] = entry
@@ -108,7 +126,8 @@ def save_state_dict(state_dict, path, process_group=None,
                 meta["tensors"][key] = {
                     "shape": list(arr.shape), "dtype": arr.dtype.name,
                     "shards": [{"key": skey, "file": fname,
-                                "offsets": [[0, s] for s in arr.shape]}],
+                                "offsets": [[0, s] for s in arr.shape],
+                                "crc32": _crc32(arr)}],
                 }
         elif rank == coordinator_rank:
             # host scalars / plain arrays: identical on every rank, the
@@ -119,12 +138,18 @@ def save_state_dict(state_dict, path, process_group=None,
             meta["tensors"][key] = {
                 "shape": list(arr.shape), "dtype": arr.dtype.name,
                 "shards": [{"key": skey, "file": fname,
-                            "offsets": [[0, s] for s in arr.shape]}],
+                            "offsets": [[0, s] for s in arr.shape],
+                            "crc32": _crc32(arr)}],
             }
 
-    save_arrays(local, os.path.join(path, fname))
-    with open(os.path.join(path, f"{rank}.metadata.json"), "w") as f:
-        json.dump(meta, f)
+    # crash safety: write payload + metadata to *.tmp, then atomically
+    # rename — a process killed mid-save leaves stale tmp files, never a
+    # half-written shard that a later load would read
+    shard_path = os.path.join(path, fname)
+    save_arrays(local, shard_path + ".tmp")
+    inject("ckpt_commit")  # simulated crash BETWEEN write and rename
+    os.replace(shard_path + ".tmp", shard_path)
+    _atomic_json(meta, os.path.join(path, f"{rank}.metadata.json"))
 
 
 def _merged_metadata(path):
@@ -177,7 +202,7 @@ def _fill_block(block, dst_off, pieces, read):
             src_sl.append(slice(lo - s0, hi - s0))
         if empty:
             continue
-        src = read(piece["file"], piece["key"])
+        src = read(piece)
         block[tuple(dst_sl)] = src[tuple(src_sl)]
         filled += int(np.prod([sl.stop - sl.start for sl in dst_sl]))
     return filled
@@ -196,11 +221,21 @@ def load_state_dict(state_dict, path, process_group=None,
     tensors = _merged_metadata(path)
     file_cache: dict[str, ArrayFileReader] = {}
 
-    def read(fname, key):
+    def read(piece):
         # header-indexed seek+read: only overlapping pieces leave disk
+        fname, key = piece["file"], piece["key"]
         if fname not in file_cache:
             file_cache[fname] = ArrayFileReader(os.path.join(path, fname))
-        return file_cache[fname].read(key)
+        arr = file_cache[fname].read(key)
+        want = piece.get("crc32")  # absent in pre-CRC checkpoints
+        if want is not None:
+            got = _crc32(arr)
+            if got != int(want):
+                raise CheckpointCorruptionError(
+                    f"checkpoint shard {key!r} in "
+                    f"{os.path.join(path, fname)} is corrupted: crc32 "
+                    f"{got:#010x} != recorded {int(want):#010x}")
+        return arr
 
     missing = []
     for key, target in state_dict.items():
@@ -250,3 +285,109 @@ def load_state_dict(state_dict, path, process_group=None,
     if missing:
         raise KeyError(f"checkpoint at {path} is missing keys: {missing}")
     return state_dict
+
+
+# ---------------------------------------------------------------- snapshots
+#
+# Step-numbered snapshot directories under one root:
+#
+#     root/step_00000100/   (per-rank .distcp + .metadata.json, atomic)
+#     root/step_00000200/
+#
+# A snapshot is COMPLETE when every rank recorded by its own
+# 0.metadata.json has committed both files — the atomic tmp→rename order
+# (shard, then metadata) makes metadata presence the commit marker.
+# ``load_latest_snapshot`` walks newest→oldest, skipping incomplete
+# directories and (optionally) falling back past corrupted ones.
+
+_SNAP_RE = re.compile(r"^step_(\d+)$")
+
+
+def _snapshot_dirs(root):
+    """[(step, path)] ascending by step."""
+    out = []
+    if os.path.isdir(root):
+        for name in os.listdir(root):
+            m = _SNAP_RE.match(name)
+            if m:
+                out.append((int(m.group(1)), os.path.join(root, name)))
+    return sorted(out)
+
+
+def _is_complete(path) -> bool:
+    first = os.path.join(path, "0.metadata.json")
+    if not os.path.exists(first):
+        return False
+    try:
+        with open(first) as f:
+            world = int(json.load(f).get("world_size", 1))
+    except (OSError, ValueError):
+        return False
+    return all(
+        os.path.exists(os.path.join(path, f"{r}.metadata.json"))
+        and os.path.exists(os.path.join(path, f"{r}.distcp"))
+        for r in range(world))
+
+
+def save_snapshot(state_dict, root, step, keep=None):
+    """Save ``state_dict`` under ``root/step_{step:08d}`` (crash-safe,
+    checksummed). With ``keep``, rank 0 prunes the oldest snapshots so at
+    most ``keep`` remain. Returns the snapshot directory."""
+    import shutil
+
+    import jax
+
+    path = os.path.join(root, f"step_{int(step):08d}")
+    save_state_dict(state_dict, path)
+    if keep is not None and jax.process_index() == 0:
+        # only COMPLETE snapshots count toward ``keep`` — an interrupted
+        # save must never crowd out the fallback candidates. Incomplete
+        # leftovers older than the newest complete snapshot are debris
+        # and go too; newer ones may be a concurrent in-flight save.
+        snaps = _snapshot_dirs(root)
+        complete = [(s, p) for s, p in snaps if _is_complete(p)]
+        # keep <= 0 keeps nothing (complete[-0:] would keep EVERYTHING)
+        keep_set = ({p for _, p in complete[-int(keep):]}
+                    if int(keep) > 0 else set())
+        newest_step = complete[-1][0] if complete else None
+        for s, p in snaps:
+            if p in keep_set:
+                continue
+            if _is_complete(p) or (newest_step is not None
+                                   and s < newest_step):
+                shutil.rmtree(p, ignore_errors=True)
+    return path
+
+
+def latest_complete_snapshot(root):
+    """Newest complete snapshot directory under ``root``, or None."""
+    for _, path in reversed(_snapshot_dirs(root)):
+        if _is_complete(path):
+            return path
+    return None
+
+
+def load_latest_snapshot(state_dict, root, fallback=True):
+    """Load the newest complete snapshot under ``root`` into
+    ``state_dict``. With ``fallback`` (default), a snapshot that fails to
+    load — corrupted shard, missing file, coverage gap — is skipped with a
+    warning and the next-newest complete one is tried; without it the
+    first failure propagates. Returns the directory actually loaded."""
+    tried = []
+    for _, path in reversed(_snapshot_dirs(root)):
+        if not _is_complete(path):
+            logger.warning("skipping incomplete snapshot %s", path)
+            continue
+        try:
+            load_state_dict(state_dict, path)
+            return path
+        except (CheckpointCorruptionError, FileNotFoundError, KeyError,
+                ValueError) as e:
+            if not fallback:
+                raise
+            logger.warning("snapshot %s failed to load (%s); falling back",
+                           path, e)
+            tried.append(path)
+    raise FileNotFoundError(
+        f"no loadable snapshot under {root} "
+        f"(failed candidates: {tried or 'none'})")

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import resilience
+from ..core.resilience import CommTimeoutError, Deadline, RetryPolicy, inject
 from ..core.tensor import Tensor
 from .placement import Partial, Replicate, Shard
 from .process_mesh import ProcessMesh, get_mesh
@@ -35,7 +37,7 @@ __all__ = [
     "reduce", "scatter", "all_to_all", "reduce_scatter", "send", "recv",
     "isend", "irecv",
     "ReduceOp", "P2POp", "batch_isend_irecv", "destroy_process_group",
-    "in_dynamic_mode_collectives",
+    "in_dynamic_mode_collectives", "CommTimeoutError",
 ]
 
 
@@ -293,26 +295,68 @@ def _p2p_key(src, dst):
     return f"p2p/{src}->{dst}/{seq}"
 
 
-def _kv_publish(key, payload: bytes):
+# transient-for-the-transport errors: connection/timeouts/OS plus
+# RuntimeError, because the jax coordination client surfaces
+# DEADLINE_EXCEEDED/UNAVAILABLE as JaxRuntimeError. TypeError/ValueError
+# (programming errors) propagate immediately, un-retried and un-wrapped.
+_TRANSIENT = (ConnectionError, TimeoutError, OSError, RuntimeError)
+
+
+def _kv_publish(key, payload: bytes, deadline: Deadline | None = None):
     """Publish raw bytes on the coordination-service KV (shared by eager
-    p2p and the object collectives)."""
+    p2p and the object collectives). Transient coordinator errors are
+    retried with backoff under ``deadline``."""
     import base64
 
-    _p2p_client().key_value_set(key, base64.b64encode(payload).decode())
+    client = _p2p_client()  # usage errors (no multi-controller) don't retry
+    enc = base64.b64encode(payload).decode()
+
+    def _set():
+        inject("kv_publish")
+        client.key_value_set(key, enc)
+
+    RetryPolicy(retry_on=_TRANSIENT).call(
+        _set, deadline=deadline, describe=f"kv publish {key!r}")
 
 
-def _kv_fetch(key, timeout_ms=120_000, consume=True) -> bytes:
-    """Blocking fetch; ``consume`` deletes the key afterwards so per-call
-    channels never grow the coordinator's store."""
+def _kv_fetch(key, timeout_ms=None, consume=True, src=None,
+              dst=None) -> bytes:
+    """Blocking fetch under a wall-clock deadline (``timeout_ms``, default
+    FLAGS_comm_timeout_ms). Transient coordinator errors — including
+    injected ``kv_drop`` faults — are retried with backoff; when the
+    deadline or attempt budget runs out a ``CommTimeoutError`` naming
+    key/src/dst is raised instead of hanging. ``consume`` deletes the key
+    afterwards so per-call channels never grow the coordinator's store;
+    delete failures are counted (``kv_delete_failures``), not swallowed
+    silently, so leaked keys stay observable."""
     import base64
 
     client = _p2p_client()
-    raw = client.blocking_key_value_get(key, timeout_ms)
+    if timeout_ms is None:
+        timeout_ms = resilience.flag("FLAGS_comm_timeout_ms")
+    deadline = Deadline.from_ms(timeout_ms)
+
+    def _get():
+        inject("kv_drop")
+        slice_ms = max(int(min(deadline.remaining_ms(), timeout_ms)), 1)
+        return client.blocking_key_value_get(key, slice_ms)
+
+    try:
+        raw = RetryPolicy(retry_on=_TRANSIENT).call(
+            _get, deadline=deadline, describe=f"kv fetch {key!r}")
+    except _TRANSIENT as e:
+        raise CommTimeoutError(
+            f"p2p fetch of key {key!r} (src={src}, dst={dst}) failed after "
+            f"retries within {timeout_ms}ms: {e}",
+            key=key, src=src, dst=dst) from e
     if consume:
         try:
             client.key_value_delete(key)
-        except Exception:
-            pass
+        except Exception as e:
+            resilience.bump_counter("kv_delete_failures")
+            resilience.logger.warning(
+                "key_value_delete(%r) failed (leaked coordinator key): %s",
+                key, e)
     return base64.b64decode(raw)
 
 
@@ -337,14 +381,16 @@ def send(tensor, dst=0, group=None, sync_op=True):
 
 
 class _RecvTask:
-    def __init__(self, tensor, key, timeout_ms):
+    def __init__(self, tensor, key, timeout_ms, src=None, dst=None):
         self._tensor, self._key, self._timeout = tensor, key, timeout_ms
+        self._src, self._dst = src, dst
         self._done = False
 
     def wait(self):
         if self._done:
             return self._tensor
-        raw = _kv_fetch(self._key, self._timeout)  # consumed on read
+        raw = _kv_fetch(self._key, self._timeout,  # consumed on read
+                        src=self._src, dst=self._dst)
         t = self._tensor
         is_tensor = isinstance(t, Tensor)  # raw jax arrays also expose a
         val = t._value if is_tensor else t  # _value property — be explicit
@@ -359,11 +405,14 @@ class _RecvTask:
         return self._tensor
 
 
-def recv(tensor, src=0, group=None, sync_op=True, timeout_ms=120_000):
+def recv(tensor, src=0, group=None, sync_op=True, timeout_ms=None):
     """Receive into ``tensor`` (shape/dtype contract, reference
-    semantics) from process ``src``; blocks when ``sync_op``."""
-    task = _RecvTask(tensor, _p2p_key(int(src), jax.process_index()),
-                     timeout_ms)
+    semantics) from process ``src``; blocks when ``sync_op``. The fetch
+    runs under a deadline (``timeout_ms``, default FLAGS_comm_timeout_ms)
+    and raises ``CommTimeoutError`` naming key/src/dst on expiry."""
+    dst = jax.process_index()
+    task = _RecvTask(tensor, _p2p_key(int(src), dst),
+                     timeout_ms, src=int(src), dst=dst)
     if sync_op:
         # wait() returns the FILLED value — for raw-array buffers (no
         # in-place _value) the original object cannot carry the payload

@@ -50,21 +50,17 @@ class ElasticManager:
         self.prefix = prefix
         self.np_min, self.np_max = np_range or (world_size, world_size)
         self._stop = threading.Event()
-        self._thread = None
+        self._hb = None
         self._join_thread = None
 
     def _key(self, rank):
         return f"{self.prefix}/host/{rank}"
 
     def start(self):
-        def beat():
-            while not self._stop.is_set():
-                self.store.set(self._key(self.rank),
-                               str(time.time()).encode())
-                self._stop.wait(self.interval)
-
-        self._thread = threading.Thread(target=beat, daemon=True)
-        self._thread.start()
+        # liveness rides the store's heartbeat/watchdog API (store.py):
+        # one daemon thread beating `{prefix}/host/{rank}` every interval
+        self._hb = self.store.register_heartbeat(
+            self.rank, self.interval, prefix=f"{self.prefix}/host")
         return self
 
     def stop(self):
@@ -72,27 +68,18 @@ class ElasticManager:
         hold the native store client, and a set() after close is a
         use-after-free."""
         self._stop.set()
-        if self._thread:
-            self._thread.join(self.interval + 1)
+        if self._hb:
+            self._hb.stop(self.interval + 1)
         if self._join_thread:
             self._join_thread.join(self.interval + 1)
 
     def alive_ranks(self):
         """Ranks whose heartbeat is within the lease (reference
-        _update_hosts)."""
-        now = time.time()
-        alive = []
-        for r in range(self.world_size):
-            key = self._key(r)
-            if not self.store.check(key):
-                continue
-            try:
-                t = float(self.store.get(key).decode())
-            except (ValueError, RuntimeError):
-                continue
-            if now - t <= self.lease:
-                alive.append(r)
-        return alive
+        _update_hosts) — the complement of the store watchdog's
+        ``dead_ranks`` view."""
+        dead = set(self.store.dead_ranks(
+            self.world_size, ttl=self.lease, prefix=f"{self.prefix}/host"))
+        return [r for r in range(self.world_size) if r not in dead]
 
     def health_check(self):
         """COMPLETED if all ranks beat recently; RESTART when some died
@@ -119,7 +106,7 @@ class ElasticManager:
             while not self._stop.is_set():
                 try:
                     self.store.set(key, str(time.time()).encode())
-                except RuntimeError:
+                except (RuntimeError, ConnectionError):
                     return
                 self._stop.wait(self.interval)
 
@@ -131,7 +118,7 @@ class ElasticManager:
         try:
             n = self.store.add(f"{self.prefix}/joiners", 0)
             base = self.store.add(f"{self.prefix}/join_base", 0)
-        except RuntimeError:
+        except (RuntimeError, ConnectionError):
             return 0
         now = time.time()
         alive = 0
@@ -141,7 +128,7 @@ class ElasticManager:
                 continue
             try:
                 t = float(self.store.get(key).decode())
-            except (ValueError, RuntimeError):
+            except (ValueError, RuntimeError, ConnectionError):
                 continue
             if now - t <= self.lease:
                 alive += 1
@@ -184,7 +171,7 @@ class ElasticManager:
     def current_generation(self):
         try:
             return self.store.add(f"{self.prefix}/generation", 0)
-        except RuntimeError:
+        except (RuntimeError, ConnectionError):
             return 0
 
 

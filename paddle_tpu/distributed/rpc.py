@@ -182,20 +182,27 @@ def get_worker_info(name=None):
 
 
 class _Future:
-    def __init__(self, req_id, store):
+    def __init__(self, req_id, store, timeout=None, to=None):
         self._id = req_id
         self._store = store
+        self._timeout = timeout  # rpc_async's default budget
+        self._to = to
         self._done = None
 
     def wait(self, timeout=None):
+        from ..core.resilience import Deadline
+
+        if timeout is None:
+            timeout = self._timeout
         if self._done is None:
             key = f"rpc/reply/{self._id}"
             if timeout is not None:
-                deadline = time.time() + timeout
+                deadline = Deadline.after(timeout)
                 while not self._store.check(key):
-                    if time.time() > deadline:
+                    if deadline.expired():
                         raise TimeoutError(
-                            f"rpc reply not received within {timeout}s")
+                            f"rpc reply from {self._to!r} (request "
+                            f"{self._id}) not received within {timeout}s")
                     time.sleep(0.01)
             payload = _decode(self._store.get(key))
             if not payload["ok"]:
@@ -214,7 +221,7 @@ def rpc_async(to, fn, args=(), kwargs=None, timeout=None):
     inbox = f"rpc/inbox/{to}"
     slot = _state.store.add(inbox, 1) - 1
     _state.store.set(f"{inbox}/{slot}", _encode(req))
-    return _Future(req_id, _state.store)
+    return _Future(req_id, _state.store, timeout=timeout, to=to)
 
 
 def rpc_sync(to, fn, args=(), kwargs=None, timeout=None):

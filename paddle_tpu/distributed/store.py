@@ -9,9 +9,13 @@ available a pure-python in-process fallback serves single-host tests.
 """
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import threading
 import time
+
+from ..core.flags import flag
+from ..core.resilience import Deadline, RetryPolicy, inject
 
 __all__ = ["TCPStore", "create_or_get_global_tcp_store"]
 
@@ -92,14 +96,53 @@ class _PyStore:
 _py_stores: dict = {}
 
 
+class _HeartbeatHandle:
+    """Background liveness beats for one rank over a TCPStore."""
+
+    def __init__(self, store, rank, interval, prefix):
+        self._store = store
+        self._rank = rank
+        self._interval = interval
+        self._prefix = prefix
+        self._stop = threading.Event()
+        self._thread = None
+
+    def start(self):
+        def beat():
+            while not self._stop.is_set():
+                try:
+                    self._store.heartbeat(self._rank, self._prefix)
+                except (RuntimeError, ConnectionError):
+                    return  # store gone: the rank will read as dead
+                self._stop.wait(self._interval)
+
+        self._thread = threading.Thread(target=beat, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, join_timeout=None):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(join_timeout if join_timeout is not None
+                              else self._interval + 1)
+
+
 class TCPStore:
     def __init__(self, host="127.0.0.1", port=0, is_master=False,
-                 world_size=1, timeout=900):
+                 world_size=1, timeout=None):
         self.host = host
         self.is_master = is_master
-        self.timeout = timeout
+        # A USER-SUPPLIED timeout governs both blocking gets and the
+        # connect deadline (an earlier version clamped connects to
+        # min(timeout, 30), silently ignoring e.g. timeout=900 for slow
+        # multi-host rendezvous). When the caller doesn't specify one,
+        # gets keep the reference's 900s default but connects fail after
+        # 30s — a wrong endpoint should error fast, not wedge.
+        self.timeout = 900 if timeout is None else timeout
+        connect_timeout = 30 if timeout is None else timeout
         self._server = None
         self._client = None
+        self._retired = []  # clients replaced by _reconnect, freed on close
         self._py = None
         lib = _native()
         if lib is None:
@@ -114,61 +157,165 @@ class TCPStore:
                 raise RuntimeError(f"TCPStore: cannot bind port {port}")
             port = lib.tcpstore_server_port(self._server)
         self.port = port
-        deadline = time.time() + min(timeout, 30)
+        deadline = Deadline.after(connect_timeout)
         while True:
             self._client = lib.tcpstore_client_new(host.encode(), port)
             if self._client:
                 break
-            if time.time() > deadline:
-                raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+            if deadline.expired():
+                raise RuntimeError(
+                    f"TCPStore: cannot connect {host}:{port} "
+                    f"within {connect_timeout}s")
             time.sleep(0.05)
+
+    # ------------------------------------------------ resilience plumbing
+
+    def _reconnect(self):
+        """Re-dial the native client socket (server restart / transient
+        network failure); no-op for the in-process fallback. The OLD
+        client pointer is retired, not freed: another thread (e.g. a
+        heartbeat daemon sharing this store) may be mid-call on it, and
+        freeing it here would be a use-after-free. Retired clients are
+        released in close()."""
+        if self._py is not None:
+            return
+        # dial the replacement FIRST, then swap in one assignment —
+        # self._client must never be observably None/NULL to a concurrent
+        # thread (heartbeat daemons share this store) mid-reconnect
+        new = self._lib.tcpstore_client_new(self.host.encode(), self.port)
+        if not new:
+            raise ConnectionError(
+                f"TCPStore: reconnect to {self.host}:{self.port} failed")
+        old, self._client = self._client, new
+        if old:
+            self._retired.append(old)
+
+    def _retrying(self, site, op, deadline=None):
+        """Run a store op under the retry policy: an injected fault or a
+        failed native call triggers reconnect + backoff. TimeoutError is
+        NOT retried — a blocking get's timeout is already a deadline —
+        and ``deadline`` additionally bounds the whole retry loop (the
+        native client reports a timed-out blocking get the same way as a
+        disconnect, so get() passes its own timeout here to avoid
+        re-blocking attempt after attempt)."""
+
+        def _attempt():
+            inject(site)
+            return op()
+
+        def _on_retry(attempt, exc):
+            with contextlib.suppress(Exception):
+                self._reconnect()
+
+        return RetryPolicy(retry_on=(ConnectionError,)).call(
+            _attempt, deadline=deadline, describe=f"TCPStore.{site}",
+            on_retry=_on_retry)
 
     # ------------------------------------------------ API (reference store.h)
 
     def set(self, key: str, value) -> None:
         if isinstance(value, str):
             value = value.encode()
-        if self._py is not None:
-            return self._py.set(key, value)
-        rc = self._lib.tcpstore_set(self._client, key.encode(),
-                                    bytes(value), len(value))
-        if rc != 0:
-            raise RuntimeError("TCPStore.set failed")
+
+        def _op():
+            if self._py is not None:
+                return self._py.set(key, value)
+            rc = self._lib.tcpstore_set(self._client, key.encode(),
+                                        bytes(value), len(value))
+            if rc != 0:
+                raise ConnectionError("TCPStore.set failed")
+
+        return self._retrying("store_set", _op)
 
     def get(self, key: str) -> bytes:
-        if self._py is not None:
-            return self._py.get(key, self.timeout)
-        buf = ctypes.create_string_buffer(1 << 20)
-        n = self._lib.tcpstore_get(self._client, key.encode(), buf, len(buf))
-        if n < 0:
-            raise RuntimeError("TCPStore.get failed")
-        if n > len(buf):
-            # value larger than the first buffer: GET is idempotent (the
-            # server keeps the key), so re-request with the exact size
-            buf = ctypes.create_string_buffer(n)
+        def _op():
+            if self._py is not None:
+                return self._py.get(key, self.timeout)
+            buf = ctypes.create_string_buffer(1 << 20)
             n = self._lib.tcpstore_get(self._client, key.encode(), buf,
                                        len(buf))
             if n < 0:
-                raise RuntimeError("TCPStore.get failed")
-        return buf.raw[:n]
+                raise ConnectionError("TCPStore.get failed")
+            if n > len(buf):
+                # value larger than the first buffer: GET is idempotent
+                # (the server keeps the key), so re-request exact-size
+                buf = ctypes.create_string_buffer(n)
+                n = self._lib.tcpstore_get(self._client, key.encode(), buf,
+                                           len(buf))
+                if n < 0:
+                    raise ConnectionError("TCPStore.get failed")
+            return buf.raw[:n]
+
+        return self._retrying("store_get", _op,
+                              deadline=Deadline.after(self.timeout))
 
     def add(self, key: str, delta: int) -> int:
-        if self._py is not None:
-            return self._py.add(key, delta)
-        return int(self._lib.tcpstore_add(self._client, key.encode(), delta))
+        def _op():
+            if self._py is not None:
+                return self._py.add(key, delta)
+            return int(self._lib.tcpstore_add(self._client, key.encode(),
+                                              delta))
+
+        return self._retrying("store_add", _op)
 
     def check(self, key: str) -> bool:
-        if self._py is not None:
-            return self._py.check(key)
-        return self._lib.tcpstore_check(self._client, key.encode()) == 1
+        def _op():
+            if self._py is not None:
+                return self._py.check(key)
+            return self._lib.tcpstore_check(self._client, key.encode()) == 1
+
+        return self._retrying("store_check", _op)
 
     def wait(self, key: str) -> None:
         self.get(key)
 
     def delete_key(self, key: str) -> None:
-        if self._py is not None:
-            return self._py.delete(key)
-        self._lib.tcpstore_delete(self._client, key.encode())
+        def _op():
+            if self._py is not None:
+                return self._py.delete(key)
+            self._lib.tcpstore_delete(self._client, key.encode())
+
+        return self._retrying("store_delete", _op)
+
+    # ------------------------------------------ heartbeat / watchdog API
+
+    def heartbeat(self, rank: int, prefix: str = "hb") -> None:
+        """Write one liveness beat for ``rank`` (wall-clock seconds)."""
+        self.set(f"{prefix}/{rank}", str(time.time()).encode())
+
+    def register_heartbeat(self, rank: int, interval: float = 2.0,
+                           prefix: str = "hb") -> "_HeartbeatHandle":
+        """Start a daemon thread beating every ``interval`` seconds.
+        Returns a handle whose ``stop()`` MUST run before the store is
+        closed (the thread holds the native client)."""
+        handle = _HeartbeatHandle(self, rank, interval, prefix)
+        handle.start()
+        return handle
+
+    def last_heartbeat(self, rank: int, prefix: str = "hb"):
+        """Timestamp of ``rank``'s last beat, or None if never seen."""
+        key = f"{prefix}/{rank}"
+        if not self.check(key):
+            return None
+        try:
+            return float(self.get(key).decode())
+        except (ValueError, RuntimeError, ConnectionError):
+            return None
+
+    def dead_ranks(self, world_size: int, ttl: float | None = None,
+                   prefix: str = "hb") -> list[int]:
+        """Ranks in [0, world_size) with no beat within ``ttl`` seconds
+        (default FLAGS_heartbeat_ttl) — the watchdog view fleet/elastic
+        polls to decide scale-in/restart."""
+        if ttl is None:
+            ttl = flag("FLAGS_heartbeat_ttl")
+        now = time.time()
+        dead = []
+        for r in range(world_size):
+            t = self.last_heartbeat(r, prefix)
+            if t is None or now - t > ttl:
+                dead.append(r)
+        return dead
 
     def barrier(self, prefix: str, world_size: int) -> None:
         """All ``world_size`` participants block until everyone arrived."""
@@ -180,6 +327,9 @@ class TCPStore:
     def close(self):
         if self._py is not None:
             return
+        for old in self._retired:
+            self._lib.tcpstore_client_free(old)
+        self._retired.clear()
         if self._client:
             self._lib.tcpstore_client_free(self._client)
             self._client = None
@@ -188,10 +338,8 @@ class TCPStore:
             self._server = None
 
     def __del__(self):
-        try:
+        with contextlib.suppress(Exception):
             self.close()
-        except Exception:
-            pass
 
 
 _global_store = None

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.resilience import Deadline
 from ..core.tensor import Tensor
 from .generation import _make_paged_cache, _sample_with_key
 
@@ -217,12 +218,27 @@ class ContinuousBatchingEngine:
 
     # ------------------------------------------------------------ host loop
 
-    def run(self, prompts, max_new_tokens, segment=16):
+    def run(self, prompts, max_new_tokens, segment=16,
+            request_deadline_s=None, timeout_s=None):
         """Generate ``max_new_tokens`` for every prompt (list of 1-D int
         arrays, mixed lengths), admitting/retiring between ``segment``-step
         compiled decode windows. Returns (outputs, stats): outputs[i] is
         the generated id array for prompts[i]; stats carries sustained
-        tokens/sec over the decode segments and occupancy."""
+        tokens/sec over the decode segments, occupancy, and per-request
+        ``statuses``.
+
+        Resilience budgets (checked BETWEEN segments, so a straggler
+        never blocks in-flight slots mid-dispatch):
+
+        * ``request_deadline_s`` — wall-clock budget per request (scalar,
+          or a per-request sequence; None entries are unbounded), measured
+          from ``run()`` entry so queue wait counts. A request past its
+          deadline is retired with whatever tokens it produced and status
+          ``"timed_out"`` — it stops pinning a slot, and queued requests
+          that expired before admission drain the same way.
+        * ``timeout_s`` — budget for the whole call; on expiry every
+          unfinished request retires as ``timed_out`` and run() returns.
+        """
         import time
 
         params = {k: p._value for k, p in self.model.named_parameters()}
@@ -253,6 +269,16 @@ class ContinuousBatchingEngine:
                     f"{chunk_w}) requires max_len ({self.max_len}) to be "
                     f"a multiple of the largest bucket")
         outputs = [None] * len(prompts)
+        statuses = ["pending"] * len(prompts)
+        if request_deadline_s is None or not np.iterable(request_deadline_s):
+            request_deadline_s = [request_deadline_s] * len(prompts)
+        if len(request_deadline_s) != len(prompts):
+            raise ValueError(
+                f"request_deadline_s has {len(request_deadline_s)} entries "
+                f"for {len(prompts)} prompts")
+        req_deadlines = [Deadline(s) for s in request_deadline_s]
+        run_deadline = Deadline(timeout_s)
+        timed_out = 0
         collected = {}          # request id -> list of token ids
         slot_req = [None] * self.max_slots
         lengths = np.ones((self.max_slots,), np.int32)  # empty slots: len 1
@@ -282,7 +308,21 @@ class ContinuousBatchingEngine:
                     and collected[rid][0] == self.eos_token_id):
                 outputs[rid] = np.asarray(
                     collected.pop(rid)[:max_new_tokens], np.int32)
+                statuses[rid] = "ok"
                 slot_req[slot] = None
+
+        def retire_timed_out(slot=None, rid=None):
+            """Retire a request past its deadline with the tokens it
+            already produced; a freed slot readmits next iteration."""
+            nonlocal timed_out
+            if slot is not None:
+                rid = slot_req[slot]
+                slot_req[slot] = None
+                lengths[slot] = 1
+            outputs[rid] = np.asarray(
+                collected.pop(rid, [])[:max_new_tokens], np.int32)
+            statuses[rid] = "timed_out"
+            timed_out += 1
 
         while queue or any(r is not None for r in slot_req):
             # admit into free slots — same-bucket admissions share ONE
@@ -401,9 +441,30 @@ class ContinuousBatchingEngine:
                 if done:
                     outputs[rid] = np.asarray(toks[:max_new_tokens],
                                               np.int32)
+                    statuses[rid] = "ok"
                     collected.pop(rid)
                     slot_req[slot] = None
                     lengths[slot] = 1  # slot returns to the idle pool
+
+            # deadline enforcement BETWEEN segments (never mid-dispatch):
+            # an expired slot retires with its partial output and frees
+            # capacity for the queue; queued requests whose budget ran
+            # out while waiting drain as timed_out; a run-level timeout
+            # retires everything still unfinished
+            for slot in range(self.max_slots):
+                rid = slot_req[slot]
+                if rid is not None and (req_deadlines[rid].expired()
+                                        or run_deadline.expired()):
+                    retire_timed_out(slot=slot)
+            if queue:
+                waiting = deque()
+                for rid, prompt in queue:
+                    if (req_deadlines[rid].expired()
+                            or run_deadline.expired()):
+                        retire_timed_out(rid=rid)
+                    else:
+                        waiting.append((rid, prompt))
+                queue = waiting
 
         dt = time.time() - t0
         stats = {
@@ -412,5 +473,7 @@ class ContinuousBatchingEngine:
             "segments": seg_runs,
             "mean_occupancy": float(np.mean(occupancy)) if occupancy else 0.0,
             "wall_s": dt,
+            "timed_out": timed_out,
+            "statuses": statuses,
         }
         return outputs, stats

@@ -1,0 +1,29 @@
+"""CI guard: silent failure-swallowing is banned in the distributed stack.
+
+A bare ``except Exception: pass`` under ``paddle_tpu/distributed/`` hides
+exactly the transient errors the resilience runtime is supposed to count,
+retry, or surface (core/resilience.py). Cleanup paths that must not throw
+use ``contextlib.suppress`` (greppable intent), and swallowed-but-counted
+failures go through ``resilience.bump_counter`` + logging instead.
+"""
+import pathlib
+import re
+
+_BARE = re.compile(
+    r"except(\s+(BaseException|Exception))?\s*(as\s+\w+\s*)?:"
+    r"\s*(#[^\n]*)?\n\s*pass\b")
+
+
+def test_no_bare_except_pass_under_distributed():
+    root = (pathlib.Path(__file__).resolve().parents[1]
+            / "paddle_tpu" / "distributed")
+    offenders = []
+    for py in sorted(root.rglob("*.py")):
+        text = py.read_text()
+        for m in _BARE.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            offenders.append(f"{py.relative_to(root.parents[1])}:{line}")
+    assert not offenders, (
+        "bare 'except: pass' under paddle_tpu/distributed/ swallows "
+        "failures silently — count/log via core.resilience (or use "
+        f"contextlib.suppress in cleanup): {offenders}")

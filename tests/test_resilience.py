@@ -1,0 +1,407 @@
+"""Resilience runtime: retry/backoff/deadline primitives, deterministic
+fault injection, crash-safe checkpoints, and serving-engine deadlines.
+
+Fault sites are armed via FLAGS_fault_injection (core/resilience.py), so
+these tests exercise the REAL recovery paths — the KV transport's retry
+loop, the checkpoint loader's CRC rejection, the serving engine's
+between-segment retirement — not mocks of them.
+"""
+import os
+import re
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import resilience
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.core.resilience import (
+    CheckpointCorruptionError,
+    CommTimeoutError,
+    Deadline,
+    InjectedFault,
+    RetryPolicy,
+)
+from paddle_tpu.distributed import checkpoint, collective
+from paddle_tpu.distributed.store import TCPStore
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    resilience.reset_faults()
+    resilience.reset_counters()
+    yield
+    resilience.reset_faults()
+    resilience.reset_counters()
+
+
+# ------------------------------------------------------------- primitives
+
+
+def test_retry_policy_recovers_after_transient_failures():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ConnectionError("transient")
+        return "ok"
+
+    assert RetryPolicy(sleep=lambda s: None).call(flaky) == "ok"
+    assert len(calls) == 3
+    assert resilience.get_counter("retries") == 2
+
+
+def test_retry_policy_exhausts_attempt_budget():
+    with pytest.raises(ConnectionError):
+        RetryPolicy(max_attempts=3, sleep=lambda s: None).call(
+            lambda: (_ for _ in ()).throw(ConnectionError("always")))
+    assert resilience.get_counter("retry_budget_exhausted") == 1
+
+
+def test_retry_policy_does_not_retry_unlisted_exceptions():
+    calls = []
+
+    def bad():
+        calls.append(1)
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        RetryPolicy(sleep=lambda s: None).call(bad)
+    assert len(calls) == 1
+
+
+def test_retry_policy_respects_deadline():
+    slept = []
+
+    def always_fail():
+        raise ConnectionError("down")
+
+    with pytest.raises(ConnectionError):
+        RetryPolicy(max_attempts=50, base_delay=10.0,
+                    sleep=slept.append).call(
+            always_fail, deadline=Deadline.after(0.001))
+    assert slept == []  # first backoff would overshoot the deadline
+    assert resilience.get_counter("retry_deadline_exhausted") == 1
+
+
+def test_deadline_expiry_and_remaining():
+    d = Deadline.after(60)
+    assert not d.expired() and 0 < d.remaining() <= 60
+    assert Deadline(0.0).expired()
+    n = Deadline.never()
+    assert not n.expired() and n.remaining() == float("inf")
+    assert Deadline.from_ms(None).remaining() == float("inf")
+
+
+def test_fault_injection_budget_is_deterministic():
+    set_flags({"FLAGS_fault_injection": "site_a:2,site_b:*,site_c"})
+    for _ in range(2):
+        with pytest.raises(InjectedFault):
+            resilience.inject("site_a")
+    resilience.inject("site_a")  # budget consumed: no-op
+    for _ in range(5):
+        with pytest.raises(InjectedFault):
+            resilience.inject("site_b")  # '*' never runs out
+    with pytest.raises(InjectedFault):
+        resilience.inject("site_c")  # bare site = once
+    resilience.inject("site_c")
+    resilience.inject("never_armed")
+    assert resilience.get_counter("fault_injected:site_a") == 2
+
+
+# ------------------------------------------------------------ KV transport
+
+
+class _FakeKVClient:
+    """Coordination-service KV double (single-process tests have no
+    multi-controller client)."""
+
+    def __init__(self, fail_delete=False):
+        self.data = {}
+        self.fail_delete = fail_delete
+        self.deleted = []
+
+    def key_value_set(self, key, value):
+        self.data[key] = value
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if key in self.data:
+            return self.data[key]
+        raise RuntimeError(f"DEADLINE_EXCEEDED: {key}")
+
+    def key_value_delete(self, key):
+        if self.fail_delete:
+            raise RuntimeError("UNAVAILABLE: coordinator busy")
+        self.deleted.append(key)
+        self.data.pop(key, None)
+
+
+def test_kv_fetch_retries_injected_drops_then_succeeds(monkeypatch):
+    fake = _FakeKVClient()
+    monkeypatch.setattr(collective, "_p2p_client", lambda: fake)
+    collective._kv_publish("chan/0", b"payload")
+    set_flags({"FLAGS_fault_injection": "kv_drop:2"})
+    out = collective._kv_fetch("chan/0", timeout_ms=30_000, src=0, dst=1)
+    assert out == b"payload"
+    assert resilience.get_counter("fault_injected:kv_drop") == 2
+    assert resilience.get_counter("retries") == 2
+    assert fake.deleted == ["chan/0"]  # consumed after the retries
+
+
+def test_kv_fetch_raises_diagnostic_comm_timeout(monkeypatch):
+    fake = _FakeKVClient()
+    monkeypatch.setattr(collective, "_p2p_client", lambda: fake)
+    set_flags({"FLAGS_fault_injection": "kv_drop:*"})
+    with pytest.raises(CommTimeoutError) as ei:
+        collective._kv_fetch("p2p/0->1/7", timeout_ms=80, src=0, dst=1)
+    err = ei.value
+    assert err.key == "p2p/0->1/7" and err.src == 0 and err.dst == 1
+    assert "p2p/0->1/7" in str(err)
+
+
+def test_kv_delete_failures_are_counted_not_swallowed(monkeypatch):
+    fake = _FakeKVClient(fail_delete=True)
+    monkeypatch.setattr(collective, "_p2p_client", lambda: fake)
+    collective._kv_publish("leaky", b"x")
+    assert collective._kv_fetch("leaky", timeout_ms=5_000) == b"x"
+    assert resilience.get_counter("kv_delete_failures") == 1
+
+
+# ---------------------------------------------------------------- TCPStore
+
+
+def test_tcp_store_honors_caller_timeout():
+    master = TCPStore(is_master=True, timeout=123)
+    assert master.timeout == 123
+    # a user-supplied connect deadline is honored, not clamped: dialing a
+    # dead port gives up after ~timeout seconds
+    if master._py is None:
+        t0 = time.time()
+        with pytest.raises(RuntimeError, match="cannot connect"):
+            TCPStore(port=1, timeout=0.3)
+        assert time.time() - t0 < 10
+    master.close()
+
+
+def test_tcp_store_ops_retry_through_injected_faults():
+    master = TCPStore(is_master=True)
+    client = TCPStore(port=master.port)
+    client.set("k", b"v")
+    set_flags({"FLAGS_fault_injection": "store_get:2"})
+    assert client.get("k") == b"v"
+    assert resilience.get_counter("fault_injected:store_get") == 2
+    set_flags({"FLAGS_fault_injection": "store_set:1"})
+    client.set("k2", b"v2")
+    assert master.get("k2") == b"v2"
+    client.close()
+    master.close()
+
+
+def test_tcp_store_heartbeat_watchdog():
+    master = TCPStore(is_master=True)
+    h = master.register_heartbeat(0, interval=0.05)
+    time.sleep(0.15)
+    assert master.dead_ranks(2, ttl=5.0) == [1]  # rank 1 never beat
+    assert master.last_heartbeat(0) is not None
+    assert master.last_heartbeat(1) is None
+    h.stop()
+    time.sleep(0.3)
+    assert master.dead_ranks(2, ttl=0.2) == [0, 1]  # beats went stale
+    master.close()
+
+
+# ------------------------------------------------------------- checkpoints
+
+
+def _state(seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "w": paddle.to_tensor(rng.randn(8, 4).astype(np.float32)),
+        "b": paddle.to_tensor(rng.randn(16).astype(np.float32)),
+    }
+
+
+def _flip_byte(path, offset_from_end=3):
+    with open(path, "r+b") as f:
+        f.seek(-offset_from_end, os.SEEK_END)
+        b = f.read(1)
+        f.seek(-offset_from_end, os.SEEK_END)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_corrupted_shard_rejected_by_checksum(tmp_path):
+    src = _state(seed=1)
+    checkpoint.save_state_dict(src, str(tmp_path))
+    # array payloads sit at the tail of the .distcp container: flip one
+    # byte of tensor data, not the header
+    _flip_byte(str(tmp_path / "0.distcp"))
+    with pytest.raises(CheckpointCorruptionError, match="crc32"):
+        checkpoint.load_state_dict(_state(seed=2), str(tmp_path))
+
+
+def test_clean_checkpoint_roundtrips_with_checksums(tmp_path):
+    src = _state(seed=3)
+    checkpoint.save_state_dict(src, str(tmp_path))
+    dst = _state(seed=4)
+    checkpoint.load_state_dict(dst, str(tmp_path))
+    for k in src:
+        np.testing.assert_array_equal(np.asarray(dst[k]._value),
+                                      np.asarray(src[k]._value))
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
+def test_injected_crash_between_write_and_rename_leaves_no_shard(tmp_path):
+    set_flags({"FLAGS_fault_injection": "ckpt_commit:1"})
+    with pytest.raises(InjectedFault):
+        checkpoint.save_state_dict(_state(), str(tmp_path))
+    files = os.listdir(tmp_path)
+    assert "0.distcp" not in files  # only the uncommitted .tmp remains
+    assert "0.metadata.json" not in files
+    assert not checkpoint._is_complete(str(tmp_path))
+
+
+def test_load_latest_snapshot_falls_back_past_corruption(tmp_path):
+    root = str(tmp_path)
+    s100 = _state(seed=100)
+    checkpoint.save_snapshot(s100, root, step=100)
+    s200 = _state(seed=200)
+    checkpoint.save_snapshot(s200, root, step=200)
+    # newest snapshot: corrupt a shard; an incomplete dir is also skipped
+    _flip_byte(os.path.join(root, "step_00000200", "0.distcp"))
+    os.makedirs(os.path.join(root, "step_00000300"))
+    assert checkpoint.latest_complete_snapshot(root).endswith(
+        "step_00000200")
+
+    dst = _state(seed=5)
+    loaded = checkpoint.load_latest_snapshot(dst, root)
+    assert loaded.endswith("step_00000100")
+    for k in s100:
+        np.testing.assert_array_equal(np.asarray(dst[k]._value),
+                                      np.asarray(s100[k]._value))
+    # without fallback the corruption surfaces directly
+    with pytest.raises(CheckpointCorruptionError):
+        checkpoint.load_latest_snapshot(_state(), root, fallback=False)
+
+
+def test_save_snapshot_prunes_to_keep(tmp_path):
+    root = str(tmp_path)
+    for step in (1, 2, 3):
+        checkpoint.save_snapshot(_state(seed=step), root, step=step, keep=2)
+    steps = [s for s, _ in checkpoint._snapshot_dirs(root)]
+    assert steps == [2, 3]
+
+
+def test_save_snapshot_prune_ignores_incomplete_dirs(tmp_path):
+    root = str(tmp_path)
+    checkpoint.save_snapshot(_state(seed=1), root, step=1)
+    os.makedirs(os.path.join(root, "step_00000002"))  # crashed mid-save
+    checkpoint.save_snapshot(_state(seed=3), root, step=3, keep=2)
+    # the incomplete dir neither counts toward keep (step 1, a fallback
+    # candidate, survives) nor lingers as debris (it is older than the
+    # newest complete snapshot)
+    steps = [s for s, _ in checkpoint._snapshot_dirs(root)]
+    assert steps == [1, 3]
+
+
+def test_kv_fetch_programming_errors_propagate_unwrapped(monkeypatch):
+    class Broken:
+        def blocking_key_value_get(self, key, ms):
+            raise TypeError("payload must be str")
+
+        def key_value_delete(self, key):
+            pass
+
+    calls = []
+    broken = Broken()
+    monkeypatch.setattr(collective, "_p2p_client", lambda: broken)
+    orig = broken.blocking_key_value_get
+    broken.blocking_key_value_get = (
+        lambda k, ms: (calls.append(1), orig(k, ms))[1])
+    with pytest.raises(TypeError):  # not retried, not a CommTimeoutError
+        collective._kv_fetch("k", timeout_ms=5_000)
+    assert len(calls) == 1
+
+
+# ------------------------------------------------------- serving deadlines
+
+
+def test_serving_request_deadline_retires_timed_out():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.generation import generate
+    from paddle_tpu.models.serving import ContinuousBatchingEngine
+
+    cfg = LlamaConfig(vocab_size=211, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=256, tie_word_embeddings=True)
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 211, (n,)).astype(np.int32)
+               for n in (5, 9, 7)]
+    eng = ContinuousBatchingEngine(m, max_slots=2, max_len=64,
+                                   page_size=32, prompt_buckets=(16,))
+    # request 1's budget is already exhausted at entry: it admits, decodes
+    # one segment, and is retired between segments — the other slots keep
+    # decoding to completion
+    outs, stats = eng.run(prompts, max_new_tokens=12, segment=4,
+                          request_deadline_s=[None, 0.0, None])
+    assert stats["statuses"] == ["ok", "timed_out", "ok"]
+    assert stats["timed_out"] == 1
+    for i in (0, 2):
+        want = np.asarray(
+            generate(m, paddle.to_tensor(prompts[i][None, :]),
+                     max_new_tokens=12, cache="paged")._value
+        )[0, prompts[i].size:]
+        np.testing.assert_array_equal(outs[i], want, err_msg=f"request {i}")
+    # the timed-out request keeps the tokens it produced before
+    # retirement, and they match its greedy prefix
+    want1 = np.asarray(
+        generate(m, paddle.to_tensor(prompts[1][None, :]),
+                 max_new_tokens=12, cache="paged")._value
+    )[0, prompts[1].size:]
+    assert 1 <= outs[1].size < 12
+    np.testing.assert_array_equal(outs[1], want1[:outs[1].size])
+
+
+def test_serving_run_timeout_drains_everything():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.serving import ContinuousBatchingEngine
+
+    cfg = LlamaConfig(vocab_size=97, hidden_size=16, intermediate_size=32,
+                      num_hidden_layers=1, num_attention_heads=2,
+                      max_position_embeddings=128, tie_word_embeddings=True)
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg)
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 97, (6,)).astype(np.int32) for _ in range(4)]
+    eng = ContinuousBatchingEngine(m, max_slots=2, max_len=64,
+                                   page_size=32, prompt_buckets=(8,))
+    outs, stats = eng.run(prompts, max_new_tokens=32, segment=2,
+                          timeout_s=0.0)
+    assert all(o is not None for o in outs)
+    assert stats["timed_out"] >= 1
+    assert all(s in ("ok", "timed_out") for s in stats["statuses"])
+
+
+# ------------------------------------------------------- DataLoader errors
+
+
+def test_dataloader_worker_exception_propagates_to_consumer():
+    from paddle_tpu.io import DataLoader
+    from paddle_tpu.io.dataset import Dataset
+
+    class Exploding(Dataset):
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            if i == 19:
+                raise ValueError("bad sample 19")
+            return np.zeros((4,), np.float32)
+
+    loader = DataLoader(Exploding(), batch_size=4, num_workers=2)
+    with pytest.raises(ValueError, match="bad sample 19"):
+        for _ in loader:
+            pass
