@@ -16,7 +16,17 @@ __all__ = [
     "collect_operator_stats", "enable_operator_stats_collection",
     "disable_operator_stats_collection", "enable_tensor_checker",
     "disable_tensor_checker", "check_numerics", "TensorCheckerConfig",
+    "DebugMode",
 ]
+
+
+class DebugMode:
+    """Reference ``paddle.amp.debugging.DebugMode`` subset: what a
+    detection does. ABORT raises; CHECK_NAN_INF logs + counts and lets
+    the run continue (triage mode on a long job)."""
+
+    CHECK_NAN_INF_AND_ABORT = "check_nan_inf_and_abort"
+    CHECK_NAN_INF = "check_nan_inf"
 
 _stats: dict | None = None
 
@@ -68,26 +78,70 @@ class TensorCheckerConfig:
     def __init__(self, enable=True, debug_mode=None, output_dir=None,
                  checked_op_list=None, skipped_op_list=None):
         self.enable = enable
+        self.debug_mode = debug_mode
         self.checked_op_list = set(checked_op_list or [])
         self.skipped_op_list = set(skipped_op_list or [])
 
 
 def enable_tensor_checker(config: TensorCheckerConfig | None = None):
     """NaN/Inf checking on every op output (maps to FLAGS_check_nan_inf,
-    which the dispatcher already consults)."""
+    which the dispatcher consults; detections land in the
+    ``health.tensor_checker_nan_inf`` resilience counter either way, so
+    a triage run in CHECK_NAN_INF mode still leaves a ledger entry per
+    bad op)."""
     from ..core.flags import set_flags
 
+    if config is not None and not config.enable:
+        return
     set_flags({"FLAGS_check_nan_inf": True})
+    global _checker_config
+    _checker_config = config
 
 
 def disable_tensor_checker():
     from ..core.flags import set_flags
 
+    global _checker_config
+    _checker_config = None
     set_flags({"FLAGS_check_nan_inf": False})
 
 
+_checker_config: TensorCheckerConfig | None = None
+
+
+def _checker_debug_mode():
+    cfg = _checker_config
+    return cfg.debug_mode if cfg is not None else None
+
+
+def report_op_nan_inf(op_name: str):
+    """Dispatcher hook (ops/registry.py FLAGS_check_nan_inf path): count
+    the detection in the health ledger and decide abort vs continue per
+    the active TensorCheckerConfig.debug_mode."""
+    from ..core.resilience import bump_counter
+
+    bump_counter("health.tensor_checker_nan_inf")
+    if _checker_debug_mode() == DebugMode.CHECK_NAN_INF:
+        import logging
+
+        logging.getLogger("paddle_tpu.health").warning(
+            "op `%s` produced NaN/Inf output (FLAGS_check_nan_inf, "
+            "CHECK_NAN_INF mode — continuing)", op_name)
+        return
+    raise FloatingPointError(
+        f"Op `{op_name}` produced NaN/Inf output "
+        f"(FLAGS_check_nan_inf is enabled)")
+
+
 def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
-    """Raise on NaN/Inf in ``tensor`` (reference debugging.check_numerics)."""
+    """Report NaN/Inf in ``tensor`` (reference debugging.check_numerics).
+
+    ``debug_mode`` (default CHECK_NAN_INF_AND_ABORT) controls the
+    reaction: ABORT raises ``FloatingPointError`` naming the op and
+    variable plus the NaN/Inf counts; ``DebugMode.CHECK_NAN_INF`` logs
+    and continues. Every detection bumps the ``health.check_numerics``
+    resilience counter."""
+    from ..core.resilience import bump_counter
     from ..core.tensor import Tensor
 
     v = tensor._value if isinstance(tensor, Tensor) else jnp.asarray(tensor)
@@ -95,7 +149,14 @@ def check_numerics(tensor, op_type="", var_name="", debug_mode=None):
         n_nan = int(jnp.isnan(v).sum())
         n_inf = int(jnp.isinf(v).sum())
         if n_nan or n_inf:
-            raise FloatingPointError(
-                f"check_numerics: {op_type or 'tensor'} {var_name} has "
-                f"{n_nan} NaN and {n_inf} Inf values")
+            bump_counter("health.check_numerics")
+            msg = (f"check_numerics: op_type={op_type or '<unknown>'} "
+                   f"var_name={var_name or '<unnamed>'} has "
+                   f"{n_nan} NaN and {n_inf} Inf values")
+            if debug_mode == DebugMode.CHECK_NAN_INF:
+                import logging
+
+                logging.getLogger("paddle_tpu.health").warning(msg)
+            else:
+                raise FloatingPointError(msg)
     return tensor

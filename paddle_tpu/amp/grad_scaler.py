@@ -9,11 +9,17 @@ and skip the update after ``decr_every_n_nan_or_inf`` non-finite steps.
 """
 from __future__ import annotations
 
+import logging
+
 import jax.numpy as jnp
 
+from ..core.health import consume_fault
+from ..core.resilience import bump_counter
 from ..core.tensor import Tensor
 
 __all__ = ["GradScaler", "AmpScaler"]
+
+logger = logging.getLogger("paddle_tpu.health")
 
 
 class GradScaler:
@@ -62,15 +68,24 @@ class GradScaler:
             return
         self._unscaled_opts.add(id(optimizer))
         inv = 1.0 / self._scale
+        # deterministic chaos: FLAGS_fault_injection="health.nan_grad:1"
+        # poisons the first gradient seen, driving the REAL
+        # skip-step-and-shrink-scale recovery below
+        poison = consume_fault("health.nan_grad")
         found = False
         for p in optimizer._parameter_list:
             g = p._grad
             if g is None:
                 continue
+            if poison:
+                g._value = jnp.full_like(g._value, jnp.nan)
+                poison = False
             gv = g._value * inv
             if not bool(jnp.all(jnp.isfinite(gv))):
                 found = True
             g._value = gv
+        if found:
+            bump_counter("health.nonfinite_grad")
         self._inf_by_opt[id(optimizer)] = found
         self._found_inf = self._found_inf or found
 
@@ -81,7 +96,19 @@ class GradScaler:
             return
         self.unscale_(optimizer)
         if not self._inf_by_opt.get(id(optimizer), False):
-            optimizer.step()
+            # unscale_ already synced every grad's finiteness to host —
+            # tell the optimizer's watchdog not to pay that sync twice
+            optimizer._grads_vetted = True
+            try:
+                optimizer.step()
+            finally:
+                optimizer._grads_vetted = False
+        else:
+            bump_counter("health.skipped_steps")
+            logger.warning(
+                "GradScaler: non-finite gradients at loss scale %g — "
+                "skipping optimizer step (dynamic scaling will shrink "
+                "the scale)", self._scale)
         self._unscaled_opts.discard(id(optimizer))
         self._inf_by_opt.pop(id(optimizer), None)
 
@@ -108,6 +135,12 @@ class GradScaler:
         self.step(optimizer)
         self.update()
 
+    def get_growth_tracker(self) -> int:
+        """Consecutive finite steps since the last scale change (torch
+        ``GradScaler._growth_tracker`` analog) — with ``bad_steps`` the
+        full dynamic-scaling bookkeeping beyond the scale itself."""
+        return self._good_steps
+
     def state_dict(self):
         return {
             "scale": self._scale,
@@ -115,14 +148,33 @@ class GradScaler:
             "decr_ratio": self._decr_ratio,
             "incr_every_n_steps": self._incr_every_n_steps,
             "decr_every_n_nan_or_inf": self._decr_every_n_nan_or_inf,
+            "use_dynamic_loss_scaling": self._use_dynamic,
             "good_steps": self._good_steps,
             "bad_steps": self._bad_steps,
         }
 
     def load_state_dict(self, state):
+        """Restore the FULL dynamic-scaling state: an auto-resumed run
+        must continue with the exact scale, growth tracker, and schedule
+        an uninterrupted run would have (not re-warm from defaults)."""
         self._scale = float(state["scale"])
         self._good_steps = int(state.get("good_steps", 0))
         self._bad_steps = int(state.get("bad_steps", 0))
+        if "incr_ratio" in state:
+            self._incr_ratio = float(state["incr_ratio"])
+        if "decr_ratio" in state:
+            self._decr_ratio = float(state["decr_ratio"])
+        if "incr_every_n_steps" in state:
+            self._incr_every_n_steps = int(state["incr_every_n_steps"])
+        if "decr_every_n_nan_or_inf" in state:
+            self._decr_every_n_nan_or_inf = int(
+                state["decr_every_n_nan_or_inf"])
+        if "use_dynamic_loss_scaling" in state:
+            self._use_dynamic = bool(state["use_dynamic_loss_scaling"])
+        # in-flight per-step bookkeeping never survives a restore
+        self._found_inf = False
+        self._inf_by_opt.clear()
+        self._unscaled_opts.clear()
 
 
 AmpScaler = GradScaler

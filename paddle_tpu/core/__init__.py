@@ -1,4 +1,4 @@
-from . import autograd, dtype, flags, place, random, resilience
+from . import autograd, dtype, flags, health, place, random, resilience
 from .autograd import enable_grad, grad, is_grad_enabled, no_grad
 from .dtype import (
     bfloat16,

@@ -1,0 +1,236 @@
+"""Numerical-health watchdog for the training loop.
+
+The reference splits this across amp/debugging.py (TensorChecker,
+check_numerics), the found_inf plumbing inside AmpScaler, and ad-hoc
+NaN checks in fleet trainers. Here it is one host-side monitor shared by
+every layer that can observe a bad number:
+
+* ``GradScaler.unscale_`` reports non-finite gradients (free — it already
+  computes the finiteness reduction for dynamic loss scaling);
+* ``Optimizer.step`` consults the monitor behind
+  ``FLAGS_nonfinite_grad_policy`` (``off | warn | skip | raise``) so
+  un-scaled (bf16) training gets the same protection fp16 gets from the
+  scaler;
+* ``hapi.Model.fit`` records per-batch losses for the loss-spike EMA
+  detector and non-finite-loss detection;
+* ``amp.debugging.check_numerics`` / the dispatcher's
+  ``FLAGS_check_nan_inf`` path feed per-op detections in.
+
+Everything lands in the ``core.resilience`` counter registry
+(``health.*`` keys), so a chaos drill reads one ledger for comm retries,
+injected faults, and numeric events. The deterministic fault site
+``health.nan_grad`` poisons one gradient with NaN on demand
+(``FLAGS_fault_injection="health.nan_grad:1"``), exercising the REAL
+skip/shrink/counter paths without hand-crafting a divergent model.
+"""
+from __future__ import annotations
+
+import logging
+import math
+
+from .flags import define_flag, flag
+from .resilience import InjectedFault, bump_counter, inject
+
+__all__ = [
+    "HealthMonitor", "NonFiniteGradError", "NonFiniteLossError",
+    "get_health_monitor", "reset_health", "consume_fault",
+]
+
+logger = logging.getLogger("paddle_tpu.health")
+
+define_flag("FLAGS_nonfinite_grad_policy", "off",
+            "Optimizer.step reaction to non-finite gradients: 'off' (no "
+            "check), 'warn' (log+count, still apply), 'skip' (count, drop "
+            "the update, keep weights), 'raise' (NonFiniteGradError). "
+            "GradScaler-managed steps always skip regardless (reference "
+            "dynamic-loss-scaling semantics).")
+define_flag("FLAGS_nonfinite_loss_policy", "warn",
+            "HealthMonitor.record_loss reaction to a NaN/Inf loss: "
+            "'off' | 'warn' | 'raise'.")
+define_flag("FLAGS_loss_spike_factor", 10.0,
+            "record_loss flags a spike when loss > factor * EMA(loss) "
+            "(after the EMA has warmed up). <= 0 disables spike detection.")
+define_flag("FLAGS_loss_spike_ema", 0.9,
+            "EMA decay for the loss-spike baseline (per recorded loss).")
+define_flag("FLAGS_loss_spike_warmup", 5,
+            "Finite losses to absorb before spike detection arms.")
+
+
+class NonFiniteGradError(FloatingPointError):
+    """A gradient contained NaN/Inf under policy='raise'. Carries the
+    first offending parameter name so a diverging run names the tensor
+    instead of printing a bare traceback."""
+
+    def __init__(self, message, param_name=None, step=None):
+        super().__init__(message)
+        self.param_name = param_name
+        self.step = step
+
+
+class NonFiniteLossError(FloatingPointError):
+    """The recorded loss was NaN/Inf under FLAGS_nonfinite_loss_policy
+    ='raise'."""
+
+
+def consume_fault(site: str) -> bool:
+    """True (and one budget slot consumed) while ``site`` is armed via
+    FLAGS_fault_injection — for sites that must *corrupt data* rather
+    than raise (e.g. poisoning a gradient with NaN)."""
+    try:
+        inject(site)
+    except InjectedFault:
+        return True
+    return False
+
+
+def _is_finite_array(value) -> bool:
+    """Host-side finiteness of a jax/numpy array (syncs the device value)."""
+    import jax.numpy as jnp
+
+    if not jnp.issubdtype(value.dtype, jnp.inexact):
+        return True
+    return bool(jnp.all(jnp.isfinite(value)))
+
+
+class HealthMonitor:
+    """Aggregates numeric-health events and applies the configured policy.
+
+    Stateless across restarts on purpose: counters live in the
+    process-wide ``core.resilience`` registry and the loss EMA re-warms
+    after resume (a checkpoint restore changes the loss trajectory
+    anyway).
+    """
+
+    def __init__(self, grad_policy=None, loss_policy=None,
+                 spike_factor=None, spike_ema=None, spike_warmup=None):
+        self._grad_policy = grad_policy
+        self._loss_policy = loss_policy
+        self._spike_factor = spike_factor
+        self._spike_ema = spike_ema
+        self._spike_warmup = spike_warmup
+        self._loss_ema = None
+        self._finite_losses = 0
+
+    # policies re-read FLAGS unless pinned at construction, so
+    # paddle.set_flags mid-run retunes a live monitor (chaos drills)
+    @property
+    def grad_policy(self) -> str:
+        return self._grad_policy or str(flag("FLAGS_nonfinite_grad_policy"))
+
+    @property
+    def loss_policy(self) -> str:
+        return self._loss_policy or str(flag("FLAGS_nonfinite_loss_policy"))
+
+    # ------------------------------------------------------------ grads
+
+    def check_grads(self, params, step=None) -> list:
+        """Names of params whose ``.grad`` holds NaN/Inf (device sync per
+        grad — call only when a policy is active). The ``health.nan_grad``
+        fault site poisons the first gradient checked."""
+        import jax.numpy as jnp
+
+        poison = consume_fault("health.nan_grad")
+        bad = []
+        for p in params:
+            g = getattr(p, "_grad", None)
+            if g is None:
+                continue
+            # dense grads are Tensors (payload in ._value); row-sparse
+            # grads are SelectedRows (payload in .value) — both must be
+            # vetted BEFORE the optimizer touches the weights
+            val = getattr(g, "_value", None)
+            if val is None:
+                val = getattr(g, "value", None)
+                if val is None:
+                    continue
+            if poison and hasattr(g, "_value"):
+                g._value = val = jnp.full_like(val, jnp.nan)
+                poison = False
+            if not _is_finite_array(val):
+                bad.append(getattr(p, "name", "<param>"))
+        if bad:
+            bump_counter("health.nonfinite_grad")
+        return bad
+
+    def report_nonfinite_grads(self, bad_names, step=None,
+                               policy=None) -> bool:
+        """Apply the grad policy to a detection. Returns True when the
+        caller should still APPLY the update (policy 'warn'/'off'),
+        False when it must skip; raises under 'raise'."""
+        if not bad_names:
+            return True
+        policy = policy or self.grad_policy
+        msg = (f"non-finite gradient(s) in {list(bad_names)[:4]}"
+               f"{'...' if len(bad_names) > 4 else ''}"
+               + (f" at step {step}" if step is not None else ""))
+        if policy == "raise":
+            bump_counter("health.nonfinite_raised")
+            raise NonFiniteGradError(msg, param_name=list(bad_names)[0],
+                                     step=step)
+        if policy == "skip":
+            bump_counter("health.skipped_steps")
+            logger.warning("%s — skipping optimizer step", msg)
+            return False
+        logger.warning(msg)
+        return True
+
+    # ------------------------------------------------------------ loss
+
+    def record_loss(self, value, step=None) -> bool:
+        """Feed one scalar loss; returns False when it was non-finite.
+        Finite losses update the spike EMA; a loss exceeding
+        ``spike_factor * EMA`` is counted and logged (never raises —
+        spikes can be legitimate, e.g. an LR warm restart)."""
+        try:
+            v = float(value)
+        except (TypeError, ValueError):
+            return True
+        if not math.isfinite(v):
+            bump_counter("health.nonfinite_loss")
+            policy = self.loss_policy
+            msg = (f"non-finite loss {v!r}"
+                   + (f" at step {step}" if step is not None else ""))
+            if policy == "raise":
+                raise NonFiniteLossError(msg)
+            if policy != "off":
+                logger.warning(msg)
+            return False
+        factor = (self._spike_factor if self._spike_factor is not None
+                  else float(flag("FLAGS_loss_spike_factor")))
+        warmup = (self._spike_warmup if self._spike_warmup is not None
+                  else int(flag("FLAGS_loss_spike_warmup")))
+        if (factor > 0 and self._finite_losses >= warmup
+                and self._loss_ema is not None
+                and abs(v) > factor * max(abs(self._loss_ema), 1e-12)):
+            bump_counter("health.loss_spike")
+            logger.warning(
+                "loss spike: %.6g vs EMA baseline %.6g (factor %.3g)%s",
+                v, self._loss_ema, factor,
+                f" at step {step}" if step is not None else "")
+        beta = (self._spike_ema if self._spike_ema is not None
+                else float(flag("FLAGS_loss_spike_ema")))
+        self._loss_ema = (v if self._loss_ema is None
+                          else beta * self._loss_ema + (1.0 - beta) * v)
+        self._finite_losses += 1
+        return True
+
+    @property
+    def loss_ema(self):
+        return self._loss_ema
+
+    def reset(self):
+        self._loss_ema = None
+        self._finite_losses = 0
+
+
+_monitor = HealthMonitor()
+
+
+def get_health_monitor() -> HealthMonitor:
+    """The process-wide monitor (GradScaler/Optimizer/fit default)."""
+    return _monitor
+
+
+def reset_health():
+    """Reset the default monitor's EMA state (test teardown)."""
+    _monitor.reset()

@@ -6,10 +6,26 @@ ModelCheckpoint). The dygraph engine below runs eager; pass
 ``compiled=True`` to prepare() to train through the whole-step compiled
 path (paddle_tpu.jit.TrainStep) — the TPU-native equivalent of the
 reference's ``Model`` + ``to_static``.
+
+Fault-tolerant training (reference fleet elastic resume + TPU preemption
+discipline): ``fit(checkpoint_dir=..., checkpoint_freq=N)`` saves a
+step-numbered snapshot (network + optimizer + GradScaler + epoch/step +
+framework RNG state) through the crash-safe
+``distributed.checkpoint.save_snapshot`` every N steps;
+``fit(resume=True, checkpoint_dir=...)`` restores the newest complete
+snapshot and continues mid-epoch — the epoch's shuffle is replayed from
+the recorded epoch-start RNG state, already-trained batches are skipped,
+then the live RNG stream is restored, so a killed-and-resumed run
+reproduces an uninterrupted one step for step. While training, SIGTERM
+checkpoints once at the next batch boundary and exits (preemption
+notice → graceful handoff); the deterministic fault site ``fit.preempt``
+(``FLAGS_fault_injection="fit.preempt:1"``) simulates the kill.
 """
 from __future__ import annotations
 
+import json
 import os
+import signal
 import time
 
 import numpy as np
@@ -61,7 +77,7 @@ class ProgBarLogger(Callback):
 
     def on_epoch_begin(self, epoch, logs=None):
         self.epoch = epoch
-        self.t0 = time.time()
+        self.t0 = time.monotonic()
 
     def on_train_batch_end(self, step, logs=None):
         if self.verbose and step % self.log_freq == 0:
@@ -75,7 +91,8 @@ class ProgBarLogger(Callback):
             items = " - ".join(
                 f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
                 for k, v in (logs or {}).items())
-            print(f"epoch {epoch} done in {time.time()-self.t0:.1f}s: {items}")
+            print(f"epoch {epoch} done in {time.monotonic()-self.t0:.1f}s: "
+                  f"{items}")
 
 
 class ModelCheckpoint(Callback):
@@ -98,10 +115,16 @@ class Model:
         self._metrics = []
         self._train_step = None
         self._compiled = False
+        self._scaler = None
 
     # ------------------------------------------------ setup
 
-    def prepare(self, optimizer=None, loss=None, metrics=None, compiled=False):
+    def prepare(self, optimizer=None, loss=None, metrics=None, compiled=False,
+                scaler=None):
+        """``scaler``: an ``amp.GradScaler`` — eager ``train_batch`` then
+        runs the scale → backward → scaler.step (skip on non-finite) →
+        scaler.update recipe, and fit()'s snapshots carry the scaler's
+        dynamic-scaling state."""
         self._optimizer = optimizer
         self._loss = loss
         if metrics is None:
@@ -111,6 +134,12 @@ class Model:
         else:
             self._metrics = [metrics]
         self._compiled = compiled
+        self._scaler = scaler
+        if scaler is not None and compiled:
+            raise ValueError(
+                "prepare(scaler=...) is eager-only: the compiled TrainStep "
+                "path fuses its own update and does not consult a "
+                "GradScaler")
         return self
 
     # ------------------------------------------------ steps
@@ -137,8 +166,13 @@ class Model:
             return {"loss": float(loss)}
         out = self.network(*inputs)
         loss = self._loss(out, labels) if self._loss else out
-        loss.backward()
-        self._optimizer.step()
+        if self._scaler is not None:
+            self._scaler.scale(loss).backward()
+            self._scaler.step(self._optimizer)
+            self._scaler.update()
+        else:
+            loss.backward()
+            self._optimizer.step()
         self._optimizer.clear_grad()
         logs = {"loss": float(loss)}
         for m in self._metrics:
@@ -177,7 +211,25 @@ class Model:
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
-            shuffle=True, callbacks=None, num_workers=0):
+            shuffle=True, callbacks=None, num_workers=0,
+            resume=False, checkpoint_dir=None, checkpoint_freq=None,
+            keep_checkpoints=3):
+        """Train. Fault-tolerance knobs:
+
+        * ``checkpoint_dir``: save step-numbered training snapshots here
+          (crash-safe, checksummed); also arms the SIGTERM
+          checkpoint-once-then-exit handler and the ``fit.preempt``
+          fault site.
+        * ``checkpoint_freq``: snapshot every N global steps (default:
+          end of every epoch).
+        * ``resume=True``: restore the newest complete snapshot from
+          ``checkpoint_dir`` (no-op when none exists) and continue from
+          the exact epoch/step — mid-epoch included.
+        * ``keep_checkpoints``: prune to the newest K complete snapshots.
+        """
+        from ..core import random as framework_random
+        from ..core.health import get_health_monitor
+        from ..core.resilience import InjectedFault, inject
         from ..io import DataLoader, Dataset
 
         if isinstance(train_data, Dataset):
@@ -190,33 +242,218 @@ class Model:
             cbs.append(ModelCheckpoint(save_freq, save_dir))
         for cb in cbs:
             cb.set_model(self)
+        monitor = get_health_monitor()
+
+        if resume and not checkpoint_dir:
+            raise ValueError("fit(resume=True) requires checkpoint_dir=")
+        start_epoch, skip_steps, global_step = 0, 0, 0
+        resume_epoch_rng = None
+        if resume:
+            restored = self._restore_training_snapshot(checkpoint_dir)
+            if restored is not None:
+                start_epoch, skip_steps, global_step, resume_epoch_rng = \
+                    restored
+
+        # Preemption notice → checkpoint once at the next batch boundary,
+        # then exit. Handler installation only works in the main thread;
+        # elsewhere (fit inside a worker thread) it is skipped.
+        preempt = {"signaled": False}
+        prev_handler, handler_installed = None, False
+        if checkpoint_dir:
+            def _on_sigterm(signum, frame):
+                preempt["signaled"] = True
+            try:
+                prev_handler = signal.signal(signal.SIGTERM, _on_sigterm)
+                handler_installed = True
+            except ValueError:  # not the main thread
+                pass
+
+        def _snapshot(epoch, step_in_epoch, epoch_rng):
+            return self._save_training_snapshot(
+                checkpoint_dir, epoch, step_in_epoch, global_step,
+                epoch_rng, keep=keep_checkpoints)
+
         history = []
-        for cb in cbs:
-            cb.on_train_begin()
-        for epoch in range(epochs):
-            for m in self._metrics:
-                m.reset()
+        try:
             for cb in cbs:
-                cb.on_epoch_begin(epoch)
-            logs = {}
-            for step, batch in enumerate(train_data):
-                ins, lab = self._split(batch)
-                logs = self.train_batch(ins, lab)
+                cb.on_train_begin()
+            for epoch in range(start_epoch, epochs):
                 for m in self._metrics:
-                    logs[_name(m)] = _scalar(m.accumulate())
+                    m.reset()
                 for cb in cbs:
-                    cb.on_train_batch_end(step, logs)
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                logs.update(self.evaluate(eval_data, batch_size=batch_size,
-                                          verbose=0))
+                    cb.on_epoch_begin(epoch)
+                logs = {}
+                if epoch == start_epoch and skip_steps:
+                    # mid-epoch resume: replay this epoch's shuffle from
+                    # the recorded epoch-start RNG, fast-forward past the
+                    # already-trained batches, then restore the live RNG
+                    # stream (dropout etc. continue where they stopped).
+                    # DataLoader.iter_from skips at the SAMPLER level —
+                    # identical RNG consumption, no wasted dataset[i]
+                    # loads — and raises when the epoch no longer has
+                    # skip_steps batches (changed batch_size/dataset).
+                    live_rng = framework_random.get_rng_state()
+                    framework_random.set_rng_state(
+                        tuple(resume_epoch_rng))
+                    epoch_rng = tuple(resume_epoch_rng)
+                    if hasattr(train_data, "iter_from"):
+                        data_iter = train_data.iter_from(skip_steps)
+                    else:
+                        data_iter = iter(train_data)
+                        for done in range(skip_steps):
+                            try:
+                                next(data_iter)
+                            except StopIteration:
+                                raise ValueError(
+                                    f"resume: cannot skip {skip_steps} "
+                                    f"batches, the epoch ended after "
+                                    f"{done} — data pipeline changed "
+                                    "since the checkpoint?") from None
+                    framework_random.set_rng_state(live_rng)
+                    first_step = skip_steps
+                    skip_steps = 0
+                else:
+                    epoch_rng = framework_random.get_rng_state()
+                    data_iter = iter(train_data)
+                    first_step = 0
+                for step, batch in enumerate(data_iter, start=first_step):
+                    ins, lab = self._split(batch)
+                    logs = self.train_batch(ins, lab)
+                    global_step += 1
+                    monitor.record_loss(logs.get("loss"), step=global_step)
+                    for m in self._metrics:
+                        logs[_name(m)] = _scalar(m.accumulate())
+                    for cb in cbs:
+                        cb.on_train_batch_end(step, logs)
+                    if checkpoint_dir:
+                        if preempt["signaled"]:
+                            _snapshot(epoch, step + 1, epoch_rng)
+                            raise SystemExit(143)  # 128 + SIGTERM
+                        try:
+                            inject("fit.preempt")
+                        except InjectedFault:
+                            # simulated preemption: same
+                            # checkpoint-once-then-die path as SIGTERM
+                            _snapshot(epoch, step + 1, epoch_rng)
+                            raise
+                        if (checkpoint_freq
+                                and global_step % checkpoint_freq == 0):
+                            _snapshot(epoch, step + 1, epoch_rng)
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    logs.update(self.evaluate(eval_data,
+                                              batch_size=batch_size,
+                                              verbose=0))
+                for cb in cbs:
+                    cb.on_epoch_end(epoch, logs)
+                history.append(logs)
+                if checkpoint_dir and not checkpoint_freq:
+                    # default cadence: one snapshot per epoch, positioned
+                    # at the NEXT epoch's start
+                    _snapshot(epoch + 1, 0, framework_random.get_rng_state())
+                if any(getattr(cb, "stop_training", False) for cb in cbs):
+                    break
             for cb in cbs:
-                cb.on_epoch_end(epoch, logs)
-            history.append(logs)
-            if any(getattr(cb, "stop_training", False) for cb in cbs):
-                break
-        for cb in cbs:
-            cb.on_train_end()
+                cb.on_train_end()
+        finally:
+            if handler_installed:
+                import contextlib
+
+                with contextlib.suppress(ValueError):
+                    signal.signal(signal.SIGTERM,
+                                  prev_handler or signal.SIG_DFL)
         return history
+
+    # ------------------------------------- training snapshots (auto-resume)
+
+    def _training_state_arrays(self):
+        """Flat array state for a snapshot: ``net.*`` (live Parameters —
+        loading fills them in place) + ``opt.*`` (accumulators / master
+        weights)."""
+        arrays = {f"net.{k}": v for k, v in self.network.state_dict().items()}
+        if self._optimizer is not None:
+            for k, v in self._optimizer.state_dict().items():
+                if isinstance(v, Tensor):
+                    arrays[f"opt.{k}"] = v
+        return arrays
+
+    def _save_training_snapshot(self, checkpoint_dir, epoch, step_in_epoch,
+                                global_step, epoch_rng, keep=None):
+        """One crash-safe snapshot at ``global_step``: sharded arrays via
+        ``distributed.checkpoint.save_snapshot`` + a ``trainer_state.json``
+        (epoch/step cursor, RNG states, optimizer step count, GradScaler
+        and LR-scheduler state). The json lands BEFORE the shard commit
+        marker, so a snapshot is readable iff it is complete."""
+        from ..core import random as framework_random
+        from ..distributed import checkpoint as dckpt
+        from ..optimizer.lr import LRScheduler
+
+        opt = self._optimizer
+        lr_state = None
+        if opt is not None and isinstance(opt._learning_rate, LRScheduler):
+            lr_state = opt._learning_rate.state_dict()
+        trainer = {
+            "epoch": int(epoch),
+            "step_in_epoch": int(step_in_epoch),
+            "global_step": int(global_step),
+            "rng": list(framework_random.get_rng_state()),
+            "rng_epoch_start": list(epoch_rng),
+            "opt_step_count": int(opt._step_count) if opt is not None else 0,
+            "scaler": (self._scaler.state_dict()
+                       if self._scaler is not None else None),
+            "lr_sched": lr_state,
+        }
+        path = os.path.join(checkpoint_dir, f"step_{int(global_step):08d}")
+        os.makedirs(path, exist_ok=True)
+        dckpt._atomic_json(trainer,
+                           os.path.join(path, "trainer_state.json"))
+        dckpt.save_snapshot(self._training_state_arrays(), checkpoint_dir,
+                            global_step, keep=keep)
+        return path
+
+    def _restore_training_snapshot(self, checkpoint_dir):
+        """Load the newest complete snapshot into the live network,
+        optimizer, scaler, LR scheduler, and framework RNG. Returns
+        ``(epoch, step_in_epoch, global_step, epoch_start_rng)`` or None
+        when no snapshot exists yet (fresh start)."""
+        from ..core import random as framework_random
+        from ..distributed import checkpoint as dckpt
+        from ..optimizer.lr import LRScheduler
+
+        newest = dckpt.latest_complete_snapshot(checkpoint_dir)
+        if newest is None:
+            return None
+        saved_keys = set(dckpt._merged_metadata(newest))
+        opt = self._optimizer
+        target, opt_target = {}, {}
+        for k, v in self.network.state_dict().items():
+            if f"net.{k}" in saved_keys:
+                target[f"net.{k}"] = v
+        if opt is not None:
+            # materialize accumulator slots so the checkpoint has live
+            # targets to fill (they are otherwise created lazily at the
+            # first step); pre-created zeros match a fresh run's init
+            opt._ensure_state(opt._parameter_list)
+            for k, v in opt.state_dict().items():
+                if isinstance(v, Tensor) and f"opt.{k}" in saved_keys:
+                    opt_target[f"opt.{k}"] = v
+            target.update(opt_target)
+        path = dckpt.load_latest_snapshot(target, checkpoint_dir)
+        if opt_target:
+            opt.set_state_dict(
+                {k[len("opt."):]: v for k, v in opt_target.items()})
+        with open(os.path.join(path, "trainer_state.json")) as f:
+            trainer = json.load(f)
+        if opt is not None:
+            opt._step_count = int(trainer.get("opt_step_count", 0))
+            if (trainer.get("lr_sched")
+                    and isinstance(opt._learning_rate, LRScheduler)):
+                opt._learning_rate.set_state_dict(trainer["lr_sched"])
+        if self._scaler is not None and trainer.get("scaler"):
+            self._scaler.load_state_dict(trainer["scaler"])
+        framework_random.set_rng_state(tuple(trainer["rng"]))
+        return (int(trainer["epoch"]), int(trainer["step_in_epoch"]),
+                int(trainer["global_step"]),
+                tuple(trainer["rng_epoch_start"]))
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None):

@@ -3,7 +3,13 @@
 Analog of /root/reference/python/paddle/io/ (reader.py:262 DataLoader,
 dataloader/ dataset & sampler families).
 """
-from .dataloader import DataLoader, default_collate_fn, get_worker_info  # noqa: F401
+from .dataloader import (  # noqa: F401
+    DataLoader,
+    DataLoaderTimeoutError,
+    DataLoaderWorkerError,
+    default_collate_fn,
+    get_worker_info,
+)
 from .dataset import (  # noqa: F401
     ChainDataset,
     ComposeDataset,
@@ -26,7 +32,8 @@ from .sampler import (  # noqa: F401
 )
 
 __all__ = [
-    "DataLoader", "default_collate_fn", "get_worker_info",
+    "DataLoader", "DataLoaderWorkerError", "DataLoaderTimeoutError",
+    "default_collate_fn", "get_worker_info",
     "Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
     "ChainDataset", "ConcatDataset", "Subset", "random_split",
     "TokenFileDataset",

@@ -12,22 +12,73 @@ worker processes (the reference's dataloader_iter.py model): children run
 ``dataset[i]`` only — never jax — and ship raw samples back over the
 multiprocessing pipe; the parent collates. ``num_workers`` sizes either
 pool; ``prefetch_factor`` bounds in-flight batches.
+
+Fault tolerance (reference dataloader_iter.py worker supervision +
+_DataLoaderIterMultiProcess error re-raise):
+
+* ``timeout=`` (seconds, 0 = wait forever) bounds how long ``__next__``
+  waits for the NEXT batch on both worker paths — a wedged pipeline
+  raises ``DataLoaderTimeoutError`` instead of hanging the train loop.
+  All deadline math uses the monotonic clock.
+* A process worker that dies (OOM-killed, segfault) is detected by the
+  parent's supervision poll and RESPAWNED with the same worker id; its
+  lost in-flight batches are re-queued (duplicate results are deduped on
+  receipt). Respawns draw on a ``core.resilience.RetryPolicy`` budget —
+  once exhausted, ``DataLoaderWorkerError`` names the worker id.
+  The deterministic fault site ``dataloader.worker_crash``
+  (``FLAGS_fault_injection="dataloader.worker_crash:1"``) makes the
+  parent SIGKILL one live worker, exercising the real recovery path.
+* ``skip_corrupt_samples=True`` turns a raising ``dataset[i]`` into a
+  counted skip (``dataloader.skipped_samples`` in
+  ``core.resilience.counters()``) instead of killing the epoch; a batch
+  whose every sample raised is dropped whole.
 """
 from __future__ import annotations
 
-import time
+import os
 import queue
 import threading
+import time
 
 import numpy as np
 
+from ..core.resilience import (
+    InjectedFault,
+    RetryPolicy,
+    bump_counter,
+    inject,
+    logger,
+)
 from ..core.tensor import Tensor
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
-__all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
+__all__ = ["DataLoader", "default_collate_fn", "get_worker_info",
+           "DataLoaderWorkerError", "DataLoaderTimeoutError"]
 
 _worker_info = threading.local()
+
+# ordered-delivery sentinel: every sample in the batch raised and was
+# skipped — the consumer drops the slot instead of collating nothing
+_SKIPPED = "__paddle_tpu_skipped_batch__"
+
+# task-queue sentinel for the dataloader.worker_crash drill: the worker
+# that dequeues it hard-exits at a task boundary
+_CRASH_ORDER = "__paddle_tpu_worker_crash__"
+
+
+class DataLoaderWorkerError(RuntimeError):
+    """A DataLoader worker failed permanently. Names the worker id (and
+    pid when it was a process) so a crashing pipeline is attributable."""
+
+    def __init__(self, message, worker_id=None, pid=None):
+        super().__init__(message)
+        self.worker_id = worker_id
+        self.pid = pid
+
+
+class DataLoaderTimeoutError(DataLoaderWorkerError, TimeoutError):
+    """No batch arrived within ``timeout`` seconds."""
 
 
 class WorkerInfo:
@@ -75,14 +126,23 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=False, timeout=0, worker_init_fn=None,
-                 persistent_workers=False, use_process_workers=False):
+                 persistent_workers=False, use_process_workers=False,
+                 skip_corrupt_samples=False, worker_respawn_limit=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = int(num_workers)
         self.use_process_workers = bool(use_process_workers)
         self.prefetch_factor = max(int(prefetch_factor), 1)
         self.worker_init_fn = worker_init_fn
-        self.timeout = timeout
+        # seconds __next__ may wait for the next batch; 0 = wait forever
+        # (reference reader.py timeout semantics)
+        self.timeout = float(timeout)
+        if self.timeout < 0:
+            raise ValueError("timeout must be >= 0 (0 = wait forever)")
+        self.skip_corrupt_samples = bool(skip_corrupt_samples)
+        # total respawns allowed across one epoch's process pool; defaults
+        # to the global retry budget (FLAGS_retry_max_attempts)
+        self._respawn_policy = RetryPolicy(max_attempts=worker_respawn_limit)
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             if batch_sampler is not None:
@@ -121,27 +181,84 @@ class DataLoader:
         if batch and not self.drop_last:
             yield self.collate_fn(batch)
 
+    def _fetch_samples(self, indices):
+        """``dataset[i]`` for each index, honoring skip_corrupt_samples.
+        Returns the (possibly shorter) sample list — empty when every
+        sample raised and skipping is on."""
+        if not self.skip_corrupt_samples:
+            return [self.dataset[i] for i in indices]
+        out = []
+        for i in indices:
+            try:
+                out.append(self.dataset[i])
+            except Exception as e:
+                bump_counter("dataloader.skipped_samples")
+                logger.warning(
+                    "skipping corrupt sample %r (skip_corrupt_samples "
+                    "is on): %s", i, e)
+        return out
+
     def _load_batch(self, indices):
-        return self.collate_fn([self.dataset[i] for i in indices])
+        samples = self._fetch_samples(indices)
+        return _SKIPPED if not samples else self.collate_fn(samples)
 
     def __iter__(self):
         if self._iterable_mode:
             yield from self._batches_iterable()
             return
+        yield from self._iter_batches(list(self.batch_sampler))
+
+    def iter_from(self, start: int):
+        """Iterate this epoch skipping the first ``start`` batches WITHOUT
+        loading them (auto-resume fast-forward): the batch sampler still
+        runs in full — so shuffle-RNG consumption matches an uninterrupted
+        epoch exactly — but skipped batches never hit ``dataset[i]`` or
+        the worker pipeline. Eager about the sampler draw: call it while
+        the epoch-start RNG state is active. Raises ``ValueError`` when
+        the epoch no longer has ``start`` batches (the data pipeline
+        changed between checkpoint and resume)."""
+        start = int(start)
+        if start < 0:
+            raise ValueError(f"iter_from(start={start}): start must be >= 0")
+        if self._iterable_mode:
+            it = self._batches_iterable()
+            for done in range(start):
+                try:
+                    next(it)
+                except StopIteration:
+                    raise ValueError(
+                        f"cannot skip {start} batches: the stream ended "
+                        f"after {done} — data pipeline changed since the "
+                        "checkpoint?") from None
+            return it
+        batches = list(self.batch_sampler)  # consumes this epoch's shuffle
+        if start > len(batches):
+            raise ValueError(
+                f"cannot skip {start} batches: this epoch has only "
+                f"{len(batches)} — data pipeline changed since the "
+                "checkpoint?")
+        return self._iter_batches(batches[start:])
+
+    def _iter_batches(self, batches):
         if self.num_workers <= 0:
-            for indices in self.batch_sampler:
-                yield self._load_batch(indices)
+            for indices in batches:
+                batch = self._load_batch(indices)
+                if batch is not _SKIPPED:
+                    yield batch
             return
         if self.use_process_workers:
-            yield from self._process_prefetch_iter()
+            yield from self._process_prefetch_iter(batches)
             return
-        yield from self._prefetch_iter()
+        yield from self._prefetch_iter(batches)
 
-    def _prefetch_iter(self):
+    def _next_deadline(self):
+        """Absolute monotonic deadline for the next batch (None = none)."""
+        return time.monotonic() + self.timeout if self.timeout else None
+
+    def _prefetch_iter(self, batches):
         """Thread-pool prefetch preserving batch order: workers pull index
         lists from a task queue; results are delivered through per-batch
         slots so ordering matches the sampler."""
-        batches = list(self.batch_sampler)
         out_q: "queue.Queue" = queue.Queue()
         task_q: "queue.Queue" = queue.Queue()
         n_workers = min(self.num_workers, max(len(batches), 1))
@@ -180,13 +297,26 @@ class DataLoader:
         next_to_yield = 0
         try:
             while next_to_yield < len(batches):
+                # per-WAIT deadline: consumer time between yields must not
+                # count against the workers
+                deadline = self._next_deadline()
                 while next_to_yield not in pending:
-                    i, batch, err = out_q.get(
-                        timeout=self.timeout if self.timeout else None)
+                    try:
+                        i, batch, err = out_q.get(
+                            timeout=(max(deadline - time.monotonic(), 0.0)
+                                     if deadline is not None else None))
+                    except queue.Empty:
+                        raise DataLoaderTimeoutError(
+                            f"DataLoader batch {next_to_yield} did not "
+                            f"arrive within timeout={self.timeout}s") \
+                            from None
                     if err is not None:
                         raise err
                     pending[i] = batch
-                yield pending.pop(next_to_yield)
+                if pending[next_to_yield] is not _SKIPPED:
+                    yield pending.pop(next_to_yield)
+                else:
+                    pending.pop(next_to_yield)
                 next_to_yield += 1
                 if next_to_submit < len(batches):
                     task_q.put((next_to_submit, batches[next_to_submit]))
@@ -196,20 +326,27 @@ class DataLoader:
             for _ in threads:
                 task_q.put(None)
 
-    def _process_prefetch_iter(self):
+    def _process_prefetch_iter(self, batches):
         """Real worker PROCESSES (reference dataloader_iter.py multiprocess
         mode): forked children evaluate ``dataset[i]`` for each index list
         and pipe the raw samples back; the parent collates, preserving
-        sampler order. Children never touch jax (fork safety)."""
+        sampler order. Children never touch jax (fork safety).
+
+        Supervision: the parent polls child liveness while waiting. A dead
+        child is respawned (same worker id, fresh process) and every
+        submitted-but-undelivered batch is re-queued — results are slotted
+        by batch index, so a batch computed twice is simply deduped. When
+        the respawn budget is exhausted the loader raises
+        ``DataLoaderWorkerError`` naming the worker instead of hanging."""
         import multiprocessing as mp
 
         ctx = mp.get_context("fork")
-        batches = list(self.batch_sampler)
         n_workers = min(self.num_workers, max(len(batches), 1))
         task_q = ctx.Queue()
         out_q = ctx.Queue()
         dataset = self.dataset
         init_fn = self.worker_init_fn
+        skip_corrupt = self.skip_corrupt_samples
 
         def child(wid):
             _worker_info.info = WorkerInfo(wid, n_workers, dataset)
@@ -219,51 +356,125 @@ class DataLoader:
                 item = task_q.get()
                 if item is None:
                     return
+                if item == _CRASH_ORDER:
+                    # simulated hard crash — but flush already-queued
+                    # results first: a process dying mid-pipe-write would
+                    # corrupt the result queue for everyone (the one
+                    # failure this drill must not manufacture)
+                    out_q.close()
+                    out_q.join_thread()
+                    os._exit(1)
                 i, idxs = item
                 try:
-                    out_q.put((i, [dataset[j] for j in idxs], None))
+                    if skip_corrupt:
+                        samples = []
+                        skipped = 0
+                        for j in idxs:
+                            try:
+                                samples.append(dataset[j])
+                            except Exception:
+                                skipped += 1
+                        out_q.put((i, (samples, skipped), None))
+                    else:
+                        out_q.put((i, ([dataset[j] for j in idxs], 0), None))
                 except Exception as e:
                     out_q.put((i, None, repr(e)))
 
-        procs = [ctx.Process(target=child, args=(w,), daemon=True)
-                 for w in range(n_workers)]
-        for p in procs:
+        def spawn(wid):
+            p = ctx.Process(target=child, args=(wid,), daemon=True)
             p.start()
+            return p
+
+        procs = {w: spawn(w) for w in range(n_workers)}
+        respawns = 0
         capacity = self.prefetch_factor * n_workers
         for i, idxs in enumerate(batches[:capacity]):
             task_q.put((i, idxs))
         next_to_submit = min(capacity, len(batches))
 
+        def maybe_inject_crash():
+            """Deterministic fault site: the PARENT consumes the budget
+            (fork would duplicate a child-side budget) and orders a crash
+            through the task queue; whichever worker picks it up dies at a
+            task boundary. SIGKILLing at a random moment instead could
+            catch a worker mid-pipe-write and corrupt the result queue —
+            the drill must crash a worker, not the transport."""
+            try:
+                inject("dataloader.worker_crash")
+            except InjectedFault:
+                logger.warning(
+                    "fault injection: ordering a DataLoader worker crash")
+                task_q.put(_CRASH_ORDER)
+
+        def supervise():
+            """Respawn dead children; re-queue lost work. Raises when the
+            respawn budget runs out."""
+            nonlocal respawns
+            dead = [(w, p) for w, p in procs.items() if not p.is_alive()]
+            if not dead:
+                return False
+            for w, p in dead:
+                if respawns >= self._respawn_policy.max_attempts:
+                    raise DataLoaderWorkerError(
+                        f"DataLoader worker {w} (pid {p.pid}) died "
+                        f"(exitcode {p.exitcode}) and the respawn budget "
+                        f"({self._respawn_policy.max_attempts}) is "
+                        "exhausted", worker_id=w, pid=p.pid)
+                bump_counter("dataloader.worker_respawns")
+                logger.warning(
+                    "DataLoader worker %d (pid %s) died with exitcode %s;"
+                    " respawning (%d/%d)", w, p.pid, p.exitcode,
+                    respawns + 1, self._respawn_policy.max_attempts)
+                time.sleep(self._respawn_policy.delay(respawns)
+                           if respawns else 0.0)
+                respawns += 1
+                p.join(timeout=1)
+                procs[w] = spawn(w)
+            # a dead worker may have consumed tasks it never answered:
+            # re-queue everything submitted but not yet delivered. Tasks
+            # still sitting in task_q get run twice; the slotted `pending`
+            # dict dedupes on receipt.
+            for i in range(next_to_yield, next_to_submit):
+                if i not in pending:
+                    task_q.put((i, batches[i]))
+            return True
+
         pending = {}
         next_to_yield = 0
         try:
             while next_to_yield < len(batches):
-                # per-WAIT clock (the thread path's fresh
-                # out_q.get(timeout=...)): consumer time between yields
-                # must not count against the workers
-                last_progress = time.time()
+                maybe_inject_crash()
+                supervise()
+                # per-WAIT deadline (monotonic): consumer time between
+                # yields must not count against the workers
+                deadline = self._next_deadline()
                 while next_to_yield not in pending:
                     try:
                         # poll so a worker killed mid-decode (OOM/segfault)
-                        # raises instead of hanging the training loop
-                        i, samples, err = out_q.get(timeout=1.0)
+                        # is respawned instead of hanging the training loop
+                        i, payload, err = out_q.get(timeout=0.05)
                     except queue.Empty:
-                        dead = [p.pid for p in procs if not p.is_alive()]
-                        if dead:
-                            raise RuntimeError(
-                                f"DataLoader worker process(es) {dead} died "
-                                "unexpectedly (killed/crashed)")
-                        if (self.timeout
-                                and time.time() - last_progress > self.timeout):
-                            raise RuntimeError(
-                                "DataLoader timed out waiting for workers")
+                        supervise()
+                        if (deadline is not None
+                                and time.monotonic() > deadline):
+                            raise DataLoaderTimeoutError(
+                                f"DataLoader batch {next_to_yield} did "
+                                f"not arrive within timeout="
+                                f"{self.timeout}s") from None
                         continue
                     if err is not None:
-                        raise RuntimeError(
+                        raise DataLoaderWorkerError(
                             f"DataLoader worker failed: {err}")
-                    pending[i] = samples
-                    last_progress = time.time()
-                yield self.collate_fn(pending.pop(next_to_yield))
+                    if i >= next_to_yield and i not in pending:
+                        pending[i] = payload
+                    deadline = self._next_deadline()
+                samples, skipped = pending.pop(next_to_yield)
+                if skipped:
+                    bump_counter("dataloader.skipped_samples", skipped)
+                    logger.warning("skipped %d corrupt sample(s) in batch"
+                                   " %d", skipped, next_to_yield)
+                if samples:
+                    yield self.collate_fn(samples)
                 next_to_yield += 1
                 if next_to_submit < len(batches):
                     task_q.put((next_to_submit, batches[next_to_submit]))
@@ -271,7 +482,7 @@ class DataLoader:
         finally:
             for _ in procs:
                 task_q.put(None)
-            for p in procs:
+            for p in procs.values():
                 p.join(timeout=2)
                 if p.is_alive():
                     p.terminate()

@@ -91,9 +91,10 @@ class TokenFileDataset(Dataset):
         return out
 
     def __del__(self):
-        try:
+        import contextlib
+
+        # interpreter-teardown cleanup: the native lib may already be gone
+        with contextlib.suppress(Exception):
             if self._handle:
                 _native().token_reader_close(self._handle)
                 self._handle = None
-        except Exception:
-            pass

@@ -454,10 +454,12 @@ def _apply_op_impl(op: OpDef, args, kwargs):
         for v in outs_flat:
             if v is not None and jnp.issubdtype(v.dtype, jnp.inexact):
                 if not bool(jnp.all(jnp.isfinite(v))):
-                    raise FloatingPointError(
-                        f"Op `{op.name}` produced NaN/Inf output "
-                        f"(FLAGS_check_nan_inf is enabled)"
-                    )
+                    # counts in the health ledger and aborts or logs per
+                    # the active TensorCheckerConfig.debug_mode (lazy
+                    # import: amp imports this module at package init)
+                    from ..amp.debugging import report_op_nan_inf
+
+                    report_op_nan_inf(op.name)
 
     if single:
         return out_tensors[0]

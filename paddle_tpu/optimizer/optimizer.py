@@ -363,6 +363,29 @@ class Optimizer:
         return list(parts.values())
 
     def step(self):
+        # numerical-health watchdog (core/health.py): behind a policy flag
+        # because the finiteness reduction syncs every gradient to host.
+        # Runs BEFORE _apply_sparse_grads (which scatter-adds straight into
+        # p._value — unrecoverable afterwards) and on RAW grads (clipping
+        # an inf produces nan and would mask the source). GradScaler steps
+        # set _grads_vetted: unscale_ already did this reduction.
+        from ..core.flags import flag as _flag
+
+        policy = str(_flag("FLAGS_nonfinite_grad_policy"))
+        if policy not in ("", "off") and not getattr(
+                self, "_grads_vetted", False):
+            from ..core.health import get_health_monitor
+
+            checked = [p for p in self._parameter_list
+                       if p.trainable and p._grad is not None]
+            mon = get_health_monitor()
+            bad = mon.check_grads(checked, step=self._step_count)
+            if not mon.report_nonfinite_grads(bad, step=self._step_count,
+                                              policy=policy):
+                # skip: drop this update entirely — weights, accumulators
+                # and the bias-correction step count all stay put, exactly
+                # like a GradScaler-skipped step
+                return
         self._apply_sparse_grads()
         params = [p for p in self._parameter_list
                   if p.trainable and p._grad is not None]

@@ -1,29 +1,58 @@
-"""CI guard: silent failure-swallowing is banned in the distributed stack.
+"""CI guards: silent failure-swallowing and wall-clock deadline math.
 
-A bare ``except Exception: pass`` under ``paddle_tpu/distributed/`` hides
-exactly the transient errors the resilience runtime is supposed to count,
-retry, or surface (core/resilience.py). Cleanup paths that must not throw
-use ``contextlib.suppress`` (greppable intent), and swallowed-but-counted
-failures go through ``resilience.bump_counter`` + logging instead.
+* A bare ``except Exception: pass`` under the resilience-covered trees
+  (``paddle_tpu/distributed/``, and since the training-robustness layer
+  also ``io/``, ``amp/``, ``hapi/``) hides exactly the transient errors
+  the resilience runtime is supposed to count, retry, or surface
+  (core/resilience.py). Cleanup paths that must not throw use
+  ``contextlib.suppress`` (greppable intent), and swallowed-but-counted
+  failures go through ``resilience.bump_counter`` + logging instead.
+* ``time.time()`` is banned where deadline/elapsed math lives
+  (``core/``, ``io/``, ``amp/``, ``hapi/``): an NTP step must not expire
+  every in-flight budget (or stall a watchdog) — use
+  ``time.monotonic()`` (core/resilience.py Deadline rationale).
 """
 import pathlib
 import re
+
+import pytest
+
+_PKG = pathlib.Path(__file__).resolve().parents[1] / "paddle_tpu"
 
 _BARE = re.compile(
     r"except(\s+(BaseException|Exception))?\s*(as\s+\w+\s*)?:"
     r"\s*(#[^\n]*)?\n\s*pass\b")
 
+_WALL_CLOCK = re.compile(r"\btime\.time\(\)")
 
-def test_no_bare_except_pass_under_distributed():
-    root = (pathlib.Path(__file__).resolve().parents[1]
-            / "paddle_tpu" / "distributed")
-    offenders = []
+_NO_BARE_EXCEPT_DIRS = ("distributed", "io", "amp", "hapi")
+_MONOTONIC_ONLY_DIRS = ("core", "io", "amp", "hapi")
+
+
+def _offenders(subdir, pattern):
+    root = _PKG / subdir
+    out = []
     for py in sorted(root.rglob("*.py")):
         text = py.read_text()
-        for m in _BARE.finditer(text):
+        for m in pattern.finditer(text):
             line = text.count("\n", 0, m.start()) + 1
-            offenders.append(f"{py.relative_to(root.parents[1])}:{line}")
+            out.append(f"{py.relative_to(_PKG.parent)}:{line}")
+    return out
+
+
+@pytest.mark.parametrize("subdir", _NO_BARE_EXCEPT_DIRS)
+def test_no_bare_except_pass(subdir):
+    offenders = _offenders(subdir, _BARE)
     assert not offenders, (
-        "bare 'except: pass' under paddle_tpu/distributed/ swallows "
+        f"bare 'except: pass' under paddle_tpu/{subdir}/ swallows "
         "failures silently — count/log via core.resilience (or use "
         f"contextlib.suppress in cleanup): {offenders}")
+
+
+@pytest.mark.parametrize("subdir", _MONOTONIC_ONLY_DIRS)
+def test_no_wall_clock_for_deadline_math(subdir):
+    offenders = _offenders(subdir, _WALL_CLOCK)
+    assert not offenders, (
+        f"time.time() under paddle_tpu/{subdir}/ — deadline/elapsed math "
+        "must use time.monotonic() so an NTP step can't expire every "
+        f"in-flight budget: {offenders}")
