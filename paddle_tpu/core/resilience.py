@@ -16,6 +16,11 @@ Three pieces:
 * ``Deadline`` — an absolute point in time that propagates through call
   chains (``remaining()`` / ``remaining_ms()`` / ``expired()``), so nested
   retries share one wall-clock budget instead of multiplying timeouts.
+* ``CircuitBreaker`` — closed → open → half-open failure gate with a
+  monotonic cool-down, for subsystems (the serving engine) where retrying
+  a persistently-broken dependency only amplifies the outage: after
+  ``failure_threshold`` consecutive failures callers fail fast until a
+  half-open probe succeeds.
 * **Deterministic fault injection** — ``inject(site)`` points compiled
   into the transport/checkpoint/store paths, toggled by
   ``FLAGS_fault_injection`` (e.g. ``kv_drop:2`` = fail the first two
@@ -38,7 +43,7 @@ import time
 from .flags import define_flag, flag
 
 __all__ = [
-    "RetryPolicy", "Deadline",
+    "RetryPolicy", "Deadline", "CircuitBreaker",
     "CommTimeoutError", "InjectedFault", "CheckpointCorruptionError",
     "inject", "fault_remaining", "reset_faults",
     "bump_counter", "get_counter", "counters", "reset_counters",
@@ -179,6 +184,139 @@ class RetryPolicy:
                                attempt + 1, self.max_attempts, pause)
                 self._sleep(pause)
         raise last_exc
+
+
+# ------------------------------------------------------ circuit breaker
+
+class CircuitBreaker:
+    """Consecutive-failure gate: closed → open → half-open → closed.
+
+    * **closed** — traffic flows; ``record_failure`` increments a
+      consecutive-failure count, ``record_success`` resets it. Hitting
+      ``failure_threshold`` trips the breaker open.
+    * **open** — ``allow()`` returns False (callers fail fast) until
+      ``cooldown_s`` elapses on the MONOTONIC clock (an NTP step must not
+      half-open every tripped breaker at once).
+    * **half-open** — after the cool-down, ``allow()`` admits up to
+      ``half_open_max`` probe calls. One recorded success closes the
+      breaker; one recorded failure re-opens it for a fresh cool-down.
+
+    State transitions land in the resilience ledger as
+    ``circuit_opened:{name}`` / ``circuit_half_open:{name}`` /
+    ``circuit_closed:{name}``; ``state()`` is a non-consuming view (it
+    advances open → half-open on cool-down expiry but never spends a
+    probe slot), so health endpoints can poll it freely.
+    """
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, name="circuit", failure_threshold=5, cooldown_s=30.0,
+                 half_open_max=1, clock=time.monotonic):
+        if failure_threshold < 1:
+            raise ValueError("failure_threshold must be >= 1")
+        self.name = name
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_s = float(cooldown_s)
+        self.half_open_max = int(half_open_max)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0          # consecutive failures while closed
+        self._opened_at = None
+        self._probes = 0            # half-open probes admitted
+
+    # -- internal: advance open -> half-open once the cool-down elapsed
+    def _tick(self):
+        if (self._state == self.OPEN
+                and self._clock() - self._opened_at >= self.cooldown_s):
+            self._state = self.HALF_OPEN
+            self._probes = 0
+            bump_counter(f"circuit_half_open:{self.name}")
+
+    def state(self) -> str:
+        with self._lock:
+            self._tick()
+            return self._state
+
+    @property
+    def failures(self) -> int:
+        with self._lock:
+            return self._failures
+
+    def allow(self) -> bool:
+        """May a call proceed right now? In half-open state each True
+        consumes one of the ``half_open_max`` probe slots."""
+        with self._lock:
+            self._tick()
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.OPEN:
+                return False
+            if self._probes < self.half_open_max:
+                self._probes += 1
+                return True
+            return False
+
+    def release_probe(self):
+        """Return a half-open probe slot consumed by ``allow()`` when the
+        probe resolved with NO verdict on the dependency (cancelled, timed
+        out on its own budget) — another probe may then be admitted
+        instead of the breaker waiting forever for an outcome."""
+        with self._lock:
+            if self._state == self.HALF_OPEN and self._probes > 0:
+                self._probes -= 1
+
+    def record_success(self):
+        with self._lock:
+            self._tick()
+            if self._state == self.OPEN:
+                # a late success from work admitted BEFORE the trip must
+                # not cut the cool-down short; only a half-open probe can
+                # close the breaker
+                return
+            if self._state == self.HALF_OPEN:
+                if self._probes == 0:
+                    # half-open but NO probe admitted yet: this success is
+                    # stale pre-trip work arriving after the cool-down,
+                    # not evidence from a probe
+                    return
+                bump_counter(f"circuit_closed:{self.name}")
+                logger.info("circuit %r closed after successful probe",
+                            self.name)
+            self._state = self.CLOSED
+            self._failures = 0
+            self._probes = 0
+
+    def record_failure(self):
+        with self._lock:
+            self._tick()
+            if self._state == self.HALF_OPEN:
+                if self._probes == 0:
+                    # stale pre-trip failure arriving after the cool-down:
+                    # not probe evidence (mirror of record_success)
+                    return
+                self._trip()  # failed probe: fresh cool-down
+                return
+            if self._state == self.OPEN:
+                return
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                self._trip()
+
+    def _trip(self):
+        self._state = self.OPEN
+        self._opened_at = self._clock()
+        self._failures = 0
+        self._probes = 0
+        bump_counter(f"circuit_opened:{self.name}")
+        logger.warning("circuit %r opened (cool-down %.3fs)",
+                       self.name, self.cooldown_s)
+
+    def __repr__(self):
+        return (f"CircuitBreaker({self.name!r}, state={self.state()!r}, "
+                f"threshold={self.failure_threshold})")
 
 
 # ------------------------------------------------------- fault injection

@@ -100,12 +100,15 @@ class ElasticManager:
         prefix) — a one-shot write would expire after ``lease`` seconds."""
         idx = self.store.add(f"{self.prefix}/joiners", 1) - 1
         key = f"{self.prefix}/join/{idx}"
-        self.store.set(key, str(time.time()).encode())
+        # join-slot leases cross hosts via the store, so they use
+        # wall-clock (monotonic clocks don't share an epoch across hosts)
+        self.store.set(key, str(time.time()).encode())  # wall-clock: x-host
 
         def beat():
             while not self._stop.is_set():
                 try:
-                    self.store.set(key, str(time.time()).encode())
+                    self.store.set(key,
+                                   str(time.time()).encode())  # wall-clock: x-host
                 except (RuntimeError, ConnectionError):
                     return
                 self._stop.wait(self.interval)
@@ -120,7 +123,7 @@ class ElasticManager:
             base = self.store.add(f"{self.prefix}/join_base", 0)
         except (RuntimeError, ConnectionError):
             return 0
-        now = time.time()
+        now = time.time()  # wall-clock: x-host (compared to store leases)
         alive = 0
         for i in range(base, n):
             key = f"{self.prefix}/join/{i}"
@@ -177,13 +180,22 @@ class ElasticManager:
 
 class CommTaskManager:
     """Watchdog for host-side phases: register a task, it must complete
-    within ``timeout`` or the on_timeout hook fires with a dump."""
+    within ``timeout`` or the on_timeout hook fires with a dump. Also
+    carries HEALTH PROBES — callables polled every watch cycle (e.g. a
+    ``ServingFrontend.ready`` bound method) whose falsy/raising result
+    fires ``on_unhealthy`` — so one watchdog thread covers both wedged
+    phases and sick subsystems.
+
+    Elapsed/deadline math runs on the MONOTONIC clock: the watchdog is
+    purely process-local, and an NTP step must neither dump every
+    in-flight phase at once nor mask a real wedge."""
 
     def __init__(self, timeout=1800.0, poll_interval=1.0, on_timeout=None):
         self.timeout = timeout
         self.poll = poll_interval
         self.on_timeout = on_timeout or self._default_dump
         self._tasks = {}
+        self._probes = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._watch, daemon=True)
@@ -195,18 +207,76 @@ class CommTaskManager:
         print(f"[comm watchdog] task {name!r} exceeded {self.timeout}s "
               f"(elapsed {elapsed:.1f}s)", file=sys.stderr)
 
+    def _default_unhealthy(self, name, result):
+        import sys
+
+        print(f"[comm watchdog] probe {name!r} unhealthy: {result!r}",
+              file=sys.stderr)
+
+    def register_probe(self, name, probe, on_unhealthy=None):
+        """Poll ``probe()`` every watch cycle; a falsy return or a raise
+        fires ``on_unhealthy(name, result_or_exc)`` (default: stderr dump
+        + an ``elastic.unhealthy_probe`` count in the resilience ledger).
+        EDGE-TRIGGERED: the hook fires once per healthy→unhealthy
+        transition, not once per poll, so a long outage counts as one
+        incident instead of flooding logs. Probes stay registered until
+        ``remove_probe``."""
+        with self._lock:
+            # [probe, hook, currently-unhealthy] — the flag is only
+            # touched by the single watch thread
+            self._probes[name] = [probe,
+                                  on_unhealthy or self._default_unhealthy,
+                                  False]
+
+    def remove_probe(self, name):
+        with self._lock:
+            self._probes.pop(name, None)
+
+    def _fire_hook(self, hook, *args):
+        # the watchdog thread is the component that DETECTS silent
+        # failure: a raising dump/unhealthy callback must never kill it
+        from ...core.resilience import bump_counter, logger
+
+        try:
+            hook(*args)
+        except Exception:
+            bump_counter("elastic.watchdog_hook_error")
+            logger.exception("comm watchdog hook %r raised", hook)
+
+    def _check_probes(self):
+        from ...core.resilience import bump_counter
+
+        with self._lock:
+            probes = list(self._probes.items())
+        for name, rec in probes:
+            probe, on_unhealthy = rec[0], rec[1]
+            try:
+                result = probe()
+            except Exception as e:  # a raising probe IS an unhealthy probe
+                result = e
+            unhealthy = not result or isinstance(result, Exception)
+            if unhealthy and not rec[2]:
+                bump_counter("elastic.unhealthy_probe")
+                self._fire_hook(on_unhealthy, name, result)
+            rec[2] = unhealthy
+
     def _watch(self):
         while not self._stop.wait(self.poll):
-            now = time.time()
+            now = time.monotonic()
             with self._lock:
-                for name, started in list(self._tasks.items()):
-                    if now - started > self.timeout:
-                        self.on_timeout(name, started, now - started)
-                        self._tasks.pop(name, None)
+                expired = [(name, started)
+                           for name, started in self._tasks.items()
+                           if now - started > self.timeout]
+                for name, _ in expired:
+                    self._tasks.pop(name, None)
+            for name, started in expired:
+                self._fire_hook(self.on_timeout, name, started,
+                                now - started)
+            self._check_probes()
 
     def start_task(self, name):
         with self._lock:
-            self._tasks[name] = time.time()
+            self._tasks[name] = time.monotonic()
 
     def end_task(self, name):
         with self._lock:

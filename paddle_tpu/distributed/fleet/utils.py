@@ -37,7 +37,7 @@ class _Timer:
     def start(self):
         if self._started is not None:
             raise RuntimeError(f"timer {self.name!r} already started")
-        self._started = time.time()
+        self._started = time.monotonic()
 
     def stop(self, sync_on=None):
         if self._started is None:
@@ -48,13 +48,13 @@ class _Timer:
                 v.block_until_ready()
             except AttributeError:
                 pass
-        self._elapsed += time.time() - self._started
+        self._elapsed += time.monotonic() - self._started
         self._started = None
 
     def elapsed(self, reset=True):
         out = self._elapsed
         if self._started is not None:
-            out += time.time() - self._started
+            out += time.monotonic() - self._started
         if reset:
             self._elapsed = 0.0
         return out

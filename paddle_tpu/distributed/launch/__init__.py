@@ -81,10 +81,10 @@ class Pod:
         for p in self.procs:
             if p.poll() is None:
                 p.send_signal(sig)
-        deadline = time.time() + 10
+        deadline = time.monotonic() + 10
         for p in self.procs:
             try:
-                p.wait(max(deadline - time.time(), 0.1))
+                p.wait(max(deadline - time.monotonic(), 0.1))
             except subprocess.TimeoutExpired:
                 p.kill()
         for f in self.log_files:

@@ -281,7 +281,8 @@ class TCPStore:
 
     def heartbeat(self, rank: int, prefix: str = "hb") -> None:
         """Write one liveness beat for ``rank`` (wall-clock seconds)."""
-        self.set(f"{prefix}/{rank}", str(time.time()).encode())
+        self.set(f"{prefix}/{rank}",
+                 str(time.time()).encode())  # wall-clock: x-host
 
     def register_heartbeat(self, rank: int, interval: float = 2.0,
                            prefix: str = "hb") -> "_HeartbeatHandle":
@@ -309,7 +310,7 @@ class TCPStore:
         polls to decide scale-in/restart."""
         if ttl is None:
             ttl = flag("FLAGS_heartbeat_ttl")
-        now = time.time()
+        now = time.time()  # wall-clock: x-host (vs store beats)
         dead = []
         for r in range(world_size):
             t = self.last_heartbeat(r, prefix)

@@ -54,5 +54,8 @@ __all__ += [
 ]
 
 from .generation import generate  # noqa: F401
+from .frontend import RequestResult, ServingFrontend  # noqa: F401
+from .serving import ContinuousBatchingEngine  # noqa: F401
 
-__all__ += ["generate"]
+__all__ += ["generate", "ContinuousBatchingEngine", "ServingFrontend",
+            "RequestResult"]

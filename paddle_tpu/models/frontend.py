@@ -1,0 +1,389 @@
+"""Serving frontend: admission control, load shedding, circuit breaking,
+graceful drain — the request-lifecycle layer over ContinuousBatchingEngine.
+
+The engine (serving.py) is a pure scheduler: it decodes whatever sits in
+its queue. Production traffic needs the layer above it — the part of a
+vLLM-style serving stack that decides what is ALLOWED to reach the
+scheduler and how the system degrades when it is saturated or broken:
+
+* **Bounded admission queue** — ``submit()`` sheds load instead of
+  buffering unboundedly: past ``max_queue`` entries or a
+  ``max_queued_tokens`` backlog the request is ``"rejected"`` at the
+  door. With priority classes, a higher-priority admission evicts the
+  lowest-priority queued request (high-priority work sheds LAST).
+* **Circuit breaker** — repeated engine-level failures (poison requests
+  retired as ``"failed"``) trip a ``core.resilience.CircuitBreaker``;
+  while it is open every submit fails fast as ``"unavailable"`` instead
+  of feeding a broken engine, and a half-open probe request closes it
+  again on success.
+* **Graceful drain** — ``shutdown(drain=True)`` stops admitting,
+  finishes the slots already decoding, and reports ``"cancelled"`` for
+  everything still queued; ``drain=False`` cancels in-flight work too.
+* **Health** — ``health()`` / ``ready()`` snapshots for watchdogs
+  (``fleet.elastic.CommTaskManager`` can both scope ``step()`` under its
+  timeout watch and poll ``ready`` as a registered probe).
+
+The frontend is a synchronous pump: callers ``submit()`` whenever
+requests arrive and drive progress with ``step()`` (one admit → decode →
+retire turn) or ``results(wait=True)`` (pump until everything pending has
+resolved). Request statuses:
+``ok | timed_out | rejected | failed | cancelled | unavailable``.
+"""
+from __future__ import annotations
+
+import bisect
+import itertools
+
+import numpy as np
+
+from ..core.resilience import CircuitBreaker, Deadline, bump_counter
+
+__all__ = ["ServingFrontend", "RequestResult"]
+
+
+class RequestResult:
+    """Terminal record for one submitted request."""
+
+    __slots__ = ("rid", "status", "tokens", "reason")
+
+    def __init__(self, rid, status, tokens=None, reason=None):
+        self.rid = rid
+        self.status = status
+        self.tokens = (np.zeros((0,), np.int32) if tokens is None
+                       else np.asarray(tokens, np.int32))
+        self.reason = reason
+
+    def __repr__(self):
+        return (f"RequestResult(rid={self.rid}, status={self.status!r}, "
+                f"tokens={len(self.tokens)})")
+
+
+class _Pending:
+    """A queued admission, ordered by (priority DESC, arrival ASC)."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "priority", "deadline",
+                 "cost", "seq")
+
+    def __init__(self, rid, prompt, max_new_tokens, priority, deadline,
+                 seq):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.priority = priority
+        self.deadline = deadline
+        # backlog cost: prompt tokens to prefill + tokens to decode
+        self.cost = prompt.size + max_new_tokens
+        self.seq = seq
+
+    def __lt__(self, other):
+        return (-self.priority, self.seq) < (-other.priority, other.seq)
+
+
+class ServingFrontend:
+    """submit()/results()/cancel() lifecycle over a
+    ``ContinuousBatchingEngine`` (requests arrive over time, not as one
+    list), with bounded admission, failure isolation surfaced as request
+    statuses, a circuit breaker, and graceful drain.
+
+    Usage::
+
+        fe = ServingFrontend(engine, max_queue=32, max_queued_tokens=4096)
+        rid = fe.submit(prompt, max_new_tokens=64, priority=1)
+        for rid, res in fe.results(wait=True).items():
+            print(rid, res.status, res.tokens)
+        fe.shutdown(drain=True)
+    """
+
+    def __init__(self, engine, max_queue=64, max_queued_tokens=None,
+                 default_max_new_tokens=64, segment=16, breaker=None,
+                 breaker_threshold=5, breaker_cooldown_s=30.0,
+                 watchdog=None, watch_name="serving.step"):
+        self.engine = engine
+        self.max_queue = int(max_queue)
+        self.max_queued_tokens = max_queued_tokens
+        self.default_max_new_tokens = int(default_max_new_tokens)
+        self.breaker = breaker or CircuitBreaker(
+            "serving.engine", failure_threshold=breaker_threshold,
+            cooldown_s=breaker_cooldown_s)
+        self._watchdog = watchdog
+        self._watch_name = watch_name
+        self._queue: list[_Pending] = []   # sorted: high priority first
+        self._inflight = {}                # rid -> engine Request
+        self._probe_rids = set()           # half-open probes awaiting verdict
+        self._results: dict[int, RequestResult] = {}
+        self._rids = itertools.count()
+        self._seq = itertools.count()
+        self._draining = False
+        self._closed = False
+        engine.start(segment=segment)
+
+    # ------------------------------------------------------------ admission
+
+    def _finish(self, rid, status, tokens=None, reason=None):
+        self._results[rid] = RequestResult(rid, status, tokens, reason)
+        return rid
+
+    def _reject(self, rid, reason):
+        bump_counter("serving.rejected")
+        self.engine.note_rejection()  # stats()['rejected'] sees shedding
+        return self._finish(rid, "rejected", reason=reason)
+
+    def _cancel_bookkeeping(self, rid, tokens=None, reason=""):
+        self._inflight.pop(rid, None)
+        bump_counter("serving.cancelled")
+        self._finish(rid, "cancelled", tokens=tokens, reason=reason)
+        self._resolve_probe(rid, "cancelled")
+
+    def queued_tokens(self) -> int:
+        return sum(e.cost for e in self._queue)
+
+    def submit(self, prompt, max_new_tokens=None, priority=0,
+               deadline_s=None) -> int:
+        """Admit one request; returns its rid. Never raises for a bad or
+        shed request — the verdict lands in ``results()`` as status
+        ``rejected`` (admission control / malformed), ``unavailable``
+        (circuit open), or a terminal decode status later."""
+        rid = next(self._rids)
+        if self._closed or self._draining:
+            return self._reject(rid, "shutting down")
+        max_new = (self.default_max_new_tokens if max_new_tokens is None
+                   else int(max_new_tokens))
+        try:
+            prompt = np.asarray(prompt).astype(np.int32).ravel()
+            self.engine._validate(prompt, max_new)
+        except (ValueError, TypeError) as e:
+            # a request the engine could NEVER schedule is a poison pill
+            # caught at the door — admission is where it must die, not
+            # inside a co-batched dispatch
+            return self._reject(rid, str(e))
+        probe = False
+        if self.breaker.state() != CircuitBreaker.CLOSED:
+            # half-open admission goes through the breaker's own probe
+            # accounting (allow() consumes one of half_open_max slots);
+            # while open, allow() is False and we fail fast
+            if not self.breaker.allow():
+                bump_counter("serving.unavailable")
+                return self._finish(
+                    rid, "unavailable",
+                    reason=f"circuit breaker {self.breaker.state()}")
+            probe = True
+        entry = _Pending(rid, prompt, max_new, int(priority),
+                         (deadline_s if isinstance(deadline_s, Deadline)
+                          else Deadline(deadline_s)), next(self._seq))
+        self._sweep_expired()  # dead entries must not shed live traffic
+        # bounded admission: shed the lowest-priority queued request
+        # (LAST in sorted order) while budgets are exceeded — but only
+        # after proving the newcomer CAN fit once every out-ranked entry
+        # is gone; an infeasible request must not empty the queue first
+        if self._over_budget(entry) and not self._feasible(entry):
+            if probe:
+                self.breaker.release_probe()
+            return self._reject(
+                rid, f"admission queue full "
+                     f"(depth {len(self._queue)}/{self.max_queue})")
+        while self._over_budget(entry):
+            # _feasible guarantees the tail outranks nothing: every
+            # remaining over-budget token/slot is held by a lower-priority
+            # entry, so the victim is always evictable
+            victim = self._queue.pop()
+            bump_counter("serving.shed")
+            self._reject(victim.rid, "shed by higher-priority admission")
+            self._resolve_probe(victim.rid, "rejected")
+        bisect.insort(self._queue, entry)
+        if probe:
+            self._probe_rids.add(rid)
+        return rid
+
+    def _over_budget(self, entry) -> bool:
+        if len(self._queue) + 1 > self.max_queue:
+            return True
+        if self.max_queued_tokens is not None:
+            return self.queued_tokens() + entry.cost > self.max_queued_tokens
+        return False
+
+    def _feasible(self, entry) -> bool:
+        """Could ``entry`` fit the budgets after evicting every queued
+        request it outranks? (Entries of equal/higher priority are never
+        evicted on its behalf.)"""
+        kept = [e for e in self._queue if e.priority >= entry.priority]
+        if len(kept) + 1 > self.max_queue:
+            return False
+        if self.max_queued_tokens is not None:
+            return (sum(e.cost for e in kept) + entry.cost
+                    <= self.max_queued_tokens)
+        return True
+
+    # ------------------------------------------------------------- pumping
+
+    def _watched(self, fn):
+        """Run ``fn`` under the watchdog's watch scope (when given) so a
+        wedged engine dispatch trips the ``CommTaskManager`` timeout
+        dump."""
+        if self._watchdog is not None:
+            from ..distributed.fleet.elastic import watch
+
+            with watch(self._watchdog, self._watch_name):
+                return fn()
+        return fn()
+
+    def step(self):
+        """One scheduler turn: move admissible queued requests into the
+        engine's free slots, run one decode segment, record outcomes —
+        watchdog-scoped."""
+        return self._watched(self._step)
+
+    def _sweep_expired(self):
+        """Retire queue entries whose deadline ran out, independent of
+        free slots: while the engine is saturated they would otherwise
+        keep pinning the queue/backlog budgets and shed live traffic for
+        dead work. Runs on every step AND every admission attempt."""
+        live = []
+        for entry in self._queue:
+            if entry.deadline.expired():
+                self._finish(entry.rid, "timed_out",
+                             reason="expired while queued")
+                self._resolve_probe(entry.rid, "timed_out")
+            else:
+                live.append(entry)
+        self._queue = live
+
+    def _step(self):
+        self._sweep_expired()
+        room = self.engine.free_slots() - len(self.engine.queued_requests())
+        while room > 0 and self._queue:
+            entry = self._queue.pop(0)
+            req = self.engine.submit(entry.prompt, entry.max_new_tokens,
+                                     deadline_s=entry.deadline,
+                                     rid=entry.rid)
+            self._inflight[entry.rid] = req
+            room -= 1
+        if self.engine.has_work():
+            self._record(self.engine.step())
+
+    def _record(self, finished):
+        for req in finished:
+            self._inflight.pop(req.rid, None)
+            self._finish(req.rid, req.status, tokens=req.output(),
+                         reason=(str(req.error) if req.error is not None
+                                 else None))
+            if req.status == "failed":
+                # while recovering, only a PROBE's failure re-trips; a
+                # stale failure from pre-trip work is not probe evidence
+                if (self.breaker.state() != CircuitBreaker.HALF_OPEN
+                        or req.rid in self._probe_rids):
+                    self.breaker.record_failure()
+            elif req.status == "ok":
+                # while recovering, only an admitted PROBE's success is
+                # evidence the engine healed — a stale ok from pre-trip
+                # work must not close the breaker on its behalf
+                if (self.breaker.state() == CircuitBreaker.CLOSED
+                        or req.rid in self._probe_rids):
+                    self.breaker.record_success()
+            self._resolve_probe(req.rid, req.status)
+
+    def _resolve_probe(self, rid, status):
+        """A half-open probe that resolved WITHOUT a verdict on the engine
+        (cancelled / its own deadline) frees its probe slot; ok/failed
+        verdicts already closed or re-opened the breaker."""
+        if rid in self._probe_rids:
+            self._probe_rids.discard(rid)
+            if status not in ("ok", "failed"):
+                self.breaker.release_probe()
+
+    def pending(self) -> int:
+        """Requests submitted but without a terminal result yet (engine-
+        queued requests are already tracked in ``_inflight``)."""
+        return len(self._queue) + len(self._inflight)
+
+    def results(self, wait=False) -> dict:
+        """Pop terminal results as ``{rid: RequestResult}``. With
+        ``wait=True`` the frontend pumps ``step()`` until every pending
+        request resolves."""
+        if wait:
+            while self.pending() or self.engine.has_work():
+                self.step()
+        out, self._results = self._results, {}
+        return out
+
+    def cancel(self, rid) -> bool:
+        """Cancel a queued or in-flight request; its partial tokens (if
+        any) land in results with status ``"cancelled"``. Returns False
+        when the rid is unknown or already terminal."""
+        for entry in self._queue:
+            if entry.rid == rid:
+                self._queue.remove(entry)
+                self._cancel_bookkeeping(rid, reason="cancelled in queue")
+                return True
+        req = self.engine.abort(rid, "cancelled")
+        if req is not None:
+            self._cancel_bookkeeping(rid, tokens=req.output(),
+                                     reason="cancelled in flight")
+            return True
+        return False
+
+    # ------------------------------------------------------------ shutdown
+
+    def shutdown(self, drain=True):
+        """Stop admitting. ``drain=True`` finishes the requests already
+        holding slots (their results arrive normally) and reports
+        ``"cancelled"`` for everything still queued; ``drain=False`` also
+        cancels the in-flight slots, keeping their partial tokens."""
+        if self._closed:
+            return
+        self._draining = True
+        for entry in self._queue:
+            self._cancel_bookkeeping(entry.rid,
+                                     reason="shutdown before admission")
+        self._queue.clear()
+        for req in self.engine.queued_requests():
+            self.engine.abort(req.rid, "cancelled")
+            self._cancel_bookkeeping(req.rid, tokens=req.output(),
+                                     reason="shutdown before a slot was "
+                                            "assigned")
+        if drain:
+            # the drain pump stays under the watchdog scope: a dispatch
+            # that wedges DURING shutdown still trips the timeout dump
+            while self.engine.has_work():
+                self._watched(lambda: self._record(self.engine.step()))
+        else:
+            for req in list(self.engine.active_requests()):
+                self.engine.abort(req.rid, "cancelled")
+                self._cancel_bookkeeping(req.rid, tokens=req.output(),
+                                         reason="shutdown cancelled "
+                                                "in-flight")
+        self._closed = True
+
+    # -------------------------------------------------------------- health
+
+    def ready(self) -> bool:
+        """Admitting traffic right now? (False while draining, stopped,
+        or with the breaker open — the state an elastic watchdog polls
+        before routing work here.)"""
+        return (not self._closed and not self._draining
+                and self.breaker.state() != CircuitBreaker.OPEN)
+
+    def health(self) -> dict:
+        """Snapshot for watchdogs/load-balancers: overall ``state``
+        (``ok | degraded | draining | unavailable | stopped``), breaker
+        state, queue depth/backlog, and slot occupancy."""
+        breaker_state = self.breaker.state()
+        if self._closed:
+            state = "stopped"
+        elif self._draining:
+            state = "draining"
+        elif breaker_state == CircuitBreaker.OPEN:
+            state = "unavailable"
+        elif breaker_state == CircuitBreaker.HALF_OPEN:
+            state = "degraded"
+        else:
+            state = "ok"
+        return {
+            "state": state,
+            "ready": self.ready(),
+            "breaker": breaker_state,
+            "draining": self._draining,
+            "queue_depth": len(self._queue),
+            "queued_tokens": self.queued_tokens(),
+            "active_slots": len(self.engine.active_requests()),
+            "free_slots": self.engine.free_slots(),
+        }

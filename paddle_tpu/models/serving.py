@@ -20,17 +20,50 @@ kernel-launch scheduler.
 """
 from __future__ import annotations
 
+import time
 from collections import deque
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.resilience import Deadline
+from ..core.resilience import Deadline, InjectedFault, bump_counter, inject
 from ..core.tensor import Tensor
 from .generation import _make_paged_cache, _sample_with_key
 
-__all__ = ["ContinuousBatchingEngine"]
+__all__ = ["ContinuousBatchingEngine", "Request"]
+
+
+class Request:
+    """One in-flight generation request inside the engine scheduler.
+
+    ``status`` lifecycle: ``pending`` → (``ok`` | ``timed_out`` |
+    ``failed`` | ``cancelled``). ``tokens`` accumulates generated ids;
+    ``poisoned`` is the sticky poison mark set when the
+    ``serving.engine_fault`` injection site fires for this request, so
+    bisection retries fail deterministically on the same offender.
+    """
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "deadline", "tokens",
+                 "status", "poisoned", "poison_checked", "error")
+
+    def __init__(self, rid, prompt, max_new_tokens, deadline=None):
+        self.rid = rid
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline = deadline or Deadline.never()
+        self.tokens: list[int] = []
+        self.status = "pending"
+        self.poisoned = False
+        self.poison_checked = False
+        self.error = None
+
+    def output(self):
+        return np.asarray(self.tokens[:self.max_new_tokens], np.int32)
+
+    def __repr__(self):
+        return (f"Request(rid={self.rid}, len={self.prompt.size}, "
+                f"status={self.status!r})")
 
 
 def _bucket(n, buckets):
@@ -216,6 +249,421 @@ class ContinuousBatchingEngine:
                                     dtype=np.uint32)
         return jnp.asarray(bits, self._zero_key.dtype)
 
+    # ----------------------------------------------------------- scheduler
+    #
+    # The engine is a STEPWISE scheduler: ``start()`` resets a session,
+    # ``submit()`` enqueues requests (over time — the ServingFrontend
+    # feeds it incrementally), ``step()`` performs one admit → decode →
+    # retire turn and returns the requests that finished, ``abort()``
+    # pulls a request back out. ``run()`` below is the batch convenience
+    # wrapper that submits a whole list and steps to completion.
+
+    def _validate(self, prompt, max_new_tokens):
+        """Reject a request whose prefill could write outside its slot's
+        pages — BEFORE any work is dispatched for it."""
+        chunk_w = self.prompt_buckets[-1]
+        if prompt.size + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens ({max_new_tokens}) "
+                f"exceeds slot capacity {self.max_len}")
+        # validate buckets UP FRONT: prefill writes the whole padded
+        # bucket/chunk into the slot's pages, and an oversized bucket
+        # must not surface mid-run after other requests' work
+        if prompt.size <= chunk_w:
+            b = _bucket(prompt.size, self.prompt_buckets)
+            if b > self.max_len:
+                raise ValueError(
+                    f"prompt bucket {b} (for a {prompt.size}-token prompt) "
+                    f"exceeds slot capacity {self.max_len}; add a "
+                    f"smaller bucket or raise max_len")
+        elif self.max_len % chunk_w:
+            # chunked prefill pads the final chunk to chunk_w; the
+            # write stays inside the slot's pages iff chunk_w divides
+            # the capacity
+            raise ValueError(
+                f"chunked prefill (prompt {prompt.size} > largest bucket "
+                f"{chunk_w}) requires max_len ({self.max_len}) to be "
+                f"a multiple of the largest bucket")
+
+    def start(self, segment=16, run_deadline=None):
+        """Reset the scheduler for a new serving session: snapshot the
+        parameters, clear slots/queue/counters. ``segment`` is the compiled
+        decode window per ``step()``; ``run_deadline`` bounds the whole
+        session (unfinished requests retire as ``timed_out`` past it)."""
+        self._params = {k: p._value for k, p in self.model.named_parameters()}
+        self._segment_len = int(segment)
+        self._run_deadline = run_deadline or Deadline.never()
+        self._queue: deque[Request] = deque()
+        self._slot_req: list[Request | None] = [None] * self.max_slots
+        self._lengths = np.ones((self.max_slots,), np.int32)  # idle: len 1
+        self._cur_tok = np.zeros((self.max_slots,), np.int32)
+        # per-slot length budget: prompt + max_new - 1 is the final length
+        # the last needed emission reaches; the segment program deactivates
+        # a slot there so it never advances past validated capacity
+        self._limits = np.full((self.max_slots,), self.max_len, np.int32)
+        self._useful = 0
+        self._seg_runs = 0
+        # occupancy as running sum/count: a long-lived serving session
+        # must not grow a per-step list without bound
+        self._occ_sum = 0.0
+        self._occ_n = 0
+        self._counts = {"ok": 0, "timed_out": 0, "failed": 0,
+                        "cancelled": 0, "rejected": 0}
+        self._auto_rid = 0
+        self._t0 = time.monotonic()
+        return self
+
+    def submit(self, prompt, max_new_tokens, deadline_s=None, rid=None):
+        """Enqueue one request (requires a prior ``start()``); raises
+        ``ValueError`` if it can never fit a slot. ``deadline_s`` is a
+        per-request budget (seconds or a ``Deadline``), measured from
+        submission so queue wait counts. Returns the ``Request`` handle."""
+        prompt = np.asarray(prompt).astype(np.int32).ravel()
+        self._validate(prompt, max_new_tokens)
+        if rid is None:
+            rid = self._auto_rid
+            self._auto_rid += 1
+        elif isinstance(rid, int) and rid >= self._auto_rid:
+            # keep auto rids strictly above every explicit rid seen, so
+            # mixing the two can't alias different requests
+            self._auto_rid = rid + 1
+        deadline = (deadline_s if isinstance(deadline_s, Deadline)
+                    else Deadline(deadline_s))
+        req = Request(rid, prompt, max_new_tokens, deadline)
+        self._queue.append(req)
+        return req
+
+    def has_work(self) -> bool:
+        return bool(self._queue) or any(
+            r is not None for r in self._slot_req)
+
+    def free_slots(self) -> int:
+        return sum(r is None for r in self._slot_req)
+
+    def active_requests(self) -> list:
+        return [r for r in self._slot_req if r is not None]
+
+    def queued_requests(self) -> list:
+        return list(self._queue)
+
+    def abort(self, rid, status="cancelled"):
+        """Pull a request out of the queue or its slot (its partial tokens
+        stay on the handle). Returns the ``Request`` or None if unknown /
+        already finished."""
+        for req in self._queue:
+            if req.rid == rid:
+                self._queue.remove(req)
+                self._retire(req, status)
+                return req
+        for slot, req in enumerate(self._slot_req):
+            if req is not None and req.rid == rid:
+                self._retire(req, status, slot=slot)
+                return req
+        return None
+
+    # ----------------------------------------------- failure isolation
+
+    def _retire(self, req, status, finished=None, slot=None):
+        if req.status != "pending":
+            return  # already retired (e.g. timed out inside a bisected try)
+        if slot is not None:
+            self._slot_req[slot] = None
+            self._lengths[slot] = 1  # slot returns to the idle pool
+        req.status = status
+        self._counts[status] = self._counts.get(status, 0) + 1
+        if finished is not None:
+            finished.append(req)
+
+    def _check_poison(self, items):
+        """Consume the ``serving.engine_fault`` injection budget once per
+        request (STICKY: the poison mark survives bisection retries so the
+        same offender fails deterministically), then fail the dispatch if
+        any member of this batch is poisoned."""
+        for _, req in items:
+            if not req.poison_checked:
+                req.poison_checked = True
+                try:
+                    inject("serving.engine_fault")
+                except InjectedFault:
+                    req.poisoned = True
+        bad = [req for _, req in items if req.poisoned]
+        if bad:
+            raise InjectedFault(
+                f"injected engine fault for request {bad[0].rid}")
+
+    def _isolate(self, group, dispatch, finished):
+        """Poison-request isolation: run ``dispatch(sub)`` over the
+        admission group, BISECTING on failure so one poison request cannot
+        take down its co-batched peers — survivors are re-dispatched in
+        smaller batches (page writes are idempotent: a replayed prefill
+        rewrites the same slot pages), and the offender retires as
+        ``"failed"`` (``serving.poison_request`` in the ledger) instead of
+        raising out of the scheduler with every in-flight slot lost."""
+        group = [it for it in group if it[1].status == "pending"]
+        if not group:
+            return
+        try:
+            self._check_poison(group)
+            dispatch(group)
+            return
+        except Exception as e:  # isolation boundary: bisect, never crash
+            if len(group) == 1:
+                _, req = group[0]
+                bump_counter("serving.poison_request")
+                req.error = e
+                self._retire(req, "failed", finished)
+                return
+        mid = len(group) // 2
+        self._isolate(group[:mid], dispatch, finished)
+        self._isolate(group[mid:], dispatch, finished)
+
+    # ------------------------------------------------------- dispatches
+
+    def _finish_admit(self, slot, req, tok, finished):
+        """Shared post-prefill bookkeeping (short AND chunked paths):
+        register the slot, count the sampled first token, set the
+        per-slot budget, and retire immediately on eos / max_new=1."""
+        self._slot_req[slot] = req
+        req.tokens.append(int(tok))
+        self._useful += 1  # the prefill-sampled first token
+        self._lengths[slot] = req.prompt.size
+        self._cur_tok[slot] = int(tok)
+        self._limits[slot] = req.prompt.size + req.max_new_tokens - 1
+        if len(req.tokens) >= req.max_new_tokens or (
+                self.eos_token_id is not None
+                and req.tokens[0] == self.eos_token_id):
+            self._slot_req[slot] = None
+            self._retire(req, "ok", finished)
+
+    def _dispatch_prefill(self, group, bucket, finished):
+        # FIXED admission batch (max_slots rows): one compiled prefill
+        # shape per bucket; padding rows write scratch
+        g = self.max_slots
+        padded = np.zeros((g, bucket), np.int32)
+        true_lens = np.ones((g,), np.int32)
+        rows = np.full((g,), self.max_slots, np.int64)  # scratch
+        for i, (slot, req) in enumerate(group):
+            padded[i, :req.prompt.size] = req.prompt
+            true_lens[i] = req.prompt.size
+            rows[i] = slot
+        tok0, self._ks, self._vs = self._prefill_p(
+            self._params, self._ks, self._vs, jnp.asarray(padded),
+            self._tables[rows], jnp.asarray(true_lens),
+            self._next_keys(1)[0])
+        tok0 = np.asarray(tok0)
+        for i, (slot, req) in enumerate(group):
+            self._finish_admit(slot, req, tok0[i], finished)
+
+    def _split_expired(self, items):
+        live, expired = [], []
+        for slot, req in items:
+            if req.deadline.expired() or self._run_deadline.expired():
+                expired.append((slot, req))
+            else:
+                live.append((slot, req))
+        return live, expired
+
+    def _chunked_prefill(self, group, finished):
+        # CHUNKED PREFILL (long-context admission): full ``chunk_w``-token
+        # chunks at per-row base offsets, then one padded final chunk that
+        # also samples the first token. Rows are aligned by chunk index;
+        # rows already past their full chunks ride the scratch page row.
+        # The request deadline is checked BETWEEN chunks: a long-context
+        # admission whose budget expired mid-prefill retires as
+        # ``timed_out`` without dispatching its remaining chunks.
+        chunk_w = self.prompt_buckets[-1]
+        g = self.max_slots
+        scratch = self.max_slots
+        n_full = {req.rid: (req.prompt.size - 1) // chunk_w
+                  for _, req in group}
+        live = list(group)
+        expired = []
+        c = 0
+        while live:
+            live, dead = self._split_expired(live)
+            expired += dead
+            if not live or not any(c < n_full[req.rid] for _, req in live):
+                break
+            chunk_arr = np.zeros((g, chunk_w), np.int32)
+            bases = np.zeros((g,), np.int32)
+            rows = np.full((g,), scratch, np.int64)
+            for i, (slot, req) in enumerate(live):
+                if c < n_full[req.rid]:
+                    p = req.prompt
+                    chunk_arr[i] = p[c * chunk_w:(c + 1) * chunk_w]
+                    bases[i] = c * chunk_w
+                    rows[i] = slot
+            self._ks, self._vs = self._chunk_p(
+                self._params, self._ks, self._vs, jnp.asarray(chunk_arr),
+                self._tables[rows], jnp.asarray(bases))
+            c += 1
+        if live:
+            final_arr = np.zeros((g, chunk_w), np.int32)
+            bases = np.zeros((g,), np.int32)
+            true_rem = np.ones((g,), np.int32)
+            rows = np.full((g,), scratch, np.int64)
+            for i, (slot, req) in enumerate(live):
+                p = req.prompt
+                done = n_full[req.rid] * chunk_w
+                rem = p.size - done
+                final_arr[i, :rem] = p[done:]
+                bases[i] = done
+                true_rem[i] = rem
+                rows[i] = slot
+            tok0, self._ks, self._vs = self._final_chunk_p(
+                self._params, self._ks, self._vs, jnp.asarray(final_arr),
+                self._tables[rows], jnp.asarray(bases),
+                jnp.asarray(true_rem), self._next_keys(1)[0])
+            tok0 = np.asarray(tok0)
+            for i, (slot, req) in enumerate(live):
+                self._finish_admit(slot, req, tok0[i], finished)
+        for _, req in expired:
+            self._retire(req, "timed_out", finished)
+
+    def _dispatch_segment(self, mask):
+        keys = self._next_keys(self._segment_len)
+        emitted, was_active, tok, new_lengths, still_active, \
+            self._ks, self._vs = self._segment_p(
+                self._params, self._ks, self._vs,
+                self._tables[:self.max_slots],
+                jnp.asarray(self._lengths), jnp.asarray(self._cur_tok),
+                jnp.asarray(mask), jnp.asarray(self._limits), keys)
+        # ONE host round trip for every segment output (separate
+        # np.asarray calls each pay the transfer latency)
+        emitted, was_active, cur_tok, lengths, still_active = \
+            jax.device_get(
+                (emitted, was_active, tok, new_lengths, still_active))
+        # slots outside ``mask`` pass through the program unchanged, so
+        # wholesale assignment composes across bisected sub-batches
+        self._lengths = lengths.copy()
+        self._cur_tok = cur_tok.copy()
+        self._seg_runs += 1
+        return emitted, was_active, still_active
+
+    def _segment_round(self, mask, finished):
+        """One compiled decode segment over the slots in ``mask`` + host
+        token collection. A dispatch failure bisects the ACTIVE MASK (the
+        compiled shape is fixed, so isolation masks slots out rather than
+        re-batching) until the offending slot is alone, then retires it as
+        ``"failed"`` — its co-batched slots decode in the retried halves."""
+        if not mask.any():
+            return
+        try:
+            emitted, was_active, still_active = self._dispatch_segment(mask)
+        except Exception as e:  # isolation boundary: bisect, never crash
+            idx = np.flatnonzero(mask)
+            if len(idx) == 1:
+                slot = int(idx[0])
+                req = self._slot_req[slot]
+                bump_counter("serving.poison_request")
+                req.error = e
+                self._retire(req, "failed", finished, slot=slot)
+                return
+            left = mask.copy()
+            left[idx[len(idx) // 2:]] = False
+            self._segment_round(left, finished)
+            self._segment_round(mask & ~left, finished)
+            return
+        for slot in np.flatnonzero(mask):
+            req = self._slot_req[slot]
+            if req is None:
+                continue
+            toks = req.tokens
+            for s in range(self._segment_len):
+                if not was_active[s, slot] or len(toks) >= \
+                        req.max_new_tokens:
+                    break
+                toks.append(int(emitted[s, slot]))
+                self._useful += 1
+            done = (len(toks) >= req.max_new_tokens
+                    or (self.eos_token_id is not None
+                        and toks and toks[-1] == self.eos_token_id)
+                    or not bool(still_active[slot]))
+            if done:
+                self._retire(req, "ok", finished, slot=slot)
+
+    def step(self):
+        """One scheduler turn: admit queued requests into free slots
+        (same-bucket admissions share ONE compiled prefill dispatch, under
+        poison isolation), run one compiled decode segment, then enforce
+        deadlines BETWEEN segments (never mid-dispatch). Returns the list
+        of ``Request`` objects retired this turn."""
+        finished: list[Request] = []
+        admitting, long_adm = [], []
+        for slot in range(self.max_slots):
+            if self._slot_req[slot] is not None or not self._queue:
+                continue
+            req = self._queue.popleft()
+            if req.status != "pending":
+                continue
+            if req.prompt.size > self.prompt_buckets[-1]:
+                long_adm.append((slot, req))
+            else:
+                admitting.append((slot, req))
+        by_bucket: dict[int, list] = {}
+        for slot, req in admitting:
+            b = _bucket(req.prompt.size, self.prompt_buckets)
+            by_bucket.setdefault(b, []).append((slot, req))
+        for bucket, grp in by_bucket.items():
+            self._isolate(
+                grp, lambda sub, b=bucket: self._dispatch_prefill(
+                    sub, b, finished), finished)
+        if long_adm:
+            self._isolate(
+                long_adm, lambda sub: self._chunked_prefill(sub, finished),
+                finished)
+
+        active_np = np.array([r is not None for r in self._slot_req])
+        if active_np.any():
+            self._occ_sum += float(active_np.mean())
+            self._occ_n += 1
+            self._segment_round(active_np, finished)
+
+        # deadline enforcement BETWEEN segments: an expired slot retires
+        # with its partial output and frees capacity for the queue; queued
+        # requests whose budget ran out while waiting drain as timed_out;
+        # a run-level timeout retires everything still unfinished
+        for slot in range(self.max_slots):
+            req = self._slot_req[slot]
+            if req is not None and (req.deadline.expired()
+                                    or self._run_deadline.expired()):
+                self._retire(req, "timed_out", finished, slot=slot)
+        if self._queue:
+            waiting: deque[Request] = deque()
+            for req in self._queue:
+                if req.status != "pending":
+                    continue
+                if req.deadline.expired() or self._run_deadline.expired():
+                    self._retire(req, "timed_out", finished)
+                else:
+                    waiting.append(req)
+            self._queue = waiting
+        return finished
+
+    def note_rejection(self):
+        """Count a frontend-level rejection in the session stats, so
+        ``stats()['rejected']`` reflects the whole serving stack (the
+        engine itself never rejects — admission control lives above)."""
+        self._counts["rejected"] = self._counts.get("rejected", 0) + 1
+
+    def stats(self):
+        """Running session stats. ``tokens_per_sec`` is 0.0 for an empty
+        or zero-duration session (never inf)."""
+        dt = time.monotonic() - self._t0
+        return {
+            "tokens_per_sec": (self._useful / dt
+                               if dt > 0 and self._useful else 0.0),
+            "useful_tokens": self._useful,
+            "segments": self._seg_runs,
+            "mean_occupancy": (self._occ_sum / self._occ_n
+                               if self._occ_n else 0.0),
+            "wall_s": dt,
+            "timed_out": self._counts.get("timed_out", 0),
+            "failed": self._counts.get("failed", 0),
+            "cancelled": self._counts.get("cancelled", 0),
+            "rejected": self._counts.get("rejected", 0),
+        }
+
     # ------------------------------------------------------------ host loop
 
     def run(self, prompts, max_new_tokens, segment=16,
@@ -224,8 +672,9 @@ class ContinuousBatchingEngine:
         arrays, mixed lengths), admitting/retiring between ``segment``-step
         compiled decode windows. Returns (outputs, stats): outputs[i] is
         the generated id array for prompts[i]; stats carries sustained
-        tokens/sec over the decode segments, occupancy, and per-request
-        ``statuses``.
+        tokens/sec over the decode segments, occupancy, per-request
+        ``statuses``, and ``timed_out``/``failed``/``cancelled``/
+        ``rejected`` counts.
 
         Resilience budgets (checked BETWEEN segments, so a straggler
         never blocks in-flight slots mid-dispatch):
@@ -234,246 +683,35 @@ class ContinuousBatchingEngine:
           or a per-request sequence; None entries are unbounded), measured
           from ``run()`` entry so queue wait counts. A request past its
           deadline is retired with whatever tokens it produced and status
-          ``"timed_out"`` — it stops pinning a slot, and queued requests
-          that expired before admission drain the same way.
+          ``"timed_out"`` — it stops pinning a slot, queued requests that
+          expired before admission drain the same way, and a long-context
+          admission expiring mid-prefill skips its remaining chunks.
         * ``timeout_s`` — budget for the whole call; on expiry every
           unfinished request retires as ``timed_out`` and run() returns.
-        """
-        import time
 
-        params = {k: p._value for k, p in self.model.named_parameters()}
-        queue = deque((i, np.asarray(p).astype(np.int32).ravel())
-                      for i, p in enumerate(prompts))
-        chunk_w = self.prompt_buckets[-1]
-        for _, p in queue:
-            if p.size + max_new_tokens > self.max_len:
-                raise ValueError(
-                    f"prompt ({p.size}) + max_new_tokens ({max_new_tokens}) "
-                    f"exceeds slot capacity {self.max_len}")
-            # validate buckets UP FRONT: prefill writes the whole padded
-            # bucket/chunk into the slot's pages, and an oversized bucket
-            # must not surface mid-run after other requests' work
-            if p.size <= chunk_w:
-                b = _bucket(p.size, self.prompt_buckets)
-                if b > self.max_len:
-                    raise ValueError(
-                        f"prompt bucket {b} (for a {p.size}-token prompt) "
-                        f"exceeds slot capacity {self.max_len}; add a "
-                        f"smaller bucket or raise max_len")
-            elif self.max_len % chunk_w:
-                # chunked prefill pads the final chunk to chunk_w; the
-                # write stays inside the slot's pages iff chunk_w divides
-                # the capacity
-                raise ValueError(
-                    f"chunked prefill (prompt {p.size} > largest bucket "
-                    f"{chunk_w}) requires max_len ({self.max_len}) to be "
-                    f"a multiple of the largest bucket")
-        outputs = [None] * len(prompts)
-        statuses = ["pending"] * len(prompts)
+        Failure isolation: an exception inside a prefill / chunked-prefill
+        / decode dispatch bisects the batch (see ``_isolate``) — the
+        offending request retires as ``"failed"`` with its partial tokens
+        while its co-batched peers complete normally.
+        """
+        prompts_np = [np.asarray(p).astype(np.int32).ravel()
+                      for p in prompts]
+        for p in prompts_np:
+            # validate UP FRONT: a request that can never fit must raise
+            # before any other request's work is dispatched
+            self._validate(p, max_new_tokens)
         if request_deadline_s is None or not np.iterable(request_deadline_s):
             request_deadline_s = [request_deadline_s] * len(prompts)
         if len(request_deadline_s) != len(prompts):
             raise ValueError(
                 f"request_deadline_s has {len(request_deadline_s)} entries "
                 f"for {len(prompts)} prompts")
-        req_deadlines = [Deadline(s) for s in request_deadline_s]
-        run_deadline = Deadline(timeout_s)
-        timed_out = 0
-        collected = {}          # request id -> list of token ids
-        slot_req = [None] * self.max_slots
-        lengths = np.ones((self.max_slots,), np.int32)  # empty slots: len 1
-        cur_tok = np.zeros((self.max_slots,), np.int32)
-        # per-slot length budget: prompt + max_new - 1 is the final length
-        # the last needed emission reaches; the segment program deactivates
-        # a slot there so it never advances past validated capacity
-        limits = np.full((self.max_slots,), self.max_len, np.int32)
-        t0 = time.time()
-        useful = 0
-        seg_runs = 0
-        occupancy = []
-
-        def finish_admit(slot, rid, prompt, tok):
-            """Shared post-prefill bookkeeping (short AND chunked paths):
-            register the slot, count the sampled first token, set the
-            per-slot budget, and retire immediately on eos / max_new=1."""
-            nonlocal useful
-            slot_req[slot] = rid
-            collected[rid] = [int(tok)]
-            useful += 1  # the prefill-sampled first token
-            lengths[slot] = prompt.size
-            cur_tok[slot] = int(tok)
-            limits[slot] = prompt.size + max_new_tokens - 1
-            if len(collected[rid]) >= max_new_tokens or (
-                    self.eos_token_id is not None
-                    and collected[rid][0] == self.eos_token_id):
-                outputs[rid] = np.asarray(
-                    collected.pop(rid)[:max_new_tokens], np.int32)
-                statuses[rid] = "ok"
-                slot_req[slot] = None
-
-        def retire_timed_out(slot=None, rid=None):
-            """Retire a request past its deadline with the tokens it
-            already produced; a freed slot readmits next iteration."""
-            nonlocal timed_out
-            if slot is not None:
-                rid = slot_req[slot]
-                slot_req[slot] = None
-                lengths[slot] = 1
-            outputs[rid] = np.asarray(
-                collected.pop(rid, [])[:max_new_tokens], np.int32)
-            statuses[rid] = "timed_out"
-            timed_out += 1
-
-        while queue or any(r is not None for r in slot_req):
-            # admit into free slots — same-bucket admissions share ONE
-            # compiled prefill dispatch (batched rows, each writing its
-            # own slot's pages)
-            admitting = []   # short prompts: (slot, rid, prompt, bucket)
-            long_adm = []    # beyond the largest bucket: chunked prefill
-            for slot in range(self.max_slots):
-                if slot_req[slot] is not None or not queue:
-                    continue
-                rid, prompt = queue.popleft()
-                if prompt.size > chunk_w:
-                    long_adm.append((slot, rid, prompt))
-                else:
-                    admitting.append(
-                        (slot, rid, prompt,
-                         _bucket(prompt.size, self.prompt_buckets)))
-            by_bucket: dict[int, list] = {}
-            for item in admitting:
-                by_bucket.setdefault(item[3], []).append(item)
-            for bucket, group in by_bucket.items():
-                # FIXED admission batch (max_slots rows): one compiled
-                # prefill shape per bucket; padding rows write scratch
-                g = self.max_slots
-                padded = np.zeros((g, bucket), np.int32)
-                true_lens = np.ones((g,), np.int32)
-                rows = np.full((g,), self.max_slots, np.int64)  # scratch
-                for i, (slot, _, prompt, _) in enumerate(group):
-                    padded[i, :prompt.size] = prompt
-                    true_lens[i] = prompt.size
-                    rows[i] = slot
-                tok0, self._ks, self._vs = self._prefill_p(
-                    params, self._ks, self._vs, jnp.asarray(padded),
-                    self._tables[rows], jnp.asarray(true_lens),
-                    self._next_keys(1)[0])
-                tok0 = np.asarray(tok0)
-                for i, (slot, rid, prompt, _) in enumerate(group):
-                    finish_admit(slot, rid, prompt, tok0[i])
-
-            if long_adm:
-                # CHUNKED PREFILL (long-context admission): full
-                # ``chunk_w``-token chunks at per-row base offsets, then
-                # one padded final chunk that also samples the first
-                # token. Rows are aligned by chunk index; rows already
-                # past their full chunks ride the scratch page row.
-                g = self.max_slots
-                scratch = self.max_slots
-                n_full = {rid: (p.size - 1) // chunk_w
-                          for _, rid, p in long_adm}
-                for c in range(max(n_full.values())):
-                    chunk_arr = np.zeros((g, chunk_w), np.int32)
-                    bases = np.zeros((g,), np.int32)
-                    rows = np.full((g,), scratch, np.int64)
-                    for i, (slot, rid, p) in enumerate(long_adm):
-                        if c < n_full[rid]:
-                            chunk_arr[i] = p[c * chunk_w:(c + 1) * chunk_w]
-                            bases[i] = c * chunk_w
-                            rows[i] = slot
-                    self._ks, self._vs = self._chunk_p(
-                        params, self._ks, self._vs, jnp.asarray(chunk_arr),
-                        self._tables[rows], jnp.asarray(bases))
-                final_arr = np.zeros((g, chunk_w), np.int32)
-                bases = np.zeros((g,), np.int32)
-                true_rem = np.ones((g,), np.int32)
-                rows = np.full((g,), scratch, np.int64)
-                for i, (slot, rid, p) in enumerate(long_adm):
-                    done = n_full[rid] * chunk_w
-                    rem = p.size - done
-                    final_arr[i, :rem] = p[done:]
-                    bases[i] = done
-                    true_rem[i] = rem
-                    rows[i] = slot
-                tok0, self._ks, self._vs = self._final_chunk_p(
-                    params, self._ks, self._vs, jnp.asarray(final_arr),
-                    self._tables[rows], jnp.asarray(bases),
-                    jnp.asarray(true_rem), self._next_keys(1)[0])
-                tok0 = np.asarray(tok0)
-                for i, (slot, rid, p) in enumerate(long_adm):
-                    finish_admit(slot, rid, p, tok0[i])
-
-            active_np = np.array([r is not None for r in slot_req])
-            if not active_np.any():
-                continue
-            occupancy.append(active_np.mean())
-            keys = self._next_keys(segment)
-            emitted, was_active, tok, new_lengths, still_active, \
-                self._ks, self._vs = self._segment_p(
-                    params, self._ks, self._vs,
-                    self._tables[:self.max_slots],
-                    jnp.asarray(lengths), jnp.asarray(cur_tok),
-                    jnp.asarray(active_np), jnp.asarray(limits), keys)
-            # ONE host round trip for every segment output (separate
-            # np.asarray calls each pay the transfer latency)
-            emitted, was_active, cur_tok, lengths, still_active = \
-                jax.device_get(
-                    (emitted, was_active, tok, new_lengths, still_active))
-            lengths = lengths.copy()
-            cur_tok = cur_tok.copy()
-            seg_runs += 1
-
-            for slot in range(self.max_slots):
-                rid = slot_req[slot]
-                if rid is None:
-                    continue
-                toks = collected[rid]
-                for step in range(segment):
-                    if not was_active[step, slot] or len(toks) >= \
-                            max_new_tokens:
-                        break
-                    toks.append(int(emitted[step, slot]))
-                    useful += 1
-                done = (len(toks) >= max_new_tokens
-                        or (self.eos_token_id is not None
-                            and toks and toks[-1] == self.eos_token_id)
-                        or not bool(still_active[slot]))
-                if done:
-                    outputs[rid] = np.asarray(toks[:max_new_tokens],
-                                              np.int32)
-                    statuses[rid] = "ok"
-                    collected.pop(rid)
-                    slot_req[slot] = None
-                    lengths[slot] = 1  # slot returns to the idle pool
-
-            # deadline enforcement BETWEEN segments (never mid-dispatch):
-            # an expired slot retires with its partial output and frees
-            # capacity for the queue; queued requests whose budget ran
-            # out while waiting drain as timed_out; a run-level timeout
-            # retires everything still unfinished
-            for slot in range(self.max_slots):
-                rid = slot_req[slot]
-                if rid is not None and (req_deadlines[rid].expired()
-                                        or run_deadline.expired()):
-                    retire_timed_out(slot=slot)
-            if queue:
-                waiting = deque()
-                for rid, prompt in queue:
-                    if (req_deadlines[rid].expired()
-                            or run_deadline.expired()):
-                        retire_timed_out(rid=rid)
-                    else:
-                        waiting.append((rid, prompt))
-                queue = waiting
-
-        dt = time.time() - t0
-        stats = {
-            "tokens_per_sec": useful / dt if dt > 0 else float("inf"),
-            "useful_tokens": useful,
-            "segments": seg_runs,
-            "mean_occupancy": float(np.mean(occupancy)) if occupancy else 0.0,
-            "wall_s": dt,
-            "timed_out": timed_out,
-            "statuses": statuses,
-        }
-        return outputs, stats
+        self.start(segment=segment, run_deadline=Deadline(timeout_s))
+        reqs = [self.submit(p, max_new_tokens, deadline_s=s, rid=i)
+                for i, (p, s) in enumerate(
+                    zip(prompts_np, request_deadline_s))]
+        while self.has_work():
+            self.step()
+        stats = self.stats()
+        stats["statuses"] = [r.status for r in reqs]
+        return [r.output() for r in reqs], stats

@@ -8,9 +8,13 @@
   ``contextlib.suppress`` (greppable intent), and swallowed-but-counted
   failures go through ``resilience.bump_counter`` + logging instead.
 * ``time.time()`` is banned where deadline/elapsed math lives
-  (``core/``, ``io/``, ``amp/``, ``hapi/``): an NTP step must not expire
-  every in-flight budget (or stall a watchdog) — use
-  ``time.monotonic()`` (core/resilience.py Deadline rationale).
+  (``core/``, ``io/``, ``amp/``, ``hapi/``, and since the serving
+  robustness layer also ``models/`` and ``distributed/``): an NTP step
+  must not expire every in-flight budget (or stall a watchdog) — use
+  ``time.monotonic()`` (core/resilience.py Deadline rationale). The ONE
+  legitimate wall-clock use is a timestamp that crosses hosts via the
+  store (monotonic clocks don't share an epoch across hosts); those
+  lines carry an explicit ``# wall-clock`` pragma the guard honors.
 """
 import pathlib
 import re
@@ -25,17 +29,25 @@ _BARE = re.compile(
 
 _WALL_CLOCK = re.compile(r"\btime\.time\(\)")
 
-_NO_BARE_EXCEPT_DIRS = ("distributed", "io", "amp", "hapi")
-_MONOTONIC_ONLY_DIRS = ("core", "io", "amp", "hapi")
+_NO_BARE_EXCEPT_DIRS = ("distributed", "io", "amp", "hapi", "models")
+_MONOTONIC_ONLY_DIRS = ("core", "io", "amp", "hapi", "models",
+                        "distributed")
+
+# the one sanctioned wall-clock use: timestamps that cross hosts via the
+# store must be wall-clock (no shared monotonic epoch) and say so inline
+_PRAGMA = "# wall-clock"
 
 
-def _offenders(subdir, pattern):
+def _offenders(subdir, pattern, pragma=None):
     root = _PKG / subdir
     out = []
     for py in sorted(root.rglob("*.py")):
         text = py.read_text()
+        lines = text.splitlines()
         for m in pattern.finditer(text):
             line = text.count("\n", 0, m.start()) + 1
+            if pragma is not None and pragma in lines[line - 1]:
+                continue
             out.append(f"{py.relative_to(_PKG.parent)}:{line}")
     return out
 
@@ -51,8 +63,9 @@ def test_no_bare_except_pass(subdir):
 
 @pytest.mark.parametrize("subdir", _MONOTONIC_ONLY_DIRS)
 def test_no_wall_clock_for_deadline_math(subdir):
-    offenders = _offenders(subdir, _WALL_CLOCK)
+    offenders = _offenders(subdir, _WALL_CLOCK, pragma=_PRAGMA)
     assert not offenders, (
         f"time.time() under paddle_tpu/{subdir}/ — deadline/elapsed math "
         "must use time.monotonic() so an NTP step can't expire every "
-        f"in-flight budget: {offenders}")
+        "in-flight budget (cross-host store timestamps may opt out with "
+        f"a '{_PRAGMA}' pragma): {offenders}")

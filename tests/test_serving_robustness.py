@@ -1,0 +1,648 @@
+"""Serving-stack robustness: admission control, poison-request isolation,
+circuit breaker, graceful drain (ISSUE 3).
+
+The acceptance drill: with ``serving.engine_fault`` armed to fail one
+request's prefill, that request must end ``"failed"`` while every
+co-batched request ends ``"ok"`` with the exact greedy tokens, and
+repeated faults must trip the breaker to ``"unavailable"`` then recover
+through half-open. Faults are injected through FLAGS_fault_injection
+(core/resilience.py) so these tests exercise the REAL bisection /
+breaker / drain paths, not mocks of them.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import resilience
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.core.resilience import CircuitBreaker
+from paddle_tpu.distributed.fleet.elastic import (
+    CommTaskManager,
+    ElasticManager,
+    ElasticStatus,
+    watch,
+)
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.frontend import ServingFrontend
+from paddle_tpu.models.generation import generate
+from paddle_tpu.models.serving import ContinuousBatchingEngine
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    resilience.reset_faults()
+    resilience.reset_counters()
+    yield
+    resilience.reset_faults()
+    resilience.reset_counters()
+
+
+def _model(vocab=211):
+    cfg = LlamaConfig(vocab_size=vocab, hidden_size=32, intermediate_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      max_position_embeddings=256, tie_word_embeddings=True)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+def _tiny_model():
+    cfg = LlamaConfig(vocab_size=97, hidden_size=16, intermediate_size=32,
+                      num_hidden_layers=1, num_attention_heads=2,
+                      max_position_embeddings=128, tie_word_embeddings=True)
+    paddle.seed(0)
+    return LlamaForCausalLM(cfg)
+
+
+def _want(m, prompt, max_new):
+    return np.asarray(
+        generate(m, paddle.to_tensor(prompt[None, :]),
+                 max_new_tokens=max_new, cache="paged")._value
+    )[0, prompt.size:]
+
+
+# ------------------------------------------------------- circuit breaker
+
+
+def test_circuit_breaker_lifecycle_with_fake_clock():
+    t = [0.0]
+    br = CircuitBreaker("t", failure_threshold=2, cooldown_s=10.0,
+                        clock=lambda: t[0])
+    assert br.state() == CircuitBreaker.CLOSED and br.allow()
+    br.record_failure()
+    assert br.state() == CircuitBreaker.CLOSED  # below threshold
+    br.record_success()                          # success resets the count
+    assert br.failures == 0
+    br.record_failure()
+    br.record_failure()
+    assert br.state() == CircuitBreaker.OPEN and not br.allow()
+    br.record_success()  # late success from pre-trip work: NOT a probe
+    assert br.state() == CircuitBreaker.OPEN
+    t[0] = 5.0
+    assert not br.allow()                        # cool-down not elapsed
+    t[0] = 10.0
+    assert br.state() == CircuitBreaker.HALF_OPEN
+    assert br.allow()                            # the one probe slot
+    assert not br.allow()                        # probes capped
+    br.record_failure()                          # failed probe: re-open
+    assert br.state() == CircuitBreaker.OPEN
+    t[0] = 20.0
+    assert br.allow()                            # half-open again
+    br.record_success()
+    assert br.state() == CircuitBreaker.CLOSED and br.failures == 0
+    assert resilience.get_counter("circuit_opened:t") == 2
+    assert resilience.get_counter("circuit_half_open:t") == 2
+    assert resilience.get_counter("circuit_closed:t") == 1
+
+
+def test_circuit_breaker_release_probe_frees_the_slot():
+    t = [0.0]
+    br = CircuitBreaker("r", failure_threshold=1, cooldown_s=1.0,
+                        clock=lambda: t[0])
+    br.record_failure()
+    t[0] = 1.0
+    assert br.state() == CircuitBreaker.HALF_OPEN
+    assert br.allow() and not br.allow()
+    br.release_probe()              # probe resolved with no verdict
+    assert br.allow()               # slot is available again
+
+
+def test_circuit_breaker_stale_success_cannot_close_half_open():
+    """Pre-trip work finishing after the cool-down is not probe evidence:
+    with NO probe admitted, record_success must leave the breaker
+    half-open."""
+    t = [0.0]
+    br = CircuitBreaker("s", failure_threshold=1, cooldown_s=1.0,
+                        clock=lambda: t[0])
+    br.record_failure()
+    t[0] = 1.0                      # cool-down elapsed: half-open
+    br.record_success()             # stale ok, zero probes admitted
+    assert br.state() == CircuitBreaker.HALF_OPEN
+    br.record_failure()             # stale failure: also not evidence
+    assert br.state() == CircuitBreaker.HALF_OPEN
+    assert br.allow()               # a real probe is still required
+    br.record_success()             # the probe's verdict closes it
+    assert br.state() == CircuitBreaker.CLOSED
+
+
+# ------------------------------------------------- poison-request isolation
+
+
+def test_poison_prefill_isolated_from_cobatched_peers():
+    """The acceptance drill, engine level: one armed engine fault fails
+    exactly one request's prefill; its co-batched peers (same bucket, same
+    compiled dispatch) finish with the exact greedy tokens."""
+    m = _model()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, 211, (n,)).astype(np.int32)
+               for n in (5, 11, 3)]
+    eng = ContinuousBatchingEngine(m, max_slots=3, max_len=128,
+                                   page_size=32, prompt_buckets=(16,))
+    set_flags({"FLAGS_fault_injection": "serving.engine_fault:1"})
+    outs, stats = eng.run(prompts, max_new_tokens=10, segment=4)
+    # the poisoned request (first through the poison check) ends "failed";
+    # every co-batched request ends "ok" with correct tokens
+    assert stats["statuses"] == ["failed", "ok", "ok"]
+    assert stats["failed"] == 1 and stats["timed_out"] == 0
+    assert outs[0].size == 0  # never prefilled
+    for i in (1, 2):
+        np.testing.assert_array_equal(outs[i], _want(m, prompts[i], 10),
+                                      err_msg=f"request {i}")
+    assert resilience.get_counter("serving.poison_request") == 1
+    assert resilience.get_counter(
+        "fault_injected:serving.engine_fault") == 1
+
+
+def test_poison_chunked_prefill_isolated():
+    """A poison long-context admission (chunked prefill path) dies alone;
+    a co-admitted long request and a short request both complete."""
+    m = _model()
+    rng = np.random.RandomState(4)
+    prompts = [rng.randint(0, 211, (n,)).astype(np.int32)
+               for n in (70, 100, 9)]  # 70/100 chunked, 9 short
+    eng = ContinuousBatchingEngine(m, max_slots=2, max_len=128,
+                                   page_size=32, prompt_buckets=(32,))
+    set_flags({"FLAGS_fault_injection": "serving.engine_fault:1"})
+    outs, stats = eng.run(prompts, max_new_tokens=8, segment=4)
+    assert stats["statuses"] == ["failed", "ok", "ok"]
+    for i in (1, 2):
+        np.testing.assert_array_equal(outs[i], _want(m, prompts[i], 8),
+                                      err_msg=f"request {i}")
+    assert resilience.get_counter("serving.poison_request") == 1
+
+
+def test_segment_dispatch_failure_isolates_offending_slot():
+    """A decode-segment dispatch failure bisects the ACTIVE MASK until
+    the offending slot is alone; its peers keep decoding correctly."""
+    m = _model()
+    rng = np.random.RandomState(2)
+    prompts = [rng.randint(0, 211, (n,)).astype(np.int32)
+               for n in (5, 7, 9)]
+    eng = ContinuousBatchingEngine(m, max_slots=3, max_len=128,
+                                   page_size=32, prompt_buckets=(16,))
+    orig = eng._segment_p
+
+    def boom(params, ks, vs, tables, lengths, toks, active, limits, keys):
+        if bool(np.asarray(active)[1]):  # slot 1 poisons every dispatch
+            raise RuntimeError("simulated XLA dispatch failure")
+        return orig(params, ks, vs, tables, lengths, toks, active, limits,
+                    keys)
+
+    eng._segment_p = boom
+    outs, stats = eng.run(prompts, max_new_tokens=6, segment=2)
+    assert stats["statuses"] == ["ok", "failed", "ok"]
+    for i in (0, 2):
+        np.testing.assert_array_equal(outs[i], _want(m, prompts[i], 6),
+                                      err_msg=f"request {i}")
+    # the failed slot keeps its prefill token (greedy prefix), nothing more
+    np.testing.assert_array_equal(outs[1], _want(m, prompts[1], 6)[:1])
+    assert resilience.get_counter("serving.poison_request") == 1
+
+
+# --------------------------------------------------- breaker through the
+# frontend: repeated faults -> unavailable -> half-open recovery
+
+
+def test_repeated_faults_trip_breaker_then_recover_through_half_open():
+    m = _tiny_model()
+    rng = np.random.RandomState(1)
+    prompts = [rng.randint(0, 97, (6,)).astype(np.int32) for _ in range(4)]
+    eng = ContinuousBatchingEngine(m, max_slots=2, max_len=64,
+                                   page_size=32, prompt_buckets=(8,))
+    fe = ServingFrontend(eng, max_queue=8, segment=2,
+                         breaker_threshold=2, breaker_cooldown_s=0.2)
+    set_flags({"FLAGS_fault_injection": "serving.engine_fault:2"})
+    r0 = fe.submit(prompts[0], max_new_tokens=4)
+    r1 = fe.submit(prompts[1], max_new_tokens=4)
+    res = fe.results(wait=True)
+    assert res[r0].status == "failed" and res[r1].status == "failed"
+    # two consecutive engine-level failures tripped the breaker
+    assert fe.breaker.state() == CircuitBreaker.OPEN
+    assert fe.health()["state"] == "unavailable" and not fe.ready()
+    r2 = fe.submit(prompts[2], max_new_tokens=4)
+    res = fe.results(wait=True)
+    assert res[r2].status == "unavailable"  # failed fast, nothing dispatched
+    assert resilience.get_counter("serving.unavailable") == 1
+
+    time.sleep(0.25)  # cool-down elapses -> half-open
+    assert fe.health()["state"] == "degraded"
+    r3 = fe.submit(prompts[3], max_new_tokens=4)  # the half-open probe
+    # a second request during the probe window is shed as unavailable
+    r4 = fe.submit(prompts[0], max_new_tokens=4)
+    assert fe.results()[r4].status == "unavailable"
+    res = fe.results(wait=True)
+    assert res[r3].status == "ok"
+    np.testing.assert_array_equal(res[r3].tokens, _want(m, prompts[3], 4))
+    # the successful probe closed the breaker: traffic flows again
+    assert fe.breaker.state() == CircuitBreaker.CLOSED and fe.ready()
+    assert resilience.get_counter("circuit_opened:serving.engine") == 1
+    assert resilience.get_counter("circuit_closed:serving.engine") == 1
+
+
+# ------------------------------------------------------- admission control
+
+
+def test_admission_queue_depth_and_priority_shedding():
+    m = _tiny_model()
+    rng = np.random.RandomState(3)
+    mk = lambda: rng.randint(0, 97, (6,)).astype(np.int32)
+    eng = ContinuousBatchingEngine(m, max_slots=1, max_len=64,
+                                   page_size=32, prompt_buckets=(8,))
+    fe = ServingFrontend(eng, max_queue=2, segment=2)
+    r0 = fe.submit(mk(), max_new_tokens=4)
+    r1 = fe.submit(mk(), max_new_tokens=4)
+    r2 = fe.submit(mk(), max_new_tokens=4)        # over depth, equal prio
+    r3 = fe.submit(mk(), max_new_tokens=4, priority=1)  # evicts lowest
+    res = fe.results(wait=True)
+    assert res[r2].status == "rejected" and "queue full" in res[r2].reason
+    # the higher-priority admission shed the newest low-priority entry
+    assert res[r1].status == "rejected" and "shed" in res[r1].reason
+    assert res[r0].status == "ok" and res[r3].status == "ok"
+    assert resilience.get_counter("serving.shed") == 1
+    assert resilience.get_counter("serving.rejected") == 2
+    assert eng.stats()["rejected"] == 2  # engine stats see the shedding
+
+
+def test_admission_token_backlog_budget_and_malformed_request():
+    m = _tiny_model()
+    rng = np.random.RandomState(5)
+    eng = ContinuousBatchingEngine(m, max_slots=1, max_len=64,
+                                   page_size=32, prompt_buckets=(8,))
+    fe = ServingFrontend(eng, max_queue=64, max_queued_tokens=12, segment=2)
+    p = rng.randint(0, 97, (6,)).astype(np.int32)
+    r0 = fe.submit(p, max_new_tokens=4)           # cost 10, fits
+    r1 = fe.submit(p, max_new_tokens=4)           # backlog would hit 20
+    # a request that can NEVER fit a slot is rejected at the door, not
+    # exploded inside a co-batched dispatch
+    r2 = fe.submit(rng.randint(0, 97, (80,)).astype(np.int32),
+                   max_new_tokens=32)
+    res = fe.results(wait=True)
+    assert res[r0].status == "ok"
+    assert res[r1].status == "rejected"
+    assert res[r2].status == "rejected"
+    assert "exceeds slot capacity" in res[r2].reason
+    # a prompt numpy can't even cast is rejected, never raised
+    r3 = fe.submit("definitely not token ids", max_new_tokens=4)
+    assert fe.results()[r3].status == "rejected"
+
+
+def test_infeasible_admission_never_evicts_queued_work():
+    """A request that cannot fit the budgets even after evicting every
+    out-ranked entry is rejected WITHOUT shedding anything."""
+    m = _tiny_model()
+    rng = np.random.RandomState(12)
+    eng = ContinuousBatchingEngine(m, max_slots=1, max_len=64,
+                                   page_size=32, prompt_buckets=(8,))
+    fe = ServingFrontend(eng, max_queue=64, max_queued_tokens=40, segment=2)
+    p = rng.randint(0, 97, (6,)).astype(np.int32)
+    rids = [fe.submit(p, max_new_tokens=4) for _ in range(3)]  # cost 10 each
+    # cost 62 > the whole 40-token budget: infeasible under ANY eviction
+    big = fe.submit(rng.randint(0, 97, (30,)).astype(np.int32),
+                    max_new_tokens=32, priority=5)
+    res = fe.results(wait=True)
+    assert res[big].status == "rejected"
+    assert all(res[r].status == "ok" for r in rids)  # queue untouched
+    assert resilience.get_counter("serving.shed") == 0
+
+
+def test_cancelled_half_open_probe_releases_its_slot():
+    """A probe request that resolves with no verdict (cancelled) must not
+    wedge the half-open breaker waiting for an outcome forever."""
+    m = _tiny_model()
+    rng = np.random.RandomState(13)
+    prompts = [rng.randint(0, 97, (6,)).astype(np.int32) for _ in range(3)]
+    eng = ContinuousBatchingEngine(m, max_slots=1, max_len=64,
+                                   page_size=32, prompt_buckets=(8,))
+    fe = ServingFrontend(eng, segment=2, breaker_threshold=1,
+                         breaker_cooldown_s=0.05)
+    set_flags({"FLAGS_fault_injection": "serving.engine_fault:1"})
+    r0 = fe.submit(prompts[0], max_new_tokens=4)
+    assert fe.results(wait=True)[r0].status == "failed"  # breaker opens
+    time.sleep(0.1)                                      # -> half-open
+    r1 = fe.submit(prompts[1], max_new_tokens=4)         # probe, queued
+    assert fe.cancel(r1)                                 # no verdict
+    r2 = fe.submit(prompts[2], max_new_tokens=4)         # freed slot
+    res = fe.results(wait=True)
+    assert res[r1].status == "cancelled"
+    assert res[r2].status == "ok"                        # NOT unavailable
+    assert fe.breaker.state() == CircuitBreaker.CLOSED
+
+
+def test_shed_half_open_probe_releases_its_slot():
+    """A queued probe evicted by a higher-priority admission releases the
+    breaker's probe slot — later submits must be shed for QUEUE reasons,
+    not wedged 'unavailable' on a leaked slot."""
+    m = _tiny_model()
+    rng = np.random.RandomState(14)
+    prompts = [rng.randint(0, 97, (6,)).astype(np.int32) for _ in range(4)]
+    eng = ContinuousBatchingEngine(m, max_slots=1, max_len=64,
+                                   page_size=32, prompt_buckets=(8,))
+    br = CircuitBreaker("shed", failure_threshold=1, cooldown_s=0.05,
+                        half_open_max=2)
+    fe = ServingFrontend(eng, max_queue=1, segment=2, breaker=br)
+    set_flags({"FLAGS_fault_injection": "serving.engine_fault:1"})
+    r0 = fe.submit(prompts[0], max_new_tokens=4)
+    assert fe.results(wait=True)[r0].status == "failed"  # breaker opens
+    time.sleep(0.1)                                      # -> half-open
+    r1 = fe.submit(prompts[1], max_new_tokens=4)          # probe slot 1
+    r2 = fe.submit(prompts[2], max_new_tokens=4,
+                   priority=9)     # probe slot 2; evicts r1 -> releases 1
+    # both slots would be consumed without the release; with it, r3 passes
+    # the breaker gate and is shed for queue-capacity reasons instead
+    r3 = fe.submit(prompts[3], max_new_tokens=4)
+    res = fe.results(wait=True)
+    assert res[r1].status == "rejected" and "shed" in res[r1].reason
+    assert res[r3].status == "rejected" and "queue full" in res[r3].reason
+    assert res[r2].status == "ok"    # the surviving probe heals the breaker
+    assert fe.breaker.state() == CircuitBreaker.CLOSED and fe.ready()
+
+
+def test_engine_auto_rid_never_aliases_explicit_rid():
+    m = _tiny_model()
+    eng = ContinuousBatchingEngine(m, max_slots=2, max_len=64,
+                                   page_size=32, prompt_buckets=(8,))
+    eng.start()
+    p = np.arange(6, dtype=np.int32)
+    a = eng.submit(p, 4, rid=1)
+    b = eng.submit(p, 4)           # auto rid must skip past explicit 1
+    assert b.rid != a.rid
+    assert eng.abort(b.rid) is b   # aborts the right request
+    assert eng.abort(a.rid) is a
+
+
+def test_expired_queued_entries_free_admission_budget():
+    """Dead queue entries (deadline ran out while slots were saturated)
+    must not pin the admission budgets and shed live traffic."""
+    m = _tiny_model()
+    rng = np.random.RandomState(15)
+    p = rng.randint(0, 97, (6,)).astype(np.int32)
+    eng = ContinuousBatchingEngine(m, max_slots=1, max_len=64,
+                                   page_size=32, prompt_buckets=(8,))
+    fe = ServingFrontend(eng, max_queue=2, segment=2)
+    r0 = fe.submit(p, max_new_tokens=32)
+    fe.step()                                         # r0 holds the slot
+    r1 = fe.submit(p, max_new_tokens=4, deadline_s=0.01)
+    r2 = fe.submit(p, max_new_tokens=4, deadline_s=0.01)  # queue full
+    time.sleep(0.05)                                  # both expire queued
+    r3 = fe.submit(p, max_new_tokens=4)               # must NOT be shed
+    res = fe.results(wait=True)
+    assert res[r1].status == "timed_out"
+    assert res[r2].status == "timed_out"
+    assert res[r3].status == "ok" and res[r0].status == "ok"
+
+
+def test_frontend_requests_arrive_over_time_and_cancel():
+    m = _tiny_model()
+    rng = np.random.RandomState(6)
+    prompts = [rng.randint(0, 97, (6,)).astype(np.int32) for _ in range(3)]
+    eng = ContinuousBatchingEngine(m, max_slots=1, max_len=64,
+                                   page_size=32, prompt_buckets=(8,))
+    fe = ServingFrontend(eng, segment=2)
+    r0 = fe.submit(prompts[0], max_new_tokens=8)
+    fe.step()                      # r0 admitted and decoding
+    r1 = fe.submit(prompts[1], max_new_tokens=8)   # arrives later
+    r2 = fe.submit(prompts[2], max_new_tokens=8)
+    assert fe.cancel(r1)           # cancelled while queued
+    assert not fe.cancel(12345)    # unknown rid
+    res = fe.results(wait=True)
+    assert res[r1].status == "cancelled" and res[r1].tokens.size == 0
+    assert res[r0].status == "ok" and res[r2].status == "ok"
+    np.testing.assert_array_equal(res[r0].tokens, _want(m, prompts[0], 8))
+    np.testing.assert_array_equal(res[r2].tokens, _want(m, prompts[2], 8))
+    # cancel in flight: partial tokens come back with the result
+    r3 = fe.submit(prompts[0], max_new_tokens=32)
+    fe.step()
+    assert fe.cancel(r3)
+    res = fe.results(wait=True)
+    assert res[r3].status == "cancelled" and res[r3].tokens.size >= 1
+    assert not eng.has_work()
+
+
+# --------------------------------------------------------- graceful drain
+
+
+def test_graceful_drain_finishes_in_flight_cancels_queued():
+    m = _tiny_model()
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, 97, (6,)).astype(np.int32) for _ in range(3)]
+    eng = ContinuousBatchingEngine(m, max_slots=1, max_len=64,
+                                   page_size=32, prompt_buckets=(8,))
+    fe = ServingFrontend(eng, segment=2)
+    r0 = fe.submit(prompts[0], max_new_tokens=12)
+    r1 = fe.submit(prompts[1], max_new_tokens=12)
+    r2 = fe.submit(prompts[2], max_new_tokens=12)
+    fe.step()                      # r0 holds the slot, r1/r2 queued
+    fe.shutdown(drain=True)
+    res = fe.results()
+    assert res[r0].status == "ok"  # in-flight slot finished cleanly
+    np.testing.assert_array_equal(res[r0].tokens, _want(m, prompts[0], 12))
+    assert res[r1].status == "cancelled" and res[r2].status == "cancelled"
+    assert not fe.ready() and fe.health()["state"] == "stopped"
+    # admissions after shutdown are shed at the door
+    r3 = fe.submit(prompts[0], max_new_tokens=4)
+    assert fe.results()[r3].status == "rejected"
+
+
+def test_hard_shutdown_cancels_in_flight_with_partial_tokens():
+    m = _tiny_model()
+    rng = np.random.RandomState(8)
+    eng = ContinuousBatchingEngine(m, max_slots=1, max_len=64,
+                                   page_size=32, prompt_buckets=(8,))
+    fe = ServingFrontend(eng, segment=2)
+    r0 = fe.submit(rng.randint(0, 97, (6,)).astype(np.int32),
+                   max_new_tokens=32)
+    fe.step()
+    fe.shutdown(drain=False)
+    res = fe.results()
+    assert res[r0].status == "cancelled"
+    assert 1 <= res[r0].tokens.size < 32  # partial output preserved
+    assert not eng.has_work()
+
+
+# ------------------------------------------------ deadlines in the engine
+
+
+def test_chunked_prefill_checks_deadline_between_chunks():
+    """A long-context admission whose deadline expired retires as
+    timed_out WITHOUT dispatching its prefill chunks; co-running short
+    requests are untouched."""
+    m = _model()
+    rng = np.random.RandomState(9)
+    long_p = rng.randint(0, 211, (100,)).astype(np.int32)
+    short_p = rng.randint(0, 211, (9,)).astype(np.int32)
+    eng = ContinuousBatchingEngine(m, max_slots=2, max_len=128,
+                                   page_size=32, prompt_buckets=(32,))
+    chunk_calls = []
+    orig = eng._chunk_p
+    eng._chunk_p = lambda *a: (chunk_calls.append(1), orig(*a))[1]
+    outs, stats = eng.run([long_p, short_p], max_new_tokens=8, segment=4,
+                          request_deadline_s=[0.0, None])
+    assert stats["statuses"] == ["timed_out", "ok"]
+    assert not chunk_calls     # zero chunks dispatched for the dead request
+    assert outs[0].size == 0
+    np.testing.assert_array_equal(outs[1], _want(m, short_p, 8))
+
+
+def test_run_stats_degenerate_cases():
+    m = _tiny_model()
+    eng = ContinuousBatchingEngine(m, max_slots=1, max_len=64,
+                                   page_size=32, prompt_buckets=(8,))
+    outs, stats = eng.run([], max_new_tokens=4)
+    assert outs == [] and stats["statuses"] == []
+    assert stats["tokens_per_sec"] == 0.0      # never inf
+    assert stats["useful_tokens"] == 0
+    for key in ("timed_out", "rejected", "failed", "cancelled"):
+        assert stats[key] == 0
+
+
+# -------------------------------------------------- elastic layer coverage
+
+
+def test_comm_task_manager_timeout_hook_fires_and_removes_task():
+    fired = []
+    mgr = CommTaskManager(timeout=0.05, poll_interval=0.02,
+                          on_timeout=lambda n, s, e: fired.append((n, e)))
+    try:
+        mgr.start_task("wedged-barrier")
+        time.sleep(0.3)
+        assert fired and fired[0][0] == "wedged-barrier"
+        assert fired[0][1] > 0.05
+        assert "wedged-barrier" not in mgr.pending()  # dumped once, removed
+        # a task that completes in time never fires
+        with watch(mgr, "quick-phase"):
+            pass
+        time.sleep(0.2)
+        assert not any(n == "quick-phase" for n, _ in fired)
+    finally:
+        mgr.shutdown()
+
+
+def test_watchdog_thread_survives_raising_hooks():
+    """A raising on_timeout / on_unhealthy callback must never kill the
+    watchdog thread — the failure detector cannot fail silently."""
+    fired = []
+
+    def bad_hook(name, started, elapsed):
+        fired.append(name)
+        raise RuntimeError("dump destination gone")
+
+    mgr = CommTaskManager(timeout=0.03, poll_interval=0.02,
+                          on_timeout=bad_hook)
+    try:
+        mgr.start_task("a")
+        time.sleep(0.15)
+        assert "a" in fired
+        mgr.start_task("b")         # the thread must still be watching
+        time.sleep(0.15)
+        assert "b" in fired
+        assert mgr._thread.is_alive()
+        assert resilience.get_counter("elastic.watchdog_hook_error") >= 2
+    finally:
+        mgr.shutdown()
+
+
+def test_comm_task_manager_health_probe_fires_on_unhealthy():
+    unhealthy = []
+    state = {"ok": True}
+    mgr = CommTaskManager(timeout=60.0, poll_interval=0.02)
+    try:
+        mgr.register_probe("svc", lambda: state["ok"],
+                           on_unhealthy=lambda n, r: unhealthy.append(n))
+        time.sleep(0.1)
+        assert not unhealthy
+        state["ok"] = False
+        time.sleep(0.15)
+        # EDGE-triggered: one incident, not one fire per poll cycle
+        assert unhealthy == ["svc"]
+        assert resilience.get_counter("elastic.unhealthy_probe") == 1
+        state["ok"] = True
+        time.sleep(0.1)
+        state["ok"] = False          # second distinct incident
+        time.sleep(0.15)
+        assert unhealthy == ["svc", "svc"]
+        mgr.remove_probe("svc")
+        state["ok"] = True
+        n = len(unhealthy)
+        time.sleep(0.1)
+        assert len(unhealthy) == n  # removed probes stop firing
+    finally:
+        mgr.shutdown()
+
+
+def test_scale_plan_exit_when_no_hosts_and_no_joiners():
+    store = TCPStore(is_master=True)
+    try:
+        m = ElasticManager(store=store, rank=0, world_size=2, lease=0.2,
+                           np_range=(1, 2))
+        # never start()ed: nobody heartbeats, nobody joined
+        status, world = m.scale_plan()
+        assert status == ElasticStatus.EXIT and world == 0
+    finally:
+        store.close()
+
+
+def test_scale_plan_scale_out_capped_at_np_max():
+    store = TCPStore(is_master=True)
+    lead = joiner1 = joiner2 = None
+    try:
+        lead = ElasticManager(store=store, rank=0, world_size=1,
+                              heartbeat_interval=0.05, lease=1.0,
+                              np_range=(1, 2)).start()
+        joiner1 = ElasticManager(store=store, rank=10, world_size=1,
+                                 heartbeat_interval=0.05, lease=1.0,
+                                 np_range=(1, 2))
+        joiner2 = ElasticManager(store=store, rank=11, world_size=1,
+                                 heartbeat_interval=0.05, lease=1.0,
+                                 np_range=(1, 2))
+        joiner1.announce_join()
+        joiner2.announce_join()
+        time.sleep(0.2)
+        status, world = lead.scale_plan()
+        # two joiners but np_max=2: the plan is capped, not overgrown
+        assert status == ElasticStatus.RESTART and world == 2
+    finally:
+        # beat threads hold the native store client: stop BEFORE close
+        for m in (joiner1, joiner2, lead):
+            if m is not None:
+                m.stop()
+        store.close()
+
+
+def test_frontend_health_wired_through_elastic_watchdog():
+    """The fleet.elastic watchdog both scopes frontend steps under its
+    timeout watch and polls ready() as a health probe: a tripped breaker
+    turns the probe unhealthy."""
+    m = _tiny_model()
+    rng = np.random.RandomState(11)
+    prompts = [rng.randint(0, 97, (6,)).astype(np.int32) for _ in range(2)]
+    eng = ContinuousBatchingEngine(m, max_slots=1, max_len=64,
+                                   page_size=32, prompt_buckets=(8,))
+    mgr = CommTaskManager(timeout=60.0, poll_interval=0.02)
+    try:
+        fe = ServingFrontend(eng, segment=2, breaker_threshold=1,
+                             breaker_cooldown_s=60.0, watchdog=mgr)
+        watched = []
+        orig_start = mgr.start_task
+        mgr.start_task = lambda name: (watched.append(name),
+                                       orig_start(name))[1]
+        unhealthy = []
+        mgr.register_probe("serving.ready", fe.ready,
+                           on_unhealthy=lambda n, r: unhealthy.append(n))
+        r0 = fe.submit(prompts[0], max_new_tokens=4)
+        res = fe.results(wait=True)
+        assert res[r0].status == "ok"
+        assert "serving.step" in watched       # steps ran inside the scope
+        assert mgr.pending() == []             # and the scope closed
+        time.sleep(0.1)
+        assert not unhealthy                   # healthy while serving
+        set_flags({"FLAGS_fault_injection": "serving.engine_fault:1"})
+        r1 = fe.submit(prompts[1], max_new_tokens=4)
+        res = fe.results(wait=True)
+        assert res[r1].status == "failed"      # threshold 1: breaker opens
+        assert not fe.ready()
+        time.sleep(0.15)
+        assert "serving.ready" in unhealthy    # the watchdog saw it
+    finally:
+        mgr.shutdown()
