@@ -45,6 +45,7 @@ from .flags import define_flag, flag
 __all__ = [
     "RetryPolicy", "Deadline", "CircuitBreaker",
     "CommTimeoutError", "InjectedFault", "CheckpointCorruptionError",
+    "PeerFailureError",
     "inject", "fault_remaining", "reset_faults",
     "bump_counter", "get_counter", "counters", "reset_counters",
 ]
@@ -65,6 +66,8 @@ define_flag("FLAGS_comm_timeout_ms", 120_000,
             "Default deadline for coordination-KV p2p fetches (ms)")
 define_flag("FLAGS_heartbeat_ttl", 6.0,
             "Seconds without a store heartbeat before a rank counts dead")
+define_flag("FLAGS_gang_barrier_timeout", 600.0,
+            "Seconds a gang_barrier waits for all ranks before giving up")
 
 
 # ------------------------------------------------------------------ errors
@@ -88,6 +91,25 @@ class CommTimeoutError(TimeoutError):
 
 class CheckpointCorruptionError(RuntimeError):
     """A checkpoint shard failed its recorded CRC32 on load."""
+
+
+class PeerFailureError(Exception):
+    """A gang peer stopped heartbeating (or a gang barrier could not
+    complete) — the job must stop collective work NOW, checkpoint, and
+    exit for supervised restart instead of burning the full comm timeout.
+
+    Deliberately NOT a RuntimeError/ConnectionError/TimeoutError: every
+    transport retry policy classifies those as transient, and a dead
+    peer is not transient — the error must escape retry loops unwrapped
+    so the training loop's elastic handler sees it within one heartbeat
+    lease. Carries the dead ``rank`` (None when the gang is broken but
+    no single culprit is known, e.g. a barrier timeout with all
+    heartbeats live) and the ``phase`` that was blocked."""
+
+    def __init__(self, message, rank=None, phase=None):
+        super().__init__(message)
+        self.rank = rank
+        self.phase = phase
 
 
 # ------------------------------------------------------------------ deadline

@@ -14,6 +14,7 @@ from . import auto_tuner  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import comm_ops  # noqa: F401
 from . import fleet  # noqa: F401
+from . import gang  # noqa: F401
 from . import io  # noqa: F401
 from . import launch  # noqa: F401
 from . import ps  # noqa: F401
@@ -46,11 +47,20 @@ from .extras import (  # noqa: F401
 from .auto_parallel import DistModel, Strategy, to_static  # noqa: F401
 from .checkpoint import (  # noqa: F401
     CheckpointCorruptionError,
+    commit_snapshot,
+    committed_step,
     latest_complete_snapshot,
     load_latest_snapshot,
     load_state_dict,
     save_snapshot,
     save_state_dict,
+)
+from .gang import (  # noqa: F401
+    GangContext,
+    PeerFailureDetector,
+    PeerFailureError,
+    gang_barrier,
+    gang_context,
 )
 from .spawn import MultiprocessContext, spawn  # noqa: F401
 from .api import (  # noqa: F401
@@ -125,5 +135,7 @@ __all__ = [
     "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
     "InMemoryDataset", "QueueDataset", "launch", "io",
     "CheckpointCorruptionError", "save_snapshot", "load_latest_snapshot",
-    "latest_complete_snapshot",
+    "latest_complete_snapshot", "commit_snapshot", "committed_step",
+    "PeerFailureError", "PeerFailureDetector", "GangContext",
+    "gang_barrier", "gang_context", "gang",
 ]

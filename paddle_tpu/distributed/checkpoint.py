@@ -40,7 +40,31 @@ from ..framework.io import save_arrays
 __all__ = [
     "save_state_dict", "load_state_dict", "CheckpointCorruptionError",
     "save_snapshot", "load_latest_snapshot", "latest_complete_snapshot",
+    "commit_snapshot", "committed_step",
 ]
+
+
+def _gang_rank():
+    """This process's rank in the GANG. Under real multi-controller jax
+    that is ``jax.process_index()``; under the multi-process launcher
+    WITHOUT ``jax.distributed`` every worker is process 0 of its own
+    runtime, so the launcher's ``PADDLE_TRAINER_ID`` is authoritative —
+    otherwise peers would all write ``0.distcp`` and race to prune the
+    same directories."""
+    import jax
+
+    if jax.process_count() > 1:
+        return jax.process_index()
+    return int(os.environ.get("PADDLE_TRAINER_ID",
+                              jax.process_index()) or 0)
+
+
+def _gang_world():
+    import jax
+
+    if jax.process_count() > 1:
+        return jax.process_count()
+    return int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
 
 
 def _crc32(arr: np.ndarray) -> int:
@@ -48,7 +72,10 @@ def _crc32(arr: np.ndarray) -> int:
 
 
 def _atomic_json(obj, path):
-    tmp = path + ".tmp"
+    # per-process tmp name: gang ranks sharing a directory may write the
+    # same json (identical content) concurrently, and a shared tmp name
+    # makes one rank's os.replace yank the other's file mid-commit
+    tmp = f"{path}.tmp.{os.getpid()}"
     with open(tmp, "w") as f:
         json.dump(obj, f)
     os.replace(tmp, path)
@@ -72,26 +99,51 @@ def _is_jax_array(v):
 
 
 def save_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, num_shards=None, async_save=False):
+                    coordinator_rank=0, num_shards=None, async_save=False,
+                    gang_layout=False):
     """Write ``state_dict`` as a sharded checkpoint directory: this
     process's addressable shards + this process's metadata.
 
     ``num_shards``/``async_save`` are accepted for reference-API parity but
     ignored: file parallelism is one file per process (the reference's
     per-rank ``.distcp`` layout), and saving is synchronous.
+
+    ``gang_layout=True`` is for launcher gangs writing into ONE SHARED
+    directory (``fit(elastic=True)``): shard files and metadata are named
+    by the GANG rank (``PADDLE_TRAINER_ID``) instead of
+    ``jax.process_index()`` — under the multi-process launcher without
+    ``jax.distributed`` every worker is process 0 of its own runtime and
+    would otherwise collide on ``0.distcp``. It must stay off (default)
+    for per-host directories: in gang layout non-zero ranks write only a
+    completion marker, which is the wrong thing on a disk rank 0 never
+    sees. Under real multi-controller jax the two layouts coincide.
     """
     import jax
 
     os.makedirs(path, exist_ok=True)
-    rank = jax.process_index()
+    rank = _gang_rank() if gang_layout else jax.process_index()
     fname = f"{rank}.distcp"
     local: dict[str, np.ndarray] = {}
     # world_size lets load ignore stale higher-rank files left behind by an
     # earlier save into the same directory from a larger world
     meta = {"tensors": {}, "version": 2,
-            "world_size": jax.process_count()}
+            "world_size": (_gang_world() if gang_layout
+                           else jax.process_count())}
+
+    # In gang layout WITHOUT jax.distributed each worker is a full
+    # single-process runtime: every tensor is a fully addressable replica
+    # on every gang rank. One writer (gang rank 0) records them — N ranks
+    # writing full copies into one shared directory would alias every
+    # byte N times (the reference's dedup_tensor rule, applied at gang
+    # granularity). Non-zero ranks still commit their (possibly empty)
+    # shard + metadata files, which is exactly the per-rank completion
+    # marker the commit protocol checks.
+    gang_replicated = (gang_layout and jax.process_count() == 1
+                       and _gang_world() > 1)
 
     for key, v in state_dict.items():
+        if gang_replicated and rank != 0:
+            continue
         if isinstance(v, Tensor):
             v = v._value
         if _is_jax_array(v) and v.ndim > 0:
@@ -323,32 +375,69 @@ def _is_complete(path) -> bool:
             world = int(json.load(f).get("world_size", 1))
     except (OSError, ValueError):
         return False
-    return all(
-        os.path.exists(os.path.join(path, f"{r}.metadata.json"))
-        and os.path.exists(os.path.join(path, f"{r}.distcp"))
-        for r in range(world))
+    for r in range(world):
+        mpath = os.path.join(path, f"{r}.metadata.json")
+        if not (os.path.exists(mpath)
+                and os.path.exists(os.path.join(path, f"{r}.distcp"))):
+            return False
+        if r == 0:
+            continue
+        # every rank must have saved from the SAME world: a directory
+        # mixing a 2-rank save with debris from a differently-sized run
+        # would pass a bare existence check but merge inconsistent shards
+        try:
+            with open(mpath) as f:
+                if int(json.load(f).get("world_size", 1)) != world:
+                    return False
+        except (OSError, ValueError):
+            return False
+    return True
 
 
-def save_snapshot(state_dict, root, step, keep=None):
+def save_snapshot(state_dict, root, step, keep=None, coordinated=False,
+                  commit_timeout=None, gang_layout=False):
     """Save ``state_dict`` under ``root/step_{step:08d}`` (crash-safe,
-    checksummed). With ``keep``, rank 0 prunes the oldest snapshots so at
-    most ``keep`` remain. Returns the snapshot directory."""
+    checksummed). With ``keep``, the oldest snapshots are pruned so at
+    most ``keep`` remain. With ``gang_layout`` (shared-directory gangs,
+    see :func:`save_state_dict`) shard naming AND the pruning gate use
+    the gang rank — exactly one pruner even when every worker is process
+    0 of its own jax runtime, so peers never race to ``rmtree`` the same
+    directories. With ``coordinated``, the gang runs a commit barrier
+    after the shards land and rank 0 publishes the cluster-agreed
+    ``committed_step`` to the gang store — a dead peer surfaces as
+    ``PeerFailureError`` from the barrier; an unreachable store leaves
+    the step uncommitted (degraded, counted). Returns the snapshot
+    directory."""
     import shutil
 
     import jax
 
     path = os.path.join(root, f"step_{int(step):08d}")
-    save_state_dict(state_dict, path)
-    if keep is not None and jax.process_index() == 0:
+    save_state_dict(state_dict, path, gang_layout=gang_layout)
+    committed = None
+    if coordinated and commit_snapshot(root, step, timeout=commit_timeout):
+        committed = int(step)
+    pruner_rank = _gang_rank() if gang_layout else jax.process_index()
+    if keep is not None and pruner_rank == 0:
+        if committed is None:
+            # pin the published committed step regardless of ``keep`` and
+            # of ``coordinated`` — an UNcoordinated emergency save must
+            # not prune the one directory the store still points every
+            # rank at
+            committed = committed_step()
         # only COMPLETE snapshots count toward ``keep`` — an interrupted
         # save must never crowd out the fallback candidates. Incomplete
         # leftovers older than the newest complete snapshot are debris
-        # and go too; newer ones may be a concurrent in-flight save.
+        # and go too; newer ones may be a concurrent in-flight save. The
+        # cluster-agreed committed step is pinned regardless of ``keep``:
+        # it is the one directory every rank may still need to resume.
         snaps = _snapshot_dirs(root)
         complete = [(s, p) for s, p in snaps if _is_complete(p)]
         # keep <= 0 keeps nothing (complete[-0:] would keep EVERYTHING)
         keep_set = ({p for _, p in complete[-int(keep):]}
                     if int(keep) > 0 else set())
+        if committed is not None:
+            keep_set.add(os.path.join(root, f"step_{committed:08d}"))
         newest_step = complete[-1][0] if complete else None
         for s, p in snaps:
             if p in keep_set:
@@ -359,20 +448,142 @@ def save_snapshot(state_dict, root, step, keep=None):
     return path
 
 
-def latest_complete_snapshot(root):
-    """Newest complete snapshot directory under ``root``, or None."""
+# --------------------------------------------- cluster-agreed commit
+#
+# A snapshot directory being complete on THIS host's disk does not make
+# it the gang's resume point: a crash can interrupt a later save after
+# some ranks wrote their shards (or, without a shared filesystem, hosts
+# can simply disagree on "newest complete"). The commit protocol makes
+# the choice cluster-consistent: after every rank's shards land, the
+# gang runs a commit barrier and rank 0 publishes ``committed_step`` to
+# the supervisor-owned gang store. Loaders with ``coordinated=True``
+# resume from exactly that step on every rank; anything newer is
+# uncommitted debris that gang rank 0 prunes.
+
+
+def commit_snapshot(root, step, ctx=None, timeout=None, detector=None,
+                    barrier_name=None) -> bool:
+    """Commit barrier + publish for ``root/step_{step}``. Returns True
+    when the step became the cluster-agreed resume point, False when
+    there is no gang or the store was unreachable/partitioned (the step
+    stays uncommitted — degraded but safe: loaders fall back to the last
+    published step). A dead peer raises ``PeerFailureError``.
+
+    ``barrier_name`` must differ from any EARLIER commit attempt for the
+    same step: barrier arrival counts are single-use, so an emergency
+    retry reusing the periodic name would see its own stale arrival and
+    publish a snapshot the dead peer never finished (fit's emergency
+    path passes ``ckpt_emergency/{step}``)."""
+    from ..core.resilience import bump_counter
+    from . import gang
+
+    ctx = ctx if ctx is not None else gang.gang_context()
+    if ctx is None:
+        return False
+    try:
+        gang.gang_barrier(barrier_name or f"ckpt_commit/{int(step)}",
+                          ctx=ctx, timeout=timeout, detector=detector)
+        if ctx.rank == 0:
+            gang.guarded_store_op(
+                lambda: ctx.store.set(gang.COMMITTED_STEP_KEY,
+                                      str(int(step)).encode()),
+                "publish committed_step")
+            bump_counter("gang.commit_published")
+        bump_counter("gang.commit")
+        return True
+    except (ConnectionError, TimeoutError, RuntimeError) as e:
+        bump_counter("gang.commit_failed")
+        logger.warning("commit of snapshot step %s failed (%s); the step "
+                       "stays uncommitted", step, e)
+        return False
+
+
+def committed_step(ctx=None):
+    """The cluster-agreed snapshot step from the gang store, or None
+    (no gang / nothing published yet / store partitioned — callers fall
+    back to per-host newest-complete)."""
+    from . import gang
+
+    ctx = ctx if ctx is not None else gang.gang_context()
+    if ctx is None:
+        return None
+    try:
+        def _read():
+            if not ctx.store.check(gang.COMMITTED_STEP_KEY):
+                return None
+            return int(ctx.store.get(gang.COMMITTED_STEP_KEY).decode())
+
+        return gang.guarded_store_op(_read, "read committed_step")
+    except (ConnectionError, TimeoutError, RuntimeError, ValueError) as e:
+        logger.warning("cannot read committed step (%s); falling back to "
+                       "per-host newest-complete", e)
+        return None
+
+
+def _committed_snapshot_dir(root, ctx=None):
+    """(step, path) of the cluster-agreed snapshot when it is resolvable
+    AND present/complete on this host, else None (per-host fallback)."""
+    step = committed_step(ctx)
+    if step is None:
+        return None
+    path = os.path.join(root, f"step_{int(step):08d}")
+    if not _is_complete(path):
+        logger.warning(
+            "cluster-agreed snapshot step %s is missing or incomplete "
+            "under %s on this host; falling back to per-host "
+            "newest-complete", step, root)
+        return None
+    return int(step), path
+
+
+def latest_complete_snapshot(root, coordinated=False):
+    """Newest complete snapshot directory under ``root``, or None. With
+    ``coordinated``, the cluster-agreed committed step (when resolvable)
+    wins over this host's newest-complete view."""
+    if coordinated:
+        agreed = _committed_snapshot_dir(root)
+        if agreed is not None:
+            return agreed[1]
     for _, path in reversed(_snapshot_dirs(root)):
         if _is_complete(path):
             return path
     return None
 
 
-def load_latest_snapshot(state_dict, root, fallback=True):
+def load_latest_snapshot(state_dict, root, fallback=True,
+                         coordinated=False):
     """Load the newest complete snapshot under ``root`` into
     ``state_dict``. With ``fallback`` (default), a snapshot that fails to
     load — corrupted shard, missing file, coverage gap — is skipped with a
     warning and the next-newest complete one is tried; without it the
-    first failure propagates. Returns the directory actually loaded."""
+    first failure propagates. Returns the directory actually loaded.
+
+    With ``coordinated``, the cluster-agreed ``committed_step`` from the
+    gang store picks the directory so every rank resumes at the same
+    global step even when a crash interrupted a later partial save; gang
+    rank 0 prunes the newer uncommitted debris (exactly one pruner). A
+    failure to load the agreed snapshot propagates — silently walking
+    back past the agreement would split the gang. When no store is
+    reachable (or nothing was ever committed) this degrades to the
+    per-host newest-complete walk."""
+    if coordinated:
+        agreed = _committed_snapshot_dir(root)
+        if agreed is not None:
+            step, path = agreed
+            if _gang_rank() == 0:
+                import shutil
+
+                from ..core.resilience import bump_counter
+
+                for s, p in _snapshot_dirs(root):
+                    if s > step:
+                        logger.warning("pruning uncommitted snapshot "
+                                       "debris %s (committed step is %s)",
+                                       p, step)
+                        bump_counter("gang.debris_pruned")
+                        shutil.rmtree(p, ignore_errors=True)
+            load_state_dict(state_dict, path)
+            return path
     tried = []
     for _, path in reversed(_snapshot_dirs(root)):
         if not _is_complete(path):

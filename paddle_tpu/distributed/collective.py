@@ -159,8 +159,26 @@ def new_group(ranks=None, backend=None, timeout=None):
 
 
 def barrier(group=None):
-    """Block until all devices reach this point: round-trip a tiny psum."""
+    """Block until every rank reaches this point. With a parallel env
+    initialized AND a gang store available (multi-process launch), a
+    WHOLE-WORLD barrier (``group`` None or the default group) is a real
+    store-backed :func:`gang.gang_barrier` over the gang — it fails
+    fast with ``PeerFailureError`` when a peer dies instead of hanging.
+    Subgroup barriers (and the single-controller case) degrade to the
+    device round-trip, which only orders THIS process's async work —
+    routing a subgroup through the gang barrier would deadlock the
+    non-member ranks' arrival count."""
+    from . import gang
+
+    # always flush this process's pending async device work first — the
+    # gang rendezvous must be a strict superset of the old semantics
     (jnp.zeros(()) + 1).block_until_ready()
+    if _default_group is not None and (group is None
+                                       or group is _default_group):
+        ctx = gang.gang_context()
+        if ctx is not None and ctx.world_size > 1:
+            seq = ctx.next_seq("collective.barrier")
+            gang.gang_barrier(f"collective.barrier/{seq}", ctx=ctx)
 
 
 # ------------------------------------------------------------------
@@ -331,6 +349,8 @@ def _kv_fetch(key, timeout_ms=None, consume=True, src=None,
     silently, so leaked keys stay observable."""
     import base64
 
+    from . import gang
+
     client = _p2p_client()
     if timeout_ms is None:
         timeout_ms = resilience.flag("FLAGS_comm_timeout_ms")
@@ -338,8 +358,29 @@ def _kv_fetch(key, timeout_ms=None, consume=True, src=None,
 
     def _get():
         inject("kv_drop")
-        slice_ms = max(int(min(deadline.remaining_ms(), timeout_ms)), 1)
-        return client.blocking_key_value_get(key, slice_ms)
+        det = gang.get_active_detector()
+        if det is None:
+            slice_ms = max(int(min(deadline.remaining_ms(), timeout_ms)), 1)
+            return client.blocking_key_value_get(key, slice_ms)
+        # gang-aware wait: block at most one heartbeat lease per slice,
+        # re-checking the detector in between — a dead sender surfaces as
+        # PeerFailureError within ~one lease instead of this rank burning
+        # the full KV timeout on a payload that can never arrive.
+        # PeerFailureError is deliberately not a _TRANSIENT subclass, so
+        # it escapes the retry policy unwrapped.
+        phase = f"kv_fetch {key}"
+        while True:
+            det.check(phase)
+            slice_ms = max(int(min(deadline.remaining_ms(), timeout_ms,
+                                   det.lease * 1000.0)), 1)
+            try:
+                return client.blocking_key_value_get(key, slice_ms)
+            except _TRANSIENT as e:
+                if (deadline.remaining_ms() <= 0
+                        or "DEADLINE_EXCEEDED" not in str(e)):
+                    raise
+                # the lease slice elapsed with no payload — not a failure;
+                # loop to re-check the gang and keep waiting
 
     try:
         raw = RetryPolicy(retry_on=_TRANSIENT).call(

@@ -81,6 +81,21 @@ class ElasticManager:
             self.world_size, ttl=self.lease, prefix=f"{self.prefix}/host"))
         return [r for r in range(self.world_size) if r not in dead]
 
+    def make_detector(self, lease=None, interval=None, grace=None):
+        """A :class:`~..gang.PeerFailureDetector` riding THIS manager's
+        host heartbeats (same store, same ``{prefix}/host`` keys): the
+        manager's slow control-plane view (scale_plan, health_check)
+        and the training loop's fast in-job detection then share one
+        liveness source. The caller starts/stops it; starting it while
+        this manager beats is redundant but harmless (same key)."""
+        from ..gang import GangContext, PeerFailureDetector
+
+        ctx = GangContext(self.store, self.rank, self.world_size)
+        return PeerFailureDetector(
+            ctx, lease=lease if lease is not None else self.lease,
+            interval=interval if interval is not None else self.interval,
+            grace=grace, prefix=f"{self.prefix}/host")
+
     def health_check(self):
         """COMPLETED if all ranks beat recently; RESTART when some died
         (reference _update_fault_tolerance)."""

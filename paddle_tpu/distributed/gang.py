@@ -1,0 +1,402 @@
+"""Gang recovery: fast peer-failure detection + store-backed gang barriers.
+
+The reference's elastic manager (fleet/elastic/manager.py, fault tolerance
+at _update_fault_tolerance:457) makes a multi-host job survive rank death
+end-to-end: detect, abort collectives fast, re-rendezvous, resume from a
+cluster-agreed checkpoint. This module is the detection/abort half of that
+loop for the TPU-native stack:
+
+* :class:`GangContext` — one process's membership view of the gang: the
+  shared TCPStore (the ``launch()`` supervisor creates it and exports
+  ``PADDLE_GANG_STORE``), this process's gang rank, the world size, and
+  the elastic *generation*. Every store key the gang writes is
+  generation-tagged, so a restarted generation can never rendezvous
+  against a dead generation's stale barrier counts or heartbeats.
+* :class:`PeerFailureDetector` — rides the store heartbeat machinery
+  (store.py register_heartbeat/last_heartbeat): each rank beats
+  ``gang/{gen}/hb/{rank}``; ``check(phase)`` raises
+  :class:`PeerFailureError` naming the dead rank within one heartbeat
+  lease instead of letting a blocked collective burn the full KV timeout.
+  Registered as the process-wide *active detector*, it is consulted by
+  ``collective._kv_fetch`` (lease-sliced blocking gets), ``gang_barrier``
+  waits, and ``Model.fit(elastic=True)`` step boundaries.
+* :func:`gang_barrier` — a store-backed, generation-tagged barrier that
+  (unlike ``collective.barrier``'s group-less psum) actually spans the
+  gang and FAILS FAST: while waiting it polls the detector, so a dead
+  peer surfaces as ``PeerFailureError(rank, phase)`` in about one lease.
+
+Deterministic fault sites: ``elastic.peer_dead`` (a check_peers call
+raises as if a peer died) and ``store.partition`` (gang-store traffic
+fails as if the store were unreachable — coordinated checkpointing then
+degrades to per-host behavior). Counters land in the resilience ledger
+under ``gang.*``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..core.flags import flag
+from ..core.resilience import (
+    Deadline,
+    InjectedFault,
+    PeerFailureError,
+    bump_counter,
+    inject,
+    logger,
+)
+
+__all__ = [
+    "GangContext", "PeerFailureDetector", "PeerFailureError",
+    "gang_context", "gang_barrier", "check_peers",
+    "set_active_detector", "get_active_detector", "reset_gang",
+    "GANG_STORE_ENV", "GENERATION_ENV",
+]
+
+GANG_STORE_ENV = "PADDLE_GANG_STORE"
+GENERATION_ENV = "PADDLE_ELASTIC_GENERATION"
+
+# store key (NOT generation-tagged: it must survive restarts) where rank 0
+# publishes the cluster-agreed checkpoint step after a commit barrier
+COMMITTED_STEP_KEY = "gang/ckpt/committed_step"
+# store key the launch() supervisor bumps at each re-rendezvous; a worker
+# observing a newer value than its own generation is a zombie from a dead
+# generation and must exit instead of corrupting the new gang's state
+GENERATION_KEY = "gang/gen"
+
+
+class GangContext:
+    """One process's view of the gang: shared store + (rank, world,
+    generation). Barrier names are made unique per call site via
+    ``next_seq`` — every rank calls the same barriers in the same order
+    (SPMD), so the per-name counters agree across the gang."""
+
+    def __init__(self, store, rank, world_size, generation=0):
+        self.store = store
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.generation = int(generation)
+        self._seq: dict[str, int] = {}
+        self._seq_lock = threading.Lock()
+
+    @property
+    def hb_prefix(self):
+        return f"gang/{self.generation}/hb"
+
+    def next_seq(self, name: str) -> int:
+        with self._seq_lock:
+            n = self._seq.get(name, 0)
+            self._seq[name] = n + 1
+            return n
+
+    def __repr__(self):
+        return (f"GangContext(rank={self.rank}/{self.world_size}, "
+                f"generation={self.generation})")
+
+
+_ctx_lock = threading.Lock()
+_ctx_cache: dict = {}
+_warned_no_native = False
+
+
+def gang_context():
+    """The ambient :class:`GangContext` from the launcher env
+    (``PADDLE_GANG_STORE`` + ``PADDLE_TRAINER_ID`` /
+    ``PADDLE_TRAINERS_NUM`` / ``PADDLE_ELASTIC_GENERATION``), or None
+    when this process is not part of a multi-process gang. Cached per
+    (endpoint, rank, world, generation); the store client lives for the
+    process."""
+    global _warned_no_native
+    endpoint = os.environ.get(GANG_STORE_ENV)
+    if not endpoint:
+        return None
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1") or 1)
+    if world < 2:
+        return None
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0") or 0)
+    gen = int(os.environ.get(GENERATION_ENV, "0") or 0)
+    key = (endpoint, rank, world, gen)
+    with _ctx_lock:
+        ctx = _ctx_cache.get(key)
+        if ctx is not None:
+            return ctx
+        from . import store as store_mod
+
+        host, _, port = endpoint.rpartition(":")
+        host = host or "127.0.0.1"
+        try:
+            port = int(port)
+        except ValueError:
+            logger.warning("malformed %s=%r; gang recovery disabled",
+                           GANG_STORE_ENV, endpoint)
+            return None
+        if (store_mod._native() is None
+                and (host, port) not in store_mod._py_stores):
+            # the pure-python fallback store is per-process: a gang store
+            # endpoint from ANOTHER process cannot be reached, and acting
+            # on its (empty) heartbeat view would declare every peer dead
+            if not _warned_no_native:
+                _warned_no_native = True
+                logger.warning(
+                    "PADDLE_GANG_STORE=%s set but the native TCPStore is "
+                    "unavailable; gang recovery disabled", endpoint)
+            return None
+        try:
+            store = store_mod.TCPStore(host, port, is_master=False,
+                                       timeout=10)
+        except (RuntimeError, ConnectionError, ValueError) as e:
+            bump_counter("gang.store_unreachable")
+            logger.warning("gang store %s unreachable (%s); gang recovery "
+                           "disabled", endpoint, e)
+            return None
+        ctx = GangContext(store, rank, world, gen)
+        _ctx_cache[key] = ctx
+        return ctx
+
+
+def guarded_store_op(op, describe=""):
+    """Run one gang-store operation through the ``store.partition`` fault
+    site. A partition (injected or real ConnectionError) is counted as
+    ``gang.store_partition`` and re-raised — callers degrade to per-host
+    behavior."""
+    try:
+        inject("store.partition")
+        return op()
+    except ConnectionError:
+        bump_counter("gang.store_partition")
+        raise
+
+
+# ------------------------------------------------------ failure detector
+
+class PeerFailureDetector:
+    """Watch the gang's heartbeat keys; raise within one lease of a death.
+
+    Each rank's :meth:`start` registers a daemon beat on the context's
+    generation-tagged prefix. :meth:`check` (throttled to the beat
+    interval) reads every peer's last beat: a peer whose beat is older
+    than ``lease`` — or that never appeared within the startup grace —
+    raises :class:`PeerFailureError` naming the rank and the blocked
+    ``phase``. It also watches the supervisor's generation key: a bumped
+    generation means THIS process is the zombie and must stand down.
+    """
+
+    def __init__(self, ctx: GangContext, lease=None, interval=None,
+                 grace=None, prefix=None):
+        self.ctx = ctx
+        # default: the context's generation-tagged prefix; overridable so
+        # other heartbeat schemes (ElasticManager's `{prefix}/host`) can
+        # feed the same fast-detection machinery
+        self.prefix = prefix or ctx.hb_prefix
+        self.lease = float(lease if lease is not None
+                           else flag("FLAGS_heartbeat_ttl"))
+        self.interval = float(interval if interval is not None
+                              else max(self.lease / 3.0, 0.05))
+        # a peer that NEVER beat is only dead once the gang had time to
+        # come up — generous, because interpreter+jax start is slow
+        self.grace = float(grace if grace is not None
+                           else max(4 * self.lease, 10.0))
+        self._hb = None
+        self._started_at = None
+        self._last_poll = None      # monotonic stamp of last store read
+        self._last_gen_check = None
+        self._cached_dead: list[int] = []
+        self._lock = threading.Lock()
+
+    def start(self):
+        self._hb = self.ctx.store.register_heartbeat(
+            self.ctx.rank, self.interval, prefix=self.prefix)
+        self._started_at = time.monotonic()
+        return self
+
+    def stop(self):
+        if self._hb is not None:
+            self._hb.stop(self.interval + 1)
+            self._hb = None
+
+    # -- internal: one throttled store sweep
+    def _poll(self, force=False):
+        now_mono = time.monotonic()
+        with self._lock:
+            if (not force and self._last_poll is not None
+                    and now_mono - self._last_poll < self.interval):
+                return list(self._cached_dead)
+            self._last_poll = now_mono
+        started = self._started_at or now_mono
+        dead = []
+        try:
+            def _sweep():
+                now = time.time()  # wall-clock: x-host (vs store beats)
+                out = []
+                for r in range(self.ctx.world_size):
+                    if r == self.ctx.rank:
+                        continue
+                    t = self.ctx.store.last_heartbeat(
+                        r, prefix=self.prefix)
+                    if t is None:
+                        if now_mono - started > self.grace:
+                            out.append(r)
+                    elif now - t > self.lease:
+                        out.append(r)
+                return out
+
+            dead = guarded_store_op(_sweep, "peer sweep")
+        except (ConnectionError, TimeoutError, RuntimeError) as e:
+            # a partitioned store is no EVIDENCE of a dead peer; stay
+            # quiet (counted by guarded_store_op) and keep the last view
+            logger.warning("peer sweep failed (%s); keeping last view", e)
+            with self._lock:
+                return list(self._cached_dead)
+        with self._lock:
+            self._cached_dead = list(dead)
+        return dead
+
+    def dead_peers(self, force=False):
+        return self._poll(force=force)
+
+    def _check_generation(self):
+        # same throttle as the heartbeat sweep: check() runs at every
+        # batch boundary / 50ms wait slice, and the generation only ever
+        # changes at a supervisor restart — don't hammer the store for it
+        now = time.monotonic()
+        with self._lock:
+            if (self._last_gen_check is not None
+                    and now - self._last_gen_check < self.interval):
+                return
+            self._last_gen_check = now
+        try:
+            store = self.ctx.store
+            if not guarded_store_op(
+                    lambda: store.check(GENERATION_KEY), "gen check"):
+                return
+            cur = int(guarded_store_op(
+                lambda: store.get(GENERATION_KEY), "gen read").decode())
+        except (ConnectionError, TimeoutError, RuntimeError, ValueError):
+            return
+        if cur > self.ctx.generation:
+            bump_counter("gang.stale_generation")
+            raise PeerFailureError(
+                f"gang moved to generation {cur} while this worker is "
+                f"still at {self.ctx.generation} — standing down",
+                rank=None, phase="stale-generation")
+
+    def check(self, phase="unknown"):
+        """Raise :class:`PeerFailureError` if a peer is dead, the
+        supervisor re-rendezvoused past this generation, or the
+        ``elastic.peer_dead`` fault site is armed; else no-op."""
+        _inject_peer_dead(phase)
+        dead = self._poll()
+        if dead:
+            bump_counter("gang.peer_dead")
+            raise PeerFailureError(
+                f"rank {dead[0]} stopped heartbeating (lease "
+                f"{self.lease:g}s) during phase {phase!r}"
+                + (f"; also dead: {dead[1:]}" if len(dead) > 1 else ""),
+                rank=dead[0], phase=phase)
+        self._check_generation()
+
+
+def _inject_peer_dead(phase):
+    try:
+        inject("elastic.peer_dead")
+    except InjectedFault as e:
+        bump_counter("gang.peer_dead")
+        raise PeerFailureError(
+            f"injected peer failure during phase {phase!r}",
+            rank=None, phase=phase) from e
+
+
+# ----------------------------------------------------- active detector
+
+_active_lock = threading.Lock()
+_active_detector: PeerFailureDetector | None = None
+
+
+def set_active_detector(det):
+    """Install ``det`` as the process-wide detector consulted by blocked
+    transports (collective._kv_fetch) and barrier waits. Returns the
+    previous detector so callers can restore it."""
+    global _active_detector
+    with _active_lock:
+        prev = _active_detector
+        _active_detector = det
+        return prev
+
+
+def get_active_detector():
+    with _active_lock:
+        return _active_detector
+
+
+def check_peers(phase="unknown"):
+    """Module-level peer check: consult the active detector when one is
+    installed, else just the ``elastic.peer_dead`` fault site (so
+    single-process drills exercise the recovery path without a store)."""
+    det = get_active_detector()
+    if det is not None:
+        return det.check(phase)
+    _inject_peer_dead(phase)
+
+
+# ------------------------------------------------------------- barrier
+
+def gang_barrier(name, ctx=None, timeout=None, poll=0.05, detector=None):
+    """Store-backed, generation-tagged barrier over the whole gang.
+
+    Every rank bumps ``gang/{gen}/barrier/{name}/n``; the last arrival
+    publishes the go key and everyone proceeds. While waiting, the
+    detector (the active one unless ``detector`` is given) is polled —
+    a dead peer raises :class:`PeerFailureError` within about one lease
+    instead of the barrier hanging for ``timeout`` (default
+    ``FLAGS_gang_barrier_timeout``). Barrier names are single-use within
+    a generation: a failed barrier's partial count is abandoned, never
+    retried under the same name.
+
+    No-op when there is no gang (``ctx`` is None and no launcher env) or
+    the gang has one member. Store unreachability (including the
+    ``store.partition`` fault site) raises ``ConnectionError``.
+    """
+    ctx = ctx if ctx is not None else gang_context()
+    if ctx is None or ctx.world_size < 2:
+        return
+    if timeout is None:
+        timeout = flag("FLAGS_gang_barrier_timeout")
+    det = detector if detector is not None else get_active_detector()
+    store = ctx.store
+    key = f"gang/{ctx.generation}/barrier/{name}"
+    n = guarded_store_op(lambda: store.add(f"{key}/n", 1),
+                         f"barrier {name} arrive")
+    if n >= ctx.world_size:
+        guarded_store_op(lambda: store.set(f"{key}/go", b"1"),
+                         f"barrier {name} release")
+        return
+    deadline = Deadline.after(timeout)
+    phase = f"gang_barrier:{name}"
+    while True:
+        if guarded_store_op(lambda: store.check(f"{key}/go"),
+                            f"barrier {name} wait"):
+            return
+        if det is not None:
+            det.check(phase)
+        else:
+            _inject_peer_dead(phase)
+        if deadline.expired():
+            bump_counter("gang.barrier_timeout")
+            raise PeerFailureError(
+                f"gang barrier {name!r} (generation {ctx.generation}) "
+                f"timed out after {timeout:g}s with {n}/{ctx.world_size} "
+                "arrivals and no dead peer identified",
+                rank=None, phase=phase)
+        time.sleep(poll)
+
+
+def reset_gang():
+    """Forget cached contexts and the active detector (test teardown)."""
+    global _warned_no_native
+    with _active_lock:
+        global _active_detector
+        _active_detector = None
+    with _ctx_lock:
+        _ctx_cache.clear()
+    _warned_no_native = False

@@ -1,4 +1,4 @@
-"""paddle_tpu.distributed.launch — the process launcher.
+"""paddle_tpu.distributed.launch — the process launcher / supervisor.
 
 Analog of /root/reference/python/paddle/distributed/launch/ (main.py:23,
 controllers/collective.py, controllers/master.py): rendezvous via a KV
@@ -12,9 +12,34 @@ workers use it for barrier/endpoint exchange, mirroring HTTPMaster/
 ETCDMaster. On TPU pods each *process* drives one host's chips
 (multi-controller jax), so nproc_per_node maps to hosts-per-node rather
 than chips.
+
+Supervisor duties (the gang-recovery layer, reference ElasticManager
+fault tolerance at fleet/elastic/manager.py:457):
+
+* a dedicated **gang store** (exported as ``PADDLE_GANG_STORE``) carries
+  worker heartbeats, gang barriers, and the cluster-agreed checkpoint
+  ``committed_step`` — it lives in the supervisor, so it survives every
+  worker death and restart;
+* each generation publishes a **rendezvous key** (``gang/gen``) before
+  workers start: gang keys are generation-tagged, and a zombie worker
+  from a dead generation that observes a newer value stands down instead
+  of corrupting the new gang's state;
+* worker exits are **classified** — clean (0), preempted-and-checkpointed
+  (143 = 128+SIGTERM, the ``fit(elastic=True)``/SIGTERM contract), or
+  crashed (anything else) — and surviving workers get a **drain grace**
+  to detect the death themselves, checkpoint once, and exit 143 before
+  the pod is torn down;
+* restarts draw from a **rolling budget** (``max_restarts`` failures per
+  ``restart_window`` seconds) with **exponential backoff** between
+  generations, and the failed worker's log tail is replayed to stderr so
+  the failure is diagnosable from the supervisor alone.
+
+The deterministic fault site ``launch.worker_crash`` kills one live
+worker from the watch loop, drilling the whole restart path.
 """
 from __future__ import annotations
 
+import logging
 import os
 import signal
 import subprocess
@@ -22,6 +47,8 @@ import sys
 import time
 
 __all__ = ["launch", "Pod"]
+
+logger = logging.getLogger("paddle_tpu.launch")
 
 
 class Pod:
@@ -57,8 +84,10 @@ class Pod:
             })
             cmd = [sys.executable, self.entry, *self.entry_args]
             if self.log_dir:
+                # append: a restarted generation must not truncate the
+                # failed generation's diagnostics out of existence
                 log = open(os.path.join(self.log_dir, f"worker.{rank}.log"),
-                           "w")
+                           "a")
                 self.log_files.append(log)
                 proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
             else:
@@ -92,14 +121,62 @@ class Pod:
         self.log_files.clear()
 
 
+def _classify_exit(rc):
+    """clean / preempted (checkpointed, restartable) / crashed."""
+    if rc == 0:
+        return "clean"
+    if rc == 143:  # 128 + SIGTERM: the checkpoint-once-then-exit contract
+        return "preempted"
+    return "crashed"
+
+
+def _log_tail(log_dir, rank, tail_lines):
+    """Replay the failed worker's last log lines through the supervisor's
+    stderr so the failure is diagnosable without chasing per-rank files."""
+    if not log_dir or tail_lines <= 0:
+        return
+    path = os.path.join(log_dir, f"worker.{rank}.log")
+    try:
+        import collections
+
+        with open(path, errors="replace") as f:
+            # bounded: logs append across generations and can grow large;
+            # never slurp the whole file to print the last few lines
+            tail = list(collections.deque(f, maxlen=tail_lines))
+    except OSError:
+        return
+    if tail:
+        logger.error("last %d line(s) of %s:\n%s", len(tail), path,
+                     "".join(tail).rstrip("\n"))
+
+
 def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
-           max_restarts=0, env=None, elastic_np=None):
+           max_restarts=0, env=None, elastic_np=None, restart_window=None,
+           backoff_base=0.5, backoff_cap=30.0, poll_interval=0.2,
+           drain_grace=5.0, tail_lines=20):
     """Run ``entry`` as ``nproc_per_node`` ranked worker processes.
 
     Returns 0 on success. Reference flow (launch/main.py → CollectiveController
     → Pod): start a TCPStore master, spawn ranked workers, watch; on worker
     failure stop the pod and (if restarts remain) relaunch everyone —
     elastic manager semantics (fleet/elastic/manager.py ElasticManager:125).
+
+    Supervisor knobs:
+
+    * ``max_restarts`` failures are tolerated — within a rolling
+      ``restart_window`` seconds when set (None = over the whole run,
+      the legacy counter), with ``backoff_base * 2**n`` seconds (capped
+      at ``backoff_cap``) between generations;
+    * exit codes are classified (0 clean / 143 preempted-checkpointed /
+      crashed) and the failed worker's last ``tail_lines`` log lines are
+      replayed to stderr;
+    * after a failure, surviving workers get ``drain_grace`` seconds to
+      notice the dead peer (gang heartbeats), checkpoint once, and exit
+      143 on their own before the pod is stopped;
+    * the watch loop polls every ``poll_interval`` seconds;
+    * a supervisor-owned gang store is exported as ``PADDLE_GANG_STORE``
+      (native TCPStore only) and the per-generation rendezvous key
+      ``gang/gen`` is published before each generation starts.
 
     ``elastic_np=(np_min, np_max)`` enables scale-in/out re-rendezvous
     (manager.py _update_fault_tolerance:457): after a worker failure the
@@ -108,14 +185,28 @@ def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
     scale-out request (``request_scale_out``, e.g. from a recovered host)
     grows the next generation toward np_max.
     """
-    from ..store import TCPStore
+    from ...core.resilience import InjectedFault, bump_counter, inject
+    from ..gang import GANG_STORE_ENV, GENERATION_KEY
+    from ..store import TCPStore, _native
 
     store = None
     if master is None:
         store = TCPStore(is_master=True)
         master = f"127.0.0.1:{store.port}"
 
+    gang_store = None
+    if _native() is not None:
+        # the gang store must be reachable from OTHER processes; the pure
+        # python fallback is in-process only, so export nothing without
+        # the native transport (workers then run without gang recovery)
+        try:
+            gang_store = TCPStore(is_master=True)
+        except RuntimeError as e:
+            logger.warning("cannot start gang store (%s); gang recovery "
+                           "disabled for this job", e)
+
     restarts = 0
+    failure_stamps: list[float] = []
     nproc = nproc_per_node
     generation = 0
     scale_store = store  # client connection created lazily for external masters
@@ -124,32 +215,70 @@ def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
         while True:
             gen_env = dict(env or {})
             gen_env["PADDLE_ELASTIC_GENERATION"] = str(generation)
+            if gang_store is not None:
+                gen_env[GANG_STORE_ENV] = f"127.0.0.1:{gang_store.port}"
+                # rendezvous key: gang state (heartbeats, barriers) is
+                # generation-tagged, and a zombie from an older generation
+                # observing this newer value stands down
+                gang_store.set(GENERATION_KEY, str(generation).encode())
             pod = Pod(nproc, entry, list(entry_args), master,
                       log_dir=log_dir, env=gen_env)
             pod.start()
             while True:
                 status = pod.poll()
                 if status is None:
-                    time.sleep(0.2)
+                    try:
+                        inject("launch.worker_crash")
+                    except InjectedFault:
+                        victim = pod.procs[-1]
+                        if victim.poll() is None:
+                            logger.warning(
+                                "injected worker crash: killing rank %d "
+                                "(generation %d)", nproc - 1, generation)
+                            victim.kill()
+                    time.sleep(poll_interval)
                     continue
                 rank, rc = status
                 break
             if rc == 0:
                 return 0
+            kind = _classify_exit(rc)
+            bump_counter(f"gang.worker_{kind}")
+            # drain: let survivors detect the death via gang heartbeats,
+            # checkpoint once, and exit 143 themselves — SIGTERMing them
+            # instantly would race their own PeerFailureError handling
+            drain_deadline = time.monotonic() + max(drain_grace, 0.0)
+            while (time.monotonic() < drain_deadline
+                   and any(p.poll() is None for p in pod.procs)):
+                time.sleep(poll_interval)
+            # a host whose worker is still running, exited clean, or
+            # exited 143 (checkpointed, restartable) survives into the
+            # next generation's world
             survivors = sum(1 for p in pod.procs
-                            if p.poll() in (None, 0))
+                            if p.poll() in (None, 0, 143))
             pod.stop()
-            if restarts >= max_restarts:
-                print(f"[launch] worker {rank} failed with code {rc}; "
-                      f"no restarts left", file=sys.stderr)
+            _log_tail(log_dir, rank, tail_lines)
+            now = time.monotonic()
+            if restart_window is not None:
+                failure_stamps[:] = [t for t in failure_stamps
+                                     if now - t < restart_window]
+                used = len(failure_stamps)
+                budget = (f"{used}/{max_restarts} restarts in the last "
+                          f"{restart_window:g}s")
+            else:
+                used = restarts
+                budget = f"{used}/{max_restarts} restarts"
+            if used >= max_restarts:
+                logger.error("worker %d %s (exit code %d); restart budget "
+                             "exhausted (%s)", rank, kind, rc, budget)
                 return rc
+            failure_stamps.append(now)
             restarts += 1
             generation += 1
+            backoff = min(backoff_base * (2 ** (restarts - 1)), backoff_cap)
             if elastic_np is not None:
                 np_min, np_max = elastic_np
                 if scale_store is None:
-                    from ..store import TCPStore
-
                     try:
                         host, port = master.rsplit(":", 1)
                         scale_store = TCPStore(host=host, port=int(port),
@@ -160,18 +289,25 @@ def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
                 want = _pending_scale_out(scale_store)
                 new_n = max(min(max(survivors, want), np_max), np_min)
                 if new_n != nproc:
-                    print(f"[launch] elastic re-rendezvous: world "
-                          f"{nproc} -> {new_n} (generation {generation})",
-                          file=sys.stderr)
+                    logger.warning("elastic re-rendezvous: world %d -> %d "
+                                   "(generation %d)", nproc, new_n,
+                                   generation)
                 nproc = new_n
                 if survivors < np_min and want == 0:
-                    print(f"[launch] only {survivors} survivors < np_min "
-                          f"{np_min}; relaunching at np_min", file=sys.stderr)
-            print(f"[launch] worker {rank} failed (code {rc}); restart "
-                  f"{restarts}/{max_restarts}", file=sys.stderr)
+                    logger.warning("only %d survivors < np_min %d; "
+                                   "relaunching at np_min", survivors,
+                                   np_min)
+            logger.warning("worker %d %s (exit code %d); restarting as "
+                           "generation %d after %.2fs backoff (%s used)",
+                           rank, kind, rc, generation, backoff, budget)
+            bump_counter("gang.restart")
+            if backoff > 0:
+                time.sleep(backoff)
     finally:
         if owns_scale_store and scale_store is not None:
             scale_store.close()
+        if gang_store is not None:
+            gang_store.close()
         if store is not None:
             store.close()
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import multiprocessing
 import os
+import time
 import traceback
 
 __all__ = ["spawn", "MultiprocessContext"]
@@ -76,18 +77,29 @@ class MultiprocessContext:
             self._tracebacks[rank] = tb
 
     def join(self, timeout=None):
-        import time as _t
-
-        deadline = None if timeout is None else _t.time() + timeout
+        # MONOTONIC deadline: an NTP step during a long join must not
+        # expire (or extend) the caller's wall-clock budget
+        deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             self._drain()
             alive = [p for p in self.processes if p.exitcode is None]
             if not alive:
                 break
-            if deadline is not None and _t.time() >= deadline:
+            if deadline is not None and time.monotonic() >= deadline:
                 break
             alive[0].join(0.1)
         self._drain()
+        still_alive = [i for i, p in enumerate(self.processes)
+                       if p.exitcode is None]
+        if still_alive:
+            # a timed-out join is a reportable outcome, not a silent one:
+            # the caller sees False AND the ledger/log name the stragglers
+            from ..core.resilience import bump_counter, logger
+
+            bump_counter("spawn.join_timeout")
+            logger.warning(
+                "spawn join timed out after %ss; workers still alive: "
+                "ranks %s", timeout, still_alive)
         failed = [(p, i) for i, p in enumerate(self.processes)
                   if p.exitcode not in (0, None)]
         if failed:

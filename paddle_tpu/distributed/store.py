@@ -228,6 +228,28 @@ class TCPStore:
         return self._retrying("store_set", _op)
 
     def get(self, key: str) -> bytes:
+        """Blocking get under ``self.timeout``. The native GET blocks
+        SERVER-side until the key exists with no wire timeout, so a key
+        a dead peer was supposed to write would hang this client past
+        every budget; instead the wait is a cheap non-blocking check()
+        poll that (a) honors the store timeout like the python fallback
+        does and (b) consults the active gang PeerFailureDetector
+        between slices — a dead peer surfaces as ``PeerFailureError``
+        within one heartbeat lease instead of a 900s wedge."""
+        from . import gang
+
+        deadline = Deadline.after(self.timeout)
+        poll = 0.05
+        while not self.check(key):
+            det = gang.get_active_detector()
+            if det is not None:
+                det.check(f"store_get {key}")
+            if deadline.expired():
+                raise TimeoutError(
+                    f"TCPStore.get({key!r}) timed out "
+                    f"after {self.timeout}s")
+            time.sleep(poll)
+
         def _op():
             if self._py is not None:
                 return self._py.get(key, self.timeout)
@@ -246,8 +268,7 @@ class TCPStore:
                     raise ConnectionError("TCPStore.get failed")
             return buf.raw[:n]
 
-        return self._retrying("store_get", _op,
-                              deadline=Deadline.after(self.timeout))
+        return self._retrying("store_get", _op, deadline=deadline)
 
     def add(self, key: str, delta: int) -> int:
         def _op():

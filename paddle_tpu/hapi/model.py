@@ -213,7 +213,7 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             shuffle=True, callbacks=None, num_workers=0,
             resume=False, checkpoint_dir=None, checkpoint_freq=None,
-            keep_checkpoints=3):
+            keep_checkpoints=3, elastic=False):
         """Train. Fault-tolerance knobs:
 
         * ``checkpoint_dir``: save step-numbered training snapshots here
@@ -226,10 +226,26 @@ class Model:
           ``checkpoint_dir`` (no-op when none exists) and continue from
           the exact epoch/step — mid-epoch included.
         * ``keep_checkpoints``: prune to the newest K complete snapshots.
+        * ``elastic=True``: gang-recovery mode for supervised
+          multi-process runs (``distributed.launch``); requires a
+          ``checkpoint_dir`` SHARED by all ranks (snapshots use the
+          gang shard layout — one directory, per-gang-rank files). A
+          ``PeerFailureDetector`` heartbeats the supervisor's gang store
+          and is checked at every batch boundary (and by blocked
+          collectives); a dead peer raises ``PeerFailureError`` within
+          one heartbeat lease, whereupon fit reuses the SIGTERM
+          checkpoint-once path and exits 143 so the supervisor restarts
+          the gang at a bumped generation. Periodic snapshots run the
+          coordinated commit protocol (``committed_step`` published to
+          the gang store) and ``resume=True`` resolves the
+          cluster-agreed step so every rank restarts at the same global
+          step. The ``elastic.peer_dead`` fault site drills the whole
+          path deterministically.
         """
         from ..core import random as framework_random
         from ..core.health import get_health_monitor
-        from ..core.resilience import InjectedFault, inject
+        from ..core.resilience import InjectedFault, PeerFailureError, inject
+        from ..distributed import gang as gang_mod
         from ..io import DataLoader, Dataset
 
         if isinstance(train_data, Dataset):
@@ -246,10 +262,31 @@ class Model:
 
         if resume and not checkpoint_dir:
             raise ValueError("fit(resume=True) requires checkpoint_dir=")
+        if elastic and not checkpoint_dir:
+            raise ValueError("fit(elastic=True) requires checkpoint_dir=")
+
+        detector, prev_detector = None, None
+        if elastic:
+            ctx = gang_mod.gang_context()
+            if ctx is not None:
+                detector = gang_mod.PeerFailureDetector(ctx).start()
+                prev_detector = gang_mod.set_active_detector(detector)
+
         start_epoch, skip_steps, global_step = 0, 0, 0
         resume_epoch_rng = None
         if resume:
-            restored = self._restore_training_snapshot(checkpoint_dir)
+            try:
+                restored = self._restore_training_snapshot(
+                    checkpoint_dir, coordinated=elastic)
+            except BaseException:
+                # the detector is already installed process-wide but the
+                # cleanup try/finally hasn't started: don't leak the
+                # heartbeat thread (or a stale global detector) on a
+                # failed restore
+                if detector is not None:
+                    gang_mod.set_active_detector(prev_detector)
+                    detector.stop()
+                raise
             if restored is not None:
                 start_epoch, skip_steps, global_step, resume_epoch_rng = \
                     restored
@@ -268,11 +305,42 @@ class Model:
             except ValueError:  # not the main thread
                 pass
 
-        def _snapshot(epoch, step_in_epoch, epoch_rng):
-            return self._save_training_snapshot(
+        def _snapshot(epoch, step_in_epoch, epoch_rng, emergency=False):
+            # periodic elastic snapshots run the coordinated commit (all
+            # ranks barrier, rank 0 publishes committed_step); emergency
+            # ones (preemption, peer death) save FIRST, then attempt the
+            # commit with a short budget. The step-keyed barrier name
+            # makes this deliberately conservative: it publishes only
+            # when every rank saved the SAME step (a step-aligned
+            # whole-pod preemption), and fails fast otherwise — skewed
+            # ranks or a dead peer leave the step uncommitted debris
+            # below the last agreed step, never a wrong agreement
+            path = self._save_training_snapshot(
                 checkpoint_dir, epoch, step_in_epoch, global_step,
-                epoch_rng, keep=keep_checkpoints)
+                epoch_rng, keep=keep_checkpoints,
+                coordinated=elastic and not emergency,
+                gang_layout=elastic)
+            if elastic and emergency:
+                import contextlib
 
+                from ..core.resilience import PeerFailureError as _PFE
+                from ..distributed import checkpoint as dckpt
+
+                with contextlib.suppress(_PFE):
+                    dckpt.commit_snapshot(
+                        checkpoint_dir, global_step,
+                        timeout=(2 * detector.lease if detector is not None
+                                 else 5.0),
+                        detector=detector,
+                        # fresh barrier name: a retry on the periodic
+                        # name would count its own earlier arrival and
+                        # publish a snapshot the dead peer never wrote
+                        barrier_name=f"ckpt_emergency/{int(global_step)}")
+            return path
+
+        # last completed batch boundary (epoch, next step, epoch RNG) —
+        # where the PeerFailureError handler checkpoints from
+        cursor = None
         history = []
         try:
             for cb in cbs:
@@ -316,25 +384,33 @@ class Model:
                     epoch_rng = framework_random.get_rng_state()
                     data_iter = iter(train_data)
                     first_step = 0
+                cursor = (epoch, first_step, epoch_rng)
                 for step, batch in enumerate(data_iter, start=first_step):
                     ins, lab = self._split(batch)
                     logs = self.train_batch(ins, lab)
                     global_step += 1
+                    cursor = (epoch, step + 1, epoch_rng)
                     monitor.record_loss(logs.get("loss"), step=global_step)
                     for m in self._metrics:
                         logs[_name(m)] = _scalar(m.accumulate())
                     for cb in cbs:
                         cb.on_train_batch_end(step, logs)
+                    if elastic:
+                        # one lease after a peer dies this raises
+                        # PeerFailureError -> checkpoint-once -> exit 143
+                        gang_mod.check_peers(f"train step {global_step}")
                     if checkpoint_dir:
                         if preempt["signaled"]:
-                            _snapshot(epoch, step + 1, epoch_rng)
+                            _snapshot(epoch, step + 1, epoch_rng,
+                                      emergency=True)
                             raise SystemExit(143)  # 128 + SIGTERM
                         try:
                             inject("fit.preempt")
                         except InjectedFault:
                             # simulated preemption: same
                             # checkpoint-once-then-die path as SIGTERM
-                            _snapshot(epoch, step + 1, epoch_rng)
+                            _snapshot(epoch, step + 1, epoch_rng,
+                                      emergency=True)
                             raise
                         if (checkpoint_freq
                                 and global_step % checkpoint_freq == 0):
@@ -354,7 +430,27 @@ class Model:
                     break
             for cb in cbs:
                 cb.on_train_end()
+        except PeerFailureError as e:
+            if not elastic:
+                raise
+            # gang broken: reuse the SIGTERM checkpoint-once path and
+            # exit 143 — the launch() supervisor classifies that as
+            # "preempted (checkpointed)" and restarts the gang at a
+            # bumped generation, which resumes from the cluster-agreed
+            # committed step
+            from ..core.resilience import bump_counter, logger as _rlog
+
+            bump_counter("gang.elastic_exit")
+            _rlog.warning("peer failure during training (%s); "
+                          "checkpointing once and exiting 143 for "
+                          "supervised restart", e)
+            if cursor is not None:
+                _snapshot(*cursor, emergency=True)
+            raise SystemExit(143) from e
         finally:
+            if detector is not None:
+                gang_mod.set_active_detector(prev_detector)
+                detector.stop()
             if handler_installed:
                 import contextlib
 
@@ -377,12 +473,15 @@ class Model:
         return arrays
 
     def _save_training_snapshot(self, checkpoint_dir, epoch, step_in_epoch,
-                                global_step, epoch_rng, keep=None):
+                                global_step, epoch_rng, keep=None,
+                                coordinated=False, gang_layout=False):
         """One crash-safe snapshot at ``global_step``: sharded arrays via
         ``distributed.checkpoint.save_snapshot`` + a ``trainer_state.json``
         (epoch/step cursor, RNG states, optimizer step count, GradScaler
         and LR-scheduler state). The json lands BEFORE the shard commit
-        marker, so a snapshot is readable iff it is complete."""
+        marker, so a snapshot is readable iff it is complete. With
+        ``coordinated``, the gang's commit barrier runs after the shards
+        land and rank 0 publishes the cluster-agreed step."""
         from ..core import random as framework_random
         from ..distributed import checkpoint as dckpt
         from ..optimizer.lr import LRScheduler
@@ -407,19 +506,23 @@ class Model:
         dckpt._atomic_json(trainer,
                            os.path.join(path, "trainer_state.json"))
         dckpt.save_snapshot(self._training_state_arrays(), checkpoint_dir,
-                            global_step, keep=keep)
+                            global_step, keep=keep, coordinated=coordinated,
+                            gang_layout=gang_layout)
         return path
 
-    def _restore_training_snapshot(self, checkpoint_dir):
+    def _restore_training_snapshot(self, checkpoint_dir, coordinated=False):
         """Load the newest complete snapshot into the live network,
-        optimizer, scaler, LR scheduler, and framework RNG. Returns
+        optimizer, scaler, LR scheduler, and framework RNG (with
+        ``coordinated``, the cluster-agreed committed step instead of
+        this host's newest-complete view). Returns
         ``(epoch, step_in_epoch, global_step, epoch_start_rng)`` or None
         when no snapshot exists yet (fresh start)."""
         from ..core import random as framework_random
         from ..distributed import checkpoint as dckpt
         from ..optimizer.lr import LRScheduler
 
-        newest = dckpt.latest_complete_snapshot(checkpoint_dir)
+        newest = dckpt.latest_complete_snapshot(checkpoint_dir,
+                                                coordinated=coordinated)
         if newest is None:
             return None
         saved_keys = set(dckpt._merged_metadata(newest))
@@ -437,7 +540,8 @@ class Model:
                 if isinstance(v, Tensor) and f"opt.{k}" in saved_keys:
                     opt_target[f"opt.{k}"] = v
             target.update(opt_target)
-        path = dckpt.load_latest_snapshot(target, checkpoint_dir)
+        path = dckpt.load_latest_snapshot(target, checkpoint_dir,
+                                          coordinated=coordinated)
         if opt_target:
             opt.set_state_dict(
                 {k[len("opt."):]: v for k, v in opt_target.items()})

@@ -29,6 +29,15 @@ _BARE = re.compile(
 
 _WALL_CLOCK = re.compile(r"\btime\.time\(\)")
 
+# aliased forms evade the time.time() grep — `import time as _t` then
+# `_t.time()` (the historical spawn.py offender), or `from time import
+# time` then a bare `time()`. Banning the import forms themselves keeps
+# every wall-clock call greppable as literal `time.time()`.
+_WALL_CLOCK_ALIAS = re.compile(
+    r"^[ \t]*(?:import[ \t]+time[ \t]+as[ \t]+\w+"
+    r"|from[ \t]+time[ \t]+import[ \t]+(?:\(?[\w \t,]*\btime\b))",
+    re.M)
+
 _NO_BARE_EXCEPT_DIRS = ("distributed", "io", "amp", "hapi", "models")
 _MONOTONIC_ONLY_DIRS = ("core", "io", "amp", "hapi", "models",
                         "distributed")
@@ -69,3 +78,13 @@ def test_no_wall_clock_for_deadline_math(subdir):
         "must use time.monotonic() so an NTP step can't expire every "
         "in-flight budget (cross-host store timestamps may opt out with "
         f"a '{_PRAGMA}' pragma): {offenders}")
+
+
+@pytest.mark.parametrize("subdir", _MONOTONIC_ONLY_DIRS)
+def test_no_aliased_wall_clock_imports(subdir):
+    offenders = _offenders(subdir, _WALL_CLOCK_ALIAS, pragma=_PRAGMA)
+    assert not offenders, (
+        f"aliased time import under paddle_tpu/{subdir}/ (`import time "
+        "as ...` / `from time import time`) hides wall-clock calls from "
+        "the time.time() guard — import the module plainly so every "
+        f"wall-clock use is greppable: {offenders}")
