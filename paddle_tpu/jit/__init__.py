@@ -30,7 +30,42 @@ from ..core.tensor import Tensor, TracedConcretizationError
 __all__ = [
     "to_static", "TrainStep", "cond", "while_loop", "scan",
     "ignore_module", "not_to_static", "StaticFunction",
+    "enable_compilation_cache",
 ]
+
+
+def enable_compilation_cache(cache_dir, min_compile_time_s=0.0):
+    """Wire JAX's persistent compilation cache at ``cache_dir`` so
+    compiled programs (including the serving engine's AOT ``warmup()``
+    shapes) survive process restarts — a restarted server replays its
+    warmup from disk instead of re-invoking XLA per shape.
+
+    ``min_compile_time_s=0.0`` caches even sub-second programs (the
+    default JAX threshold would skip the small per-width prefill shapes).
+    Safe to call repeatedly; later calls just repoint the directory.
+    Returns the directory wired in."""
+    jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    for opt, val in (
+            ("jax_persistent_cache_min_compile_time_secs",
+             float(min_compile_time_s)),
+            ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(opt, val)
+        except Exception:
+            # knob absent in this jax build: the cache still works with
+            # its defaults
+            pass
+    try:
+        # jax latches cache initialization at the FIRST compile of the
+        # process: if anything compiled before this call (it always has —
+        # model init alone compiles), the new directory is silently
+        # ignored until the cache is reset
+        from jax._src import compilation_cache as _cc
+
+        _cc.reset_cache()
+    except Exception:
+        pass
+    return str(cache_dir)
 
 
 # ------------------------------------------------------------ traced RNG

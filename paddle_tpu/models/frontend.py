@@ -26,7 +26,15 @@ scheduler and how the system degrades when it is saturated or broken:
 The frontend is a synchronous pump: callers ``submit()`` whenever
 requests arrive and drive progress with ``step()`` (one admit → decode →
 retire turn) or ``results(wait=True)`` (pump until everything pending has
-resolved). Request statuses:
+resolved). With the engine's overlapped scheduler
+(``FLAGS_serving_pipeline``, default on) each pumped turn dispatches the
+NEXT decode segment before consuming the previous one, so results arrive
+one segment behind the device — admission control, poison bisection,
+deadlines, and the circuit breaker are unchanged because the engine
+drains its pipeline before any admission, bisection replay, or
+mask-changing retirement. ``warmup()`` (delegated to the engine)
+AOT-compiles every serving shape so the first request pays no compile
+time. Request statuses:
 ``ok | timed_out | rejected | failed | cancelled | unavailable``.
 """
 from __future__ import annotations
@@ -115,7 +123,15 @@ class ServingFrontend:
         self._seq = itertools.count()
         self._draining = False
         self._closed = False
+        self._segment = int(segment)
         engine.start(segment=segment)
+
+    def warmup(self, cache_dir=None):
+        """AOT-compile every engine shape at THIS frontend's segment
+        length (see ``ContinuousBatchingEngine.warmup``) so the first
+        submitted request hits only precompiled programs."""
+        return self.engine.warmup(segment=self._segment,
+                                  cache_dir=cache_dir)
 
     # ------------------------------------------------------------ admission
 

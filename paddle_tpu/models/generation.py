@@ -47,6 +47,20 @@ def _sample(logits, temperature, top_k, top_p, greedy):
     return _sample_with_key(logits, key, temperature, top_k, top_p, greedy)
 
 
+def _sample_rows(logits, keys, temperature, top_k, top_p, greedy):
+    """Per-row sampling: row i of ``logits`` (N, V) is drawn with ITS OWN
+    key from ``keys`` ((N,) + key-data shape) — the batched form the
+    serving engine uses for per-request key streams, so a row's tokens
+    never depend on who it was batched with. Greedy ignores the keys
+    entirely (callers pass cached zeros)."""
+    if greedy:
+        return jnp.argmax(logits, axis=-1)
+    typed = jax.random.wrap_key_data(keys)
+    return jax.vmap(
+        lambda lg, k: _sample_with_key(lg, k, temperature, top_k, top_p,
+                                       False))(logits, typed)
+
+
 def _make_static_cache(k, v, length):
     from .llama import StaticCache
 

@@ -10,28 +10,53 @@ and readmit between segments — so the chip never drains to serve one
 straggler.
 
 TPU-native shape: everything device-side is a fixed-shape compiled
-program. One prefill program per prompt-length bucket writes a new
-request's KV into its slot's pages (batch-1, donated pools). ONE decode
-program scans a segment of steps over the full slot batch, with
-per-slot lengths driving paged attention, per-slot rope positions, and an
-active mask freezing finished slots. The host only admits/retires between
-segments — the vLLM-style loop, expressed as jit + scan instead of a
-kernel-launch scheduler.
+program. Prefill programs per (prompt-length bucket x admission group
+width) write new requests' KV into their slots' pages (power-of-two
+widths, donated pools — a single admission pays a width-1 forward, not a
+``max_slots``-wide one). ONE decode program scans a segment of steps over
+the full slot batch, with per-slot lengths driving paged attention,
+per-slot rope positions, and an active mask freezing finished slots.
+
+The host loop is an OVERLAPPED scheduler (the ragged-paged-attention
+serving discipline): segment N+1 is dispatched from segment N's DEVICE
+outputs (token/lengths/active carry — no host round trip) while the host
+consumes N's results, so the chip stays busy through host bookkeeping.
+Whenever the host changes the slot mask in a way the device cannot see
+(admission, abort, deadline retirement), the pipeline drains and the next
+dispatch is a synchronous turn from host state. Sampling uses PER-REQUEST
+key streams — a pure function of (engine seed, rid, token index) — so a
+speculatively dispatched segment, a bisection replay, and the serial
+schedule all emit bit-identical tokens. ``FLAGS_serving_pipeline=0``
+selects the serial one-segment-at-a-time loop.
+
+``warmup()`` AOT-compiles (``jit(...).lower().compile()``) every declared
+(bucket x group-width) prefill shape plus the chunked-prefill and
+decode-segment programs, and can wire JAX's persistent compilation cache,
+so first-request latency and ``stats()`` throughput stop absorbing
+compile time.
 """
 from __future__ import annotations
 
 import time
+import zlib
 from collections import deque
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core.flags import define_flag, flag
 from ..core.resilience import Deadline, InjectedFault, bump_counter, inject
 from ..core.tensor import Tensor
-from .generation import _make_paged_cache, _sample_with_key
+from ..profiler import annotate
+from .generation import _make_paged_cache, _sample_rows
 
 __all__ = ["ContinuousBatchingEngine", "Request"]
+
+define_flag("FLAGS_serving_pipeline", True,
+            "Overlap host bookkeeping with the next compiled decode "
+            "segment in ContinuousBatchingEngine (0 = serial fallback: "
+            "dispatch, wait, consume, one segment at a time)")
 
 
 class Request:
@@ -73,6 +98,21 @@ def _bucket(n, buckets):
     raise ValueError(f"prompt length {n} exceeds largest bucket {buckets[-1]}")
 
 
+# splitmix64 constants for the per-request key streams: a vectorized
+# counter-based hash (pure uint64 arithmetic, stable across numpy
+# versions) instead of per-token SeedSequence objects, which would put
+# O(segment x slots) Python-object work on the dispatch critical path
+_SM64_A = np.uint64(0x9E3779B97F4A7C15)
+_SM64_B = np.uint64(0xBF58476D1CE4E5B9)
+_SM64_C = np.uint64(0x94D049BB133111EB)
+
+
+def _mix64(x):
+    x = (x ^ (x >> np.uint64(30))) * _SM64_B
+    x = (x ^ (x >> np.uint64(27))) * _SM64_C
+    return x ^ (x >> np.uint64(31))
+
+
 class ContinuousBatchingEngine:
     """Mixed-length generation over ``max_slots`` concurrent sequences.
 
@@ -85,13 +125,17 @@ class ContinuousBatchingEngine:
     Usage::
 
         eng = ContinuousBatchingEngine(model, max_slots=8, max_len=512)
+        eng.warmup(segment=16)   # optional: AOT-compile every shape
         outs, stats = eng.run(prompts, max_new_tokens=64, segment=16)
+
+    ``pipeline=None`` (default) follows ``FLAGS_serving_pipeline``;
+    ``pipeline=False`` forces the serial scheduler for this engine.
     """
 
     def __init__(self, model, max_slots, max_len, page_size=128,
                  do_sample=False, temperature=1.0, top_k=None, top_p=None,
                  eos_token_id=None, prompt_buckets=(16, 32, 64, 128),
-                 seed=0):
+                 seed=0, pipeline=None):
         from ..jit import _FunctionalModel
 
         model.eval()
@@ -110,6 +154,7 @@ class ContinuousBatchingEngine:
         self.top_p = top_p
         self.eos_token_id = eos_token_id
         self.prompt_buckets = tuple(sorted(prompt_buckets))
+        self.pipeline_opt = pipeline
         kv = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
         try:
             dtype = next(iter(model.parameters()))._value.dtype
@@ -117,11 +162,12 @@ class ContinuousBatchingEngine:
             dtype = jnp.float32
         per_seq = self.max_len // self.page_size
         # + a SCRATCH page row: admission groups are padded to a fixed
-        # batch (one compiled prefill shape per bucket, not one per group
-        # size) and padding rows write into scratch, never into a live
-        # slot's pages. Padding rows write at most chunk_w tokens (base
-        # 0), so scratch holds chunk_w/page pages; the row's remaining
-        # table columns alias the last scratch page (never read — masked)
+        # power-of-two batch width (one compiled prefill shape per
+        # bucket x width, not one per group size) and padding rows write
+        # into scratch, never into a live slot's pages. Padding rows write
+        # at most chunk_w tokens (base 0), so scratch holds chunk_w/page
+        # pages; the row's remaining table columns alias the last scratch
+        # page (never read — masked)
         scratch_np = max(self.prompt_buckets[-1] // self.page_size, 1)
         n_pages = self.max_slots * per_seq + scratch_np
         self._nl = cfg.num_hidden_layers
@@ -134,16 +180,28 @@ class ContinuousBatchingEngine:
                 + np.arange(self.max_slots, dtype=np.int32)[:, None])
         scratch_ids = self.max_slots * per_seq + np.minimum(
             np.arange(per_seq, dtype=np.int32), scratch_np - 1)
-        self._tables = jnp.asarray(
-            np.concatenate([real, scratch_ids[None, :]], axis=0))
+        # host copy kept for prefill row gathers (a NUMPY index, not a
+        # compiled device gather — the post-warmup hot path must not
+        # trigger a single compilation)
+        self._tables_np = np.concatenate([real, scratch_ids[None, :]], axis=0)
+        self._tables = jnp.asarray(self._tables_np)
+        # per-segment invariants hoisted out of the dispatch loop: the
+        # slot-rows view never changes; the limits device copy changes
+        # only at admission and is invalidated there
+        self._tables_active = self._tables[:self.max_slots]
+        self._limits_dev = None
         self._functional = _FunctionalModel(model)
         self._buffers = {k: b._value for k, b in model.named_buffers()}
         self._zero_key = jax.random.key_data(jax.random.PRNGKey(0))
-        # sampling keys are fabricated HOST-side (threefry key data is raw
-        # uint32 bits): drawing via jax.random.split would cost device
-        # dispatches per segment — pure tunnel latency in this setup
-        self._np_rng = np.random.RandomState(seed)
         self._key_shape = tuple(self._zero_key.shape)
+        self._key_size = int(np.prod(self._key_shape))
+        # sampling keys are fabricated HOST-side as PER-REQUEST streams:
+        # key(rid, t) is a pure function of (seed, rid, token index), so
+        # token streams never depend on batching, bisection replays, or
+        # pipeline speculation — and cost no device dispatches
+        self._seed = int(seed)
+        self._zeros_cache: dict[tuple, jnp.ndarray] = {}
+        self._aot: dict[tuple, object] = {}
         self._prefill_p = None
         self._segment_p = None
         self._build_programs()
@@ -166,7 +224,13 @@ class ContinuousBatchingEngine:
         greedy = not self.do_sample
         eos = self.eos_token_id
 
-        def sample_true_last(logits, true_lens, key):
+        def sample_batch(last, keys):
+            # per-row key streams: row i is drawn with ITS OWN key, so a
+            # row's tokens are independent of who it was batched with
+            return _sample_rows(last, keys, temperature, top_k, top_p,
+                                greedy).astype(jnp.int32)
+
+        def sample_true_last(logits, true_lens, keys):
             # first token from each row's TRUE last position (padding
             # rows are never read — causal)
             idx = (true_lens - 1).astype(jnp.int32)[:, None, None]
@@ -174,9 +238,7 @@ class ContinuousBatchingEngine:
                 logits, jnp.broadcast_to(
                     idx, (logits.shape[0], 1, logits.shape[-1])),
                 axis=1)[:, 0]
-            return _sample_with_key(
-                last, jax.random.wrap_key_data(key),
-                temperature, top_k, top_p, greedy).astype(jnp.int32)
+            return sample_batch(last, keys)
 
         def write_prompts(params, ks, vs, prompts, table_rows, base):
             # run the model over (N, L) prompt rows writing each row's
@@ -188,12 +250,12 @@ class ContinuousBatchingEngine:
             return (logits, [c.k_pages for c in caches2],
                     [c.v_pages for c in caches2])
 
-        def prefill(params, ks, vs, prompts, table_rows, true_lens, key):
+        def prefill(params, ks, vs, prompts, table_rows, true_lens, keys):
             # N same-bucket admissions in ONE dispatch (static zero base:
             # the fast causal prefill path)
             logits, ks2, vs2 = write_prompts(
                 params, ks, vs, prompts, table_rows, 0)
-            return sample_true_last(logits, true_lens, key), ks2, vs2
+            return sample_true_last(logits, true_lens, keys), ks2, vs2
 
         def chunk_step(params, ks, vs, chunk, table_rows, bases):
             # CHUNKED PREFILL body: write one full chunk of a long prompt
@@ -204,11 +266,11 @@ class ContinuousBatchingEngine:
             return ks2, vs2
 
         def final_chunk(params, ks, vs, chunk, table_rows, bases, true_lens,
-                        key):
+                        keys):
             # last (padded) chunk of a long prompt: write + sample
             logits, ks2, vs2 = write_prompts(
                 params, ks, vs, chunk, table_rows, bases)
-            return sample_true_last(logits, true_lens, key), ks2, vs2
+            return sample_true_last(logits, true_lens, keys), ks2, vs2
 
         def segment(params, ks, vs, tables, lengths, toks, active, limits,
                     keys):
@@ -218,9 +280,7 @@ class ContinuousBatchingEngine:
                 (logits, caches2), _ = functional(
                     params, buffers, (tok[:, None],), {"caches": caches},
                     zero_key)
-                nxt = _sample_with_key(
-                    logits[:, -1, :], jax.random.wrap_key_data(key),
-                    temperature, top_k, top_p, greedy).astype(jnp.int32)
+                nxt = sample_batch(logits[:, -1, :], key)
                 nxt = jnp.where(active, nxt, tok)  # frozen slots emit noise
                 new_lengths = jnp.where(active, lengths + 1, lengths)
                 # deactivate at the per-slot token budget: a slot must
@@ -244,10 +304,169 @@ class ContinuousBatchingEngine:
         self._final_chunk_p = jax.jit(final_chunk, donate_argnums=(1, 2))
         self._segment_p = jax.jit(segment, donate_argnums=(1, 2))
 
-    def _next_keys(self, n):
-        bits = self._np_rng.randint(0, 2**32, (n,) + self._key_shape,
-                                    dtype=np.uint32)
-        return jnp.asarray(bits, self._zero_key.dtype)
+    # --------------------------------------------------- program dispatch
+
+    def _call(self, key, fallback, *args):
+        """Dispatch through the AOT-compiled executable when ``warmup()``
+        built one for this shape, else through the lazily-compiling jitted
+        program (``fallback`` is looked up at call time so tests can
+        monkeypatch ``_segment_p``/``_chunk_p``/...)."""
+        exe = self._aot.get(key)
+        if exe is not None:
+            return exe(*args)
+        return fallback(*args)
+
+    def _group_width(self, n):
+        """Smallest power-of-two admission batch width >= n, capped at
+        ``max_slots`` — the compiled prefill shape this group rides."""
+        w = 1
+        while w < n:
+            w <<= 1
+        return min(w, self.max_slots)
+
+    def group_widths(self):
+        """Every compiled admission width: {1, 2, 4, ..., max_slots}."""
+        out = []
+        w = 1
+        while w < self.max_slots:
+            out.append(w)
+            w <<= 1
+        out.append(self.max_slots)
+        return tuple(out)
+
+    def warmup(self, segment=None, cache_dir=None):
+        """AOT-compile (``jit(...).lower().compile()``) every declared
+        serving shape: one prefill program per (prompt bucket x admission
+        group width), the chunked-prefill chunk/final programs per width
+        (when ``max_len`` admits chunking), and the decode-segment program
+        at ``segment`` steps. After warmup a ``run()``/``step()`` session
+        over in-bucket prompts triggers ZERO compilations — first-request
+        latency and ``stats()['tokens_per_sec']`` stop absorbing compile
+        time.
+
+        ``segment`` must match the segment length later sessions use
+        (defaults to the last ``start(segment=...)`` or 16).
+        ``cache_dir`` additionally wires JAX's persistent compilation
+        cache so the compiles survive process restarts. Returns
+        ``{"programs": newly compiled, "cached": already present,
+        "seconds": wall}``.
+        """
+        if cache_dir is not None:
+            from ..jit import enable_compilation_cache
+
+            enable_compilation_cache(cache_dir)
+        t0 = time.monotonic()
+        params = {k: p._value for k, p in self.model.named_parameters()}
+        sds = lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+        p_s = jax.tree_util.tree_map(sds, params)
+        ks_s = [sds(k) for k in self._ks]
+        vs_s = [sds(v) for v in self._vs]
+        kdt = self._zero_key.dtype
+        cols = self._tables_np.shape[1]
+        i32 = jnp.int32
+        stats = {"programs": 0, "cached": 0}
+
+        def compile_(key, jitted, *avals):
+            if key in self._aot:
+                stats["cached"] += 1
+                return
+            self._aot[key] = jitted.lower(p_s, ks_s, vs_s, *avals).compile()
+            stats["programs"] += 1
+
+        chunk_w = self.prompt_buckets[-1]
+        for g in self.group_widths():
+            rows_s = jax.ShapeDtypeStruct((g, cols), i32)
+            lens_s = jax.ShapeDtypeStruct((g,), i32)
+            keys_s = jax.ShapeDtypeStruct((g,) + self._key_shape, kdt)
+            for bucket in self.prompt_buckets:
+                compile_(("prefill", bucket, g), self._prefill_p,
+                         jax.ShapeDtypeStruct((g, bucket), i32),
+                         rows_s, lens_s, keys_s)
+            if self.max_len > chunk_w and self.max_len % chunk_w == 0:
+                chunk_s = jax.ShapeDtypeStruct((g, chunk_w), i32)
+                bases_s = jax.ShapeDtypeStruct((g,), i32)
+                compile_(("chunk", g), self._chunk_p, chunk_s, rows_s,
+                         bases_s)
+                compile_(("final", g), self._final_chunk_p, chunk_s, rows_s,
+                         bases_s, lens_s, keys_s)
+        seg = int(segment if segment is not None
+                  else getattr(self, "_segment_len", 16))
+        m = self.max_slots
+        compile_(("segment", seg), self._segment_p,
+                 jax.ShapeDtypeStruct((m, cols), i32),
+                 jax.ShapeDtypeStruct((m,), i32),
+                 jax.ShapeDtypeStruct((m,), i32),
+                 jax.ShapeDtypeStruct((m,), jnp.bool_),
+                 jax.ShapeDtypeStruct((m,), i32),
+                 jax.ShapeDtypeStruct((seg, m) + self._key_shape, kdt))
+        stats["seconds"] = time.monotonic() - t0
+        return stats
+
+    # ------------------------------------------------------- sampling keys
+
+    def _key_zeros(self, shape):
+        # greedy sampling ignores keys: serve a cached device-resident
+        # zeros array (built via device_put, never a compiled fill)
+        arr = self._zeros_cache.get(shape)
+        if arr is None:
+            arr = jnp.asarray(np.zeros(shape, np.uint32).astype(
+                self._zero_key.dtype))
+            self._zeros_cache[shape] = arr
+        return arr
+
+    def _rid_seed(self, rid):
+        """Per-request stream root — a pure function of (engine seed,
+        rid), so token streams are identical whether a token is produced
+        by the serial loop, a speculative pipelined segment, or a
+        bisection replay."""
+        try:
+            r = int(rid) & 0xFFFFFFFFFFFFFFFF
+        except (TypeError, ValueError):
+            r = zlib.crc32(str(rid).encode())
+        # shape-(1,) operands: numpy wraps ARRAY uint64 overflow silently
+        # (the intended mod-2^64 arithmetic) but warns on scalars
+        return _mix64(np.asarray([self._seed], np.uint64) * _SM64_A
+                      + np.asarray([r], np.uint64) * _SM64_B
+                      + np.uint64(1))
+
+    def _req_key_block(self, rid, base, n):
+        """(n, key_size) uint32 key-data words for request ``rid``'s
+        tokens ``base .. base+n-1`` — one vectorized hash over the
+        (token index, word) grid, no per-token Python objects."""
+        t = (np.uint64(base)
+             + np.arange(n, dtype=np.uint64))[:, None]
+        w = np.arange(1, self._key_size + 1, dtype=np.uint64)[None, :]
+        h = _mix64(self._rid_seed(rid) + t * _SM64_A + w * _SM64_C)
+        return (h >> np.uint64(32)).astype(np.uint32)
+
+    def _prefill_keys(self, group, g):
+        # first token of each admitted request: index 0 of its stream
+        shape = (g,) + self._key_shape
+        if not self.do_sample:
+            return self._key_zeros(shape)
+        bits = np.zeros(shape, np.uint32)
+        for i, (_, req) in enumerate(group):
+            bits[i] = self._req_key_block(req.rid, 0, 1).reshape(
+                self._key_shape)
+        return jnp.asarray(bits)
+
+    def _segment_keys(self, offset):
+        """Keys for one decode segment: slot s step i uses its request's
+        stream at index ``len(tokens) + offset + i``. ``offset`` is the
+        in-flight emission count a speculative dispatch must skip past
+        (``segment_len`` when one segment is unconsumed, else 0)."""
+        seg = self._segment_len
+        shape = (seg, self.max_slots) + self._key_shape
+        if not self.do_sample:
+            return self._key_zeros(shape)
+        bits = np.zeros(shape, np.uint32)
+        for slot, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            bits[:, slot] = self._req_key_block(
+                req.rid, len(req.tokens) + offset, seg).reshape(
+                    (seg,) + self._key_shape)
+        return jnp.asarray(bits)
 
     # ----------------------------------------------------------- scheduler
     #
@@ -301,6 +520,7 @@ class ContinuousBatchingEngine:
         # the last needed emission reaches; the segment program deactivates
         # a slot there so it never advances past validated capacity
         self._limits = np.full((self.max_slots,), self.max_len, np.int32)
+        self._limits_dev = None
         self._useful = 0
         self._seg_runs = 0
         # occupancy as running sum/count: a long-lived serving session
@@ -310,6 +530,19 @@ class ContinuousBatchingEngine:
         self._counts = {"ok": 0, "timed_out": 0, "failed": 0,
                         "cancelled": 0, "rejected": 0}
         self._auto_rid = 0
+        # pipeline state: at most ONE dispatched-but-unconsumed segment;
+        # ``_dirty`` marks host mask changes the device cannot see
+        # (abort / deadline retirement), forcing a drain + sync turn
+        self._pipeline = (bool(flag("FLAGS_serving_pipeline"))
+                          if self.pipeline_opt is None
+                          else bool(self.pipeline_opt))
+        self._inflight = None
+        self._dirty = False
+        # host-gap accounting: time from finishing one segment's host
+        # bookkeeping to issuing the next dispatch
+        self._gap_sum = 0.0
+        self._gap_n = 0
+        self._t_host0 = None
         self._t0 = time.monotonic()
         return self
 
@@ -358,6 +591,11 @@ class ContinuousBatchingEngine:
         for slot, req in enumerate(self._slot_req):
             if req is not None and req.rid == rid:
                 self._retire(req, status, slot=slot)
+                if self._inflight is not None:
+                    # the in-flight segment still decodes this slot; its
+                    # emissions are discarded at consume, but the next
+                    # dispatch must be a sync turn from the repaired mask
+                    self._dirty = True
                 return req
         return None
 
@@ -419,6 +657,11 @@ class ContinuousBatchingEngine:
 
     # ------------------------------------------------------- dispatches
 
+    def _limits_device(self):
+        if self._limits_dev is None:
+            self._limits_dev = jnp.asarray(self._limits)
+        return self._limits_dev
+
     def _finish_admit(self, slot, req, tok, finished):
         """Shared post-prefill bookkeeping (short AND chunked paths):
         register the slot, count the sampled first token, set the
@@ -429,6 +672,7 @@ class ContinuousBatchingEngine:
         self._lengths[slot] = req.prompt.size
         self._cur_tok[slot] = int(tok)
         self._limits[slot] = req.prompt.size + req.max_new_tokens - 1
+        self._limits_dev = None  # admission changed the device invariant
         if len(req.tokens) >= req.max_new_tokens or (
                 self.eos_token_id is not None
                 and req.tokens[0] == self.eos_token_id):
@@ -436,9 +680,12 @@ class ContinuousBatchingEngine:
             self._retire(req, "ok", finished)
 
     def _dispatch_prefill(self, group, bucket, finished):
-        # FIXED admission batch (max_slots rows): one compiled prefill
-        # shape per bucket; padding rows write scratch
-        g = self.max_slots
+        # admission batch padded to the GROUP WIDTH (smallest power of two
+        # >= the group, capped at max_slots): one compiled prefill shape
+        # per (bucket x width), so a single admission pays a width-1
+        # forward instead of a max_slots-wide one; padding rows write
+        # scratch
+        g = self._group_width(len(group))
         padded = np.zeros((g, bucket), np.int32)
         true_lens = np.ones((g,), np.int32)
         rows = np.full((g,), self.max_slots, np.int64)  # scratch
@@ -446,11 +693,13 @@ class ContinuousBatchingEngine:
             padded[i, :req.prompt.size] = req.prompt
             true_lens[i] = req.prompt.size
             rows[i] = slot
-        tok0, self._ks, self._vs = self._prefill_p(
-            self._params, self._ks, self._vs, jnp.asarray(padded),
-            self._tables[rows], jnp.asarray(true_lens),
-            self._next_keys(1)[0])
-        tok0 = np.asarray(tok0)
+        with annotate("serving.prefill"):
+            tok0, self._ks, self._vs = self._call(
+                ("prefill", bucket, g), self._prefill_p,
+                self._params, self._ks, self._vs, jnp.asarray(padded),
+                jnp.asarray(self._tables_np[rows]), jnp.asarray(true_lens),
+                self._prefill_keys(group, g))
+            tok0 = np.asarray(tok0)
         for i, (slot, req) in enumerate(group):
             self._finish_admit(slot, req, tok0[i], finished)
 
@@ -472,7 +721,6 @@ class ContinuousBatchingEngine:
         # admission whose budget expired mid-prefill retires as
         # ``timed_out`` without dispatching its remaining chunks.
         chunk_w = self.prompt_buckets[-1]
-        g = self.max_slots
         scratch = self.max_slots
         n_full = {req.rid: (req.prompt.size - 1) // chunk_w
                   for _, req in group}
@@ -484,6 +732,7 @@ class ContinuousBatchingEngine:
             expired += dead
             if not live or not any(c < n_full[req.rid] for _, req in live):
                 break
+            g = self._group_width(len(live))
             chunk_arr = np.zeros((g, chunk_w), np.int32)
             bases = np.zeros((g,), np.int32)
             rows = np.full((g,), scratch, np.int64)
@@ -493,11 +742,14 @@ class ContinuousBatchingEngine:
                     chunk_arr[i] = p[c * chunk_w:(c + 1) * chunk_w]
                     bases[i] = c * chunk_w
                     rows[i] = slot
-            self._ks, self._vs = self._chunk_p(
-                self._params, self._ks, self._vs, jnp.asarray(chunk_arr),
-                self._tables[rows], jnp.asarray(bases))
+            with annotate("serving.chunked_prefill"):
+                self._ks, self._vs = self._call(
+                    ("chunk", g), self._chunk_p,
+                    self._params, self._ks, self._vs, jnp.asarray(chunk_arr),
+                    jnp.asarray(self._tables_np[rows]), jnp.asarray(bases))
             c += 1
         if live:
+            g = self._group_width(len(live))
             final_arr = np.zeros((g, chunk_w), np.int32)
             bases = np.zeros((g,), np.int32)
             true_rem = np.ones((g,), np.int32)
@@ -510,46 +762,110 @@ class ContinuousBatchingEngine:
                 bases[i] = done
                 true_rem[i] = rem
                 rows[i] = slot
-            tok0, self._ks, self._vs = self._final_chunk_p(
-                self._params, self._ks, self._vs, jnp.asarray(final_arr),
-                self._tables[rows], jnp.asarray(bases),
-                jnp.asarray(true_rem), self._next_keys(1)[0])
-            tok0 = np.asarray(tok0)
+            with annotate("serving.chunked_prefill"):
+                tok0, self._ks, self._vs = self._call(
+                    ("final", g), self._final_chunk_p,
+                    self._params, self._ks, self._vs, jnp.asarray(final_arr),
+                    jnp.asarray(self._tables_np[rows]), jnp.asarray(bases),
+                    jnp.asarray(true_rem), self._prefill_keys(live, g))
+                tok0 = np.asarray(tok0)
             for i, (slot, req) in enumerate(live):
                 self._finish_admit(slot, req, tok0[i], finished)
         for _, req in expired:
             self._retire(req, "timed_out", finished)
 
-    def _dispatch_segment(self, mask):
-        keys = self._next_keys(self._segment_len)
-        emitted, was_active, tok, new_lengths, still_active, \
-            self._ks, self._vs = self._segment_p(
-                self._params, self._ks, self._vs,
-                self._tables[:self.max_slots],
-                jnp.asarray(self._lengths), jnp.asarray(self._cur_tok),
-                jnp.asarray(mask), jnp.asarray(self._limits), keys)
-        # ONE host round trip for every segment output (separate
-        # np.asarray calls each pay the transfer latency)
-        emitted, was_active, cur_tok, lengths, still_active = \
-            jax.device_get(
-                (emitted, was_active, tok, new_lengths, still_active))
-        # slots outside ``mask`` pass through the program unchanged, so
-        # wholesale assignment composes across bisected sub-batches
-        self._lengths = lengths.copy()
-        self._cur_tok = cur_tok.copy()
+    def _dispatch_segment(self, mask, carry=None, key_offset=0):
+        """Dispatch ONE compiled decode segment (async — no host wait).
+
+        ``carry=None`` is a SYNC dispatch from host state; otherwise
+        ``carry`` is the previous segment's device outputs
+        ``(tok, lengths, active)`` fed straight back as operands — the
+        speculative pipelined turn, which costs no host round trip.
+        Returns the in-flight handle consumed later by ``_consume``."""
+        now = time.monotonic()
+        if self._t_host0 is not None:
+            self._gap_sum += now - self._t_host0
+            self._gap_n += 1
+            self._t_host0 = None
+        keys = self._segment_keys(key_offset)
+        if carry is None:
+            toks = jnp.asarray(self._cur_tok)
+            lengths = jnp.asarray(self._lengths)
+            active = jnp.asarray(mask)
+        else:
+            toks, lengths, active = carry
+        with annotate("serving.segment_dispatch"):
+            emitted, was_active, tok, new_lengths, still_active, \
+                self._ks, self._vs = self._call(
+                    ("segment", self._segment_len), self._segment_p,
+                    self._params, self._ks, self._vs, self._tables_active,
+                    lengths, toks, active, self._limits_device(), keys)
         self._seg_runs += 1
-        return emitted, was_active, still_active
+        return {"emitted": emitted, "was_active": was_active, "tok": tok,
+                "lengths": new_lengths, "active": still_active,
+                "mask": np.asarray(mask)}
+
+    def _consume(self, h, finished):
+        """Fetch one dispatched segment's outputs (ONE host round trip for
+        all of them) and do the host bookkeeping: mirror lengths/tokens,
+        append emissions, retire finished slots."""
+        emitted, was_active, cur_tok, lengths, still_active = \
+            jax.device_get((h["emitted"], h["was_active"], h["tok"],
+                            h["lengths"], h["active"]))
+        with annotate("serving.host_bookkeeping"):
+            # slots outside ``mask`` pass through the program unchanged, so
+            # wholesale assignment composes across bisected sub-batches
+            self._lengths = lengths.copy()
+            self._cur_tok = cur_tok.copy()
+            for slot in np.flatnonzero(h["mask"]):
+                req = self._slot_req[slot]
+                if req is None:
+                    continue
+                toks = req.tokens
+                for s in range(self._segment_len):
+                    if not was_active[s, slot] or len(toks) >= \
+                            req.max_new_tokens:
+                        break
+                    toks.append(int(emitted[s, slot]))
+                    self._useful += 1
+                done = (len(toks) >= req.max_new_tokens
+                        or (self.eos_token_id is not None
+                            and toks and toks[-1] == self.eos_token_id)
+                        or not bool(still_active[slot]))
+                if done:
+                    self._retire(req, "ok", finished, slot=slot)
+        self._t_host0 = time.monotonic()
+
+    def _drain_pipeline(self, finished):
+        """Consume the in-flight segment (if any) so the host view of
+        slots/lengths is current — required before any admission, and
+        before bisection replays. A segment whose async execution failed
+        is replayed serially from the last synced host state so the
+        bisection isolation still applies."""
+        h, self._inflight = self._inflight, None
+        self._dirty = False
+        if h is None:
+            return
+        try:
+            self._consume(h, finished)
+        except Exception:  # isolation boundary: replay serially + bisect
+            live = np.array([r is not None for r in self._slot_req])
+            self._segment_round(h["mask"] & live, finished)
 
     def _segment_round(self, mask, finished):
         """One compiled decode segment over the slots in ``mask`` + host
-        token collection. A dispatch failure bisects the ACTIVE MASK (the
-        compiled shape is fixed, so isolation masks slots out rather than
-        re-batching) until the offending slot is alone, then retires it as
-        ``"failed"`` — its co-batched slots decode in the retried halves."""
+        token collection — the SERIAL turn (dispatch, wait, consume). A
+        dispatch failure bisects the ACTIVE MASK (the compiled shape is
+        fixed, so isolation masks slots out rather than re-batching) until
+        the offending slot is alone, then retires it as ``"failed"`` — its
+        co-batched slots decode in the retried halves. Per-request key
+        streams make the replayed halves token-identical to an unbisected
+        run."""
         if not mask.any():
             return
         try:
-            emitted, was_active, still_active = self._dispatch_segment(mask)
+            h = self._dispatch_segment(mask)
+            self._consume(h, finished)
         except Exception as e:  # isolation boundary: bisect, never crash
             idx = np.flatnonzero(mask)
             if len(idx) == 1:
@@ -563,32 +879,80 @@ class ContinuousBatchingEngine:
             left[idx[len(idx) // 2:]] = False
             self._segment_round(left, finished)
             self._segment_round(mask & ~left, finished)
+
+    def _pipelined_round(self, mask, finished):
+        """One OVERLAPPED scheduler turn: dispatch the next segment before
+        consuming the previous one, so the device computes segment N+1
+        while the host does segment N's bookkeeping.
+
+        The speculative dispatch feeds segment N's device outputs straight
+        back as the carry — retirements the device itself decided (eos,
+        token budget) ride the carried active mask, so no host sync is
+        needed. Host-only mask changes (admission, abort, deadline) drain
+        the pipeline first via ``step()``. Per-request key streams keep
+        the speculative segment token-identical to the serial schedule."""
+        prev = self._inflight
+        if prev is None:
+            try:
+                self._inflight = self._dispatch_segment(mask)
+            except Exception:
+                # sync dispatch failed: fall back to the serial round,
+                # which replays with bisection
+                self._segment_round(mask, finished)
             return
-        for slot in np.flatnonzero(mask):
-            req = self._slot_req[slot]
-            if req is None:
-                continue
-            toks = req.tokens
-            for s in range(self._segment_len):
-                if not was_active[s, slot] or len(toks) >= \
-                        req.max_new_tokens:
-                    break
-                toks.append(int(emitted[s, slot]))
-                self._useful += 1
-            done = (len(toks) >= req.max_new_tokens
-                    or (self.eos_token_id is not None
-                        and toks and toks[-1] == self.eos_token_id)
-                    or not bool(still_active[slot]))
-            if done:
-                self._retire(req, "ok", finished, slot=slot)
+        seg = self._segment_len
+        # speculate only when some slot can outlive the in-flight segment
+        # (absent eos): otherwise every masked slot retires when ``prev``
+        # is consumed and the speculative segment would be pure waste
+        spec_worthy = any(
+            self._slot_req[s] is not None
+            and len(self._slot_req[s].tokens) + seg
+            < self._slot_req[s].max_new_tokens
+            for s in np.flatnonzero(mask))
+        if not spec_worthy:
+            self._drain_pipeline(finished)
+            return
+        try:
+            h = self._dispatch_segment(
+                mask, carry=(prev["tok"], prev["lengths"], prev["active"]),
+                key_offset=seg)
+        except Exception:
+            # the speculative dispatch failed before running: drain the
+            # pipeline, then replay this segment serially with bisection
+            self._drain_pipeline(finished)
+            live = np.array([r is not None for r in self._slot_req])
+            self._segment_round(mask & live, finished)
+            return
+        try:
+            self._consume(prev, finished)
+        except Exception:  # isolation boundary: bisect, never crash
+            # prev's ASYNC execution failed (surfaced at the fetch, not
+            # the dispatch): the speculative segment was built on its
+            # outputs — discard it and replay prev's window serially from
+            # the last synced host state, bisecting to isolate
+            self._inflight = None
+            live = np.array([r is not None for r in self._slot_req])
+            self._segment_round(prev["mask"] & live, finished)
+            return
+        self._inflight = h
 
     def step(self):
         """One scheduler turn: admit queued requests into free slots
-        (same-bucket admissions share ONE compiled prefill dispatch, under
-        poison isolation), run one compiled decode segment, then enforce
-        deadlines BETWEEN segments (never mid-dispatch). Returns the list
-        of ``Request`` objects retired this turn."""
+        (same-bucket admissions share ONE compiled prefill dispatch at the
+        group width, under poison isolation), run one compiled decode
+        segment — overlapped with the previous segment's host bookkeeping
+        when the pipeline is enabled — then enforce deadlines BETWEEN
+        segments (never mid-dispatch). Returns the list of ``Request``
+        objects retired this turn (one segment behind the device when
+        pipelined)."""
         finished: list[Request] = []
+        # admission and mask repair need a current host view: consume the
+        # in-flight segment BEFORE touching slots (prefill rewrites a
+        # freed slot's pages; the in-flight segment was built on the old
+        # mask)
+        if self._inflight is not None and (
+                self._dirty or (self._queue and self.free_slots() > 0)):
+            self._drain_pipeline(finished)
         admitting, long_adm = [], []
         for slot in range(self.max_slots):
             if self._slot_req[slot] is not None or not self._queue:
@@ -617,17 +981,30 @@ class ContinuousBatchingEngine:
         if active_np.any():
             self._occ_sum += float(active_np.mean())
             self._occ_n += 1
-            self._segment_round(active_np, finished)
+            if self._pipeline:
+                self._pipelined_round(active_np, finished)
+            else:
+                self._segment_round(active_np, finished)
+        elif self._inflight is not None:
+            # nothing live in the host view but a segment still in flight
+            # (every slot retired at the last consume): drain it
+            self._drain_pipeline(finished)
 
         # deadline enforcement BETWEEN segments: an expired slot retires
         # with its partial output and frees capacity for the queue; queued
         # requests whose budget ran out while waiting drain as timed_out;
         # a run-level timeout retires everything still unfinished
+        retired_slot = False
         for slot in range(self.max_slots):
             req = self._slot_req[slot]
             if req is not None and (req.deadline.expired()
                                     or self._run_deadline.expired()):
                 self._retire(req, "timed_out", finished, slot=slot)
+                retired_slot = True
+        if retired_slot and self._inflight is not None:
+            # the device cannot see a deadline retirement: force a drain
+            # + sync turn before the next dispatch
+            self._dirty = True
         if self._queue:
             waiting: deque[Request] = deque()
             for req in self._queue:
@@ -648,7 +1025,17 @@ class ContinuousBatchingEngine:
 
     def stats(self):
         """Running session stats. ``tokens_per_sec`` is 0.0 for an empty
-        or zero-duration session (never inf)."""
+        or zero-duration session (never inf).
+
+        ``tokens_per_sec`` is measured over the session WALL clock, so a
+        cold session (no prior ``warmup()``) absorbs every first-shape
+        compilation into the number — call ``warmup()`` first (or compare
+        only warmed sessions) when reading it as device throughput.
+        ``host_gap_ms`` is the mean host-side gap between finishing one
+        segment's bookkeeping and issuing the next dispatch
+        (``host_gap_total_s`` is the session total) — with the pipeline
+        enabled this work overlaps device compute; a growing value flags
+        host-overhead regressions either way."""
         dt = time.monotonic() - self._t0
         return {
             "tokens_per_sec": (self._useful / dt
@@ -658,6 +1045,10 @@ class ContinuousBatchingEngine:
             "mean_occupancy": (self._occ_sum / self._occ_n
                                if self._occ_n else 0.0),
             "wall_s": dt,
+            "host_gap_ms": (1e3 * self._gap_sum / self._gap_n
+                            if self._gap_n else 0.0),
+            "host_gap_total_s": self._gap_sum,
+            "pipelined": bool(getattr(self, "_pipeline", False)),
             "timed_out": self._counts.get("timed_out", 0),
             "failed": self._counts.get("failed", 0),
             "cancelled": self._counts.get("cancelled", 0),
@@ -692,7 +1083,9 @@ class ContinuousBatchingEngine:
         Failure isolation: an exception inside a prefill / chunked-prefill
         / decode dispatch bisects the batch (see ``_isolate``) — the
         offending request retires as ``"failed"`` with its partial tokens
-        while its co-batched peers complete normally.
+        while its co-batched peers complete normally. Token streams are
+        identical with the pipeline on or off, under bisection replays,
+        and for any admission interleaving (per-request key streams).
         """
         prompts_np = [np.asarray(p).astype(np.int32).ravel()
                       for p in prompts]

@@ -16,8 +16,27 @@ import time
 
 __all__ = [
     "Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
-    "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+    "annotate", "make_scheduler", "export_chrome_tracing",
+    "load_profiler_result",
 ]
+
+
+@contextlib.contextmanager
+def annotate(name):
+    """Hot-loop XLA trace scope: a bare ``jax.profiler.TraceAnnotation``
+    (so the span shows up in a TPU XPlane trace around the host work it
+    brackets) without the host-event ring bookkeeping of ``RecordEvent``.
+    The serving engine wraps its prefill / chunked-prefill / segment
+    dispatches and host bookkeeping in these, which is how a pipelined
+    schedule's host/device overlap is read off a trace."""
+    try:
+        import jax.profiler as jp
+
+        ctx = jp.TraceAnnotation(name)
+    except Exception:
+        ctx = contextlib.nullcontext()
+    with ctx:
+        yield
 
 
 class ProfilerTarget:
