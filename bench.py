@@ -12,6 +12,7 @@ Weak-1):
   (c2) BERT-base fused-attention train step (BASELINE config 2)
   (d) Pallas paged decode attention kernel + its streaming-floor calibration
   (e) whole-model compiled decode (generate(), paged caches)
+      + (e2) continuous batching + (e3) replica-fleet router overhead gate
   (f) per-op microbench: adaptive iters (no 0.0us clamp readings), compared
       against OPBENCH_BASELINE.json, then the baseline is RE-RECORDED with
       this run's numbers (reference: tools/ci_op_benchmark.sh relative gate)
@@ -593,6 +594,69 @@ except Exception as e:
     log(f"continuous batching section FAILED: {type(e).__name__}: {e}")
     cb_metrics = {"continuous_error": f"{type(e).__name__}: {e}"[:200]}
 
+# ------------------------------------------------- (e3) replica fleet
+# Router tier over N engine replicas (health-gated dispatch, bit-exact
+# failover): the acceptance gate is ROUTER OVERHEAD — time spent in
+# routing/bookkeeping outside the replica frontends must stay < 5% of
+# request wall time (fleet_router_overhead_pct).
+fleet_metrics = {}
+try:
+    from paddle_tpu.models.frontend import ServingFrontend
+    from paddle_tpu.models.router import ServingRouter
+    from paddle_tpu.models.serving import ContinuousBatchingEngine
+
+    if SMOKE:
+        FL_REPS, FL_SLOTS, FL_REQ, FL_NEW, FL_SEG = 2, 2, 6, 6, 3
+        FL_BUCKETS = (32,)
+    else:
+        FL_REPS, FL_SLOTS, FL_REQ, FL_NEW, FL_SEG = 2, 4, 16, 32, 16
+        FL_BUCKETS = (32,)
+    log(f"replica fleet: {FL_REPS} replicas x {FL_SLOTS} slots, "
+        f"{FL_REQ} requests, segment={FL_SEG}...")
+    router = ServingRouter(max_failovers=2)
+    for i in range(FL_REPS):
+        f_eng = ContinuousBatchingEngine(model, max_slots=FL_SLOTS,
+                                         max_len=256, page_size=128,
+                                         prompt_buckets=FL_BUCKETS,
+                                         seed=0)
+        fe = ServingFrontend(f_eng, max_queue=64, segment=FL_SEG)
+        log(f"fleet replica {i}: AOT warmup...")
+        router.add_replica(fe, warmup=True)
+    rng_fl = np.random.RandomState(11)
+    # tiny warm pass (first-dispatch/tunnel overheads, as in e2)
+    for rid in [router.submit(rng_fl.randint(0, cfg.vocab_size, (12,))
+                              .astype(np.int32), max_new_tokens=2)
+                for _ in range(FL_REPS)]:
+        pass
+    router.results(wait=True, timeout_s=600)
+    t_fl = time.time()
+    rids = [router.submit(
+        rng_fl.randint(0, cfg.vocab_size,
+                       (int(rng_fl.randint(8, 28)),)).astype(np.int32),
+        max_new_tokens=FL_NEW) for _ in range(FL_REQ)]
+    fl_res = router.results(wait=True, timeout_s=600)
+    fl_wall = time.time() - t_fl
+    assert all(fl_res[r].status == "ok" for r in rids), \
+        {r: fl_res[r].status for r in rids}
+    fl_stats = router.stats()
+    fl_tokens = sum(len(fl_res[r].tokens) for r in rids)
+    fleet_metrics = {
+        "fleet_replicas": FL_REPS,
+        "fleet_tokens_per_sec": round(fl_tokens / fl_wall, 1)
+            if fl_wall > 0 else None,
+        "fleet_router_overhead_pct": round(
+            fl_stats["router_overhead_pct"], 3),
+        "fleet_requests_ok": fl_stats.get("requests_ok", 0),
+    }
+    router.shutdown()
+    log(f"replica fleet: {fleet_metrics['fleet_tokens_per_sec']} tok/s "
+        f"over {FL_REPS} replicas, router overhead "
+        f"{fleet_metrics['fleet_router_overhead_pct']}% of active "
+        f"request-processing time (gate: < 5%)")
+except Exception as e:
+    log(f"replica fleet section FAILED: {type(e).__name__}: {e}")
+    fleet_metrics = {"fleet_error": f"{type(e).__name__}: {e}"[:200]}
+
 # ------------------------------------------------------- (f) op microbench
 # Per-op regression gate (reference: tools/ci_op_benchmark.sh relative
 # check): ~20 hot ops + eager dispatch overhead, compared against the
@@ -681,6 +745,7 @@ result = {
     "model_decode_tokens_per_sec": round(model_decode_tok_s, 1),
     "model_decode_ms_per_token_step": round(gen_dt / GNEW * 1e3, 2),
     **cb_metrics,
+    **fleet_metrics,
     "op_bench_us": op_results,
     "op_bench_vs_baseline": op_vs_baseline,
     "op_bench_regressions": op_regressions,

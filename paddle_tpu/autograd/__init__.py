@@ -14,9 +14,11 @@ import jax.numpy as jnp
 from ..core import autograd as _engine
 from ..core.autograd import GradNode
 from ..core.tensor import Tensor
+from .saved_tensors_hooks import saved_tensors_hooks  # noqa: F401
 
 __all__ = ["PyLayer", "PyLayerContext", "backward", "grad",
-           "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled"]
+           "no_grad", "enable_grad", "is_grad_enabled", "set_grad_enabled",
+           "saved_tensors_hooks"]
 
 backward = _engine.backward
 grad = _engine.grad
@@ -35,13 +37,31 @@ class PyLayerContext:
 
     def __init__(self):
         self._saved = ()
+        self._unpack = None
         self._materialize_grads = True
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        # active saved_tensors_hooks apply here too (reference: PyLayer
+        # saved tensors go through the same eager pack/unpack pair):
+        # Tensors are packed at save (forward) time, non-tensors pass
+        # through untouched
+        hooks = _engine.get_saved_tensors_hooks()
+        if hooks is None:
+            self._saved = tensors
+            self._unpack = None
+            return
+        pack_hook, unpack_hook = hooks
+        self._saved = tuple(
+            (True, pack_hook(t)) if isinstance(t, Tensor) else (False, t)
+            for t in tensors)
+        self._unpack = unpack_hook
 
     def saved_tensor(self):
-        return self._saved
+        if self._unpack is None:
+            return self._saved
+        unpack = self._unpack
+        return tuple(unpack(p) if was_tensor else p
+                     for was_tensor, p in self._saved)
 
     saved_tensors = saved_tensor
 

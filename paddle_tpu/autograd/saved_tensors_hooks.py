@@ -1,0 +1,57 @@
+"""paddle.autograd.saved_tensors_hooks — pack/unpack hooks on tensors the
+tape captures for backward.
+
+Analog of /root/reference/python/paddle/autograd/saved_tensors_hooks.py
+(which registers the pair through ``core.eager``): while the context is
+active, every tensor an op saves for its backward is passed through
+``pack_hook`` at capture (forward) time, and the packed object is passed
+through ``unpack_hook`` when the backward pass needs the value. The
+canonical use is activation memory: pack to host (numpy) and unpack back
+to device, trading transfer time for HBM.
+
+Capture points wired here: the eager dispatcher's cached-vjp backward
+(saved input primals, ops/registry.py), explicit backward rules' saved
+inputs/outputs, and ``PyLayerContext.save_for_backward``. The rare
+nojit/stateful-RNG fallback keeps its residuals inside ``jax.vjp``'s
+closure where no hook can see them — documented, not silently partial:
+those ops never call the hooks.
+
+Usage::
+
+    def pack(t):   return np.asarray(t._value)      # offload to host
+    def unpack(p): return paddle.to_tensor(p)       # back to device
+
+    with paddle.autograd.saved_tensors_hooks(pack, unpack):
+        y = model(x)
+    y.backward()          # unpack runs here, outside the context
+"""
+from __future__ import annotations
+
+from ..core import autograd as _engine
+
+__all__ = ["saved_tensors_hooks"]
+
+
+class saved_tensors_hooks:  # noqa: N801 — reference-parity lowercase name
+    """Context manager registering a (pack, unpack) saved-tensors pair.
+
+    ``pack_hook(tensor) -> obj`` runs once per captured tensor at forward
+    time; ``unpack_hook(obj) -> tensor`` runs when backward materializes
+    it. Contexts nest — the innermost pair is the active one. Tensors
+    captured OUTSIDE the context are untouched, even if their backward
+    runs inside it (and vice versa): the hook choice is made at capture
+    time, matching the reference semantics.
+    """
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _engine.register_saved_tensors_hooks(self.pack_hook,
+                                             self.unpack_hook)
+        return self
+
+    def __exit__(self, *args):
+        _engine.reset_saved_tensors_hooks()
+        return False

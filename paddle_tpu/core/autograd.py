@@ -28,15 +28,76 @@ from typing import Callable, Sequence
 import jax
 import jax.numpy as jnp
 
-__all__ = ["GradNode", "no_grad", "enable_grad", "is_grad_enabled", "backward", "grad"]
+__all__ = ["GradNode", "no_grad", "enable_grad", "is_grad_enabled", "backward", "grad",
+           "register_saved_tensors_hooks", "reset_saved_tensors_hooks",
+           "get_saved_tensors_hooks"]
 
 
 class _GradState(threading.local):
     def __init__(self):
         self.enabled = True
+        # saved-tensors pack/unpack hook stack (reference
+        # python/paddle/autograd/saved_tensors_hooks.py): the innermost
+        # (pack, unpack) pair is applied to tensors captured for backward
+        # while the context is active
+        self.saved_hooks = []
 
 
 _state = _GradState()
+
+
+def register_saved_tensors_hooks(pack_hook, unpack_hook):
+    """Push a (pack, unpack) hook pair applied to every tensor the tape
+    captures for backward while registered (reference
+    ``core.eager.register_saved_tensors_hooks``). ``pack_hook(Tensor) ->
+    obj`` runs at capture (forward) time; ``unpack_hook(obj) -> Tensor``
+    runs when the backward pass needs the value. Hooks nest as a stack —
+    the innermost registration wins."""
+    if not callable(pack_hook) or not callable(unpack_hook):
+        raise TypeError("saved-tensors hooks must be callables "
+                        "(pack_hook, unpack_hook)")
+    _state.saved_hooks.append((pack_hook, unpack_hook))
+
+
+def reset_saved_tensors_hooks():
+    """Pop the innermost saved-tensors hook pair (reference
+    ``core.eager.reset_saved_tensors_hooks``)."""
+    if _state.saved_hooks:
+        _state.saved_hooks.pop()
+
+
+def get_saved_tensors_hooks():
+    """The active (pack, unpack) pair, or None."""
+    return _state.saved_hooks[-1] if _state.saved_hooks else None
+
+
+def pack_saved_values(values):
+    """Run the active pack hook over a flat list of raw jax arrays being
+    captured for backward. Returns ``None`` when no hooks are active
+    (caller keeps its list), else a zero-arg ``restore()`` that unpacks
+    them back to raw arrays at backward time. Non-array entries (python
+    scalars, None) pass through unpacked — hooks only see real tensors."""
+    hooks = get_saved_tensors_hooks()
+    if hooks is None:
+        return None
+    from .tensor import Tensor
+
+    pack_hook, unpack_hook = hooks
+    packed = [(True, pack_hook(Tensor._from_value(v, stop_gradient=True)))
+              if isinstance(v, jax.Array) else (False, v)
+              for v in values]
+
+    def restore():
+        out = []
+        for was_tensor, p in packed:
+            if not was_tensor:
+                out.append(p)
+                continue
+            v = unpack_hook(p)
+            out.append(v._value if isinstance(v, Tensor) else jnp.asarray(v))
+        return out
+
+    return restore
 
 
 def is_grad_enabled() -> bool:

@@ -311,6 +311,18 @@ class CircuitBreaker:
             self._failures = 0
             self._probes = 0
 
+    def trip(self):
+        """Force the breaker open NOW, regardless of the consecutive-
+        failure count — for callers holding out-of-band evidence the
+        dependency is down (a serving router seeing a replica's gang
+        heartbeat lapse does not need ``failure_threshold`` failed
+        requests to stop routing there). Recovery is the normal path: the
+        cool-down elapses, a half-open probe succeeds, the breaker
+        closes."""
+        with self._lock:
+            if self._state != self.OPEN:
+                self._trip()
+
     def record_failure(self):
         with self._lock:
             self._tick()

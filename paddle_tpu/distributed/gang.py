@@ -183,12 +183,19 @@ class PeerFailureDetector:
     """
 
     def __init__(self, ctx: GangContext, lease=None, interval=None,
-                 grace=None, prefix=None):
+                 grace=None, prefix=None, ranks=None):
         self.ctx = ctx
         # default: the context's generation-tagged prefix; overridable so
         # other heartbeat schemes (ElasticManager's `{prefix}/host`) can
         # feed the same fast-detection machinery
         self.prefix = prefix or ctx.hb_prefix
+        # membership to sweep: default is the SPMD gang (every rank in
+        # range(world_size) except self). A serving fleet's membership is
+        # elastic — replicas register/deregister over time — so ``ranks``
+        # may be a zero-arg callable returning the CURRENT member ranks
+        # (or a static iterable); deregistered members must not read as
+        # dead forever
+        self._ranks = ranks
         self.lease = float(lease if lease is not None
                            else flag("FLAGS_heartbeat_ttl"))
         self.interval = float(interval if interval is not None
@@ -204,9 +211,14 @@ class PeerFailureDetector:
         self._cached_dead: list[int] = []
         self._lock = threading.Lock()
 
-    def start(self):
-        self._hb = self.ctx.store.register_heartbeat(
-            self.ctx.rank, self.interval, prefix=self.prefix)
+    def start(self, beat=True):
+        """Arm the detector. ``beat=False`` for a pure OBSERVER (a
+        serving router watching replica heartbeats without being a gang
+        member itself) — the grace window still starts now, but no
+        heartbeat is registered for this process."""
+        if beat:
+            self._hb = self.ctx.store.register_heartbeat(
+                self.ctx.rank, self.interval, prefix=self.prefix)
         self._started_at = time.monotonic()
         return self
 
@@ -228,8 +240,14 @@ class PeerFailureDetector:
         try:
             def _sweep():
                 now = time.time()  # wall-clock: x-host (vs store beats)
+                if self._ranks is None:
+                    members = range(self.ctx.world_size)
+                elif callable(self._ranks):
+                    members = self._ranks()
+                else:
+                    members = self._ranks
                 out = []
-                for r in range(self.ctx.world_size):
+                for r in members:
                     if r == self.ctx.rank:
                         continue
                     t = self.ctx.store.last_heartbeat(

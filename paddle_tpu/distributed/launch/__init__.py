@@ -63,36 +63,52 @@ class Pod:
         self.log_dir = log_dir
         self.base_env = env or {}
         self.procs: list[subprocess.Popen] = []
-        self.log_files = []
+        self.log_files: dict[int, object] = {}  # rank -> open handle
+
+    def _spawn(self, rank, extra_env=None):
+        env = dict(os.environ)
+        env.update(self.base_env)
+        if extra_env:
+            env.update(extra_env)
+        # workers run with sys.path[0] = script dir; keep the launcher's
+        # cwd importable (the reference launcher inherits it via cwd)
+        env["PYTHONPATH"] = os.pathsep.join(
+            p for p in (os.getcwd(), env.get("PYTHONPATH", "")) if p)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(self.nprocs),
+            "PADDLE_MASTER": self.master_endpoint,
+            "PADDLE_RANK_IN_NODE": str(rank),
+            "PADDLE_LOCAL_SIZE": str(self.nprocs),
+        })
+        cmd = [sys.executable, self.entry, *self.entry_args]
+        if self.log_dir:
+            # append: a restarted generation must not truncate the
+            # failed generation's diagnostics out of existence. One
+            # handle per rank: a worker-policy fleet respawns ranks
+            # indefinitely and must not leak an fd per restart.
+            old = self.log_files.pop(rank, None)
+            if old is not None:
+                old.close()
+            log = open(os.path.join(self.log_dir, f"worker.{rank}.log"),
+                       "a")
+            self.log_files[rank] = log
+            return subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
+        return subprocess.Popen(cmd, env=env)
 
     def start(self):
         if self.log_dir:
             os.makedirs(self.log_dir, exist_ok=True)
         for rank in range(self.nprocs):
-            env = dict(os.environ)
-            env.update(self.base_env)
-            # workers run with sys.path[0] = script dir; keep the launcher's
-            # cwd importable (the reference launcher inherits it via cwd)
-            env["PYTHONPATH"] = os.pathsep.join(
-                p for p in (os.getcwd(), env.get("PYTHONPATH", "")) if p)
-            env.update({
-                "PADDLE_TRAINER_ID": str(rank),
-                "PADDLE_TRAINERS_NUM": str(self.nprocs),
-                "PADDLE_MASTER": self.master_endpoint,
-                "PADDLE_RANK_IN_NODE": str(rank),
-                "PADDLE_LOCAL_SIZE": str(self.nprocs),
-            })
-            cmd = [sys.executable, self.entry, *self.entry_args]
-            if self.log_dir:
-                # append: a restarted generation must not truncate the
-                # failed generation's diagnostics out of existence
-                log = open(os.path.join(self.log_dir, f"worker.{rank}.log"),
-                           "a")
-                self.log_files.append(log)
-                proc = subprocess.Popen(cmd, env=env, stdout=log, stderr=log)
-            else:
-                proc = subprocess.Popen(cmd, env=env)
-            self.procs.append(proc)
+            self.procs.append(self._spawn(rank))
+
+    def respawn_rank(self, rank, extra_env=None):
+        """Replace ONE dead worker (serving-fleet restart_policy="worker"):
+        the survivors keep running — replica fleets have no gang state
+        forcing a pod-wide re-rendezvous."""
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+        self.procs[rank] = self._spawn(rank, extra_env=extra_env)
 
     def poll(self):
         """None while running; else (rank, returncode) of first failure or
@@ -116,7 +132,7 @@ class Pod:
                 p.wait(max(deadline - time.monotonic(), 0.1))
             except subprocess.TimeoutExpired:
                 p.kill()
-        for f in self.log_files:
+        for f in self.log_files.values():
             f.close()
         self.log_files.clear()
 
@@ -153,7 +169,7 @@ def _log_tail(log_dir, rank, tail_lines):
 def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
            max_restarts=0, env=None, elastic_np=None, restart_window=None,
            backoff_base=0.5, backoff_cap=30.0, poll_interval=0.2,
-           drain_grace=5.0, tail_lines=20):
+           drain_grace=5.0, tail_lines=20, restart_policy="pod"):
     """Run ``entry`` as ``nproc_per_node`` ranked worker processes.
 
     Returns 0 on success. Reference flow (launch/main.py → CollectiveController
@@ -176,7 +192,14 @@ def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
     * the watch loop polls every ``poll_interval`` seconds;
     * a supervisor-owned gang store is exported as ``PADDLE_GANG_STORE``
       (native TCPStore only) and the per-generation rendezvous key
-      ``gang/gen`` is published before each generation starts.
+      ``gang/gen`` is published before each generation starts;
+    * ``restart_policy`` selects the failure domain: ``"pod"`` (default,
+      SPMD training — one death collapses the gang, everyone restarts at
+      a bumped generation) or ``"worker"`` (serving REPLICA fleets — the
+      replicas share no collective state, so only the dead rank is
+      respawned while the survivors keep serving; the restart budget and
+      backoff apply per failure, and the respawned worker alone sees the
+      bumped ``PADDLE_ELASTIC_GENERATION``).
 
     ``elastic_np=(np_min, np_max)`` enables scale-in/out re-rendezvous
     (manager.py _update_fault_tolerance:457): after a worker failure the
@@ -205,12 +228,28 @@ def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
             logger.warning("cannot start gang store (%s); gang recovery "
                            "disabled for this job", e)
 
+    if restart_policy not in ("pod", "worker"):
+        raise ValueError(f"restart_policy must be 'pod' or 'worker', "
+                         f"got {restart_policy!r}")
     restarts = 0
     failure_stamps: list[float] = []
     nproc = nproc_per_node
     generation = 0
     scale_store = store  # client connection created lazily for external masters
     owns_scale_store = False
+
+    def budget_used():
+        # rolling-window budget when restart_window is set, else the
+        # whole-run counter; returns (used, human-readable description)
+        now = time.monotonic()
+        if restart_window is not None:
+            failure_stamps[:] = [t for t in failure_stamps
+                                 if now - t < restart_window]
+            return len(failure_stamps), (
+                f"{len(failure_stamps)}/{max_restarts} restarts in the "
+                f"last {restart_window:g}s")
+        return restarts, f"{restarts}/{max_restarts} restarts"
+
     try:
         while True:
             gen_env = dict(env or {})
@@ -239,6 +278,38 @@ def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
                     time.sleep(poll_interval)
                     continue
                 rank, rc = status
+                if rc != 0 and restart_policy == "worker":
+                    # replica-fleet failure domain: respawn ONLY the dead
+                    # rank; survivors keep serving (no gang to collapse)
+                    kind = _classify_exit(rc)
+                    bump_counter(f"gang.worker_{kind}")
+                    _log_tail(log_dir, rank, tail_lines)
+                    used, budget = budget_used()
+                    if used >= max_restarts:
+                        logger.error(
+                            "replica %d %s (exit code %d); restart budget "
+                            "exhausted (%s)", rank, kind, rc, budget)
+                        pod.stop()
+                        return rc
+                    failure_stamps.append(time.monotonic())
+                    restarts += 1
+                    generation += 1
+                    backoff = min(backoff_base * (2 ** (restarts - 1)),
+                                  backoff_cap)
+                    logger.warning(
+                        "replica %d %s (exit code %d); respawning it alone "
+                        "as generation %d after %.2fs backoff (%s used)",
+                        rank, kind, rc, generation, backoff, budget)
+                    bump_counter("gang.replica_restart")
+                    # deliberately NOT bumping the shared gang/gen key:
+                    # survivors keep serving and must not stand down as
+                    # zombies; only the respawned worker sees the new
+                    # generation (via its env)
+                    if backoff > 0:
+                        time.sleep(backoff)
+                    pod.respawn_rank(rank, extra_env={
+                        "PADDLE_ELASTIC_GENERATION": str(generation)})
+                    continue
                 break
             if rc == 0:
                 return 0
@@ -258,21 +329,12 @@ def launch(entry, entry_args=(), nproc_per_node=1, master=None, log_dir=None,
                             if p.poll() in (None, 0, 143))
             pod.stop()
             _log_tail(log_dir, rank, tail_lines)
-            now = time.monotonic()
-            if restart_window is not None:
-                failure_stamps[:] = [t for t in failure_stamps
-                                     if now - t < restart_window]
-                used = len(failure_stamps)
-                budget = (f"{used}/{max_restarts} restarts in the last "
-                          f"{restart_window:g}s")
-            else:
-                used = restarts
-                budget = f"{used}/{max_restarts} restarts"
+            used, budget = budget_used()
             if used >= max_restarts:
                 logger.error("worker %d %s (exit code %d); restart budget "
                              "exhausted (%s)", rank, kind, rc, budget)
                 return rc
-            failure_stamps.append(now)
+            failure_stamps.append(time.monotonic())
             restarts += 1
             generation += 1
             backoff = min(backoff_base * (2 ** (restarts - 1)), backoff_cap)

@@ -314,6 +314,12 @@ class TCPStore:
         handle.start()
         return handle
 
+    def delete_heartbeat(self, rank: int, prefix: str = "hb") -> None:
+        """Remove ``rank``'s beat key — a member DELIBERATELY leaving
+        (serving-fleet scale-in) must not linger as a stale beat that a
+        lease sweep reads as a death."""
+        self.delete_key(f"{prefix}/{rank}")
+
     def last_heartbeat(self, rank: int, prefix: str = "hb"):
         """Timestamp of ``rank``'s last beat, or None if never seen."""
         key = f"{prefix}/{rank}"

@@ -56,6 +56,7 @@ __all__ += [
 from .generation import generate  # noqa: F401
 from .frontend import RequestResult, ServingFrontend  # noqa: F401
 from .serving import ContinuousBatchingEngine  # noqa: F401
+from .router import ServingRouter, launch_fleet  # noqa: F401
 
 __all__ += ["generate", "ContinuousBatchingEngine", "ServingFrontend",
-            "RequestResult"]
+            "RequestResult", "ServingRouter", "launch_fleet"]

@@ -45,8 +45,14 @@ import itertools
 import numpy as np
 
 from ..core.resilience import CircuitBreaker, Deadline, bump_counter
+from .serving import TERMINAL_STATES as _ENGINE_TERMINAL
 
-__all__ = ["ServingFrontend", "RequestResult"]
+__all__ = ["ServingFrontend", "RequestResult", "TERMINAL_STATES"]
+
+# Every terminal status a frontend result can carry: the engine's set
+# plus the admission-level verdicts minted here. The fleet router's
+# retirement switch is CI-gated against this set.
+TERMINAL_STATES = frozenset(_ENGINE_TERMINAL | {"rejected", "unavailable"})
 
 
 class RequestResult:
@@ -70,10 +76,10 @@ class _Pending:
     """A queued admission, ordered by (priority DESC, arrival ASC)."""
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "priority", "deadline",
-                 "cost", "seq")
+                 "cost", "seq", "token_base")
 
     def __init__(self, rid, prompt, max_new_tokens, priority, deadline,
-                 seq):
+                 seq, token_base=0):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -82,6 +88,7 @@ class _Pending:
         # backlog cost: prompt tokens to prefill + tokens to decode
         self.cost = prompt.size + max_new_tokens
         self.seq = seq
+        self.token_base = token_base
 
     def __lt__(self, other):
         return (-self.priority, self.seq) < (-other.priority, other.seq)
@@ -154,12 +161,29 @@ class ServingFrontend:
         return sum(e.cost for e in self._queue)
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
-               deadline_s=None) -> int:
+               deadline_s=None, rid=None, token_base=0) -> int:
         """Admit one request; returns its rid. Never raises for a bad or
         shed request — the verdict lands in ``results()`` as status
         ``rejected`` (admission control / malformed), ``unavailable``
-        (circuit open), or a terminal decode status later."""
-        rid = next(self._rids)
+        (circuit open), or a terminal decode status later.
+
+        ``rid`` lets a caller that owns the request-id space (the fleet
+        ``ServingRouter`` — sampling streams are keyed on the rid, so a
+        failover replay must reuse it) name the request; a rid already
+        pending here raises ``ValueError``. ``token_base`` is the
+        engine's failover-resume contract (see
+        ``ContinuousBatchingEngine.submit``)."""
+        if rid is None:
+            rid = next(self._rids)
+        else:
+            if rid in self._inflight or any(e.rid == rid
+                                            for e in self._queue):
+                raise ValueError(f"rid {rid} is already pending on this "
+                                 "frontend")
+            if isinstance(rid, int) and rid >= 0:
+                # keep auto rids strictly above explicit ones (no aliasing)
+                self._rids = itertools.count(
+                    max(rid + 1, next(self._rids)))
         if self._closed or self._draining:
             return self._reject(rid, "shutting down")
         max_new = (self.default_max_new_tokens if max_new_tokens is None
@@ -185,7 +209,8 @@ class ServingFrontend:
             probe = True
         entry = _Pending(rid, prompt, max_new, int(priority),
                          (deadline_s if isinstance(deadline_s, Deadline)
-                          else Deadline(deadline_s)), next(self._seq))
+                          else Deadline(deadline_s)), next(self._seq),
+                         token_base=int(token_base))
         self._sweep_expired()  # dead entries must not shed live traffic
         # bounded admission: shed the lowest-priority queued request
         # (LAST in sorted order) while budgets are exceeded — but only
@@ -270,7 +295,8 @@ class ServingFrontend:
             entry = self._queue.pop(0)
             req = self.engine.submit(entry.prompt, entry.max_new_tokens,
                                      deadline_s=entry.deadline,
-                                     rid=entry.rid)
+                                     rid=entry.rid,
+                                     token_base=entry.token_base)
             self._inflight[entry.rid] = req
             room -= 1
         if self.engine.has_work():
@@ -367,6 +393,12 @@ class ServingFrontend:
                 self._cancel_bookkeeping(req.rid, tokens=req.output(),
                                          reason="shutdown cancelled "
                                                 "in-flight")
+            # cancelling in-flight slots can strand a dispatched-but-
+            # unconsumed pipeline segment; drain it so the engine ends
+            # the session clean (its emissions are discarded — every
+            # request is already terminal)
+            while self.engine.has_work():
+                self._watched(lambda: self._record(self.engine.step()))
         self._closed = True
 
     # -------------------------------------------------------------- health
@@ -379,9 +411,22 @@ class ServingFrontend:
                 and self.breaker.state() != CircuitBreaker.OPEN)
 
     def health(self) -> dict:
-        """Snapshot for watchdogs/load-balancers: overall ``state``
-        (``ok | degraded | draining | unavailable | stopped``), breaker
-        state, queue depth/backlog, and slot occupancy."""
+        """Snapshot for watchdogs and load-balancers — ONE machine-readable
+        payload (plain ints/floats/strings only) with everything a router
+        needs to score and gate this replica:
+
+        * overall ``state`` (``ok | degraded | draining | unavailable |
+          stopped``) and ``ready``;
+        * breaker detail: ``breaker`` state plus ``breaker_failures``
+          (consecutive failures while closed — a replica drifting toward
+          its trip point scores worse before it trips);
+        * load: ``queue_depth`` / ``queued_tokens`` backlog,
+          ``queue_by_priority`` per request class (``{priority: [depth,
+          queued_tokens]}``), and ``inflight`` (admitted to the engine,
+          not yet terminal);
+        * KV-slot occupancy: ``active_slots`` / ``free_slots`` /
+          ``kv_slots`` (total) / ``kv_occupancy`` (active/total).
+        """
         breaker_state = self.breaker.state()
         if self._closed:
             state = "stopped"
@@ -393,13 +438,25 @@ class ServingFrontend:
             state = "degraded"
         else:
             state = "ok"
+        by_prio: dict[int, list] = {}
+        for e in self._queue:
+            row = by_prio.setdefault(int(e.priority), [0, 0])
+            row[0] += 1
+            row[1] += e.cost
+        active = len(self.engine.active_requests())
+        total = int(self.engine.max_slots)
         return {
             "state": state,
             "ready": self.ready(),
             "breaker": breaker_state,
+            "breaker_failures": self.breaker.failures,
             "draining": self._draining,
             "queue_depth": len(self._queue),
             "queued_tokens": self.queued_tokens(),
-            "active_slots": len(self.engine.active_requests()),
+            "queue_by_priority": by_prio,
+            "inflight": len(self._inflight),
+            "active_slots": active,
             "free_slots": self.engine.free_slots(),
+            "kv_slots": total,
+            "kv_occupancy": (active / total) if total else 0.0,
         }

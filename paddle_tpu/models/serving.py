@@ -51,7 +51,14 @@ from ..core.tensor import Tensor
 from ..profiler import annotate
 from .generation import _make_paged_cache, _sample_rows
 
-__all__ = ["ContinuousBatchingEngine", "Request"]
+__all__ = ["ContinuousBatchingEngine", "Request", "TERMINAL_STATES"]
+
+# Every terminal status the engine can stamp on a Request (the frontend
+# adds admission-level "rejected"/"unavailable" on top). The router's
+# retirement switch is CI-gated against this set
+# (tests/test_no_bare_except.py): a new terminal state added here without
+# a router handler fails the guard, not production traffic.
+TERMINAL_STATES = frozenset({"ok", "timed_out", "failed", "cancelled"})
 
 define_flag("FLAGS_serving_pipeline", True,
             "Overlap host bookkeeping with the next compiled decode "
@@ -67,12 +74,21 @@ class Request:
     ``poisoned`` is the sticky poison mark set when the
     ``serving.engine_fault`` injection site fires for this request, so
     bisection retries fail deterministically on the same offender.
+
+    ``token_base`` is the request's sampling-stream offset: a FAILOVER
+    RESUME (router resubmitting a request stranded on a dead replica)
+    submits ``original prompt + the k tokens already emitted`` as the
+    prompt with ``token_base=k``, so the first token sampled here is
+    stream index ``k`` — bit-identical to the continuation the
+    uninterrupted run would have produced.
     """
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "deadline", "tokens",
-                 "status", "poisoned", "poison_checked", "error")
+                 "status", "poisoned", "poison_checked", "error",
+                 "token_base")
 
-    def __init__(self, rid, prompt, max_new_tokens, deadline=None):
+    def __init__(self, rid, prompt, max_new_tokens, deadline=None,
+                 token_base=0):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
@@ -82,6 +98,7 @@ class Request:
         self.poisoned = False
         self.poison_checked = False
         self.error = None
+        self.token_base = int(token_base)
 
     def output(self):
         return np.asarray(self.tokens[:self.max_new_tokens], np.int32)
@@ -440,14 +457,16 @@ class ContinuousBatchingEngine:
         return (h >> np.uint64(32)).astype(np.uint32)
 
     def _prefill_keys(self, group, g):
-        # first token of each admitted request: index 0 of its stream
+        # first token of each admitted request: index ``token_base`` of
+        # its stream (0 for fresh requests; k for a failover resume that
+        # already emitted k tokens elsewhere)
         shape = (g,) + self._key_shape
         if not self.do_sample:
             return self._key_zeros(shape)
         bits = np.zeros(shape, np.uint32)
         for i, (_, req) in enumerate(group):
-            bits[i] = self._req_key_block(req.rid, 0, 1).reshape(
-                self._key_shape)
+            bits[i] = self._req_key_block(req.rid, req.token_base,
+                                          1).reshape(self._key_shape)
         return jnp.asarray(bits)
 
     def _segment_keys(self, offset):
@@ -464,8 +483,8 @@ class ContinuousBatchingEngine:
             if req is None:
                 continue
             bits[:, slot] = self._req_key_block(
-                req.rid, len(req.tokens) + offset, seg).reshape(
-                    (seg,) + self._key_shape)
+                req.rid, req.token_base + len(req.tokens) + offset,
+                seg).reshape((seg,) + self._key_shape)
         return jnp.asarray(bits)
 
     # ----------------------------------------------------------- scheduler
@@ -546,11 +565,19 @@ class ContinuousBatchingEngine:
         self._t0 = time.monotonic()
         return self
 
-    def submit(self, prompt, max_new_tokens, deadline_s=None, rid=None):
+    def submit(self, prompt, max_new_tokens, deadline_s=None, rid=None,
+               token_base=0):
         """Enqueue one request (requires a prior ``start()``); raises
         ``ValueError`` if it can never fit a slot. ``deadline_s`` is a
         per-request budget (seconds or a ``Deadline``), measured from
-        submission so queue wait counts. Returns the ``Request`` handle."""
+        submission so queue wait counts. Returns the ``Request`` handle.
+
+        ``token_base=k`` is the FAILOVER RESUME contract: ``prompt``
+        must be the original prompt plus the ``k`` tokens already
+        emitted elsewhere, and ``max_new_tokens`` the REMAINING budget —
+        sampling keys start at stream index ``k``, so the continuation
+        is bit-identical to the uninterrupted run's (same engine seed,
+        same rid)."""
         prompt = np.asarray(prompt).astype(np.int32).ravel()
         self._validate(prompt, max_new_tokens)
         if rid is None:
@@ -562,13 +589,19 @@ class ContinuousBatchingEngine:
             self._auto_rid = rid + 1
         deadline = (deadline_s if isinstance(deadline_s, Deadline)
                     else Deadline(deadline_s))
-        req = Request(rid, prompt, max_new_tokens, deadline)
+        req = Request(rid, prompt, max_new_tokens, deadline,
+                      token_base=token_base)
         self._queue.append(req)
         return req
 
     def has_work(self) -> bool:
-        return bool(self._queue) or any(
-            r is not None for r in self._slot_req)
+        # the unconsumed in-flight segment counts as work: after the last
+        # live request is aborted mid-pipeline the carry must still be
+        # drained by one more step() — otherwise it leaks device buffers
+        # and a later submit would consume a segment built on a dead mask
+        return (bool(self._queue)
+                or any(r is not None for r in self._slot_req)
+                or getattr(self, "_inflight", None) is not None)
 
     def free_slots(self) -> int:
         return sum(r is None for r in self._slot_req)
@@ -817,6 +850,14 @@ class ContinuousBatchingEngine:
             # wholesale assignment composes across bisected sub-batches
             self._lengths = lengths.copy()
             self._cur_tok = cur_tok.copy()
+            # slots freed while this segment was in flight (abort /
+            # failover retirement) must stay at the idle length — the
+            # device view still carries the dead request's advance, and
+            # resurrecting it here would hand the next admission a slot
+            # that lies about its occupancy
+            for slot, req in enumerate(self._slot_req):
+                if req is None:
+                    self._lengths[slot] = 1
             for slot in np.flatnonzero(h["mask"]):
                 req = self._slot_req[slot]
                 if req is None:

@@ -369,9 +369,21 @@ def _apply_op_impl(op: OpDef, args, kwargs):
                 return tuple(g if need else None
                              for g, need in zip(grads, needs))
 
-            def backward_fn(grad_outputs, _pure=pure_bwd,
-                            _primals=saved_primals):
-                return _pure(_primals, grad_outputs)
+            # autograd.saved_tensors_hooks: pack the captured primals at
+            # record time; backward unpacks. The closure must not also
+            # pin the raw arrays or the pack (e.g. host offload) frees
+            # nothing.
+            restore_saved = autograd.pack_saved_values(saved_primals)
+            if restore_saved is None:
+                def backward_fn(grad_outputs, _pure=pure_bwd,
+                                _primals=saved_primals):
+                    return _pure(_primals, grad_outputs)
+            else:
+                saved_primals = None
+
+                def backward_fn(grad_outputs, _pure=pure_bwd,
+                                _restore=restore_saved):
+                    return _pure(_restore(), grad_outputs)
 
         elif vjp_fn is not None:
             out_shapes = [(v.shape, v.dtype) for v in outs_flat]
@@ -415,17 +427,64 @@ def _apply_op_impl(op: OpDef, args, kwargs):
                     flat.append(g if need else None)
                 return tuple(flat)
 
-            def backward_fn(grad_outputs, _rule=rule):
-                ctx = Ctx(saved_in, attrs, saved_out, needs_decl)
+            # autograd.saved_tensors_hooks: pack every captured array —
+            # inputs (incl. list entries) and outputs the rule may read —
+            # at record time; backward rebuilds the saved structure
+            # through the unpack hook. The nulled template (not saved_in)
+            # lives in the closure so the pack actually releases arrays.
+            flat_layout = []
+            flat_arrays = []
+            for pos, v in enumerate(saved_in):
+                if isinstance(v, list):
+                    for sub, item in enumerate(v):
+                        if isinstance(item, jax.Array):
+                            flat_layout.append((pos, sub))
+                            flat_arrays.append(item)
+                elif isinstance(v, jax.Array):
+                    flat_layout.append((pos, None))
+                    flat_arrays.append(v)
+            n_in_arrays = len(flat_arrays)
+            restore_saved = autograd.pack_saved_values(
+                flat_arrays + list(saved_out))
+            if restore_saved is None:
+                def materialize_saved():
+                    return saved_in, saved_out
+            else:
+                template = [list(v) if isinstance(v, list) else v
+                            for v in saved_in]
+                for pos, sub in flat_layout:
+                    if sub is None:
+                        template[pos] = None
+                    else:
+                        template[pos][sub] = None
+                saved_in = saved_out = None
+
+                def materialize_saved(_restore=restore_saved,
+                                      _layout=flat_layout, _n=n_in_arrays):
+                    vals = _restore()
+                    s_in = [list(v) if isinstance(v, list) else v
+                            for v in template]
+                    for (pos, sub), v in zip(_layout, vals[:_n]):
+                        if sub is None:
+                            s_in[pos] = v
+                        else:
+                            s_in[pos][sub] = v
+                    return s_in, vals[_n:]
+
+            def backward_fn(grad_outputs, _rule=rule,
+                            _saved=materialize_saved):
+                s_in, s_out = _saved()
+                ctx = Ctx(s_in, attrs, s_out, needs_decl)
                 return _flatten_decl(_rule(ctx, *grad_outputs))
 
             def pure_bwd(primal_vals, grad_outputs, _rule=rule,
-                         _kernel=op.kernel, _names=op.input_names):
+                         _kernel=op.kernel, _names=op.input_names,
+                         _saved=materialize_saved):
                 # create_graph route: recompute the forward from the primal
                 # arguments so saved outputs used by the rule (e.g. tanh's y)
                 # stay differentiable w.r.t. the inputs
                 vals = [list(v) if isinstance(v, list) else v
-                        for v in saved_in]
+                        for v in _saved()[0]]
                 _scatter(vals, specs, primal_vals)
                 out = _kernel(**dict(zip(_names, vals)), **attrs)
                 outs2 = list(out) if isinstance(out, (tuple, list)) else [out]
@@ -435,9 +494,14 @@ def _apply_op_impl(op: OpDef, args, kwargs):
         node = GradNode(op.name, backward_fn, edges, len(outs_flat), tuple(needs))
         if use_cached_vjp or (vjp_fn is None and op.backward is not None):
             # create_graph support; only set alongside pure_bwd so the
-            # vjp-fallback path doesn't pin input Tensor wrappers for nothing
-            node.pure_bwd = pure_bwd
-            node.in_tensors = list(in_tensors)
+            # vjp-fallback path doesn't pin input Tensor wrappers for
+            # nothing. With saved_tensors_hooks active the node must not
+            # pin the input wrappers either (the pack — e.g. host offload
+            # — would free nothing); create_graph through a hook-packed
+            # node then raises the standard informative error.
+            if restore_saved is None:
+                node.pure_bwd = pure_bwd
+                node.in_tensors = list(in_tensors)
         for i, t in enumerate(out_tensors):
             # Integer/bool outputs (indices from topk/argsort/...) carry no
             # gradient: keep them stop_gradient=True so jax.vjp never sees a

@@ -15,6 +15,13 @@
   legitimate wall-clock use is a timestamp that crosses hosts via the
   store (monotonic clocks don't share an epoch across hosts); those
   lines carry an explicit ``# wall-clock`` pragma the guard honors.
+* The fleet router's retirement switch must handle EVERY terminal
+  status a replica can emit (``models/serving.py TERMINAL_STATES`` +
+  the frontend's admission verdicts): a new engine status without a
+  router handler would silently drop client requests on the floor —
+  this guard fails the build instead. (Both the bare-except and
+  wall-clock bans above cover ``models/router.py`` through the
+  ``models`` tree.)
 """
 import pathlib
 import re
@@ -88,3 +95,48 @@ def test_no_aliased_wall_clock_imports(subdir):
         "as ...` / `from time import time`) hides wall-clock calls from "
         "the time.time() guard — import the module plainly so every "
         f"wall-clock use is greppable: {offenders}")
+
+
+def test_router_retirement_switch_covers_every_terminal_state():
+    """Every terminal status the engine can stamp on a Request — and
+    every admission verdict the frontend adds on top — must have a
+    handler in the router's retirement switch. A status falling through
+    the switch is a silently dropped client request."""
+    from paddle_tpu.models import frontend, serving
+    from paddle_tpu.models.router import ServingRouter
+
+    handled = set(ServingRouter._RETIREMENT)
+    missing_engine = serving.TERMINAL_STATES - handled
+    assert not missing_engine, (
+        f"engine terminal state(s) {sorted(missing_engine)} have no "
+        "handler in ServingRouter._RETIREMENT — a replica retiring a "
+        "request with one of these would strand it forever")
+    missing_frontend = frontend.TERMINAL_STATES - handled
+    assert not missing_frontend, (
+        f"frontend terminal state(s) {sorted(missing_frontend)} have no "
+        "handler in ServingRouter._RETIREMENT")
+    # every handler must actually exist and be callable
+    for status, name in ServingRouter._RETIREMENT.items():
+        assert callable(getattr(ServingRouter, name, None)), (
+            f"router handler {name!r} for status {status!r} is missing")
+
+
+def test_engine_retire_only_stamps_declared_terminal_states():
+    """The TERMINAL_STATES contract goes both ways: every status the
+    engine's scheduler actually stamps (grepped from _retire/abort call
+    sites in serving.py) must be declared, or the router guard above is
+    checking a stale set."""
+    import pathlib
+
+    from paddle_tpu.models import serving
+
+    src = (pathlib.Path(serving.__file__)).read_text()
+    stamped = set(re.findall(
+        r"_retire\([^,]+,\s*\"(\w+)\"", src))
+    stamped |= set(re.findall(r"abort\([^,]*,\s*status=\"(\w+)\"", src))
+    stamped.discard("pending")
+    undeclared = stamped - serving.TERMINAL_STATES
+    assert not undeclared, (
+        f"serving.py stamps terminal state(s) {sorted(undeclared)} not "
+        "declared in TERMINAL_STATES — declare them so the router "
+        "retirement guard sees them")

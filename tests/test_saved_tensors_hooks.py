@@ -1,0 +1,180 @@
+"""paddle.autograd.saved_tensors_hooks (VERDICT r5 §8: the reference API
+python/paddle/autograd/saved_tensors_hooks.py was missing and failed the
+namespace gate).
+
+Pack hooks run at capture (forward) time, unpack hooks when backward
+materializes the value; gradients must be bit-identical with and without
+hooks; PyLayer's save_for_backward rides the same pair; the registration
+is a nestable context and capture-time choice sticks."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer, saved_tensors_hooks
+from paddle_tpu.core.autograd import get_saved_tensors_hooks
+
+
+def _leaf(shape=(3, 4), seed=0):
+    rng = np.random.RandomState(seed)
+    t = paddle.to_tensor(rng.rand(*shape).astype(np.float32))
+    t.stop_gradient = False
+    return t
+
+
+def _counting_hooks(log):
+    def pack(t):
+        log["pack"] += 1
+        return np.asarray(t._value)  # offload to host
+
+    def unpack(p):
+        log["unpack"] += 1
+        return paddle.to_tensor(p)   # back to device
+
+    return pack, unpack
+
+
+def test_namespace_exposes_saved_tensors_hooks():
+    assert hasattr(paddle.autograd, "saved_tensors_hooks")
+
+
+def test_pack_runs_at_forward_unpack_at_backward():
+    log = {"pack": 0, "unpack": 0}
+    x = _leaf()
+    with saved_tensors_hooks(*_counting_hooks(log)):
+        y = (x * x).sum()
+        assert log["pack"] > 0          # capture happened inside forward
+        assert log["unpack"] == 0       # nothing materialized yet
+    y.backward()
+    assert log["unpack"] > 0
+
+
+def test_gradients_bit_identical_with_host_offload_hooks():
+    x = _leaf(seed=3)
+    y0 = (paddle.exp(x) * x).sum()
+    y0.backward()
+    want = np.asarray(x.grad._value)
+    x.clear_gradient()
+    log = {"pack": 0, "unpack": 0}
+    with saved_tensors_hooks(*_counting_hooks(log)):
+        y1 = (paddle.exp(x) * x).sum()
+    y1.backward()
+    np.testing.assert_array_equal(np.asarray(x.grad._value), want)
+    assert log["pack"] > 0 and log["unpack"] > 0
+
+
+def test_capture_time_choice_sticks():
+    """A tensor saved OUTSIDE the context backwards without hooks even if
+    backward runs inside one, and vice versa (reference semantics)."""
+    log = {"pack": 0, "unpack": 0}
+    x = _leaf(seed=1)
+    y_out = (x * x).sum()               # captured hook-free
+    with saved_tensors_hooks(*_counting_hooks(log)):
+        y_out.backward()
+        assert log["unpack"] == 0       # no packed state to unpack
+    x.clear_gradient()
+    with saved_tensors_hooks(*_counting_hooks(log)):
+        y_in = (x * x).sum()            # captured WITH hooks
+    packs = log["pack"]
+    assert packs > 0
+    y_in.backward()                     # outside the context
+    assert log["unpack"] > 0
+
+
+def test_contexts_nest_and_restore():
+    a = {"pack": 0, "unpack": 0}
+    b = {"pack": 0, "unpack": 0}
+    x = _leaf(seed=2)
+    assert get_saved_tensors_hooks() is None
+    with saved_tensors_hooks(*_counting_hooks(a)):
+        with saved_tensors_hooks(*_counting_hooks(b)):
+            y_inner = (x * 2.0 * x).sum()
+        y_outer = (x * 3.0 * x).sum()
+    assert get_saved_tensors_hooks() is None
+    inner_packs, outer_packs = b["pack"], a["pack"]
+    assert inner_packs > 0 and outer_packs > 0
+    y_inner.backward()
+    y_outer.backward()
+    assert b["unpack"] > 0 and a["unpack"] > 0
+
+
+def test_pylayer_save_for_backward_rides_hooks():
+    log = {"pack": 0, "unpack": 0}
+
+    class Scale(PyLayer):
+        @staticmethod
+        def forward(ctx, x, alpha):
+            ctx.save_for_backward(x)
+            ctx.alpha = alpha
+            return x * alpha
+
+        @staticmethod
+        def backward(ctx, g):
+            (x,) = ctx.saved_tensor()
+            assert isinstance(x, paddle.Tensor)
+            return g * ctx.alpha
+
+    x = _leaf(seed=4)
+    with saved_tensors_hooks(*_counting_hooks(log)):
+        y = Scale.apply(x, 3.0)
+    packs_after_apply = log["pack"]
+    assert packs_after_apply >= 1       # ctx.save_for_backward packed
+    y.sum().backward()
+    assert log["unpack"] >= 1
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               np.full((3, 4), 3.0), rtol=1e-6)
+
+
+def test_pylayer_non_tensor_saves_pass_through():
+    log = {"pack": 0, "unpack": 0}
+
+    class Mix(PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x, 2.5)     # tensor + plain scalar
+            return x * 2.5
+
+        @staticmethod
+        def backward(ctx, g):
+            x, scale = ctx.saved_tensor()
+            assert scale == 2.5
+            return g * scale
+
+    x = _leaf(seed=5)
+    with saved_tensors_hooks(*_counting_hooks(log)):
+        y = Mix.apply(x)
+    # exactly ONE pack so far: the saved tensor (the 2.5 passed through
+    # untouched; forward itself runs under no_grad so its ops record
+    # nothing)
+    assert log["pack"] == 1
+    y.sum().backward()
+    np.testing.assert_allclose(np.asarray(x.grad._value),
+                               np.full((3, 4), 2.5), rtol=1e-6)
+
+
+def test_explicit_rule_ops_pack_saved_inputs_and_outputs():
+    """Ops with declared backward rules (e.g. tanh reads its saved
+    output) must route their saved values through the hooks too."""
+    log = {"pack": 0, "unpack": 0}
+    x = _leaf(seed=6)
+    y0 = paddle.tanh(x).sum()
+    y0.backward()
+    want = np.asarray(x.grad._value)
+    x.clear_gradient()
+    with saved_tensors_hooks(*_counting_hooks(log)):
+        y1 = paddle.tanh(x).sum()
+    y1.backward()
+    assert log["pack"] > 0 and log["unpack"] > 0
+    np.testing.assert_array_equal(np.asarray(x.grad._value), want)
+
+
+def test_non_callable_hooks_raise():
+    with pytest.raises(TypeError):
+        with saved_tensors_hooks("not-callable", lambda p: p):
+            pass
+
+
+def test_hooks_do_not_leak_after_exception():
+    with pytest.raises(RuntimeError):
+        with saved_tensors_hooks(lambda t: t, lambda p: p):
+            raise RuntimeError("boom")
+    assert get_saved_tensors_hooks() is None
