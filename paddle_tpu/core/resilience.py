@@ -45,7 +45,7 @@ from .flags import define_flag, flag
 __all__ = [
     "RetryPolicy", "Deadline", "CircuitBreaker",
     "CommTimeoutError", "InjectedFault", "CheckpointCorruptionError",
-    "PeerFailureError",
+    "PeerFailureError", "ServingUnavailable",
     "inject", "fault_remaining", "reset_faults",
     "bump_counter", "get_counter", "counters", "reset_counters",
 ]
@@ -91,6 +91,16 @@ class CommTimeoutError(TimeoutError):
 
 class CheckpointCorruptionError(RuntimeError):
     """A checkpoint shard failed its recorded CRC32 on load."""
+
+
+class ServingUnavailable(RuntimeError):
+    """A serving replica refused work: its frontend is stopped/draining,
+    its circuit breaker is open, or (cross-process) the addressed
+    ``ReplicaServer`` is not registered on the callee. Raised instead of
+    a generic RuntimeError so a router-side caller can classify it as
+    replica-level unavailability (reroute) rather than a request-level
+    bug — and so the RPC transport can re-raise it TYPED on the caller
+    side (models/remote.py, distributed/rpc.py)."""
 
 
 class PeerFailureError(Exception):

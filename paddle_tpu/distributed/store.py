@@ -227,18 +227,22 @@ class TCPStore:
 
         return self._retrying("store_set", _op)
 
-    def get(self, key: str) -> bytes:
-        """Blocking get under ``self.timeout``. The native GET blocks
-        SERVER-side until the key exists with no wire timeout, so a key
-        a dead peer was supposed to write would hang this client past
-        every budget; instead the wait is a cheap non-blocking check()
-        poll that (a) honors the store timeout like the python fallback
-        does and (b) consults the active gang PeerFailureDetector
-        between slices — a dead peer surfaces as ``PeerFailureError``
-        within one heartbeat lease instead of a 900s wedge."""
+    def get(self, key: str, timeout=None) -> bytes:
+        """Blocking get under ``self.timeout`` (or a per-call
+        ``timeout`` override — the RPC transport waits on reply keys
+        with the CALL's budget, not the store's 900s rendezvous
+        default). The native GET blocks SERVER-side until the key
+        exists with no wire timeout, so a key a dead peer was supposed
+        to write would hang this client past every budget; instead the
+        wait is a cheap non-blocking check() poll that (a) honors the
+        timeout like the python fallback does and (b) consults the
+        active gang PeerFailureDetector between slices — a dead peer
+        surfaces as ``PeerFailureError`` within one heartbeat lease
+        instead of a 900s wedge."""
         from . import gang
 
-        deadline = Deadline.after(self.timeout)
+        budget = self.timeout if timeout is None else timeout
+        deadline = Deadline.after(budget)
         poll = 0.05
         while not self.check(key):
             det = gang.get_active_detector()
@@ -247,12 +251,12 @@ class TCPStore:
             if deadline.expired():
                 raise TimeoutError(
                     f"TCPStore.get({key!r}) timed out "
-                    f"after {self.timeout}s")
+                    f"after {budget}s")
             time.sleep(poll)
 
         def _op():
             if self._py is not None:
-                return self._py.get(key, self.timeout)
+                return self._py.get(key, budget)
             buf = ctypes.create_string_buffer(1 << 20)
             n = self._lib.tcpstore_get(self._client, key.encode(), buf,
                                        len(buf))
@@ -269,6 +273,41 @@ class TCPStore:
             return buf.raw[:n]
 
         return self._retrying("store_get", _op, deadline=deadline)
+
+    def get_now(self, key: str) -> bytes:
+        """Fast-path get for a key the caller KNOWS exists (it just saw
+        ``check(key)`` true): no check poll, no detector consult — the
+        RPC transport's per-call latency budget is built from these.
+        Raises ``KeyError`` if the key is in fact absent (a concurrent
+        delete can still slip between the existence check and the native
+        GET — the caller owns that race; the RPC transport treats it as
+        a vanished reply and re-polls)."""
+
+        def _op():
+            if self._py is not None:
+                if not self._py.check(key):
+                    raise KeyError(key)
+                return self._py.get(key, 0.001)
+            # the native GET blocks SERVER-side forever on an absent key
+            # (no wire timeout): spend one check so a plainly-missing key
+            # raises the documented KeyError instead of wedging the thread
+            if self._lib.tcpstore_check(self._client, key.encode()) != 1:
+                raise KeyError(key)
+            buf = ctypes.create_string_buffer(1 << 16)
+            n = self._lib.tcpstore_get(self._client, key.encode(), buf,
+                                       len(buf))
+            if n < 0:
+                raise ConnectionError("TCPStore.get_now failed")
+            if n > len(buf):
+                # oversized value: GET is idempotent, re-request exact
+                buf = ctypes.create_string_buffer(n)
+                n = self._lib.tcpstore_get(self._client, key.encode(),
+                                           buf, len(buf))
+                if n < 0:
+                    raise ConnectionError("TCPStore.get_now failed")
+            return buf.raw[:n]
+
+        return self._retrying("store_get", _op)
 
     def add(self, key: str, delta: int) -> int:
         def _op():

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import contextlib
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -154,6 +155,17 @@ def _closure_layers(fn):
     return found
 
 
+# Guards the swap-run-restore window below. The swap mutates the LIVE
+# Layer's parameters, so two threads tracing the same model concurrently
+# (e.g. two serving engines sharing weights, each behind an RPC dispatcher
+# worker) would read each other's tracers out of the shared object —
+# escaping their trace as an UnexpectedTracerError. RLock: a traced
+# forward may re-enter for a nested _FunctionalModel. Held only while
+# Python runs the forward (trace time / eager fallback); steady-state
+# compiled dispatch never takes it.
+_swap_lock = threading.RLock()
+
+
 class _FunctionalModel:
     """Pure-function view of a Layer (or plain function): swap traced arrays
     into the live Parameters, run forward, capture buffer updates, restore.
@@ -177,6 +189,11 @@ class _FunctionalModel:
                 for k, b in lay.named_buffers()}
 
     def _call_fn_mode(self, params, buffers, args, kwargs, rng_key):
+        with _swap_lock:
+            return self._call_fn_mode_locked(params, buffers, args, kwargs,
+                                             rng_key)
+
+    def _call_fn_mode_locked(self, params, buffers, args, kwargs, rng_key):
         layers = self.closure_layers
         saved = [(dict((k, p._value) for k, p in lay.named_parameters()),
                   dict((k, b._value) for k, b in lay.named_buffers()))
@@ -213,6 +230,12 @@ class _FunctionalModel:
             with _traced_rng(jax.random.wrap_key_data(rng_key)):
                 out = self.fn(*_as_tensor_tree(args), **_as_tensor_tree(kwargs))
             return _as_array_tree(out), {}
+        with _swap_lock:
+            return self._call_layer_locked(params, buffers, args, kwargs,
+                                           rng_key)
+
+    def _call_layer_locked(self, params, buffers, args, kwargs, rng_key):
+        layer = self.layer
         saved_p = {k: p._value for k, p in layer.named_parameters()}
         buffer_objs = dict(layer.named_buffers())
         saved_b = {k: b._value for k, b in buffer_objs.items()}

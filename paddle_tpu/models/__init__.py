@@ -57,6 +57,7 @@ from .generation import generate  # noqa: F401
 from .frontend import RequestResult, ServingFrontend  # noqa: F401
 from .serving import ContinuousBatchingEngine  # noqa: F401
 from .router import ServingRouter, launch_fleet  # noqa: F401
+from .remote import RemoteFrontend, ReplicaServer, replica_main  # noqa: F401
 
 __all__ += ["generate", "ContinuousBatchingEngine", "ServingFrontend",
             "RequestResult", "ServingRouter", "launch_fleet"]

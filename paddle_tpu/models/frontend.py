@@ -109,6 +109,11 @@ class ServingFrontend:
         fe.shutdown(drain=True)
     """
 
+    # the router treats local frontends and RemoteFrontend stubs
+    # (models/remote.py) interchangeably; this flag picks the handling
+    # that differs (who heartbeats, who pumps)
+    is_remote = False
+
     def __init__(self, engine, max_queue=64, max_queued_tokens=None,
                  default_max_new_tokens=64, segment=16, breaker=None,
                  breaker_threshold=5, breaker_cooldown_s=30.0,
@@ -139,6 +144,15 @@ class ServingFrontend:
         submitted request hits only precompiled programs."""
         return self.engine.warmup(segment=self._segment,
                                   cache_dir=cache_dir)
+
+    def fingerprint(self) -> tuple:
+        """The engine identity a fleet router checks at registration:
+        replicas serving the same weights with the same seed/sampling
+        config produce bit-identical streams, which is the failover
+        contract. Plain numbers so it crosses the RPC wire."""
+        eng = self.engine
+        return (eng._seed, eng.do_sample, eng.temperature, eng.top_k,
+                eng.top_p, eng.eos_token_id)
 
     # ------------------------------------------------------------ admission
 
@@ -337,12 +351,15 @@ class ServingFrontend:
         queued requests are already tracked in ``_inflight``)."""
         return len(self._queue) + len(self._inflight)
 
-    def results(self, wait=False) -> dict:
+    def results(self, wait=False, timeout=None) -> dict:
         """Pop terminal results as ``{rid: RequestResult}``. With
         ``wait=True`` the frontend pumps ``step()`` until every pending
-        request resolves."""
+        request resolves (bounded by ``timeout`` seconds when given —
+        the same per-call budget a ``RemoteFrontend`` stub honors)."""
         if wait:
-            while self.pending() or self.engine.has_work():
+            deadline = Deadline(timeout)
+            while ((self.pending() or self.engine.has_work())
+                   and not deadline.expired()):
                 self.step()
         out, self._results = self._results, {}
         return out

@@ -51,17 +51,29 @@ import time
 
 import numpy as np
 
-from ..core.resilience import CircuitBreaker, Deadline, bump_counter, logger
+from ..core.resilience import (
+    CircuitBreaker,
+    Deadline,
+    ServingUnavailable,
+    bump_counter,
+    logger,
+)
 from .frontend import RequestResult
 
 __all__ = ["ServingRouter", "launch_fleet"]
+
+# a call into a replica failing with one of these is REPLICA-level
+# evidence (process dead, transport down, server deregistered), not a
+# request-level verdict: the router kills the replica and fails over.
+# CommTimeoutError is a TimeoutError; InjectedFault a ConnectionError.
+_TRANSPORT_ERRORS = (ConnectionError, TimeoutError, ServingUnavailable)
 
 
 class _Replica:
     """One registered replica: frontend + router-side health state."""
 
     __slots__ = ("id", "frontend", "breaker", "state", "hb", "assigned",
-                 "probes", "served")
+                 "probes", "served", "h_cache", "h_ts")
 
     def __init__(self, rep_id, frontend, breaker):
         self.id = rep_id
@@ -72,6 +84,8 @@ class _Replica:
         self.assigned: set = set()   # rids currently pending here
         self.probes: set = set()     # rids riding a half-open probe slot
         self.served = 0
+        self.h_cache = None          # remote health snapshot + its age
+        self.h_ts = 0.0
 
 
 class _FleetRequest:
@@ -117,10 +131,11 @@ class ServingRouter:
                  default_max_new_tokens=64, token_unit=64,
                  store=None, fleet_prefix="fleet", lease=None,
                  heartbeat_interval=None, breaker_threshold=3,
-                 breaker_cooldown_s=30.0):
+                 breaker_cooldown_s=30.0, health_ttl=0.05):
         from ..core.flags import flag
 
         self.max_failovers = int(max_failovers)
+        self.health_ttl = float(health_ttl)  # remote snapshot reuse window
         self.hedge_default = bool(hedge)
         self.default_max_new_tokens = int(default_max_new_tokens)
         self.token_unit = float(token_unit)
@@ -145,6 +160,14 @@ class ServingRouter:
         if store is not None:
             from ..distributed.gang import GangContext, PeerFailureDetector
 
+            # publish the beat cadence replica PROCESSES must honor:
+            # they beat for themselves (a router-side beat would mask
+            # their death), and an interval derived from their own local
+            # FLAGS default could exceed this router's lease — replicas
+            # would flap dead while perfectly alive (replica_main reads
+            # this key before starting its heartbeat)
+            store.set(f"{fleet_prefix}/hb_interval",
+                      repr(self._hb_interval))
             ctx = GangContext(store, rank=-1, world_size=0)
             self._detector = PeerFailureDetector(
                 ctx, lease=self._lease, interval=self._hb_interval,
@@ -155,6 +178,10 @@ class ServingRouter:
         # fleet_router_overhead_pct = route_s / wall)
         self._route_s = 0.0
         self._pump_s = 0.0
+        # RPC accounting absorbed from remote replicas that left the
+        # fleet (scale-in, death, shutdown) so stats() keeps the totals
+        self._rpc_retired = {"rpc_s": 0.0, "remote_exec_s": 0.0,
+                             "calls": 0}
         self._counts: dict[str, int] = {}
         self._t0 = time.monotonic()
 
@@ -164,21 +191,28 @@ class ServingRouter:
         return [r.id for r in self._replicas.values() if r.state == "up"]
 
     def _fingerprint(self, frontend):
-        eng = frontend.engine
-        return (eng._seed, eng.do_sample, eng.temperature, eng.top_k,
-                eng.top_p, eng.eos_token_id)
+        return tuple(frontend.fingerprint())
 
     def add_replica(self, frontend, replica_id=None, warmup=False):
-        """Register a replica (its frontend must already be started).
-        Returns the replica id. With a fleet store, the replica's
-        membership key is published and its heartbeat starts — silent
-        death is then detected by lease, not by a failed dispatch."""
+        """Register a replica (its frontend must already be started) —
+        a local ``ServingFrontend`` or a ``RemoteFrontend`` stub for a
+        replica process, interchangeably. Returns the replica id. With a
+        fleet store, the replica's membership key is published and its
+        heartbeat starts (remote replicas beat for THEMSELVES from their
+        own process — a router-side beat would mask their death) —
+        silent death is then detected by lease, not by a failed
+        dispatch. Re-using the id of a DEAD replica replaces the corpse:
+        that is how a supervisor-respawned replica process rejoins."""
         rep_id = (next(self._rep_ids) if replica_id is None
                   else int(replica_id))
         while replica_id is None and rep_id in self._replicas:
             rep_id = next(self._rep_ids)
-        if rep_id in self._replicas:
-            raise ValueError(f"replica id {rep_id} already registered")
+        prev = self._replicas.get(rep_id)
+        if prev is not None:
+            if prev.state != "dead":
+                raise ValueError(f"replica id {rep_id} already registered")
+            self._absorb_rpc_stats(prev)
+            del self._replicas[rep_id]
         fp = self._fingerprint(frontend)
         if self._engine_fingerprint is None:
             self._engine_fingerprint = fp
@@ -199,8 +233,9 @@ class ServingRouter:
             cooldown_s=self.breaker_cooldown_s))
         if self._store is not None:
             self._store.set(f"{self._prefix}/member/{rep_id}", b"up")
-            rep.hb = self._store.register_heartbeat(
-                rep_id, self._hb_interval, prefix=f"{self._prefix}/hb")
+            if not getattr(frontend, "is_remote", False):
+                rep.hb = self._store.register_heartbeat(
+                    rep_id, self._hb_interval, prefix=f"{self._prefix}/hb")
         self._replicas[rep_id] = rep
         bump_counter("fleet.replica_up")
         self._route_parked()
@@ -222,11 +257,37 @@ class ServingRouter:
         rep = self._replicas[replica_id]
         rep.state = "draining"
         bump_counter("fleet.scale_in")
-        rep.frontend.shutdown(drain=True)
-        self._collect(rep)
-        self._deregister(rep)
+        try:
+            rep.frontend.shutdown(drain=True)
+        except _TRANSPORT_ERRORS as e:
+            # an unreachable replica cannot drain: this scale-in is a
+            # death — fail over its stranded requests instead of raising
+            # out of the removal with the corpse still registered
+            self._kill_replica(rep, f"scale_in drain failed: {e!r}")
+        else:
+            self._collect(rep)
+            self._deregister(rep)
+        self._absorb_rpc_stats(rep)
         del self._replicas[replica_id]
         self._route_parked()
+
+    @staticmethod
+    def _fold_rpc_stats(acc, frontend):
+        """Accumulate one remote frontend's transport accounting into
+        ``acc`` — the single definition of which keys make up the
+        ``fleet_rpc_overhead_pct`` inputs."""
+        if getattr(frontend, "is_remote", False):
+            with contextlib.suppress(Exception):
+                s = frontend.stats()
+                acc["rpc_s"] += s.get("rpc_s", 0.0)
+                acc["remote_exec_s"] += s.get("remote_exec_s", 0.0)
+                acc["calls"] += s.get("calls", 0)
+
+    def _absorb_rpc_stats(self, rep):
+        """Keep a departing remote replica's transport accounting in the
+        router's running totals (the bench overhead gate reads them
+        after the fleet has churned)."""
+        self._fold_rpc_stats(self._rpc_retired, rep.frontend)
 
     def _deregister(self, rep):
         if rep.hb is not None:
@@ -260,9 +321,11 @@ class ServingRouter:
                        "stranded request(s)", rep.id, reason,
                        len(rep.assigned))
         # salvage results the replica already retired before it broke —
-        # a terminal verdict that exists must not be recomputed
+        # a terminal verdict that exists must not be recomputed. Short
+        # per-call budget: a dead replica PROCESS can't answer, and the
+        # salvage must not stall failover for the full rpc timeout.
         with contextlib.suppress(Exception):
-            self._collect(rep)
+            self._collect(rep, timeout=2.0)
         self._deregister(rep)
         for rid in list(rep.assigned):
             rep.assigned.discard(rid)
@@ -301,9 +364,24 @@ class ServingRouter:
             state = rep.breaker.state()
             if state == CircuitBreaker.OPEN:
                 continue
+            t0 = time.monotonic()
             try:
-                h = rep.frontend.health()
+                # remote probes cost a wire round-trip per call, and the
+                # server already answers from a snapshot refreshed at its
+                # own pump-turn boundaries — a router-side TTL adds no
+                # staleness the wire didn't already imply. Local
+                # frontends stay uncached (health() is cheap and tests
+                # preload replicas directly between dispatches).
+                if (rep.h_cache is not None
+                        and getattr(rep.frontend, "is_remote", False)
+                        and t0 - rep.h_ts < self.health_ttl):
+                    h = rep.h_cache
+                else:
+                    h = rep.frontend.health()
+                    rep.h_cache, rep.h_ts = h, time.monotonic()
+                self._pump_s += time.monotonic() - t0
             except Exception as e:  # a broken health probe is a death
+                self._pump_s += time.monotonic() - t0
                 self._kill_replica(rep, f"health() raised: {e!r}")
                 continue
             if not h["ready"]:
@@ -315,16 +393,32 @@ class ServingRouter:
 
     def _submit_to(self, freq, rep_id):
         rep = self._replicas[rep_id]
+        if rep.state != "up":
+            # a candidate killed mid-dispatch (transport error on an
+            # earlier submit in this same pool walk)
+            return False
         probe = rep.breaker.state() == CircuitBreaker.HALF_OPEN
         if probe and not rep.breaker.allow():
             return False
         k = len(freq.emitted)
         prompt = (np.concatenate([freq.prompt, freq.emitted])
                   if k else freq.prompt)
-        rep.frontend.submit(prompt, freq.max_new_tokens - k,
-                            priority=freq.priority,
-                            deadline_s=freq.deadline, rid=freq.rid,
-                            token_base=k)
+        t0 = time.monotonic()
+        try:
+            rep.frontend.submit(prompt, freq.max_new_tokens - k,
+                                priority=freq.priority,
+                                deadline_s=freq.deadline, rid=freq.rid,
+                                token_base=k)
+            self._pump_s += time.monotonic() - t0
+        except _TRANSPORT_ERRORS as e:
+            self._pump_s += time.monotonic() - t0
+            # the per-call timeout / resend budget is the router-side
+            # evidence a replica PROCESS is gone; the dispatch falls
+            # through to the next candidate
+            if probe:
+                rep.breaker.release_probe()
+            self._kill_replica(rep, f"submit transport error: {e!r}")
+            return False
         rep.assigned.add(freq.rid)
         freq.live.add(rep_id)
         if probe:
@@ -409,10 +503,12 @@ class ServingRouter:
                              self.hedge_default if hedge is None else hedge)
         self._requests[rid] = freq
         t0 = time.monotonic()
+        pump0 = self._pump_s  # frontend.submit time lands in pump_s
         if not self._dispatch(freq):
             self._parked.append(rid)
             bump_counter("fleet.parked")
-        self._route_s += time.monotonic() - t0
+        self._route_s += ((time.monotonic() - t0)
+                          - (self._pump_s - pump0))
         return rid
 
     def cancel(self, rid) -> bool:
@@ -430,8 +526,15 @@ class ServingRouter:
             # frontend.cancel records a "cancelled" result carrying the
             # partial tokens; collecting it routes through the normal
             # retirement switch, which delivers emitted + partials
-            with contextlib.suppress(Exception):
+            try:
                 rep.frontend.cancel(rid)
+            except _TRANSPORT_ERRORS as e:
+                self._kill_replica(rep, f"cancel transport error: {e!r}")
+                if rid not in self._requests:
+                    return True  # the kill's failover resolved it
+                continue
+            except Exception:  # noqa: BLE001 — replica-local refusal
+                bump_counter("fleet.cancel_error")
             self._collect(rep)
             if rid not in self._requests:
                 return True
@@ -447,25 +550,30 @@ class ServingRouter:
         route parked work, pump every live replica one scheduler turn,
         and run the retirement switch over everything that finished."""
         t_start = time.monotonic()
+        pump0 = self._pump_s  # every frontend call below adds to pump_s
         self._sweep_liveness()
         self._route_parked()
-        pump = 0.0
         for rep in list(self._replicas.values()):
             if rep.state != "up":
                 continue
             t0 = time.monotonic()
             try:
-                if rep.frontend.pending() or rep.frontend.engine.has_work():
-                    rep.frontend.step()
+                if not getattr(rep.frontend, "is_remote", False):
+                    # remote replicas pump THEMSELVES (ReplicaServer's
+                    # pump thread); the router's turn is just the
+                    # results fetch below
+                    if (rep.frontend.pending()
+                            or rep.frontend.engine.has_work()):
+                        rep.frontend.step()
             except Exception as e:  # replica broke mid-dispatch
-                pump += time.monotonic() - t0
+                self._pump_s += time.monotonic() - t0
                 self._kill_replica(rep, f"step() raised: {e!r}")
                 continue
-            pump += time.monotonic() - t0
+            self._pump_s += time.monotonic() - t0
             self._collect(rep)
         self._route_parked()
-        self._route_s += (time.monotonic() - t_start) - pump
-        self._pump_s += pump
+        self._route_s += ((time.monotonic() - t_start)
+                          - (self._pump_s - pump0))
 
     def results(self, wait=False, timeout_s=None) -> dict:
         """Pop terminal results as ``{rid: RequestResult}``. With
@@ -505,8 +613,22 @@ class ServingRouter:
         "unavailable": "_retire_unavailable",
     }
 
-    def _collect(self, rep):
-        for rid, res in rep.frontend.results().items():
+    def _collect(self, rep, timeout=None):
+        t0 = time.monotonic()
+        try:
+            fetched = rep.frontend.results(timeout=timeout)
+        except _TRANSPORT_ERRORS as e:
+            self._pump_s += time.monotonic() - t0
+            self._kill_replica(rep, f"results transport error: {e!r}")
+            return
+        self._pump_s += time.monotonic() - t0
+        # a remote results envelope carries the replica's health snapshot
+        # for free — refresh the dispatch-score cache without spending a
+        # separate wire round-trip on a health probe
+        piggy = getattr(rep.frontend, "piggyback_health", None)
+        if piggy is not None:
+            rep.h_cache, rep.h_ts = piggy, time.monotonic()
+        for rid, res in fetched.items():
             rep.assigned.discard(rid)
             rep.probes.discard(rid)
             freq = self._requests.get(rid)
@@ -607,8 +729,18 @@ class ServingRouter:
                 rep.probes.discard(freq.rid)
                 rep.breaker.release_probe()
             if rep.state == "up":
-                with contextlib.suppress(Exception):
+                try:
                     rep.frontend.cancel(freq.rid)
+                except _TRANSPORT_ERRORS as e:
+                    # a cancel that cannot reach the replica is replica
+                    # death evidence like any other call — swallowing it
+                    # would leave the corpse "up" to stall every future
+                    # hedged delivery for the full rpc budget
+                    self._kill_replica(rep,
+                                       f"cancel transport error: {e!r}")
+                except Exception:  # noqa: BLE001 — a failed cancel on a
+                    # live replica only means the copy runs to completion
+                    bump_counter("fleet.cancel_error")
         freq.live.clear()
 
     # --------------------------------------------------- liveness sweep
@@ -625,9 +757,19 @@ class ServingRouter:
     # ------------------------------------------------------------ admin
 
     def warmup(self, cache_dir=None):
-        """AOT-warm every replica's compiled serving shapes."""
-        return {rep.id: rep.frontend.warmup(cache_dir=cache_dir)
-                for rep in self._replicas.values() if rep.state == "up"}
+        """AOT-warm every replica's compiled serving shapes. A replica
+        whose warmup fails at the TRANSPORT is classified dead (like any
+        other call) rather than aborting the remaining replicas'
+        warmups with the corpse left registered as up."""
+        out = {}
+        for rep in list(self._replicas.values()):
+            if rep.state != "up":
+                continue
+            try:
+                out[rep.id] = rep.frontend.warmup(cache_dir=cache_dir)
+            except _TRANSPORT_ERRORS as e:
+                self._kill_replica(rep, f"warmup transport error: {e!r}")
+        return out
 
     def shutdown(self, drain=True):
         """Drain (or hard-stop) every replica and deliver what resolves;
@@ -642,6 +784,8 @@ class ServingRouter:
         for freq in list(self._requests.values()):
             self._deliver(freq, "unavailable", freq.emitted,
                           "fleet shutdown")
+        for rep in self._replicas.values():
+            self._absorb_rpc_stats(rep)
         self._replicas.clear()
 
     def health(self) -> dict:
@@ -672,15 +816,33 @@ class ServingRouter:
         deliberately NOT route/wall: wall includes warmup and idle time,
         which would let an arbitrarily slow routing path pass the gate.
         The fleet acceptance gate records it as
-        ``fleet_router_overhead_pct`` (< 5%)."""
+        ``fleet_router_overhead_pct`` (< 5%).
+
+        For a fleet of REMOTE replicas the same split also yields the
+        transport gate: ``rpc_s`` is round-trip time inside
+        ``RemoteFrontend`` calls, ``remote_exec_s`` the server-side
+        execution those calls reported, and ``rpc_overhead_pct`` =
+        (rpc_s − remote_exec_s) / active — wire+serialization time as a
+        share of active processing (bench e3 gates it as
+        ``fleet_rpc_overhead_pct`` < 10%)."""
         wall = time.monotonic() - self._t0
         active = self._route_s + self._pump_s
+        rpc = dict(self._rpc_retired)
+        for rep in self._replicas.values():
+            self._fold_rpc_stats(rpc, rep.frontend)
+        rpc_overhead = max(rpc["rpc_s"] - rpc["remote_exec_s"], 0.0)
         return {
             "wall_s": wall,
             "route_s": self._route_s,
             "pump_s": self._pump_s,
             "router_overhead_pct": (100.0 * self._route_s / active
                                     if active > 0 else 0.0),
+            "rpc_s": rpc["rpc_s"],
+            "remote_exec_s": rpc["remote_exec_s"],
+            "rpc_calls": rpc["calls"],
+            "rpc_overhead_s": rpc_overhead,
+            "rpc_overhead_pct": (100.0 * rpc_overhead / active
+                                 if active > 0 else 0.0),
             "replicas_up": sum(1 for r in self._replicas.values()
                                if r.state == "up"),
             "served_by_replica": {r.id: r.served

@@ -153,7 +153,7 @@ class ContinuousBatchingEngine:
                  do_sample=False, temperature=1.0, top_k=None, top_p=None,
                  eos_token_id=None, prompt_buckets=(16, 32, 64, 128),
                  seed=0, pipeline=None):
-        from ..jit import _FunctionalModel
+        from ..jit import _FunctionalModel, _swap_lock
 
         model.eval()
         cfg = model.config
@@ -208,7 +208,12 @@ class ContinuousBatchingEngine:
         self._tables_active = self._tables[:self.max_slots]
         self._limits_dev = None
         self._functional = _FunctionalModel(model)
-        self._buffers = {k: b._value for k, b in model.named_buffers()}
+        # param/buffer snapshots must not race another engine's trace-time
+        # param swap on a SHARED model (tracers would leak into the
+        # snapshot and outlive their trace) — serialize on the swap lock
+        self._swap_lock = _swap_lock
+        with _swap_lock:
+            self._buffers = {k: b._value for k, b in model.named_buffers()}
         self._zero_key = jax.random.key_data(jax.random.PRNGKey(0))
         self._key_shape = tuple(self._zero_key.shape)
         self._key_size = int(np.prod(self._key_shape))
@@ -373,7 +378,9 @@ class ContinuousBatchingEngine:
 
             enable_compilation_cache(cache_dir)
         t0 = time.monotonic()
-        params = {k: p._value for k, p in self.model.named_parameters()}
+        with self._swap_lock:
+            params = {k: p._value
+                      for k, p in self.model.named_parameters()}
         sds = lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
         p_s = jax.tree_util.tree_map(sds, params)
         ks_s = [sds(k) for k in self._ks]
@@ -528,7 +535,9 @@ class ContinuousBatchingEngine:
         parameters, clear slots/queue/counters. ``segment`` is the compiled
         decode window per ``step()``; ``run_deadline`` bounds the whole
         session (unfinished requests retire as ``timed_out`` past it)."""
-        self._params = {k: p._value for k, p in self.model.named_parameters()}
+        with self._swap_lock:
+            self._params = {k: p._value
+                            for k, p in self.model.named_parameters()}
         self._segment_len = int(segment)
         self._run_deadline = run_deadline or Deadline.never()
         self._queue: deque[Request] = deque()

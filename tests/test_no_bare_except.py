@@ -21,7 +21,15 @@
   router handler would silently drop client requests on the floor —
   this guard fails the build instead. (Both the bare-except and
   wall-clock bans above cover ``models/router.py`` through the
-  ``models`` tree.)
+  ``models`` tree, and the hardened RPC transport
+  ``distributed/rpc.py`` through the ``distributed`` tree — its reply
+  polling is ``time.monotonic``-based ``Deadline`` math; any wall-clock
+  use there needs the pragma like everywhere else.)
+* The cross-process serving path (``models/remote.py``) must not widen
+  the status space: result rows cross the wire verbatim, so every
+  status a ``RemoteFrontend`` can deliver must already be covered by
+  the router's retirement switch, and the stub must expose the full
+  frontend surface the router dispatches on.
 """
 import pathlib
 import re
@@ -119,6 +127,45 @@ def test_router_retirement_switch_covers_every_terminal_state():
     for status, name in ServingRouter._RETIREMENT.items():
         assert callable(getattr(ServingRouter, name, None)), (
             f"router handler {name!r} for status {status!r} is missing")
+
+
+def test_remote_frontend_statuses_covered_by_retirement_switch():
+    """The cross-process path must not widen the status space: every
+    result status a ``RemoteFrontend`` can hand the router originates in
+    the replica's frontend (rows pass through the wire verbatim), so any
+    status literal ``models/remote.py`` itself stamps into a result row
+    must be a declared terminal state the router's retirement switch
+    handles — and the stub must expose the full frontend surface the
+    router dispatches on."""
+    import inspect
+    import pathlib
+
+    from paddle_tpu.models import frontend, remote, serving
+    from paddle_tpu.models.remote import RemoteFrontend
+    from paddle_tpu.models.router import ServingRouter
+
+    declared = frontend.TERMINAL_STATES | serving.TERMINAL_STATES
+    handled = set(ServingRouter._RETIREMENT)
+    src = pathlib.Path(remote.__file__).read_text()
+    stamped = set(re.findall(r"RequestResult\(\s*\w+,\s*\"(\w+)\"", src))
+    assert stamped <= declared, (
+        f"models/remote.py stamps result status(es) "
+        f"{sorted(stamped - declared)} that no frontend/engine declares "
+        "— the router's retirement switch would drop them")
+    assert declared <= handled, (
+        f"terminal state(s) {sorted(declared - handled)} reachable over "
+        "the RPC path have no ServingRouter._RETIREMENT handler")
+    # surface parity: the router treats local and remote replicas
+    # interchangeably — every frontend method it calls must exist on the
+    # stub with a compatible callable signature
+    for name in ("submit", "results", "cancel", "health", "ready",
+                 "pending", "fingerprint", "warmup", "step", "shutdown",
+                 "stats"):
+        meth = getattr(RemoteFrontend, name, None)
+        assert callable(meth), (
+            f"RemoteFrontend lacks {name}() — the router dispatches on "
+            "it for local frontends")
+        assert inspect.isfunction(meth)
 
 
 def test_engine_retire_only_stamps_declared_terminal_states():
