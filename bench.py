@@ -13,6 +13,7 @@ Weak-1):
   (d) Pallas paged decode attention kernel + its streaming-floor calibration
   (e) whole-model compiled decode (generate(), paged caches)
       + (e2) continuous batching + (e3) replica-fleet router overhead gate
+      + (e4) durable-router write-ahead journal overhead gate
   (f) per-op microbench: adaptive iters (no 0.0us clamp readings), compared
       against OPBENCH_BASELINE.json, then the baseline is RE-RECORDED with
       this run's numbers (reference: tools/ci_op_benchmark.sh relative gate)
@@ -732,6 +733,85 @@ except Exception as e:
     # in-process gate numbers the first half already measured
     fleet_metrics["fleet_error"] = f"{type(e).__name__}: {e}"[:200]
 
+# ------------------------------------------------- (e4) durable router
+# The HA router's write-ahead request journal (models/journal.py): every
+# admission durable before the rid is acked, progress checkpointed every
+# K tokens, retirement GC'd. The acceptance gate is JOURNAL OVERHEAD —
+# WAL encode+flush time as a share of active request-processing time,
+# router_journal_overhead_pct < 5% (the durability that makes a router
+# crash recoverable must not tax the hot path).
+journal_metrics = {}
+try:
+    import shutil
+    import tempfile
+
+    from paddle_tpu.models.frontend import ServingFrontend
+    from paddle_tpu.models.journal import RequestJournal
+    from paddle_tpu.models.router import ServingRouter
+    from paddle_tpu.models.serving import ContinuousBatchingEngine
+
+    if SMOKE:
+        # J_NEW is deliberately not tiny: the gate is RELATIVE journal
+        # cost, and with only a handful of decode tokens per request
+        # the per-admission fsync dominates any measurement
+        J_REPS, J_SLOTS, J_REQ, J_NEW, J_SEG = 2, 2, 8, 24, 3
+        J_BUCKETS = (32,)
+    else:
+        J_REPS, J_SLOTS, J_REQ, J_NEW, J_SEG = 2, 4, 16, 32, 16
+        J_BUCKETS = (32,)
+    log(f"durable router: {J_REPS} replicas, {J_REQ} requests, "
+        "write-ahead journal armed...")
+    j_root = tempfile.mkdtemp(prefix="bench_journal_")
+    try:
+        journal = RequestJournal(j_root, epoch=1)
+        j_router = ServingRouter(max_failovers=2, journal=journal)
+        for i in range(J_REPS):
+            j_eng = ContinuousBatchingEngine(model, max_slots=J_SLOTS,
+                                             max_len=256, page_size=128,
+                                             prompt_buckets=J_BUCKETS,
+                                             seed=0)
+            j_router.add_replica(
+                ServingFrontend(j_eng, max_queue=64, segment=J_SEG),
+                warmup=True)
+        rng_j = np.random.RandomState(17)
+        warm = [j_router.submit(rng_j.randint(0, cfg.vocab_size, (12,))
+                                .astype(np.int32), max_new_tokens=2)
+                for _ in range(J_REPS)]
+        j_router.results(wait=True, timeout_s=600)
+        t_j = time.time()
+        j_rids = [j_router.submit(
+            rng_j.randint(0, cfg.vocab_size,
+                          (int(rng_j.randint(8, 28)),)).astype(np.int32),
+            max_new_tokens=J_NEW) for _ in range(J_REQ)]
+        j_res = j_router.results(wait=True, timeout_s=600)
+        j_wall = time.time() - t_j
+        assert all(j_res[r].status == "ok" for r in j_rids), \
+            {r: j_res[r].status for r in j_rids}
+        j_stats = j_router.stats()
+        jn = journal.stats()
+        j_tokens = sum(len(j_res[r].tokens) for r in j_rids)
+        journal_metrics = {
+            "router_journal_overhead_pct": round(
+                j_stats["journal_overhead_pct"], 3),
+            "journal_tokens_per_sec": round(j_tokens / j_wall, 1)
+                if j_wall > 0 else None,
+            "journal_records": jn["records"],
+            "journal_flushes": jn["flushes"],
+            "journal_bytes": jn["bytes_written"],
+        }
+        j_router.shutdown()
+        log(f"durable router: {journal_metrics['journal_tokens_per_sec']}"
+            f" tok/s with the journal armed ({jn['records']} records, "
+            f"{jn['flushes']} flushes, {jn['bytes_written']}B), journal "
+            f"overhead "
+            f"{journal_metrics['router_journal_overhead_pct']}% of "
+            "active request-processing time (gate: < 5%)")
+    finally:
+        shutil.rmtree(j_root, ignore_errors=True)
+except Exception as e:
+    log(f"durable router section FAILED: {type(e).__name__}: {e}")
+    journal_metrics = {"journal_error": f"{type(e).__name__}: {e}"[:200]}
+
 # ------------------------------------------------------- (f) op microbench
 # Per-op regression gate (reference: tools/ci_op_benchmark.sh relative
 # check): ~20 hot ops + eager dispatch overhead, compared against the
@@ -821,6 +901,7 @@ result = {
     "model_decode_ms_per_token_step": round(gen_dt / GNEW * 1e3, 2),
     **cb_metrics,
     **fleet_metrics,
+    **journal_metrics,
     "op_bench_us": op_results,
     "op_bench_vs_baseline": op_vs_baseline,
     "op_bench_regressions": op_regressions,

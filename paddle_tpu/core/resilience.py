@@ -45,7 +45,7 @@ from .flags import define_flag, flag
 __all__ = [
     "RetryPolicy", "Deadline", "CircuitBreaker",
     "CommTimeoutError", "InjectedFault", "CheckpointCorruptionError",
-    "PeerFailureError", "ServingUnavailable",
+    "PeerFailureError", "ServingUnavailable", "StaleLeaderError",
     "inject", "fault_remaining", "reset_faults",
     "bump_counter", "get_counter", "counters", "reset_counters",
 ]
@@ -101,6 +101,19 @@ class ServingUnavailable(RuntimeError):
     replica-level unavailability (reroute) rather than a request-level
     bug — and so the RPC transport can re-raise it TYPED on the caller
     side (models/remote.py, distributed/rpc.py)."""
+
+
+class StaleLeaderError(RuntimeError):
+    """A fenced call from a DEPOSED fleet leader was rejected: the
+    envelope's fencing token is lower than the highest one this replica
+    has seen (``distributed/gang.py LeaderLease``). Deliberately NOT a
+    ConnectionError/TimeoutError/ServingUnavailable: the replica is
+    healthy — it is the CALLER that lost the leadership — so the router
+    must classify it as "stand down now" (stop dispatching, the new
+    leader owns every in-flight request) rather than as replica death,
+    which would make the zombie leader fail the request over and
+    double-dispatch it. Travels typed across the RPC wire
+    (distributed/rpc.py) like the other resilience errors."""
 
 
 class PeerFailureError(Exception):

@@ -86,7 +86,9 @@ def axis_index(axis_name: str):
 
 
 def axis_size(axis_name: str):
-    return lax.axis_size(axis_name)
+    from .fleet.jax_compat import axis_size as _axis_size
+
+    return _axis_size(axis_name)
 
 
 # --- Megatron f/g conjugate pair (mp_ops.py:_c_identity / _mp_allreduce) ---

@@ -1068,8 +1068,9 @@ def spmd_pipeline(stage_fn, stage_params, x, n_microbatches, mesh,
     (n_microbatches, mb, ...) outputs. Differentiable (ppermute transposes
     to the reverse permutation, so jax.grad yields the backward schedule).
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .jax_compat import pcast, shard_map
 
     jm = mesh.jax_mesh()
     n_stages = mesh.get_dim_size(pp_axis)
@@ -1081,9 +1082,9 @@ def spmd_pipeline(stage_fn, stage_params, x, n_microbatches, mesh,
         stage = jax.lax.axis_index(pp_axis)
         mb_shape = xs.shape[1:]
         # mark the carries device-varying over pp (shard_map vma typing)
-        state = jax.lax.pcast(jnp.zeros(mb_shape, xs.dtype), (pp_axis,),
+        state = pcast(jnp.zeros(mb_shape, xs.dtype), (pp_axis,),
                               to="varying")
-        out_buf = jax.lax.pcast(jnp.zeros_like(xs), (pp_axis,), to="varying")
+        out_buf = pcast(jnp.zeros_like(xs), (pp_axis,), to="varying")
         total = n_microbatches + n_stages - 1
 
         def tick(t, carry):
@@ -1132,8 +1133,9 @@ def spmd_pipeline_vpp(stage_fn, stage_params, x, n_microbatches, mesh,
     stage_params: pytree with leading dim n_stages*vpp (virtual-stage
     order); x: (n_microbatches, mb, ...). Differentiable.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from .jax_compat import pcast, shard_map
 
     jm = mesh.jax_mesh()
     n = mesh.get_dim_size(pp_axis)
@@ -1151,9 +1153,9 @@ def spmd_pipeline_vpp(stage_fn, stage_params, x, n_microbatches, mesh,
         # params leaves: (vpp, ...) local chunks; xs replicated
         stage = jax.lax.axis_index(pp_axis)
         mb_shape = xs.shape[1:]
-        states = jax.lax.pcast(
+        states = pcast(
             jnp.zeros((vpp,) + mb_shape, xs.dtype), (pp_axis,), to="varying")
-        out_buf = jax.lax.pcast(jnp.zeros_like(xs), (pp_axis,), to="varying")
+        out_buf = pcast(jnp.zeros_like(xs), (pp_axis,), to="varying")
         total = n_microbatches + n_virtual - 1
 
         def tick(t, carry):
@@ -1174,7 +1176,7 @@ def spmd_pipeline_vpp(stage_fn, stage_params, x, n_microbatches, mesh,
 
             outs = jax.lax.fori_loop(
                 0, vpp, run_chunk,
-                jax.lax.pcast(jnp.zeros((vpp,) + mb_shape, xs.dtype),
+                pcast(jnp.zeros((vpp,) + mb_shape, xs.dtype),
                               (pp_axis,), to="varying"))
 
             # last virtual stage (device n-1, slot vpp-1) completes

@@ -23,6 +23,7 @@ from jax import lax
 
 from ...core.tensor import Tensor
 from ..process_mesh import ProcessMesh
+from .jax_compat import axis_size, pcast, shard_map
 
 __all__ = ["ring_attention", "RingAttention"]
 
@@ -32,7 +33,7 @@ _NEG = -1e30
 def _ring_body(q, k, v, axis_name, causal, scale):
     """Local computation inside shard_map: q,k,v are (B, Sl, H, D) local
     sequence shards; returns local (B, Sl, H, D) output."""
-    n = lax.axis_size(axis_name)
+    n = axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     b, sl, h, d = q.shape
 
@@ -42,12 +43,12 @@ def _ring_body(q, k, v, axis_name, causal, scale):
     vh0 = jnp.swapaxes(v, 1, 2).astype(jnp.float32)
 
     # initial accumulators marked device-varying (shard_map vma typing)
-    m0 = lax.pcast(jnp.full((b, h, sl, 1), _NEG, jnp.float32),
-                   (axis_name,), to="varying")
-    l0 = lax.pcast(jnp.zeros((b, h, sl, 1), jnp.float32),
-                   (axis_name,), to="varying")
-    acc0 = lax.pcast(jnp.zeros((b, h, sl, d), jnp.float32),
-                     (axis_name,), to="varying")
+    m0 = pcast(jnp.full((b, h, sl, 1), _NEG, jnp.float32),
+               (axis_name,), to="varying")
+    l0 = pcast(jnp.zeros((b, h, sl, 1), jnp.float32),
+               (axis_name,), to="varying")
+    acc0 = pcast(jnp.zeros((b, h, sl, d), jnp.float32),
+                 (axis_name,), to="varying")
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     rows = lax.broadcasted_iota(jnp.int32, (sl, sl), 0)
@@ -89,7 +90,6 @@ def ring_attention(q, k, v, mesh: ProcessMesh, axis: str = "sp",
     unsharded (shard_map partitions them) or already Shard(1) over ``axis``.
     Returns (B, S, H, D), sequence-sharded the same way.
     """
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
     qv = q._value if isinstance(q, Tensor) else q

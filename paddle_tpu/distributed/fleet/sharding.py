@@ -130,7 +130,21 @@ class ShardedOptimizer:
                     p._value = jax.device_put(p._value, sh)
         else:
             self._inner.step()
-        self._move_state("pinned_host" if self._offload else None)
+        self._move_state(self._host_memory_kind() if self._offload
+                         else None)
+
+    def _host_memory_kind(self):
+        """The host memory kind this backend actually addresses: TPU/GPU
+        expose ``pinned_host``; the CPU backend only ``unpinned_host``.
+        Probed once — the answer cannot change for the mesh's life, and
+        this sits on the per-step path."""
+        if not hasattr(self, "_host_kind"):
+            dev = self._mesh.jax_mesh().devices.flat[0]
+            kinds = {m.kind for m in dev.addressable_memories()}
+            self._host_kind = next(
+                (k for k in ("pinned_host", "unpinned_host")
+                 if k in kinds), None)
+        return self._host_kind
 
     def __getattr__(self, name):
         return getattr(self._inner, name)

@@ -325,6 +325,239 @@ def _inject_peer_dead(phase):
             rank=None, phase=phase) from e
 
 
+# -------------------------------------------------------- leader lease
+
+class LeaderLease:
+    """TTL leader lease over the gang store, with monotonically
+    increasing FENCING tokens — the election half of the serving
+    router's hot-standby story (``models/router.py``).
+
+    One contender holds ``{prefix}/leader`` at a time: the record
+    (store.py ``set_lease``) carries the holder's identity, its fencing
+    token, and a wall-clock grant/renewal timestamp. A renewal daemon
+    re-stamps the record every ``interval`` seconds; a standby watching
+    the key acquires the moment the record is DELETED (clean release —
+    takeover in ~0) or its timestamp ages past ``ttl`` (holder crashed —
+    takeover within one lease).
+
+    The fencing token is bumped through ``store.add`` (atomic), so every
+    acquisition — including two standbys racing the same expiry — gets a
+    strictly increasing token. Fencing is what makes a ZOMBIE leader
+    safe: replicas remember the highest token they have served and
+    reject envelopes carrying a lower one (``StaleLeaderError``), so a
+    deposed leader that is merely slow, not dead, cannot double-dispatch
+    a request the new leader already owns. A holder detects its own
+    deposition at the next renewal turn (the record no longer names it,
+    or carries a higher fence) and stands down without touching the new
+    leader's record.
+
+    Fault site ``lease.steal`` (one renewal turn behaves as if a thief
+    took the lease: the fence is bumped, the record rewritten, and the
+    holder stands down) drills the deposition path deterministically.
+    """
+
+    def __init__(self, store, prefix="fleet", owner=None, ttl=None,
+                 interval=None):
+        import os as _os
+        import uuid
+
+        self.store = store
+        self.prefix = prefix
+        self.key = f"{prefix}/leader"
+        self.fence_key = f"{prefix}/leader_fence"
+        self.owner = (str(owner) if owner is not None
+                      else f"router-{_os.getpid()}-{uuid.uuid4().hex[:6]}")
+        self.ttl = float(ttl if ttl is not None
+                         else flag("FLAGS_heartbeat_ttl"))
+        self.interval = float(interval if interval is not None
+                              else max(self.ttl / 3.0, 0.05))
+        self.fence = None            # fencing token of OUR current hold
+        self._stop = threading.Event()
+        self._lost = threading.Event()
+        self._thread = None
+
+    # ------------------------------------------------------------ reads
+
+    def read(self):
+        """The current lease record (any holder), or None."""
+        return self.store.get_lease(self.key)
+
+    def holder_alive(self, rec=None) -> bool:
+        """Is the lease held by a live (unexpired) holder right now?
+        Pass an already-fetched record to avoid a second store read."""
+        if rec is None:
+            rec = self.read()
+        return (rec is not None
+                and time.time() - rec["ts"] <= self.ttl)  # wall-clock: x-host
+
+    def held(self) -> bool:
+        """Does THIS contender hold an un-deposed lease?"""
+        return self.fence is not None and not self._lost.is_set()
+
+    # ------------------------------------------------------ acquisition
+
+    def try_acquire(self) -> bool:
+        """One acquisition attempt: succeeds when the lease is free,
+        expired, or already ours. A success bumps the fencing token and
+        starts the renewal daemon. Returns False when a DIFFERENT holder
+        is still live.
+
+        The store has no compare-and-swap, so the record write is
+        VERIFIED and fence-ordered instead: after writing, re-read — a
+        record carrying a HIGHER fence means another contender won the
+        race (their token outranks ours everywhere that fences are
+        checked), so we lose without touching their record; a LOWER
+        fence means a slower, already-outranked writer clobbered us, and
+        we re-assert (it will observe the supersession at its own verify
+        or first renewal). Fences are atomic (``store.add``) and the
+        higher fence never yields, so this converges to exactly one
+        winner within a bounded number of re-reads."""
+        if self.held():
+            return True
+        rec = self.read()
+        if (rec is not None and rec["owner"] != self.owner
+                and self.holder_alive(rec)):
+            return False
+        if rec is not None and time.time() - rec["ts"] > self.ttl:  # wall-clock: x-host
+            bump_counter("gang.lease_expired_takeover")
+        fence = int(self.store.add(self.fence_key, 1))
+        self.store.set_lease(self.key, self.owner, fence)
+        for _ in range(20):  # verify-after-write (no CAS in the store)
+            rec = self.read()
+            if (rec is not None and rec["owner"] == self.owner
+                    and rec["fence"] == fence):
+                break
+            if rec is not None and rec["fence"] > fence:
+                bump_counter("gang.lease_race_lost")
+                return False
+            # absent (torn write) or a lower-fence clobber: re-assert
+            self.store.set_lease(self.key, self.owner, fence)
+        else:
+            bump_counter("gang.lease_race_lost")
+            return False
+        self.fence = fence
+        self._lost.clear()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._renew, daemon=True,
+                                        name=f"lease-{self.owner}")
+        self._thread.start()
+        bump_counter("gang.lease_acquired")
+        logger.info("leader lease %r acquired by %r (fence %d)",
+                    self.key, self.owner, fence)
+        return True
+
+    def wait_acquire(self, timeout=None, poll=0.05) -> bool:
+        """Block until acquisition succeeds (a standby watching for the
+        holder's crash/release) or ``timeout`` elapses."""
+        deadline = Deadline(timeout)
+        while True:
+            try:
+                if self.try_acquire():
+                    return True
+            except (ConnectionError, TimeoutError, RuntimeError) as e:
+                # a partitioned store is no evidence either way: keep
+                # polling under the caller's budget
+                bump_counter("gang.lease_store_error")
+                logger.warning("lease acquire attempt failed (%s)", e)
+            if deadline.expired():
+                return False
+            time.sleep(min(poll, self.interval))
+
+    # ---------------------------------------------------------- renewal
+
+    def _renew(self):
+        renew_fail_since = None   # monotonic start of the current outage
+        while not self._stop.wait(self.interval):
+            try:
+                inject("lease.steal")
+            except InjectedFault:
+                # drill: a thief takes the lease out from under us — bump
+                # the fence and rewrite the record exactly like a real
+                # contender would, then fall through to the supersession
+                # check below, which stands us down
+                bump_counter("gang.lease_stolen")
+                try:
+                    thief = int(self.store.add(self.fence_key, 1))
+                    self.store.set_lease(self.key, f"{self.owner}!thief",
+                                         thief)
+                except (ConnectionError, TimeoutError, RuntimeError):
+                    self._lost.set()
+                    return
+            try:
+                rec = self.read()
+                if rec is not None and rec["fence"] > self.fence:
+                    # a HIGHER fence took the lease: deposed — never
+                    # overwrite the new holder's record
+                    bump_counter("gang.lease_superseded")
+                    logger.warning(
+                        "leader lease %r superseded (now %r); %r standing "
+                        "down", self.key, rec["owner"], self.owner)
+                    self._lost.set()
+                    return
+                if (rec is None or rec["owner"] != self.owner
+                        or rec["fence"] != self.fence):
+                    # clobbered by a slower, already-outranked writer
+                    # (or torn away): re-assert — the HIGHER fence never
+                    # yields, the same convergence rule as
+                    # try_acquire's verify loop (standing down here
+                    # would leave the fleet leaderless: the lower-fence
+                    # writer is fenced off at every replica anyway)
+                    bump_counter("gang.lease_reasserted")
+                self.store.set_lease(self.key, self.owner, self.fence)
+                renew_fail_since = None
+            except (ConnectionError, TimeoutError, RuntimeError) as e:
+                # can't renew through a partition: keep trying until the
+                # ttl would have expired us, then stand down — a standby
+                # may legitimately have taken over on the other side,
+                # and held() must go False HERE too or a partitioned
+                # leader keeps serving (split-brain with no fence bounce
+                # for in-process replicas)
+                bump_counter("gang.lease_renew_error")
+                logger.warning("lease renewal failed (%s)", e)
+                now = time.monotonic()
+                if renew_fail_since is None:
+                    renew_fail_since = now
+                elif now - renew_fail_since > self.ttl:
+                    bump_counter("gang.lease_renew_expired")
+                    logger.warning(
+                        "lease %r unrenewable for > ttl (%gs); %r "
+                        "standing down", self.key, self.ttl, self.owner)
+                    self._lost.set()
+                    return
+
+    # --------------------------------------------------------- handover
+
+    def stand_down(self):
+        """Stop acting as leader WITHOUT touching the record — for a
+        deposed holder (fencing rejection, supersession): the record now
+        belongs to the new leader."""
+        self._stop.set()
+        self._lost.set()
+        if self._thread is not None:
+            self._thread.join(self.interval + 1)
+            self._thread = None
+
+    def release(self):
+        """Clean handover: stop renewing and DELETE the record (if still
+        ours) so a standby acquires immediately instead of waiting out
+        the ttl. Safe to call repeatedly and when never held."""
+        was_held = self.held()
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(self.interval + 1)
+            self._thread = None
+        if was_held:
+            try:
+                rec = self.read()
+                if rec is not None and rec["owner"] == self.owner:
+                    self.store.delete_key(self.key)
+                    bump_counter("gang.lease_released")
+            except (ConnectionError, TimeoutError, RuntimeError) as e:
+                logger.warning("lease release failed (%s); the record "
+                               "expires by ttl instead", e)
+        self._lost.set()
+
+
 # ----------------------------------------------------- active detector
 
 _active_lock = threading.Lock()

@@ -225,7 +225,7 @@ _TYPED_ERRORS = {
     for cls in (
         _res.CommTimeoutError, _res.InjectedFault,
         _res.CheckpointCorruptionError, _res.PeerFailureError,
-        _res.ServingUnavailable,
+        _res.ServingUnavailable, _res.StaleLeaderError,
     )
 }
 

@@ -359,6 +359,42 @@ class TCPStore:
         lease sweep reads as a death."""
         self.delete_key(f"{prefix}/{rank}")
 
+    # --------------------------------------------- leader-lease records
+
+    def set_lease(self, key: str, owner: str, fence: int) -> None:
+        """Write one leader-lease record: holder identity, its fencing
+        token, and the grant/renewal timestamp. Wall-clock like the
+        heartbeats — lease expiry is judged across processes, and
+        monotonic clocks don't share an epoch."""
+        import json
+
+        self.set(key, json.dumps(
+            {"owner": str(owner), "fence": int(fence),
+             "ts": time.time()}).encode())  # wall-clock: x-host
+
+    def get_lease(self, key: str):
+        """The lease record at ``key`` as ``{"owner", "fence", "ts"}``,
+        or None when absent/malformed (a torn write reads as no lease —
+        the contender's fence bump still serializes the takeover).
+        Transport errors PROPAGATE: a store we cannot reach is no
+        evidence the lease is free — swallowing the error here would
+        make a contender steal a healthy leader's lease through one
+        transient read failure (the lease layer's acquire/renew loops
+        already treat these errors as "keep polling")."""
+        if not self.check(key):
+            return None
+        try:
+            import json
+
+            rec = json.loads(self.get_now(key).decode())
+            return {"owner": str(rec["owner"]), "fence": int(rec["fence"]),
+                    "ts": float(rec["ts"])}
+        except (ValueError, KeyError, TypeError):
+            # KeyError: a concurrent release deleted it between check
+            # and read — that IS "no lease"; Value/TypeError: torn or
+            # foreign payload
+            return None
+
     def last_heartbeat(self, rank: int, prefix: str = "hb"):
         """Timestamp of ``rank``'s last beat, or None if never seen."""
         key = f"{prefix}/{rank}"

@@ -56,16 +56,23 @@ TERMINAL_STATES = frozenset(_ENGINE_TERMINAL | {"rejected", "unavailable"})
 
 
 class RequestResult:
-    """Terminal record for one submitted request."""
+    """Terminal record for one submitted request. ``token_base`` is the
+    sampling-stream offset the attempt was submitted with (the failover
+    resume contract): ``tokens`` covers stream indices ``[token_base,
+    token_base + len(tokens))``, so a fleet router recombines a resumed
+    attempt as ``known_prefix[:token_base] + tokens`` instead of
+    trusting that its emitted bookkeeping exactly matches the attempt."""
 
-    __slots__ = ("rid", "status", "tokens", "reason")
+    __slots__ = ("rid", "status", "tokens", "reason", "token_base")
 
-    def __init__(self, rid, status, tokens=None, reason=None):
+    def __init__(self, rid, status, tokens=None, reason=None,
+                 token_base=0):
         self.rid = rid
         self.status = status
         self.tokens = (np.zeros((0,), np.int32) if tokens is None
                        else np.asarray(tokens, np.int32))
         self.reason = reason
+        self.token_base = int(token_base)
 
     def __repr__(self):
         return (f"RequestResult(rid={self.rid}, status={self.status!r}, "
@@ -156,8 +163,10 @@ class ServingFrontend:
 
     # ------------------------------------------------------------ admission
 
-    def _finish(self, rid, status, tokens=None, reason=None):
-        self._results[rid] = RequestResult(rid, status, tokens, reason)
+    def _finish(self, rid, status, tokens=None, reason=None,
+                token_base=0):
+        self._results[rid] = RequestResult(rid, status, tokens, reason,
+                                           token_base=token_base)
         return rid
 
     def _reject(self, rid, reason):
@@ -165,10 +174,12 @@ class ServingFrontend:
         self.engine.note_rejection()  # stats()['rejected'] sees shedding
         return self._finish(rid, "rejected", reason=reason)
 
-    def _cancel_bookkeeping(self, rid, tokens=None, reason=""):
+    def _cancel_bookkeeping(self, rid, tokens=None, reason="",
+                            token_base=0):
         self._inflight.pop(rid, None)
         bump_counter("serving.cancelled")
-        self._finish(rid, "cancelled", tokens=tokens, reason=reason)
+        self._finish(rid, "cancelled", tokens=tokens, reason=reason,
+                     token_base=token_base)
         self._resolve_probe(rid, "cancelled")
 
     def queued_tokens(self) -> int:
@@ -296,7 +307,8 @@ class ServingFrontend:
         for entry in self._queue:
             if entry.deadline.expired():
                 self._finish(entry.rid, "timed_out",
-                             reason="expired while queued")
+                             reason="expired while queued",
+                             token_base=entry.token_base)
                 self._resolve_probe(entry.rid, "timed_out")
             else:
                 live.append(entry)
@@ -321,7 +333,7 @@ class ServingFrontend:
             self._inflight.pop(req.rid, None)
             self._finish(req.rid, req.status, tokens=req.output(),
                          reason=(str(req.error) if req.error is not None
-                                 else None))
+                                 else None), token_base=req.token_base)
             if req.status == "failed":
                 # while recovering, only a PROBE's failure re-trips; a
                 # stale failure from pre-trip work is not probe evidence
@@ -351,6 +363,23 @@ class ServingFrontend:
         queued requests are already tracked in ``_inflight``)."""
         return len(self._queue) + len(self._inflight)
 
+    def progress(self) -> dict:
+        """Live (non-terminal) request state as ``{rid: (token_base,
+        emitted_tokens)}`` — queued entries report an empty emission.
+        This is the stream a fleet router journals as PROGRESS
+        checkpoints (every K tokens) and the state a hot-standby router
+        adopts at takeover: a copy whose ``token_base`` is within the
+        journaled prefix keeps running; anything else is cancelled and
+        resubmitted from the last checkpoint, bit-identically."""
+        out = {}
+        for entry in self._queue:
+            out[entry.rid] = (int(entry.token_base),
+                              np.zeros((0,), np.int32))
+        for rid, req in self._inflight.items():
+            out[rid] = (int(req.token_base),
+                        np.asarray(req.output(), np.int32))
+        return out
+
     def results(self, wait=False, timeout=None) -> dict:
         """Pop terminal results as ``{rid: RequestResult}``. With
         ``wait=True`` the frontend pumps ``step()`` until every pending
@@ -371,12 +400,14 @@ class ServingFrontend:
         for entry in self._queue:
             if entry.rid == rid:
                 self._queue.remove(entry)
-                self._cancel_bookkeeping(rid, reason="cancelled in queue")
+                self._cancel_bookkeeping(rid, reason="cancelled in queue",
+                                         token_base=entry.token_base)
                 return True
         req = self.engine.abort(rid, "cancelled")
         if req is not None:
             self._cancel_bookkeeping(rid, tokens=req.output(),
-                                     reason="cancelled in flight")
+                                     reason="cancelled in flight",
+                                     token_base=req.token_base)
             return True
         return False
 
@@ -392,13 +423,15 @@ class ServingFrontend:
         self._draining = True
         for entry in self._queue:
             self._cancel_bookkeeping(entry.rid,
-                                     reason="shutdown before admission")
+                                     reason="shutdown before admission",
+                                     token_base=entry.token_base)
         self._queue.clear()
         for req in self.engine.queued_requests():
             self.engine.abort(req.rid, "cancelled")
             self._cancel_bookkeeping(req.rid, tokens=req.output(),
                                      reason="shutdown before a slot was "
-                                            "assigned")
+                                            "assigned",
+                                     token_base=req.token_base)
         if drain:
             # the drain pump stays under the watchdog scope: a dispatch
             # that wedges DURING shutdown still trips the timeout dump
@@ -409,7 +442,8 @@ class ServingFrontend:
                 self.engine.abort(req.rid, "cancelled")
                 self._cancel_bookkeeping(req.rid, tokens=req.output(),
                                          reason="shutdown cancelled "
-                                                "in-flight")
+                                                "in-flight",
+                                         token_base=req.token_base)
             # cancelling in-flight slots can strand a dispatched-but-
             # unconsumed pipeline segment; drain it so the engine ends
             # the session clean (its emissions are discarded — every

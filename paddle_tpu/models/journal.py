@@ -1,0 +1,508 @@
+"""Write-ahead request journal — the durability half of the HA router.
+
+PRs 6–7 made every REPLICA expendable; the ``ServingRouter`` stayed the
+last single point of failure: queued requests, rid→replica assignments,
+emitted-token progress and failover budgets lived only in its heap. This
+module is the recovery log that makes the router tier itself crash-safe:
+everything a hot standby needs to finish every in-flight request
+bit-identically lives here, bounded by the in-flight window.
+
+Three record types, CRC-framed, append-only:
+
+* **ADMIT** — the full client request as the router accepted it: rid
+  (router-owned — the sampling-key contract), prompt, token budget,
+  priority, deadline budget + admit wall time, hedge flag. Durable
+  before ``submit()`` acks the rid to the client.
+* **PROGRESS** — the router's known emitted-token prefix for one rid,
+  checkpointed every ``progress_every`` tokens (streamed from replica
+  ``results`` envelopes) and whenever a failover grows it. A standby
+  resumes the request with ``token_base`` at the last checkpoint; the
+  per-request key streams make the continuation bit-identical whether
+  the checkpoint was fresh or stale.
+* **RETIRE** — the terminal verdict (status + tokens + reason). Retires
+  both GC the live record AND back the idempotent client surface: a
+  client resubmitting a retired rid after a leader change gets the
+  cached result, not a duplicate execution (bounded by
+  ``retired_keep``).
+
+Framing: ``[u32 length][u32 crc32][payload]`` per record, payload in the
+RPC transport's in-memory container codec (tensors as dtype/shape-tagged
+blobs — the prompt/token arrays never round-trip through text). A torn
+tail record (crash mid-write) is detected by length/CRC, counted
+(``journal.torn_tail``), and truncated away; every record before it
+replays intact.
+
+Storage: one append-only file per leadership epoch
+(``wal-{fence:08d}.log`` under ``root``), so a zombie leader still
+appending to ITS epoch file can never corrupt the new leader's log. The
+gang store (optional) carries the index — ``{prefix}/journal/root`` —
+so a standby discovers the journal without configuration.
+:meth:`recover` replays the highest-epoch file and compacts it into the
+new epoch's file (live admits + latest progress + recent retires), which
+is also how growth stays bounded: live work + ``retired_keep``, never
+the full history. Batched writes: records buffer in memory and
+:meth:`flush` lands them in one ``write()`` — the router flushes at
+step boundaries, off the decode hot path (bench e4 gates the cost at
+< 5% of active processing).
+
+Durability scope: the HA threat model is ROUTER-PROCESS death (the
+SIGKILL drill) — a ``write()`` that reached the kernel page cache
+already survives that, and it happens before ``submit()`` acks. The
+default is therefore ``fsync=False``; deployments whose WAL must also
+survive a MACHINE crash (power loss on the node holding ``root``) opt
+in with ``fsync=True``, which adds the disk barrier to every batch
+carrying an ADMIT (the one record whose durability is the ack
+contract; see :meth:`flush`).
+
+Fault site ``journal.write_drop`` drops appended records before they
+reach the buffer (a crash-before-flush drill): recovery then resumes
+from the previous checkpoint, still bit-exact by determinism.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import struct
+import threading
+import time
+import zlib
+from collections import OrderedDict
+
+import numpy as np
+
+from ..core.resilience import InjectedFault, bump_counter, inject, logger
+
+# the journal payloads ride the RPC transport's container codec — one
+# serialization for everything that crosses a durability or process
+# boundary (dtype/shape-tagged tensor blobs, int-keyed dicts)
+from ..distributed.rpc import _decode as _payload_decode
+from ..distributed.rpc import _encode as _payload_encode
+
+__all__ = ["RequestJournal"]
+
+_FRAME = struct.Struct("<II")  # payload length, crc32(payload)
+
+
+def _wal_name(epoch: int) -> str:
+    return f"wal-{int(epoch):08d}.log"
+
+
+def _wal_epoch(name: str) -> int:
+    return int(name[len("wal-"):-len(".log")])
+
+
+def _scan_frames(path):
+    """Yield decoded records from ``path``; returns the byte offset of
+    the first torn/corrupt frame (== file size when the log is clean)."""
+    size = os.path.getsize(path)
+    good = 0
+    with open(path, "rb") as f:
+        while True:
+            head = f.read(_FRAME.size)
+            if len(head) < _FRAME.size:
+                break
+            length, crc = _FRAME.unpack(head)
+            payload = f.read(length)
+            if len(payload) < length or zlib.crc32(payload) != crc:
+                break
+            try:
+                rec = _payload_decode(payload)
+            except Exception:  # noqa: BLE001 — CRC passed but the codec
+                # can't read it: treat like a torn frame, stop the scan
+                break
+            good += _FRAME.size + length
+            yield rec
+    if good < size:
+        bump_counter("journal.torn_tail")
+        logger.warning("journal %s: torn tail at byte %d/%d (crash "
+                       "mid-write); replaying the %d clean bytes",
+                       path, good, size, good)
+    # communicate the clean offset to the caller via the generator's
+    # return value (StopIteration.value)
+    return good
+
+
+class RequestJournal:
+    """Append-only, CRC-framed request journal for one leadership epoch.
+
+    Active-router usage::
+
+        journal = RequestJournal(root, epoch=lease.fence, store=store)
+        journal.admit(rid, prompt, max_new, ...)   # durable before ack
+        journal.progress(rid, emitted)             # every K tokens
+        journal.retire(rid, "ok", tokens)          # GC + dedup cache
+        journal.flush()                            # step boundaries
+
+    Standby takeover::
+
+        journal = RequestJournal.recover(store=store, epoch=new_fence)
+        for rid, rec in journal.live_state().items(): ...resubmit...
+    """
+
+    def __init__(self, root, epoch=0, store=None, prefix="fleet",
+                 fsync=False, progress_every=8, compact_min_retired=64,
+                 retired_keep=256):
+        self.root = str(root)
+        self.epoch = int(epoch)
+        self.prefix = prefix
+        self._store = store
+        self._fsync = bool(fsync)
+        self.progress_every = int(progress_every)
+        self.compact_min_retired = int(compact_min_retired)
+        self.retired_keep = int(retired_keep)
+        self._lock = threading.RLock()
+        self._buffer: list[bytes] = []
+        self._buffer_admit = False   # pending batch carries an ADMIT?
+        self._live: dict[int, dict] = {}
+        self._retired: OrderedDict[int, tuple] = OrderedDict()
+        self._progress_len: dict[int, int] = {}
+        self._retired_since_compact = 0
+        self._closed = False
+        # accounting for the bench e4 overhead gate
+        self.write_s = 0.0
+        self.records = 0
+        self.progress_records = 0
+        self.flushes = 0
+        self.bytes_written = 0
+        self.compactions = 0
+        os.makedirs(self.root, exist_ok=True)
+        self.path = os.path.join(self.root, _wal_name(self.epoch))
+        if os.path.exists(self.path):
+            # same-epoch restart: replay what this epoch already wrote,
+            # truncate any torn tail, continue appending
+            self._replay_file(self.path, truncate=True)
+        self._file = open(self.path, "ab")
+        self._publish_index()
+
+    # ------------------------------------------------------------ index
+
+    def _publish_index(self):
+        if self._store is None:
+            return
+        with contextlib.suppress(Exception):
+            self._store.set(f"{self.prefix}/journal/root", self.root)
+            self._store.set(f"{self.prefix}/journal/epoch",
+                            str(self.epoch))
+
+    # ---------------------------------------------------------- records
+
+    def _frame(self, rec: dict) -> bytes:
+        payload = _payload_encode(rec)
+        return _FRAME.pack(len(payload), zlib.crc32(payload)) + payload
+
+    def _append(self, rec: dict) -> bool:
+        """Encode + buffer one record. The ``journal.write_drop`` fault
+        site models a crash before the record reached the buffer."""
+        t0 = time.monotonic()
+        try:
+            inject("journal.write_drop")
+        except InjectedFault:
+            bump_counter("journal.write_drop")
+            self.write_s += time.monotonic() - t0
+            return False
+        frame = self._frame(rec)
+        with self._lock:
+            if self._closed:
+                return False
+            self._buffer.append(frame)
+            if rec.get("t") == "admit":
+                self._buffer_admit = True
+            self.records += 1
+        self.write_s += time.monotonic() - t0
+        return True
+
+    def admit(self, rid, prompt, max_new_tokens, priority=0,
+              deadline_s=None, hedge=False) -> bool:
+        """Journal one admission. Idempotent per rid (a failover replay
+        or client resubmit must not duplicate the record)."""
+        rid = int(rid)
+        with self._lock:
+            if rid in self._live or rid in self._retired:
+                return False
+            rec = {
+                "t": "admit", "rid": rid,
+                "prompt": np.asarray(prompt, np.int32),
+                "max_new": int(max_new_tokens), "prio": int(priority),
+                "deadline_s": (None if deadline_s is None
+                               else float(deadline_s)),
+                "admit_wall": time.time(),  # wall-clock: x-process replay
+                "hedge": bool(hedge),
+            }
+            if not self._append(rec):
+                return False
+            state = dict(rec)
+            state["emitted"] = np.zeros((0,), np.int32)
+            self._live[rid] = state
+            self._progress_len[rid] = 0
+        return True
+
+    def progress(self, rid, emitted, force=False) -> bool:
+        """Checkpoint the router's known emitted-token prefix for a live
+        rid. Journaled only when it grew by ``progress_every`` tokens
+        since the last checkpoint (or ``force``) — the K-policy that
+        keeps the hot path write volume bounded."""
+        rid = int(rid)
+        emitted = np.asarray(emitted, np.int32).ravel()
+        with self._lock:
+            state = self._live.get(rid)
+            if state is None:
+                return False
+            last = self._progress_len.get(rid, 0)
+            if len(emitted) <= last:
+                return False
+            if not force and len(emitted) - last < self.progress_every:
+                return False
+            if not self._append({"t": "progress", "rid": rid,
+                                 "emitted": emitted}):
+                return False
+            state["emitted"] = emitted
+            self._progress_len[rid] = len(emitted)
+            self.progress_records += 1
+        return True
+
+    def retire(self, rid, status, tokens=None, reason=None) -> bool:
+        """Journal the terminal verdict: GCs the live record (compaction
+        drops everything about the rid except this) and feeds the
+        exactly-once resubmit cache."""
+        rid = int(rid)
+        tokens = (np.zeros((0,), np.int32) if tokens is None
+                  else np.asarray(tokens, np.int32).ravel())
+        with self._lock:
+            if rid in self._retired:
+                return False
+            if not self._append({"t": "retire", "rid": rid,
+                                 "status": str(status), "tokens": tokens,
+                                 "reason": reason}):
+                return False
+            self._apply_retire(rid, str(status), tokens, reason)
+            self._retired_since_compact += 1
+            if self._retired_since_compact >= self.compact_min_retired:
+                self._compact_locked()
+        return True
+
+    def _apply_retire(self, rid, status, tokens, reason):
+        self._live.pop(rid, None)
+        self._progress_len.pop(rid, None)
+        self._retired[rid] = (status, tokens, reason)
+        self._retired.move_to_end(rid)
+        while len(self._retired) > self.retired_keep:
+            self._retired.popitem(last=False)
+
+    # ------------------------------------------------------------ flush
+
+    def flush(self):
+        """Land the buffered records in one write. Called by the router
+        at step boundaries — batched, off the decode hot path.
+
+        fsync policy (``fsync=True`` deployments): only a batch
+        carrying an ADMIT takes the disk barrier — that is the record
+        whose durability is a contract (``submit()`` must not ack a rid
+        the journal could lose even to a machine crash). PROGRESS/
+        RETIRE batches are written without it: losing an unsynced
+        progress checkpoint only makes recovery replay from the prior
+        one (bit-identical by the key-stream contract), and losing a
+        retire record only makes the new leader re-derive the same
+        verdict — both documented recovery paths, neither worth an
+        fsync per step on the hot path. With the default
+        ``fsync=False`` every batch is a plain ``write()``: the kernel
+        page cache already survives router-process death, the HA
+        threat model."""
+        with self._lock:
+            if not self._buffer or self._closed:
+                return
+            batch, self._buffer = b"".join(self._buffer), []
+            durable, self._buffer_admit = self._buffer_admit, False
+            t0 = time.monotonic()
+            self._file.write(batch)
+            self._file.flush()
+            if self._fsync and durable:
+                os.fsync(self._file.fileno())
+            self.write_s += time.monotonic() - t0
+            self.flushes += 1
+            self.bytes_written += len(batch)
+
+    def close(self):
+        with self._lock:
+            if self._closed:
+                return
+            self.flush()
+            self._closed = True
+            self._file.close()
+
+    # ------------------------------------------------------- compaction
+
+    def _snapshot_frames(self):
+        """The compacted image of the current state: one admit (+ one
+        progress when tokens are known) per live rid, plus the recent
+        retires backing the dedup cache."""
+        frames = []
+        for rid, state in sorted(self._live.items()):
+            frames.append(self._frame({
+                "t": "admit", "rid": rid, "prompt": state["prompt"],
+                "max_new": state["max_new"], "prio": state["prio"],
+                "deadline_s": state["deadline_s"],
+                "admit_wall": state["admit_wall"],
+                "hedge": state["hedge"]}))
+            if len(state["emitted"]):
+                frames.append(self._frame({"t": "progress", "rid": rid,
+                                           "emitted": state["emitted"]}))
+        for rid, (status, tokens, reason) in self._retired.items():
+            frames.append(self._frame({"t": "retire", "rid": rid,
+                                       "status": status, "tokens": tokens,
+                                       "reason": reason}))
+        return frames
+
+    def _compact_locked(self):
+        """Rewrite the epoch file as the compacted snapshot (tmp +
+        atomic replace) — journal growth is bounded by in-flight work +
+        ``retired_keep``, not history. Caller holds the lock."""
+        t0 = time.monotonic()
+        if self._buffer:
+            # pending frames are already reflected in the in-memory
+            # state the snapshot is built from
+            self._buffer = []
+            self._buffer_admit = False
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            for frame in self._snapshot_frames():
+                f.write(frame)
+            f.flush()
+            if self._fsync:
+                os.fsync(f.fileno())
+        self._file.close()
+        os.replace(tmp, self.path)
+        self._file = open(self.path, "ab")
+        self._retired_since_compact = 0
+        self.compactions += 1
+        self.write_s += time.monotonic() - t0
+        bump_counter("journal.compaction")
+
+    # ----------------------------------------------------------- replay
+
+    def _replay_file(self, path, truncate=False):
+        gen = _scan_frames(path)
+        while True:
+            try:
+                rec = next(gen)
+            except StopIteration as stop:
+                good = stop.value
+                break
+            self._apply_record(rec)
+        if truncate and good is not None and good < os.path.getsize(path):
+            with open(path, "r+b") as f:
+                f.truncate(good)
+
+    def _apply_record(self, rec):
+        t = rec.get("t")
+        rid = int(rec.get("rid", -1))
+        if t == "admit":
+            if rid in self._retired or rid in self._live:
+                return
+            state = {k: rec[k] for k in ("prompt", "max_new", "prio",
+                                         "deadline_s", "admit_wall",
+                                         "hedge")}
+            state["rid"] = rid
+            state["prompt"] = np.asarray(state["prompt"], np.int32)
+            state["emitted"] = np.zeros((0,), np.int32)
+            self._live[rid] = state
+            self._progress_len[rid] = 0
+        elif t == "progress":
+            state = self._live.get(rid)
+            if state is not None:
+                emitted = np.asarray(rec["emitted"], np.int32)
+                if len(emitted) > len(state["emitted"]):
+                    state["emitted"] = emitted
+                    self._progress_len[rid] = len(emitted)
+        elif t == "retire":
+            self._apply_retire(rid, str(rec["status"]),
+                               np.asarray(rec["tokens"], np.int32),
+                               rec.get("reason"))
+        else:
+            bump_counter("journal.unknown_record")
+
+    @classmethod
+    def recover(cls, root=None, epoch=None, store=None, prefix="fleet",
+                **kwargs):
+        """Standby takeover: locate the journal (explicit ``root`` or
+        the store index), replay the highest-epoch WAL, and compact the
+        surviving state into THIS epoch's fresh file (``epoch`` is the
+        new leader's fencing token — a zombie still appending to its own
+        epoch file can no longer affect the recovered log). Returns the
+        new epoch's journal with ``live_state()`` / ``retired_result()``
+        populated."""
+        if root is None:
+            if store is None:
+                raise ValueError("recover() needs a journal root or a "
+                                 "store carrying the journal index")
+            root = store.get(f"{prefix}/journal/root", timeout=10).decode()
+        sources = sorted(
+            n for n in os.listdir(root)
+            if n.startswith("wal-") and n.endswith(".log")) \
+            if os.path.isdir(root) else []
+        src_epoch = _wal_epoch(sources[-1]) if sources else -1
+        if epoch is None:
+            epoch = src_epoch + 1
+        if int(epoch) <= src_epoch and _wal_name(epoch) != sources[-1]:
+            # a fence that does not outrank the newest file would compact
+            # INTO a zombie's live epoch; refuse loudly
+            raise ValueError(
+                f"recovery epoch {epoch} does not outrank the newest "
+                f"journal epoch {src_epoch} under {root}")
+        j = cls(root, epoch=epoch, store=store, prefix=prefix, **kwargs)
+        if sources and _wal_name(epoch) != sources[-1]:
+            j._replay_file(os.path.join(root, sources[-1]))
+            with j._lock:
+                j._compact_locked()
+            bump_counter("journal.recovered")
+            logger.info(
+                "journal recovered: %d live / %d retired request(s) from "
+                "%s into epoch %d", len(j._live), len(j._retired),
+                sources[-1], j.epoch)
+        return j
+
+    # ------------------------------------------------------------ views
+
+    def live_state(self) -> dict:
+        """{rid: state} for every admitted-but-unretired request; state
+        carries prompt/max_new/prio/deadline_s/admit_wall/hedge and the
+        last checkpointed ``emitted`` prefix."""
+        with self._lock:
+            return {rid: dict(state)
+                    for rid, state in self._live.items()}
+
+    def is_live(self, rid) -> bool:
+        with self._lock:
+            return int(rid) in self._live
+
+    def retired_result(self, rid):
+        """(status, tokens, reason) for a recently retired rid, or None
+        — the exactly-once cache behind ``router.submit(rid=...)``."""
+        with self._lock:
+            return self._retired.get(int(rid))
+
+    def max_rid(self) -> int:
+        """Highest rid this journal has seen (live or retired cache), or
+        -1 — a restarted/promoted router seeds its rid counter above it
+        so it can never alias a journaled rid onto a new request."""
+        with self._lock:
+            rids = [*self._live, *self._retired]
+            return max(rids) if rids else -1
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "records": self.records,
+                "progress_records": self.progress_records,
+                "flushes": self.flushes,
+                "bytes_written": self.bytes_written,
+                "compactions": self.compactions,
+                "write_s": self.write_s,
+                "live": len(self._live),
+                "retired_cached": len(self._retired),
+                "epoch": self.epoch,
+                "path": self.path,
+            }
+
+    def __repr__(self):
+        return (f"RequestJournal(epoch={self.epoch}, "
+                f"live={len(self._live)}, path={self.path!r})")

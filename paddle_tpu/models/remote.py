@@ -46,6 +46,7 @@ import numpy as np
 from ..core.resilience import (
     Deadline,
     ServingUnavailable,
+    StaleLeaderError,
     bump_counter,
     logger,
 )
@@ -62,18 +63,39 @@ _SERVERS: dict[str, "ReplicaServer"] = {}
 _servers_lock = threading.Lock()
 
 
-def _call(server, method, *args, **kwargs):
+# methods that MUTATE frontend state: their fence check must hold the
+# server lock, or a stale leader's call that passed a bare check could
+# block behind a decode segment, outlive the new leader's repin, and
+# then mutate state the new leader already inventoried
+_MUTATING_METHODS = frozenset(
+    {"submit", "cancel", "shutdown", "warmup", "repin"})
+
+
+def _call(server, method, *args, _fence=None, **kwargs):
     """Module-level RPC target (function identity travels as
     ``module:qualname``): dispatch ``method`` on the named registered
     server. The envelope carries the server-side execution time so the
-    caller can split transport overhead from real work."""
+    caller can split transport overhead from real work. ``_fence`` is
+    the caller's leader fencing token (HA router): a token below the
+    highest this server has seen is a DEPOSED leader's late write and is
+    rejected typed (``StaleLeaderError``) before the method can mutate —
+    for mutating methods the check runs UNDER the server lock, so it is
+    atomic with the mutation it guards (a repin cannot slip between the
+    check and the call)."""
     with _servers_lock:
         srv = _SERVERS.get(server)
     if srv is None:
         raise ServingUnavailable(
             f"no replica server {server!r} registered in this process")
     t0 = time.monotonic()
-    result = getattr(srv, method)(*args, **kwargs)
+    if method in _MUTATING_METHODS:
+        # self._lock is an RLock: the method re-acquires it freely
+        with srv._lock:
+            srv.check_fence(_fence)
+            result = getattr(srv, method)(*args, **kwargs)
+    else:
+        srv.check_fence(_fence)
+        result = getattr(srv, method)(*args, **kwargs)
     return {"r": result, "exec_s": time.monotonic() - t0,
             "inc": srv.incarnation}
 
@@ -100,6 +122,12 @@ class ReplicaServer:
         # replica death (breaker trip + token_base failover).
         self.incarnation = uuid.uuid4().hex
         self.poll = float(poll)
+        # highest leader fencing token served (HA router): its own tiny
+        # lock — a fence check must answer while a decode segment holds
+        # the frontend lock, and a stale leader must be rejected BEFORE
+        # it can queue behind (and then mutate) live state
+        self._fence = None
+        self._fence_lock = threading.Lock()
         self._lock = threading.RLock()
         self._stop = threading.Event()
         self.stopped = threading.Event()
@@ -108,7 +136,8 @@ class ReplicaServer:
         # health served from a snapshot refreshed every pump turn: a
         # router probe must not block on the frontend lock behind a
         # long decode segment or a first-call XLA compile
-        self._health_cache = frontend.health()
+        self._health_cache = {}
+        self._refresh_health()
         with _servers_lock:
             if self.name in _SERVERS:
                 raise ValueError(
@@ -150,12 +179,59 @@ class ReplicaServer:
     # ------------------------------------------------- the RPC surface
 
     def _refresh_health(self):
-        """Refresh the lock-free health snapshot (caller holds _lock)."""
+        """Refresh the lock-free health snapshot (caller holds _lock).
+        Stamped with the SENDER's monotonic time + incarnation: health
+        rides both direct probes and piggybacked results envelopes, and
+        without a sender stamp a delayed envelope's stale snapshot could
+        out-vote a fresher direct probe purely by arriving later — the
+        router orders snapshots by these stamps, not by arrival."""
         try:
-            self._health_cache = self.frontend.health()
+            snap = self.frontend.health()
+            snap["_ts"] = time.monotonic()
+            snap["_inc"] = self.incarnation
+            self._health_cache = snap
         except Exception:  # noqa: BLE001 — a failed snapshot keeps the
             # previous view; the router's probe still answers
             bump_counter("serving.remote_health_error")
+
+    def check_fence(self, fence):
+        """Leader-fencing gate (HA router): remember the highest fencing
+        token ever served and reject anything lower — a deposed leader's
+        late envelope must not mutate state the NEW leader now owns.
+        ``None`` (a fleet without leader election) always passes."""
+        if fence is None:
+            return
+        fence = int(fence)
+        with self._fence_lock:
+            cur = self._fence
+            if cur is not None and fence < cur:
+                bump_counter("serving.stale_leader_rejected")
+                raise StaleLeaderError(
+                    f"replica {self.name!r} rejects fencing token {fence}"
+                    f": a newer leader (fence {cur}) has taken over")
+            if cur is None or fence > cur:
+                self._fence = fence
+
+    def repin(self, fence):
+        """Takeover handshake: the NEW leader records its fencing token
+        here (everything the old leader sends afterwards bounces typed)
+        and learns this replica's live request state — ``[[rid,
+        token_base, tokens_so_far], ...]`` — so it can adopt running
+        copies whose ``token_base`` is inside the journaled prefix and
+        cancel/replay the rest."""
+        self.check_fence(fence)
+        with self._lock:
+            prog = self.frontend.progress()
+        return [[rid, base, np.asarray(toks, np.int32)]
+                for rid, (base, toks) in prog.items()]
+
+    def progress(self):
+        """Live request progress rows (same shape as :meth:`repin`'s
+        return) without the fence handshake."""
+        with self._lock:
+            prog = self.frontend.progress()
+        return [[rid, base, np.asarray(toks, np.int32)]
+                for rid, (base, toks) in prog.items()]
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
                deadline_s=None, rid=None, token_base=0):
@@ -173,13 +249,18 @@ class ReplicaServer:
             self._live.add(got)
             return got
 
-    def results(self, wait_s=0.0):
-        """Drain terminal results as ``[rows, pending, health]`` where
-        rows are ``[rid, status, tokens, reason]``, ``pending`` is the
-        count of requests still working here, and ``health`` is the
-        lock-free snapshot — the stub's ``results(wait=True)`` loop and
-        the router's dispatch scoring both want these every round, and
-        one envelope is one round-trip, not three. Blocks up to
+    def results(self, wait_s=0.0, progress=False):
+        """Drain terminal results as ``[rows, pending, health,
+        progress]`` where rows are ``[rid, status, tokens, reason,
+        token_base]``, ``pending`` is the count of requests still
+        working here, ``health`` is the lock-free snapshot, and
+        ``progress`` is the live-request progress rows — the stub's
+        ``results(wait=True)`` loop, the router's dispatch scoring AND
+        its journal PROGRESS checkpoints all want these every round, and
+        one envelope is one round-trip, not four. The progress rows are
+        OPT-IN (``progress=True``, requested by journaling HA routers):
+        they serialize every live request's emitted tokens, a wire tax a
+        journal-less fleet should not pay per poll. Blocks up to
         ``wait_s`` for the pump to produce something — the router's
         poll loop rides this instead of hammering empty fetches."""
         deadline = Deadline(wait_s if wait_s and wait_s > 0 else None)
@@ -190,7 +271,8 @@ class ReplicaServer:
                 break
             time.sleep(self.poll)
         return [self._drain_rows(out), int(self.frontend.pending()),
-                dict(self._health_cache)]
+                dict(self._health_cache),
+                self.progress() if progress else []]
 
     def _drain_rows(self, fetched):
         """Serialize fetched results into wire rows (the one definition
@@ -200,7 +282,8 @@ class ReplicaServer:
         for rid, res in fetched.items():
             self._live.discard(rid)
             rows.append([rid, res.status,
-                         np.asarray(res.tokens, np.int32), res.reason])
+                         np.asarray(res.tokens, np.int32), res.reason,
+                         int(getattr(res, "token_base", 0))])
         return rows
 
     def cancel(self, rid) -> bool:
@@ -283,6 +366,17 @@ class RemoteFrontend:
         # freshest health snapshot a results envelope carried — a free
         # ride-along the router uses instead of separate health probes
         self.piggyback_health = None
+        # freshest live-request progress rows a results envelope carried
+        # ({rid: (token_base, tokens)}) — feeds the router's journal
+        # PROGRESS checkpoints without a separate wire round-trip. The
+        # rows are requested only when want_progress is set (a journaling
+        # HA router flips it): serializing every live request's tokens
+        # per poll is a wire tax a journal-less fleet should not pay
+        self.piggyback_progress = None
+        self.want_progress = False
+        # leader fencing token every call carries once set (HA router):
+        # the server rejects anything below the highest it has served
+        self.fence = None
         # first incarnation nonce seen from the server; a mismatch means
         # the replica process died and was respawned under our name
         self._incarnation = None
@@ -300,6 +394,9 @@ class RemoteFrontend:
         resend_after = self.resend_after
         if resend_after is None:
             resend_after = max(budget / max(self.retry_attempts, 1), 0.05)
+        if self.fence is not None:
+            kwargs = dict(kwargs)
+            kwargs["_fence"] = int(self.fence)
         t0 = time.monotonic()
         env = rpc.rpc_sync(self.worker, _call,
                            args=(self.server, method, *args),
@@ -360,13 +457,19 @@ class RemoteFrontend:
             return out
         deadline = Deadline(timeout) if wait else None
         while True:
-            rows, n_pending, health = self._rpc(
-                "results", wait_s=self.results_wait, timeout=timeout)
-            # free health ride-along: the router refreshes its dispatch
-            # scores from this instead of a separate health round-trip
+            rows, n_pending, health, progress = self._rpc(
+                "results", wait_s=self.results_wait, timeout=timeout,
+                progress=bool(self.want_progress))
+            # free health/progress ride-alongs: the router refreshes its
+            # dispatch scores and journal checkpoints from these instead
+            # of separate round-trips
             self.piggyback_health = health
-            for rid, status, tokens, reason in rows:
-                out[rid] = RequestResult(rid, status, tokens, reason)
+            self.piggyback_progress = {
+                rid: (int(base), np.asarray(toks, np.int32))
+                for rid, base, toks in progress}
+            for rid, status, tokens, reason, base in rows:
+                out[rid] = RequestResult(rid, status, tokens, reason,
+                                         token_base=base)
             if not wait:
                 return out
             if not rows and not n_pending:
@@ -376,6 +479,26 @@ class RemoteFrontend:
 
     def cancel(self, rid) -> bool:
         return bool(self._rpc("cancel", rid))
+
+    def set_fence(self, fence):
+        """Pin the leader fencing token every subsequent call carries —
+        the router sets it on acquiring (or taking over) leadership."""
+        self.fence = int(fence)
+
+    def repin(self, fence):
+        """Takeover handshake (see ``ReplicaServer.repin``): record the
+        new leader's fence on the server and return the replica's live
+        request state as ``{rid: (token_base, tokens_so_far)}``."""
+        self.set_fence(fence)
+        rows = self._rpc("repin", int(fence))
+        return {rid: (int(base), np.asarray(toks, np.int32))
+                for rid, base, toks in rows}
+
+    def progress(self) -> dict:
+        """Live request progress as ``{rid: (token_base, tokens)}``."""
+        rows = self._rpc("progress", timeout=self.health_timeout)
+        return {rid: (int(base), np.asarray(toks, np.int32))
+                for rid, base, toks in rows}
 
     def health(self) -> dict:
         return self._rpc("health", timeout=self.health_timeout)
@@ -403,9 +526,9 @@ class RemoteFrontend:
             # already-deregistered server == already shut down
             rows = self._rpc("shutdown", drain=bool(drain),
                              timeout=self.warmup_timeout)
-            for rid, status, tokens, reason in rows or ():
+            for rid, status, tokens, reason, base in rows or ():
                 self._final[rid] = RequestResult(rid, status, tokens,
-                                                 reason)
+                                                 reason, token_base=base)
         self._closed = True
         return True
 

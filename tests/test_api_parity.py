@@ -2,15 +2,18 @@
 exists on paddle_tpu (the audit that drove the round-2 compat tranche),
 plus behavior checks for the in-place variants and compat helpers."""
 import ast
+import os
 
 import numpy as np
 import pytest
 
 import paddle_tpu as paddle
 
+_REFERENCE = "/root/reference/python/paddle/__init__.py"
+
 
 def _reference_all():
-    src = open("/root/reference/python/paddle/__init__.py").read()
+    src = open(_REFERENCE).read()
     tree = ast.parse(src)
     for node in ast.walk(tree):
         if isinstance(node, ast.Assign):
@@ -20,6 +23,9 @@ def _reference_all():
     raise AssertionError("reference __all__ not found")
 
 
+@pytest.mark.skipif(not os.path.exists(_REFERENCE),
+                    reason="reference checkout not present in this "
+                           "container (audit runs where it is)")
 def test_every_reference_name_exists():
     missing = [n for n in _reference_all() if not hasattr(paddle, n)]
     assert missing == [], f"missing top-level names: {missing}"

@@ -120,8 +120,9 @@ def test_collective_api_single_controller(mesh2d):
 
 
 def test_comm_ops_inside_shard_map(mesh2d):
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.fleet.jax_compat import shard_map
 
     from paddle_tpu.distributed import comm_ops
 
@@ -139,8 +140,9 @@ def test_comm_ops_inside_shard_map(mesh2d):
 
 def test_megatron_fg_pair_grads(mesh2d):
     """f/g conjugate collectives: forward values and backward psum."""
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
+
+    from paddle_tpu.distributed.fleet.jax_compat import shard_map
 
     from paddle_tpu.distributed import comm_ops
 

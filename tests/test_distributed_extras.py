@@ -1,6 +1,7 @@
 """Long-tail distributed surface (r5): full reference `__all__` parity,
 object collectives, alltoall aliases, megatron split, PS data feeds,
 distributed io."""
+import os
 import re
 
 import numpy as np
@@ -12,6 +13,9 @@ import paddle_tpu.distributed as dist
 REF_INIT = "/root/reference/python/paddle/distributed/__init__.py"
 
 
+@pytest.mark.skipif(not os.path.exists(REF_INIT),
+                    reason="reference checkout not present in this "
+                           "container (audit runs where it is)")
 def test_distributed_all_parity():
     """Every name in the reference's paddle.distributed.__all__ resolves
     here (implementation or documented absorption shim)."""

@@ -251,7 +251,11 @@ def test_spectral_norm_grad_flows():
     w = paddle.randn([8, 4])
     w.stop_gradient = False
     sn = nn.SpectralNorm([8, 4], power_iters=3)
-    out = sn(w)
+    # u/v are persistent buffers: power iteration converges across
+    # forward calls (one call's 3 iters from a random init is only a
+    # rough sigma estimate — reference semantics, not a bug)
+    for _ in range(4):
+        out = sn(w)
     # spectral norm of the output should be ~1
     s = np.linalg.svd(np.asarray(out._value), compute_uv=False)
     assert abs(s[0] - 1.0) < 0.1

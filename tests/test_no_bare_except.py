@@ -105,6 +105,38 @@ def test_no_aliased_wall_clock_imports(subdir):
         f"wall-clock use is greppable: {offenders}")
 
 
+_TESTS_DIR = pathlib.Path(__file__).resolve().parent
+
+# fault-site call forms whose FIRST literal argument is a site name; the
+# store's `_retrying(site, ...)` wrapper is its per-op inject() point
+_FAULT_SITE_CALLS = re.compile(
+    r"(?:\binject|\bconsume_fault|self\._retrying)\(\s*\"([^\"]+)\"")
+
+
+def test_every_fault_site_is_exercised_by_a_test():
+    """Registry sweep: every ``FLAGS_fault_injection`` site registered
+    anywhere in ``paddle_tpu/`` (literal ``inject("...")`` /
+    ``consume_fault("...")`` / store ``_retrying("...")`` call sites)
+    must appear in at least one test file — a new fault site cannot
+    ship untested, because an unexercised recovery path is the one that
+    fails in the real outage."""
+    sites = set()
+    for py in sorted(_PKG.rglob("*.py")):
+        sites.update(_FAULT_SITE_CALLS.findall(py.read_text()))
+    assert sites, "fault-site sweep found nothing: the regex is broken"
+    haystack = "\n".join(p.read_text()
+                         for p in sorted(_TESTS_DIR.glob("*.py")))
+    unexercised = sorted(
+        s for s in sites
+        if f'"{s}' not in haystack and f"'{s}" not in haystack)
+    assert not unexercised, (
+        f"fault site(s) {unexercised} are registered in paddle_tpu/ but "
+        "no test ever arms or references them — every injection point "
+        "needs at least one drill (FLAGS_fault_injection spec or a "
+        "direct reference) so its recovery path is tested before it is "
+        "needed in production")
+
+
 def test_router_retirement_switch_covers_every_terminal_state():
     """Every terminal status the engine can stamp on a Request — and
     every admission verdict the frontend adds on top — must have a

@@ -150,6 +150,18 @@ def test_kv_fetch_retries_injected_drops_then_succeeds(monkeypatch):
     assert fake.deleted == ["chan/0"]  # consumed after the retries
 
 
+def test_kv_publish_retries_injected_drops_then_succeeds(monkeypatch):
+    """The publish half of the KV transport has its own fault site
+    (``kv_publish``): transient coordinator failures on the SET are
+    retried just like fetch-side drops."""
+    fake = _FakeKVClient()
+    monkeypatch.setattr(collective, "_p2p_client", lambda: fake)
+    set_flags({"FLAGS_fault_injection": "kv_publish:2"})
+    collective._kv_publish("chan/1", b"payload")
+    assert resilience.get_counter("fault_injected:kv_publish") == 2
+    assert collective._kv_fetch("chan/1", timeout_ms=30_000) == b"payload"
+
+
 def test_kv_fetch_raises_diagnostic_comm_timeout(monkeypatch):
     fake = _FakeKVClient()
     monkeypatch.setattr(collective, "_p2p_client", lambda: fake)
@@ -186,6 +198,9 @@ def test_tcp_store_honors_caller_timeout():
 
 
 def test_tcp_store_ops_retry_through_injected_faults():
+    """EVERY store op site recovers through its retry policy: a
+    transient fault on set/get/add/check/delete is retried with
+    reconnect, not surfaced to the caller."""
     master = TCPStore(is_master=True)
     client = TCPStore(port=master.port)
     client.set("k", b"v")
@@ -195,6 +210,16 @@ def test_tcp_store_ops_retry_through_injected_faults():
     set_flags({"FLAGS_fault_injection": "store_set:1"})
     client.set("k2", b"v2")
     assert master.get("k2") == b"v2"
+    set_flags({"FLAGS_fault_injection": "store_add:1"})
+    assert client.add("ctr", 3) == 3
+    assert resilience.get_counter("fault_injected:store_add") == 1
+    set_flags({"FLAGS_fault_injection": "store_check:1"})
+    assert client.check("k2")
+    assert resilience.get_counter("fault_injected:store_check") == 1
+    set_flags({"FLAGS_fault_injection": "store_delete:1"})
+    client.delete_key("k2")
+    assert not master.check("k2")
+    assert resilience.get_counter("fault_injected:store_delete") == 1
     client.close()
     master.close()
 

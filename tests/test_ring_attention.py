@@ -41,8 +41,10 @@ def test_output_stays_sequence_sharded():
     mesh = dist.ProcessMesh(np.arange(8), ["sp"])
     q = jnp.ones((1, 64, 2, 16), jnp.float32)
     out = ring_attention(q, q, q, mesh, "sp")
-    assert out.sharding.spec == jax.sharding.PartitionSpec(
-        None, "sp", None, None)
+    # PartitionSpec equality over trailing Nones differs across jax
+    # releases; compare the canonical (stripped) prefix instead
+    spec = tuple(out.sharding.spec)
+    assert spec[:2] == (None, "sp") and all(s is None for s in spec[2:])
 
 
 def test_grad_matches_dense():
