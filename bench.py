@@ -14,6 +14,7 @@ Weak-1):
   (e) whole-model compiled decode (generate(), paged caches)
       + (e2) continuous batching + (e3) replica-fleet router overhead gate
       + (e4) durable-router write-ahead journal overhead gate
+      + (e5) telemetry overhead gate (tracing + metrics registry, default-on)
   (f) per-op microbench: adaptive iters (no 0.0us clamp readings), compared
       against OPBENCH_BASELINE.json, then the baseline is RE-RECORDED with
       this run's numbers (reference: tools/ci_op_benchmark.sh relative gate)
@@ -812,6 +813,81 @@ except Exception as e:
     log(f"durable router section FAILED: {type(e).__name__}: {e}")
     journal_metrics = {"journal_error": f"{type(e).__name__}: {e}"[:200]}
 
+# ------------------------------------------------- (e5) telemetry overhead
+# The fleet observability layer (core/telemetry.py): request tracing +
+# labeled metrics are DEFAULT-ON on the serving hot path, so their cost
+# is gated — telemetry_overhead_pct (throughput delta between
+# FLAGS_telemetry=0 and the default-on run, % of active processing)
+# must stay < 3%. Per-op microbenches (counter bump / histogram observe
+# / span) record the primitive costs the A/B aggregates.
+tele_metrics = {}
+try:
+    from paddle_tpu.core import telemetry as _tele
+    from paddle_tpu.core.flags import set_flags as _tele_setf
+    from paddle_tpu.models.serving import (
+        ContinuousBatchingEngine as _TeleCBE,
+    )
+
+    if SMOKE:
+        T_SLOTS, T_LEN, T_REQ, T_NEW, T_SEG = 2, 128, 8, 24, 4
+    else:
+        T_SLOTS, T_LEN, T_REQ, T_NEW, T_SEG = 8, 512, 16, 64, 32
+    log(f"telemetry overhead: {T_REQ} requests x {T_NEW} tokens, "
+        "A/B FLAGS_telemetry off/on...")
+    t_eng = _TeleCBE(model, max_slots=T_SLOTS, max_len=T_LEN,
+                     page_size=128, prompt_buckets=(32, 128))
+    t_eng.warmup(segment=T_SEG)
+    rng_t = np.random.RandomState(23)
+    t_lens = rng_t.randint(8, 28, T_REQ)
+    mk_t = lambda: [rng_t.randint(0, cfg.vocab_size,
+                                  (int(n),)).astype(np.int32)
+                    for n in t_lens]
+    t_eng.run(mk_t()[:2], max_new_tokens=2, segment=T_SEG)  # warm
+    # interleaved A/B, best-of-2 per arm: RTT jitter is additive and
+    # must not read as telemetry cost
+    tok_s = {0: 0.0, 1: 0.0}
+    for rep in range(2):
+        for arm in (0, 1):
+            _tele_setf({"FLAGS_telemetry": arm})
+            _, t_st = t_eng.run(mk_t(), max_new_tokens=T_NEW,
+                                segment=T_SEG)
+            tok_s[arm] = max(tok_s[arm], t_st["tokens_per_sec"])
+    _tele_setf({"FLAGS_telemetry": 1})
+    overhead_pct = (100.0 * (1.0 - tok_s[1] / tok_s[0])
+                    if tok_s[0] > 0 else 0.0)
+    # primitive costs (ns/op over a tight loop)
+    N_OPS = 100_000
+    t_c = _tele.counter("bench.tele_tick")
+    t0 = time.time()
+    for _ in range(N_OPS):
+        t_c.inc()
+    bump_ns = (time.time() - t0) / N_OPS * 1e9
+    t_h = _tele.histogram("bench.tele_lat_s")
+    t0 = time.time()
+    for _ in range(N_OPS):
+        t_h.observe(0.01)
+    observe_ns = (time.time() - t0) / N_OPS * 1e9
+    t0 = time.time()
+    for _ in range(N_OPS // 10):
+        with _tele.span("bench.tele_span"):
+            pass
+    span_ns = (time.time() - t0) / (N_OPS // 10) * 1e9
+    tele_metrics = {
+        "telemetry_overhead_pct": round(max(overhead_pct, 0.0), 3),
+        "telemetry_on_tokens_per_sec": round(tok_s[1], 1),
+        "telemetry_off_tokens_per_sec": round(tok_s[0], 1),
+        "telemetry_bump_ns": round(bump_ns, 1),
+        "telemetry_observe_ns": round(observe_ns, 1),
+        "telemetry_span_ns": round(span_ns, 1),
+    }
+    log(f"telemetry: {tok_s[1]:,.0f} tok/s on vs {tok_s[0]:,.0f} off -> "
+        f"overhead {tele_metrics['telemetry_overhead_pct']}% of active "
+        f"processing (gate: < 3%); bump {bump_ns:.0f}ns, observe "
+        f"{observe_ns:.0f}ns, span {span_ns:.0f}ns")
+except Exception as e:
+    log(f"telemetry section FAILED: {type(e).__name__}: {e}")
+    tele_metrics = {"telemetry_error": f"{type(e).__name__}: {e}"[:200]}
+
 # ------------------------------------------------------- (f) op microbench
 # Per-op regression gate (reference: tools/ci_op_benchmark.sh relative
 # check): ~20 hot ops + eager dispatch overhead, compared against the
@@ -902,6 +978,7 @@ result = {
     **cb_metrics,
     **fleet_metrics,
     **journal_metrics,
+    **tele_metrics,
     "op_bench_us": op_results,
     "op_bench_vs_baseline": op_vs_baseline,
     "op_bench_regressions": op_regressions,

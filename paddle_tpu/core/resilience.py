@@ -40,6 +40,7 @@ import random
 import threading
 import time
 
+from . import telemetry
 from .flags import define_flag, flag
 
 __all__ = [
@@ -343,10 +344,14 @@ class CircuitBreaker:
         cool-down elapses, a half-open probe succeeds, the breaker
         closes."""
         with self._lock:
-            if self._state != self.OPEN:
+            tripped = self._state != self.OPEN
+            if tripped:
                 self._trip()
+        if tripped:
+            self._dump_trip()
 
     def record_failure(self):
+        tripped = False
         with self._lock:
             self._tick()
             if self._state == self.HALF_OPEN:
@@ -355,12 +360,16 @@ class CircuitBreaker:
                     # not probe evidence (mirror of record_success)
                     return
                 self._trip()  # failed probe: fresh cool-down
+                tripped = True
+            elif self._state == self.OPEN:
                 return
-            if self._state == self.OPEN:
-                return
-            self._failures += 1
-            if self._failures >= self.failure_threshold:
-                self._trip()
+            else:
+                self._failures += 1
+                if self._failures >= self.failure_threshold:
+                    self._trip()
+                    tripped = True
+        if tripped:
+            self._dump_trip()
 
     def _trip(self):
         self._state = self.OPEN
@@ -370,6 +379,18 @@ class CircuitBreaker:
         bump_counter(f"circuit_opened:{self.name}")
         logger.warning("circuit %r opened (cool-down %.3fs)",
                        self.name, self.cooldown_s)
+
+    def _dump_trip(self):
+        """A tripped breaker is a post-mortem moment: dump the flight
+        recorder so the trip leaves WHY-context (the recent event ring
+        includes whatever death/failure evidence preceded it), capped
+        per process so a flapping breaker can't fill the disk. Runs
+        AFTER the breaker lock is released — the dump does file I/O,
+        and every allow()/record_* caller would stall on the lock for
+        its duration."""
+        telemetry.flight_recorder().record("circuit_opened",
+                                           breaker=self.name)
+        telemetry.flight_recorder().dump(f"breaker_trip:{self.name}")
 
     def __repr__(self):
         return (f"CircuitBreaker({self.name!r}, state={self.state()!r}, "
@@ -439,27 +460,38 @@ def reset_faults():
 
 
 # ------------------------------------------------------------- counters
-
-_counter_lock = threading.Lock()
-_counters: dict[str, int] = {}
-
+#
+# Back-compat shim over the telemetry registry (core/telemetry.py):
+# every resilience counter IS a registry Counter now, so the fleet
+# metrics view (`ServingRouter.fleet_metrics()`), the Prometheus
+# exposition, and the flight recorder all see the same ledger the
+# historical ``bump_counter`` call sites feed — one source of truth.
+# The surface (and every counter-name assertion in tests) is unchanged.
 
 def bump_counter(name: str, n: int = 1) -> int:
-    with _counter_lock:
-        _counters[name] = _counters.get(name, 0) + n
-        return _counters[name]
+    return telemetry.counter(name).inc(n)
 
 
 def get_counter(name: str) -> int:
-    with _counter_lock:
-        return _counters.get(name, 0)
+    return telemetry.counter(name).value()
 
 
 def counters() -> dict[str, int]:
-    with _counter_lock:
-        return dict(_counters)
+    """Every label-less counter series in the registry (the historical
+    resilience ledger view; labeled telemetry series are visible in
+    ``telemetry.registry().snapshot()``)."""
+    out = {}
+    for name, m in telemetry.registry().metrics().items():
+        if m.kind != "counter":
+            continue
+        for key, v in m.series().items():
+            if not key:
+                out[name] = v
+    return out
 
 
 def reset_counters():
-    with _counter_lock:
-        _counters.clear()
+    """Zero every registry metric in place (test teardown). Cached
+    metric handles stay registered and valid — only their series
+    reset."""
+    telemetry.registry().reset()

@@ -1,0 +1,774 @@
+"""Fleet-wide telemetry: labeled metrics, request tracing, flight recorder.
+
+The reference ships a profiler surface (python/paddle/profiler/) but its
+production observability lives out of tree. This module is that layer
+built fleet-native for the serving stack: ONE process-local home for
+everything a multi-process serving fleet needs to answer "what is the
+fleet doing right now" and "what was it doing when it died":
+
+* **Metrics registry** — ``Counter`` / ``Gauge`` / ``Histogram`` with
+  label sets and a lock-cheap bump on hot paths. ``registry().snapshot()``
+  is a plain-JSON view; ``to_prometheus()`` is the text exposition
+  format. Snapshots MERGE (``merge_snapshots``): replicas publish theirs
+  to the gang store on their heartbeat cadence (``models/remote.py
+  replica_main``) and the router folds them into one
+  ``ServingRouter.fleet_metrics()`` view — fleet-wide TTFT/queue-wait
+  percentiles, tokens/s, per-replica breaker state, one call.
+  ``core.resilience.bump_counter`` delegates here, so every historical
+  resilience counter is a registry metric too (one source of truth).
+* **Request tracing** — a trace id is minted at ``ServingRouter.submit``
+  (and ``ServingFrontend.submit`` standalone), rides the RPC envelope
+  into the replica process, and every layer records spans against it:
+  admit, queue-wait, prefill, chunked-prefill, decode segments, retire,
+  plus failover/hedge/takeover hops as instant events. Spans land in a
+  bounded process-local sink and export as Chrome-trace JSON
+  (``export_chrome_trace``); ``stitch_chrome_traces`` merges per-process
+  dumps so a kill-mid-decode drill yields one readable timeline of the
+  request hopping replicas. Span timestamps are wall-clock (the one
+  sanctioned use: Chrome-trace times must share an epoch ACROSS
+  processes); durations are measured on the monotonic clock.
+* **Flight recorder** — a bounded ring of recent telemetry events
+  (replica deaths, failovers, breaker transitions, poison retirements,
+  leadership changes). ``dump(reason)`` writes a post-mortem JSON file
+  (events + metrics snapshot + recent spans); it fires automatically on
+  breaker trips, poison retirements, ``StaleLeaderError`` stand-downs,
+  and replica SIGTERM — the multi-process drills leave debuggable
+  artifacts instead of nothing. Dumps are capped per process.
+
+``FLAGS_telemetry=0`` disables hot-path observation (tracing + metric
+bumps on the serving path) for A/B overhead measurement — bench e5 gates
+``telemetry_overhead_pct`` < 3% of active processing with it ON.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+from .flags import define_flag, flag
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "registry", "counter", "gauge", "histogram", "merge_snapshots",
+    "summary_from_snapshot", "enabled",
+    "new_trace_id", "Tracer", "tracer", "span", "maybe_span",
+    "trace_event",
+    "export_chrome_trace", "stitch_chrome_traces",
+    "FlightRecorder", "flight_recorder", "flight_dump", "reset_telemetry",
+]
+
+define_flag("FLAGS_telemetry", True,
+            "Master switch for hot-path telemetry (request tracing + "
+            "metric observation on the serving path). Registries and "
+            "explicit dumps still work when off; bench e5 A/Bs this "
+            "flag to gate telemetry_overhead_pct < 3%.")
+define_flag("FLAGS_trace_buffer", 8192,
+            "Bounded span-sink capacity (completed spans + instant "
+            "events kept per process; oldest dropped first)")
+define_flag("FLAGS_flight_events", 512,
+            "Flight-recorder ring capacity (most recent telemetry "
+            "events kept per process)")
+define_flag("FLAGS_flight_dir", "",
+            "Directory for flight-recorder post-mortem dumps (empty: "
+            "$PADDLE_FLIGHT_DIR, else <tmpdir>/paddle_tpu_flight)")
+define_flag("FLAGS_flight_max_dumps", 8,
+            "Max automatic flight-recorder dumps per process (a breaker "
+            "flapping in a tight loop must not fill the disk)")
+
+# default histogram buckets: serving latencies span ~100us (a counter
+# bump) to minutes (a cold warmup); seconds, log-ish spacing
+_DEF_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 120.0)
+# per-histogram-series reservoir of recent raw samples: exact percentiles
+# for the window health endpoints care about (buckets are the unbounded-
+# horizon fallback and the merge/exposition format)
+_RESERVOIR = 512
+# cap on reservoir samples serialized into a published snapshot (the
+# replica → store → router path must stay cheap on the wire)
+_SNAPSHOT_SAMPLES = 128
+
+
+def enabled() -> bool:
+    """Hot paths check this before observing (one dict lookup)."""
+    return bool(flag("FLAGS_telemetry"))
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items())) if labels else ()
+
+
+def _series_name(name: str, key: tuple) -> str:
+    if not key:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in key)
+    return f"{name}{{{inner}}}"
+
+
+class _Metric:
+    kind = "metric"
+
+    def __init__(self, name, doc=""):
+        self.name = name
+        self.doc = doc
+        self._lock = threading.Lock()
+        self._series: dict[tuple, object] = {}
+
+    def series(self) -> dict:
+        with self._lock:
+            return dict(self._series)
+
+
+class Counter(_Metric):
+    """Monotonic counter with optional labels:
+    ``counter("serving.requests_total").inc(status="ok")``."""
+
+    kind = "counter"
+
+    def inc(self, n=1, **labels):
+        key = _label_key(labels)
+        with self._lock:
+            v = self._series.get(key, 0) + n
+            self._series[key] = v
+            return v
+
+    def value(self, **labels):
+        with self._lock:
+            return self._series.get(_label_key(labels), 0)
+
+
+class Gauge(_Metric):
+    """Last-write-wins instantaneous value."""
+
+    kind = "gauge"
+
+    def set(self, v, **labels):
+        with self._lock:
+            self._series[_label_key(labels)] = v
+
+    def inc(self, n=1, **labels):
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0) + n
+
+    def dec(self, n=1, **labels):
+        self.inc(-n, **labels)
+
+    def value(self, **labels):
+        with self._lock:
+            return self._series.get(_label_key(labels), 0)
+
+
+class _HistSeries:
+    __slots__ = ("count", "sum", "buckets", "sample", "pcache")
+
+    def __init__(self, n_buckets):
+        self.count = 0
+        self.sum = 0.0
+        self.buckets = [0] * (n_buckets + 1)   # +inf bucket last
+        self.sample = collections.deque(maxlen=_RESERVOIR)
+        # (count, qs) -> percentile dict: health endpoints poll
+        # summaries far more often than observations arrive (idle pump
+        # loops, per-dispatch scoring), and the reservoir sort only
+        # changes when count does
+        self.pcache = None
+
+
+class Histogram(_Metric):
+    """Bucketed distribution + a bounded reservoir of recent raw samples
+    (exact recent-window percentiles for health endpoints; the buckets
+    are the mergeable/exportable long-horizon view)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, doc="", buckets=None):
+        super().__init__(name, doc)
+        self.bounds = tuple(sorted(buckets)) if buckets else _DEF_BUCKETS
+
+    def observe(self, v, **labels):
+        v = float(v)
+        key = _label_key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _HistSeries(len(self.bounds))
+            s.count += 1
+            s.sum += v
+            i = 0
+            for b in self.bounds:
+                if v <= b:
+                    break
+                i += 1
+            s.buckets[i] += 1
+            s.sample.append(v)
+
+    def snapshot_series(self, max_samples=None) -> dict:
+        """Serialize every series UNDER the metric lock — the reservoir
+        deque mutates concurrently with publishers (a replica heartbeat
+        thread snapshotting while the pump observes), and an unlocked
+        ``list(deque)`` can raise mid-mutation, silently dropping the
+        publish (or a flight dump) exactly when it matters."""
+        with self._lock:
+            return {
+                key: {"count": s.count, "sum": s.sum,
+                      "bounds": list(self.bounds),
+                      "buckets": list(s.buckets),
+                      "sample": (list(s.sample)[-max_samples:]
+                                 if max_samples else list(s.sample))}
+                for key, s in self._series.items()}
+
+    def percentiles(self, qs=(50, 95, 99), **labels):
+        """Percentiles over the recent-sample reservoir (exact), falling
+        back to bucket interpolation when the reservoir is empty (e.g. a
+        series reconstructed from a merged snapshot)."""
+        qs = tuple(qs)
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            if s is None or s.count == 0:
+                return {f"p{q}": 0.0 for q in qs}
+            if s.pcache is not None and s.pcache[0] == (s.count, qs):
+                return dict(s.pcache[1])
+            sample = sorted(s.sample)
+            if sample:
+                out = {f"p{q}": sample[
+                    min(int(len(sample) * q / 100.0), len(sample) - 1)]
+                    for q in qs}
+            else:
+                out = {f"p{q}": _bucket_quantile(self.bounds, s.buckets,
+                                                 s.count, q / 100.0)
+                       for q in qs}
+            s.pcache = ((s.count, qs), dict(out))
+            return out
+
+    def summary(self, qs=(50, 95, 99), **labels):
+        out = self.percentiles(qs, **labels)
+        with self._lock:
+            s = self._series.get(_label_key(labels))
+            out["count"] = s.count if s else 0
+            out["mean"] = (s.sum / s.count) if s and s.count else 0.0
+        return out
+
+
+def _bucket_quantile(bounds, buckets, count, q):
+    target = q * count
+    acc = 0
+    lo = 0.0
+    for i, b in enumerate(bounds):
+        nxt = acc + buckets[i]
+        if nxt >= target:
+            # linear interpolation inside the bucket
+            frac = (target - acc) / buckets[i] if buckets[i] else 0.0
+            return lo + frac * (b - lo)
+        acc = nxt
+        lo = b
+    return bounds[-1] if bounds else 0.0
+
+
+class MetricsRegistry:
+    """Get-or-create home for the process's metrics. One global default
+    (``registry()``); construct private ones in tests."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get(self, name, cls, doc, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, doc, **kw)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name, doc="") -> Counter:
+        return self._get(name, Counter, doc)
+
+    def gauge(self, name, doc="") -> Gauge:
+        return self._get(name, Gauge, doc)
+
+    def histogram(self, name, doc="", buckets=None) -> Histogram:
+        return self._get(name, Histogram, doc, buckets=buckets)
+
+    def metrics(self) -> dict:
+        with self._lock:
+            return dict(self._metrics)
+
+    def reset(self):
+        """Zero every metric's series IN PLACE: handles cached by hot
+        paths (``telemetry.counter(...)`` held in a local) stay
+        registered and valid — dropping the objects instead would leave
+        cached handles accumulating invisibly outside the registry."""
+        for m in self.metrics().values():
+            with m._lock:
+                m._series.clear()
+
+    # ------------------------------------------------------- exposition
+
+    def snapshot(self) -> dict:
+        """Plain-JSON view: ``{"ts": wall, "counters": {series: v},
+        "gauges": {series: v}, "histograms": {series: {count, sum,
+        bounds, buckets, sample}}}``. Series names flatten labels as
+        ``name{k=v,...}``. This is the wire format replicas publish to
+        the gang store and ``merge_snapshots`` folds."""
+        out = {"ts": time.time(),  # wall-clock: x-process snapshot age
+               "counters": {}, "gauges": {}, "histograms": {}}
+        for name, m in self.metrics().items():
+            if m.kind == "counter":
+                for key, v in m.series().items():
+                    out["counters"][_series_name(name, key)] = v
+            elif m.kind == "gauge":
+                for key, v in m.series().items():
+                    out["gauges"][_series_name(name, key)] = v
+            else:
+                rows = m.snapshot_series(max_samples=_SNAPSHOT_SAMPLES)
+                for key, row in rows.items():
+                    out["histograms"][_series_name(name, key)] = row
+        return out
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (metric names sanitized to the
+        ``[a-zA-Z_:][a-zA-Z0-9_:]*`` charset; label sets preserved)."""
+        lines = []
+        for name, m in sorted(self.metrics().items()):
+            pname = _prom_name(name)
+            if m.doc:
+                lines.append(f"# HELP {pname} {m.doc}")
+            lines.append(f"# TYPE {pname} {m.kind}")
+            if m.kind in ("counter", "gauge"):
+                for key, v in sorted(m.series().items()):
+                    lines.append(f"{pname}{_prom_labels(key)} {_num(v)}")
+            else:
+                for key, row in sorted(m.snapshot_series().items()):
+                    acc = 0
+                    for b, c in zip(m.bounds, row["buckets"]):
+                        acc += c
+                        lines.append(
+                            f"{pname}_bucket"
+                            f"{_prom_labels(key, le=repr(float(b)))} {acc}")
+                    lines.append(
+                        f"{pname}_bucket"
+                        f"{_prom_labels(key, le='+Inf')} {row['count']}")
+                    lines.append(
+                        f"{pname}_sum{_prom_labels(key)} "
+                        f"{_num(row['sum'])}")
+                    lines.append(
+                        f"{pname}_count{_prom_labels(key)} {row['count']}")
+        return "\n".join(lines) + "\n"
+
+
+def _prom_name(name: str) -> str:
+    return "".join(c if (c.isalnum() or c in "_:") else "_" for c in name)
+
+
+def _prom_labels(key: tuple, **extra) -> str:
+    items = [f'{k}="{v}"' for k, v in key] + [
+        f'{k}="{v}"' for k, v in extra.items()]
+    return "{" + ",".join(items) + "}" if items else ""
+
+
+def _num(v):
+    return int(v) if isinstance(v, float) and v.is_integer() else v
+
+
+def merge_snapshots(*snapshots) -> dict:
+    """Fold N ``MetricsRegistry.snapshot()`` dicts into one fleet view:
+    counters and histogram counts/sums/buckets SUM, gauges keep the
+    freshest snapshot's value, reservoir samples concatenate (bounded).
+    The router's ``fleet_metrics()`` runs this over its own snapshot +
+    every replica's store-published one."""
+    out = {"ts": 0.0, "counters": {}, "gauges": {}, "histograms": {}}
+    for snap in snapshots:
+        if not snap:
+            continue
+        ts = float(snap.get("ts", 0.0))
+        for k, v in snap.get("counters", {}).items():
+            out["counters"][k] = out["counters"].get(k, 0) + v
+        for k, v in snap.get("gauges", {}).items():
+            prev = out["gauges"].get(k)
+            if prev is None or ts >= out["ts"]:
+                out["gauges"][k] = v
+        for k, h in snap.get("histograms", {}).items():
+            cur = out["histograms"].get(k)
+            if cur is None:
+                out["histograms"][k] = {
+                    "count": h["count"], "sum": h["sum"],
+                    "bounds": list(h["bounds"]),
+                    "buckets": list(h["buckets"]),
+                    "sample": list(h.get("sample", ()))[-_RESERVOIR:],
+                }
+            else:
+                cur["count"] += h["count"]
+                cur["sum"] += h["sum"]
+                if (cur["buckets"] is not None
+                        and list(cur["bounds"]) == list(h["bounds"])):
+                    cur["buckets"] = [a + b for a, b in
+                                      zip(cur["buckets"], h["buckets"])]
+                elif cur["buckets"] is not None:
+                    # mixed bucket layouts (custom buckets= in one
+                    # process, mixed code versions in a rolling fleet):
+                    # summing incompatible buckets under summed counts
+                    # would yield silently-wrong interpolated
+                    # percentiles — invalidate the buckets (the merged
+                    # reservoir still answers percentiles) and count it
+                    cur["buckets"] = None
+                    counter("telemetry.merge_bounds_mismatch").inc()
+                cur["sample"] = (cur["sample"]
+                                 + list(h.get("sample", ())))[-_RESERVOIR:]
+        out["ts"] = max(out["ts"], ts)
+    return out
+
+
+def summary_from_snapshot(snapshot, name, qs=(50, 95, 99)) -> dict:
+    """Percentile summary for one histogram series out of a (possibly
+    merged) snapshot — reservoir when present, bucket interpolation
+    otherwise. Returns zeros for an unknown/empty series."""
+    h = (snapshot or {}).get("histograms", {}).get(name)
+    if not h or not h.get("count"):
+        return {f"p{q}": 0.0 for q in qs} | {"count": 0, "mean": 0.0}
+    sample = sorted(h.get("sample", ()))
+    if sample:
+        out = {f"p{q}": sample[min(int(len(sample) * q / 100.0),
+                                   len(sample) - 1)] for q in qs}
+    elif h.get("buckets"):  # None after a bounds-mismatched merge
+        out = {f"p{q}": _bucket_quantile(tuple(h["bounds"]), h["buckets"],
+                                         h["count"], q / 100.0)
+               for q in qs}
+    else:
+        out = {f"p{q}": 0.0 for q in qs}
+    out["count"] = h["count"]
+    out["mean"] = h["sum"] / h["count"]
+    return out
+
+
+_registry = MetricsRegistry()
+
+
+def registry() -> MetricsRegistry:
+    return _registry
+
+
+def counter(name, doc="") -> Counter:
+    return _registry.counter(name, doc)
+
+
+def gauge(name, doc="") -> Gauge:
+    return _registry.gauge(name, doc)
+
+
+def histogram(name, doc="", buckets=None) -> Histogram:
+    return _registry.histogram(name, doc, buckets=buckets)
+
+
+# ============================================================== tracing
+
+_trace_counter = [0]
+_trace_lock = threading.Lock()
+
+
+def new_trace_id() -> str:
+    """Process-unique trace id: pid-tagged so ids minted by different
+    fleet processes can never collide in a stitched timeline."""
+    with _trace_lock:
+        _trace_counter[0] += 1
+        n = _trace_counter[0]
+    return f"{os.getpid():x}-{int(time.time() * 1e3) & 0xFFFFFFFF:08x}-{n:x}"  # wall-clock: x-process id salt
+
+
+class _SpanHandle:
+    """Context manager for one in-flight span; records into the sink on
+    exit. ``event(name)`` adds an instant event under the same trace."""
+
+    __slots__ = ("_tracer", "name", "trace", "rid", "args", "_t0w", "_t0m")
+
+    def __init__(self, tr, name, trace, rid, args):
+        self._tracer = tr
+        self.name = name
+        self.trace = trace
+        self.rid = rid
+        self.args = args
+
+    def __enter__(self):
+        self._t0w = time.time()  # wall-clock: x-process trace epoch
+        self._t0m = time.monotonic()
+        return self
+
+    def event(self, name, **args):
+        self._tracer.event(name, trace=self.trace, rid=self.rid, **args)
+
+    def __exit__(self, *exc):
+        dur = time.monotonic() - self._t0m
+        self._tracer.add_span(self.name, self._t0w, dur,
+                              trace=self.trace, rid=self.rid, **self.args)
+        return False
+
+
+class Tracer:
+    """Bounded process-local span sink. Completed spans are stored
+    directly as Chrome-trace events (``ph:"X"`` slices, ``ph:"i"``
+    instants) stamped with this process's pid and wall-clock
+    microseconds, so export is a dump and cross-process stitching is a
+    concatenation."""
+
+    def __init__(self, capacity=None):
+        # capacity=None follows FLAGS_trace_buffer at APPEND time (the
+        # global sink is built at import, before an operator's
+        # set_flags can run — a pinned-at-import capacity would make
+        # the flag silently inert); an explicit capacity pins it.
+        self._capacity = capacity
+        cap = int(capacity if capacity is not None
+                  else flag("FLAGS_trace_buffer"))
+        self._events = collections.deque(maxlen=max(cap, 16))
+        self._lock = threading.Lock()
+
+    def _resize(self):
+        """Caller holds the lock. Re-reads the capacity flag and
+        rebuilds the ring when the operator changed it."""
+        if self._capacity is not None:
+            return
+        cap = max(int(flag("FLAGS_trace_buffer")), 16)
+        if cap != self._events.maxlen:
+            self._events = collections.deque(self._events, maxlen=cap)
+
+    def span(self, name, trace=None, rid=None, **args) -> _SpanHandle:
+        return _SpanHandle(self, name, trace, rid, args)
+
+    def add_span(self, name, start_wall_s, dur_s, trace=None, rid=None,
+                 **args):
+        """Record a completed span retroactively (queue-wait spans are
+        only known at admission time)."""
+        a = dict(args)
+        if trace is not None:
+            a["trace"] = trace
+        if rid is not None:
+            a["rid"] = rid
+        ev = {"name": name, "ph": "X", "pid": os.getpid(),
+              "tid": threading.get_ident() & 0xFFFF,
+              "ts": start_wall_s * 1e6, "dur": max(dur_s, 0.0) * 1e6,
+              "args": a}
+        with self._lock:
+            self._resize()
+            self._events.append(ev)
+
+    def event(self, name, trace=None, rid=None, **args):
+        a = dict(args)
+        if trace is not None:
+            a["trace"] = trace
+        if rid is not None:
+            a["rid"] = rid
+        ev = {"name": name, "ph": "i", "s": "p", "pid": os.getpid(),
+              "tid": threading.get_ident() & 0xFFFF,
+              "ts": time.time() * 1e6, "args": a}  # wall-clock: x-process trace epoch
+        with self._lock:
+            self._resize()
+            self._events.append(ev)
+
+    def spans(self, name=None, trace=None) -> list:
+        """Recorded events, optionally filtered by span name and/or the
+        trace id carried in ``args`` (including rid-batched spans whose
+        ``args['traces']`` LIST contains it)."""
+        with self._lock:
+            evs = list(self._events)
+        if name is not None:
+            evs = [e for e in evs if e["name"] == name]
+        if trace is not None:
+            evs = [e for e in evs
+                   if e.get("args", {}).get("trace") == trace
+                   or trace in (e.get("args", {}).get("traces") or ())]
+        return evs
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def export_chrome_trace(self, path, extra_events=()) -> str:
+        """Write the sink as chrome://tracing / Perfetto JSON."""
+        evs = self.spans() + list(extra_events)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": evs,
+                       "displayTimeUnit": "ms"}, f)
+        return path
+
+
+_tracer = Tracer()
+
+
+def tracer() -> Tracer:
+    return _tracer
+
+
+def span(name, trace=None, rid=None, **args) -> _SpanHandle:
+    return _tracer.span(name, trace=trace, rid=rid, **args)
+
+
+def trace_event(name, trace=None, rid=None, **args):
+    _tracer.event(name, trace=trace, rid=rid, **args)
+
+
+def export_chrome_trace(path, extra_events=()) -> str:
+    return _tracer.export_chrome_trace(path, extra_events=extra_events)
+
+
+def stitch_chrome_traces(paths, out_path) -> str:
+    """Merge per-process Chrome-trace dumps (router + replicas) into one
+    timeline file. Events already carry distinct pids and share the
+    wall-clock epoch, so stitching is concatenation + a time sort;
+    unreadable inputs are skipped (a SIGKILLed replica never wrote
+    one)."""
+    events = []
+    for p in paths:
+        try:
+            with open(p) as f:
+                events.extend(json.load(f).get("traceEvents", []))
+        except (OSError, ValueError):
+            continue
+    events.sort(key=lambda e: e.get("ts", 0))
+    with open(out_path, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
+    return out_path
+
+
+# ====================================================== flight recorder
+
+class FlightRecorder:
+    """Bounded ring of recent telemetry events + post-mortem dumps.
+
+    ``record(kind, **payload)`` is always-on and cheap (one deque append
+    under a lock). ``dump(reason)`` writes events + a metrics snapshot +
+    the tail of the span sink to a JSON file and returns its path —
+    called automatically on breaker trips (``core.resilience``), poison
+    retirements (``models/serving``), stale-leader stand-downs
+    (``models/router``) and replica SIGTERM (``models/remote``), capped
+    at ``FLAGS_flight_max_dumps`` per process."""
+
+    def __init__(self, capacity=None):
+        # capacity=None follows FLAGS_flight_events at append time
+        # (mirror of Tracer: the global ring exists before set_flags
+        # can run); an explicit capacity pins it
+        self._capacity = capacity
+        cap = int(capacity if capacity is not None
+                  else flag("FLAGS_flight_events"))
+        self._events = collections.deque(maxlen=max(cap, 16))
+        self._lock = threading.Lock()
+        self._dumps = 0
+
+    def record(self, kind, **payload):
+        ev = {"ts": time.time(), "kind": str(kind), **payload}  # wall-clock: x-process post-mortems
+        with self._lock:
+            if self._capacity is None:
+                cap = max(int(flag("FLAGS_flight_events")), 16)
+                if cap != self._events.maxlen:
+                    self._events = collections.deque(self._events,
+                                                     maxlen=cap)
+            self._events.append(ev)
+
+    def events(self, kind=None) -> list:
+        with self._lock:
+            evs = list(self._events)
+        if kind is not None:
+            evs = [e for e in evs if e["kind"] == kind]
+        return evs
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+            self._dumps = 0
+
+    @staticmethod
+    def dump_dir() -> str:
+        d = flag("FLAGS_flight_dir") or os.environ.get("PADDLE_FLIGHT_DIR")
+        if not d:
+            import tempfile
+
+            d = os.path.join(tempfile.gettempdir(), "paddle_tpu_flight")
+        return d
+
+    def dump(self, reason, path=None, force=False):
+        """Write the post-mortem file; returns its path, or None when the
+        per-process auto-dump cap was reached (``force=True`` — an
+        operator asking explicitly — bypasses the cap). Never raises:
+        a full disk must not mask the failure being recorded."""
+        with self._lock:
+            if not force and self._dumps >= int(
+                    flag("FLAGS_flight_max_dumps")):
+                counter("telemetry.flight_dump_skipped").inc()
+                return None
+            self._dumps += 1
+            seq = self._dumps
+            evs = list(self._events)
+        try:
+            if path is None:
+                d = self.dump_dir()
+                os.makedirs(d, exist_ok=True)
+                safe = "".join(c if (c.isalnum() or c in "._-") else "_"
+                               for c in str(reason))[:80]
+                path = os.path.join(
+                    d, f"flight-{os.getpid()}-{seq:03d}-{safe}.json")
+            payload = {
+                "reason": str(reason),
+                "pid": os.getpid(),
+                "ts": time.time(),  # wall-clock: x-process post-mortems
+                "events": evs,
+                "metrics": _registry.snapshot(),
+                "spans": _tracer.spans()[-256:],
+            }
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+            counter("telemetry.flight_dump").inc()
+            return path
+        except Exception:  # noqa: BLE001 — the dump is best-effort
+            # forensics; failing it must not mask the original failure
+            counter("telemetry.flight_dump_error").inc()
+            return None
+
+
+_flight = FlightRecorder()
+
+
+def flight_recorder() -> FlightRecorder:
+    return _flight
+
+
+def flight_dump(reason, **event):
+    """Record one event and dump the recorder — the one-liner the
+    trigger sites call."""
+    if event:
+        _flight.record(reason, **event)
+    return _flight.dump(reason)
+
+
+def reset_telemetry():
+    """Test teardown: clear the registry, the span sink, and the flight
+    ring (re-arms the per-process dump cap)."""
+    _registry.reset()
+    _tracer.clear()
+    _flight.clear()
+
+
+class _NoopSpan:
+    """Stands in for a span when telemetry is off: same surface, no
+    recording, shared instance (no per-call allocation on hot paths)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def event(self, name, **args):
+        pass
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+def maybe_span(name, trace=None, rid=None, **args):
+    """``span(...)`` when telemetry is enabled, else the shared no-op —
+    the form hot paths use so a disabled registry costs one flag read."""
+    if not enabled():
+        return NOOP_SPAN
+    return _tracer.span(name, trace=trace, rid=rid, **args)

@@ -442,6 +442,11 @@ class LeaderLease:
                                         name=f"lease-{self.owner}")
         self._thread.start()
         bump_counter("gang.lease_acquired")
+        # leadership transitions are the first thing a post-mortem wants
+        from ..core import telemetry
+
+        telemetry.flight_recorder().record("lease_acquired",
+                                           owner=self.owner, fence=fence)
         logger.info("leader lease %r acquired by %r (fence %d)",
                     self.key, self.owner, fence)
         return True
@@ -489,6 +494,12 @@ class LeaderLease:
                     # a HIGHER fence took the lease: deposed — never
                     # overwrite the new holder's record
                     bump_counter("gang.lease_superseded")
+                    from ..core import telemetry
+
+                    telemetry.flight_recorder().record(
+                        "lease_superseded", owner=self.owner,
+                        fence=self.fence, new_owner=rec["owner"],
+                        new_fence=rec["fence"])
                     logger.warning(
                         "leader lease %r superseded (now %r); %r standing "
                         "down", self.key, rec["owner"], self.owner)

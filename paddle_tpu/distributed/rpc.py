@@ -62,6 +62,7 @@ from concurrent.futures import ThreadPoolExecutor
 import numpy as np
 
 from ..core import resilience as _res
+from ..core import telemetry
 from ..core.resilience import (
     CommTimeoutError,
     Deadline,
@@ -92,6 +93,13 @@ DEFAULT_RESEND_AFTER_S = 1.0
 class RpcRemoteError(RuntimeError):
     """A remote call raised an exception type the caller cannot (or must
     not) reconstruct; the remote type/message travel in the text."""
+
+
+# caller-observed round trip (post → reply consumed), labeled by callee:
+# the wire half of the fleet's transport-overhead picture, merged into
+# fleet_metrics() like every other registry series
+_M_RTT = telemetry.histogram(
+    "rpc.roundtrip_s", "rpc_sync/rpc_async round-trip, post -> reply")
 
 
 class WorkerInfo:
@@ -539,6 +547,7 @@ class _Future:
         self._done = False
         self._result = None
         self._error = None
+        self._t0 = time.monotonic()  # rpc.roundtrip_s anchor
 
     def done(self) -> bool:
         return (self._done
@@ -645,6 +654,8 @@ class _Future:
         if attempt > 1:
             store.delete_key(f"rpc/claimed/{self._id}")
         self._done = True
+        if telemetry.enabled():
+            _M_RTT.observe(time.monotonic() - self._t0, to=self._to)
         if not payload["ok"]:
             try:
                 _raise_remote(payload["error"], self._to)

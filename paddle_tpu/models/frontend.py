@@ -41,18 +41,53 @@ from __future__ import annotations
 
 import bisect
 import itertools
+import time
 
 import numpy as np
 
+from ..core import telemetry
 from ..core.resilience import CircuitBreaker, Deadline, bump_counter
 from .serving import TERMINAL_STATES as _ENGINE_TERMINAL
 
-__all__ = ["ServingFrontend", "RequestResult", "TERMINAL_STATES"]
+__all__ = ["ServingFrontend", "RequestResult", "TERMINAL_STATES",
+           "latency_summaries"]
 
 # Every terminal status a frontend result can carry: the engine's set
 # plus the admission-level verdicts minted here. The fleet router's
 # retirement switch is CI-gated against this set.
 TERMINAL_STATES = frozenset(_ENGINE_TERMINAL | {"rejected", "unavailable"})
+
+# admission-layer metrics (module-level handles — see serving.py note).
+# serving.requests_total is shared with the engine: the engine stamps
+# the terminal states of requests it admitted; the frontend stamps the
+# verdicts the engine never saw (admission rejected/unavailable, queue
+# expiry timed_out, queue cancels) — so the one labeled counter covers
+# the whole status space.
+_M_QWAIT = telemetry.histogram(
+    "serving.queue_wait_s", "frontend admission-queue wait, submit -> "
+    "engine admission")
+_M_REQS = telemetry.counter("serving.requests_total")
+
+# the latency histograms every health/stats summary reads, keyed by the
+# short name the payloads use
+_LATENCY_HISTS = {"ttft_s": "serving.ttft_s",
+                  "token_s": "serving.token_latency_s",
+                  "queue_wait_s": "serving.queue_wait_s"}
+
+
+def latency_summaries(snapshot=None) -> dict:
+    """p50/p95/p99 + count/mean (seconds) for the serving latency
+    histograms — from the process registry by default, or from a
+    (possibly fleet-merged) ``MetricsRegistry.snapshot()`` dict. Shared
+    by ``ServingFrontend.health()``, ``ServingRouter.stats()`` and
+    ``ServingRouter.fleet_metrics()``."""
+    out = {}
+    for key, name in _LATENCY_HISTS.items():
+        if snapshot is not None:
+            out[key] = telemetry.summary_from_snapshot(snapshot, name)
+        else:
+            out[key] = telemetry.histogram(name).summary()
+    return out
 
 
 class RequestResult:
@@ -83,10 +118,10 @@ class _Pending:
     """A queued admission, ordered by (priority DESC, arrival ASC)."""
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "priority", "deadline",
-                 "cost", "seq", "token_base")
+                 "cost", "seq", "token_base", "trace", "t0m", "t0w")
 
     def __init__(self, rid, prompt, max_new_tokens, priority, deadline,
-                 seq, token_base=0):
+                 seq, token_base=0, trace=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -96,6 +131,9 @@ class _Pending:
         self.cost = prompt.size + max_new_tokens
         self.seq = seq
         self.token_base = token_base
+        self.trace = trace              # telemetry trace id
+        self.t0m = time.monotonic()     # queue-wait anchor
+        self.t0w = time.time()  # wall-clock: x-process trace epoch
 
     def __lt__(self, other):
         return (-self.priority, self.seq) < (-other.priority, other.seq)
@@ -171,6 +209,8 @@ class ServingFrontend:
 
     def _reject(self, rid, reason):
         bump_counter("serving.rejected")
+        if telemetry.enabled():
+            _M_REQS.inc(status="rejected")  # engine never saw it
         self.engine.note_rejection()  # stats()['rejected'] sees shedding
         return self._finish(rid, "rejected", reason=reason)
 
@@ -186,7 +226,8 @@ class ServingFrontend:
         return sum(e.cost for e in self._queue)
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
-               deadline_s=None, rid=None, token_base=0) -> int:
+               deadline_s=None, rid=None, token_base=0,
+               trace=None) -> int:
         """Admit one request; returns its rid. Never raises for a bad or
         shed request — the verdict lands in ``results()`` as status
         ``rejected`` (admission control / malformed), ``unavailable``
@@ -197,7 +238,12 @@ class ServingFrontend:
         failover replay must reuse it) name the request; a rid already
         pending here raises ``ValueError``. ``token_base`` is the
         engine's failover-resume contract (see
-        ``ContinuousBatchingEngine.submit``)."""
+        ``ContinuousBatchingEngine.submit``). ``trace`` is the telemetry
+        trace id the request's spans stitch under — a standalone
+        frontend MINTS one here; a fleet router passes its own (minted
+        at ``ServingRouter.submit``, riding the RPC envelope)."""
+        if trace is None and telemetry.enabled():
+            trace = telemetry.new_trace_id()
         if rid is None:
             rid = next(self._rids)
         else:
@@ -228,6 +274,8 @@ class ServingFrontend:
             # while open, allow() is False and we fail fast
             if not self.breaker.allow():
                 bump_counter("serving.unavailable")
+                if telemetry.enabled():
+                    _M_REQS.inc(status="unavailable")
                 return self._finish(
                     rid, "unavailable",
                     reason=f"circuit breaker {self.breaker.state()}")
@@ -235,7 +283,11 @@ class ServingFrontend:
         entry = _Pending(rid, prompt, max_new, int(priority),
                          (deadline_s if isinstance(deadline_s, Deadline)
                           else Deadline(deadline_s)), next(self._seq),
-                         token_base=int(token_base))
+                         token_base=int(token_base), trace=trace)
+        if telemetry.enabled():
+            telemetry.trace_event("serving.submit", trace=trace, rid=rid,
+                                  prompt_tokens=int(prompt.size),
+                                  max_new=max_new, priority=int(priority))
         self._sweep_expired()  # dead entries must not shed live traffic
         # bounded admission: shed the lowest-priority queued request
         # (LAST in sorted order) while budgets are exceeded — but only
@@ -306,6 +358,8 @@ class ServingFrontend:
         live = []
         for entry in self._queue:
             if entry.deadline.expired():
+                if telemetry.enabled():
+                    _M_REQS.inc(status="timed_out")  # engine never saw it
                 self._finish(entry.rid, "timed_out",
                              reason="expired while queued",
                              token_base=entry.token_base)
@@ -322,7 +376,17 @@ class ServingFrontend:
             req = self.engine.submit(entry.prompt, entry.max_new_tokens,
                                      deadline_s=entry.deadline,
                                      rid=entry.rid,
-                                     token_base=entry.token_base)
+                                     token_base=entry.token_base,
+                                     trace=entry.trace)
+            # TTFT anchors at frontend SUBMIT time, not engine admission
+            # — queue wait is part of the latency a client sees
+            req.t_submit = entry.t0m
+            if telemetry.enabled():
+                wait = time.monotonic() - entry.t0m
+                _M_QWAIT.observe(wait)
+                telemetry.tracer().add_span(
+                    "serving.queue_wait", entry.t0w, wait,
+                    trace=entry.trace, rid=entry.rid)
             self._inflight[entry.rid] = req
             room -= 1
         if self.engine.has_work():
@@ -400,6 +464,8 @@ class ServingFrontend:
         for entry in self._queue:
             if entry.rid == rid:
                 self._queue.remove(entry)
+                if telemetry.enabled():
+                    _M_REQS.inc(status="cancelled")  # engine never saw it
                 self._cancel_bookkeeping(rid, reason="cancelled in queue",
                                          token_base=entry.token_base)
                 return True
@@ -422,6 +488,8 @@ class ServingFrontend:
             return
         self._draining = True
         for entry in self._queue:
+            if telemetry.enabled():
+                _M_REQS.inc(status="cancelled")  # engine never saw it
             self._cancel_bookkeeping(entry.rid,
                                      reason="shutdown before admission",
                                      token_base=entry.token_base)
@@ -476,7 +544,13 @@ class ServingFrontend:
           queued_tokens]}``), and ``inflight`` (admitted to the engine,
           not yet terminal);
         * KV-slot occupancy: ``active_slots`` / ``free_slots`` /
-          ``kv_slots`` (total) / ``kv_occupancy`` (active/total).
+          ``kv_slots`` (total) / ``kv_occupancy`` (active/total);
+        * ``latency``: recent-window percentile summaries (p50/p95/p99 +
+          count/mean, seconds) for TTFT, per-token decode latency, and
+          admission-queue wait — sourced from the telemetry registry
+          histograms (``serving.ttft_s`` / ``serving.token_latency_s`` /
+          ``serving.queue_wait_s``), which are PROCESS-scoped: in a
+          one-replica-per-process fleet this is the replica's view.
         """
         breaker_state = self.breaker.state()
         if self._closed:
@@ -510,4 +584,5 @@ class ServingFrontend:
             "free_slots": self.engine.free_slots(),
             "kv_slots": total,
             "kv_occupancy": (active / total) if total else 0.0,
+            "latency": latency_summaries(),
         }

@@ -36,6 +36,7 @@ survivor token-identical to the uninterrupted run.
 from __future__ import annotations
 
 import contextlib
+import json
 import os
 import threading
 import time
@@ -43,6 +44,7 @@ import uuid
 
 import numpy as np
 
+from ..core import telemetry
 from ..core.resilience import (
     Deadline,
     ServingUnavailable,
@@ -53,11 +55,16 @@ from ..core.resilience import (
 from .frontend import RequestResult
 
 __all__ = ["ReplicaServer", "RemoteFrontend", "replica_main",
-           "RPC_MASTER_ENV"]
+           "RPC_MASTER_ENV", "TRACE_DIR_ENV"]
 
 # env var carrying the RPC master endpoint into replica processes
 # (launch_fleet passes it through ``env=``)
 RPC_MASTER_ENV = "PADDLE_RPC_MASTER"
+# when set, a replica process exports its telemetry span sink as a
+# Chrome-trace JSON here on clean exit — the per-process half a
+# multi-process drill stitches (telemetry.stitch_chrome_traces) into one
+# cross-process request timeline
+TRACE_DIR_ENV = "PADDLE_TRACE_DIR"
 
 _SERVERS: dict[str, "ReplicaServer"] = {}
 _servers_lock = threading.Lock()
@@ -234,10 +241,12 @@ class ReplicaServer:
                 for rid, (base, toks) in prog.items()]
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
-               deadline_s=None, rid=None, token_base=0):
+               deadline_s=None, rid=None, token_base=0, trace=None):
         """Rid-idempotent admission: a rid still LIVE here (pending or
         finished-but-unfetched) is a duplicate of a retried/redelivered
-        send — acknowledge it without double-enqueueing."""
+        send — acknowledge it without double-enqueueing. ``trace`` is
+        the router-minted telemetry trace id off the RPC envelope; the
+        frontend's spans in THIS process stitch under it."""
         with self._lock:
             if rid is not None and rid in self._live:
                 bump_counter("serving.dup_submit")
@@ -245,7 +254,8 @@ class ReplicaServer:
             got = self.frontend.submit(
                 np.asarray(prompt, np.int32),
                 max_new_tokens=max_new_tokens, priority=priority,
-                deadline_s=deadline_s, rid=rid, token_base=token_base)
+                deadline_s=deadline_s, rid=rid, token_base=token_base,
+                trace=trace)
             self._live.add(got)
             return got
 
@@ -434,17 +444,19 @@ class RemoteFrontend:
     # ------------------------------------------- ServingFrontend surface
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
-               deadline_s=None, rid=None, token_base=0):
+               deadline_s=None, rid=None, token_base=0, trace=None):
         # a Deadline is monotonic and process-local: ship the REMAINING
         # seconds; the replica re-anchors it on its own clock (queue wait
-        # there still counts against the budget)
+        # there still counts against the budget). The telemetry trace id
+        # rides the same envelope — the replica's spans stitch under it.
         if isinstance(deadline_s, Deadline):
             rem = deadline_s.remaining()
             deadline_s = None if rem == float("inf") else max(rem, 0.0)
         return self._rpc("submit", np.asarray(prompt, np.int32),
                          max_new_tokens=max_new_tokens,
                          priority=int(priority), deadline_s=deadline_s,
-                         rid=rid, token_base=int(token_base))
+                         rid=rid, token_base=int(token_base),
+                         trace=trace)
 
     def results(self, wait=False, timeout=None) -> dict:
         """Pop terminal results. ``wait=True`` polls until the replica
@@ -602,8 +614,18 @@ def replica_main(build_frontend, rank=None, master_endpoint=None,
                                      prefix=f"{fleet_prefix}/hb")
 
     def _term(signum, frame):
-        threading.Thread(target=server.shutdown,
-                         kwargs={"drain": False}, daemon=True).start()
+        # SIGTERM is a post-mortem moment: dump the flight recorder
+        # BEFORE draining so the artifact reflects the serving state the
+        # signal interrupted. The dump runs on the daemon thread, NOT in
+        # the signal frame: the handler interrupts arbitrary bytecode —
+        # possibly _publish_metrics holding a (non-reentrant) registry
+        # lock — and a synchronous snapshot here could deadlock the
+        # whole shutdown
+        def _dump_and_stop():
+            telemetry.flight_dump("sigterm", worker=worker, rank=rank)
+            server.shutdown(drain=False)
+
+        threading.Thread(target=_dump_and_stop, daemon=True).start()
 
     with contextlib.suppress(ValueError):  # non-main thread (tests)
         signal.signal(signal.SIGTERM, _term)
@@ -612,9 +634,20 @@ def replica_main(build_frontend, rank=None, master_endpoint=None,
     # is gone for good: a replica that outlives its control plane must
     # exit (the supervisor owns respawn), not orphan itself heartbeating
     # into the void forever
+    def _publish_metrics():
+        # the replica's registry snapshot, published at the heartbeat
+        # cadence: the router's fleet_metrics() merges these into the
+        # one fleet-wide view (TTFT/queue-wait percentiles, tokens/s)
+        with contextlib.suppress(Exception):
+            hb_store.set(f"{fleet_prefix}/metrics/{rank}",
+                         json.dumps(
+                             telemetry.registry().snapshot()).encode())
+
+    _publish_metrics()
     rc = 0
     misses = 0
     while not server.stopped.wait(max(hb_interval * 2, 1.0)):
+        _publish_metrics()
         try:
             hb_store.check(f"{fleet_prefix}/pid/{rank}")
             misses = 0
@@ -628,11 +661,21 @@ def replica_main(build_frontend, rank=None, master_endpoint=None,
                 server.shutdown(drain=False)
                 rc = 1
                 break
+    _publish_metrics()  # final snapshot: a drained exit still reports
     hb.stop(hb_interval + 1)
     with contextlib.suppress(Exception):
         hb_store.delete_heartbeat(rank, prefix=f"{fleet_prefix}/hb")
     with contextlib.suppress(Exception):
         hb_store.close()
+    tdir = os.environ.get(TRACE_DIR_ENV)
+    if tdir:
+        # this process's half of the cross-process timeline; a SIGKILLed
+        # replica never reaches here, which is exactly the gap the
+        # survivors' failover spans explain
+        with contextlib.suppress(Exception):
+            os.makedirs(tdir, exist_ok=True)
+            telemetry.export_chrome_trace(os.path.join(
+                tdir, f"trace-{worker}-{os.getpid()}.json"))
     # let the dispatcher flush the shutdown call's reply before leaving
     time.sleep(0.2)
     rpc.shutdown()

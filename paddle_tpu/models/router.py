@@ -79,6 +79,7 @@ import time
 
 import numpy as np
 
+from ..core import telemetry
 from ..core.resilience import (
     CircuitBreaker,
     Deadline,
@@ -87,7 +88,7 @@ from ..core.resilience import (
     bump_counter,
     logger,
 )
-from .frontend import RequestResult
+from .frontend import RequestResult, latency_summaries
 
 __all__ = ["ServingRouter", "launch_fleet"]
 
@@ -123,7 +124,7 @@ class _FleetRequest:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "priority", "deadline",
                  "emitted", "live", "excluded", "failovers", "hedged",
-                 "discard", "deadline_s")
+                 "discard", "deadline_s", "trace")
 
     def __init__(self, rid, prompt, max_new_tokens, priority, deadline,
                  hedged, deadline_s=None):
@@ -133,6 +134,12 @@ class _FleetRequest:
         self.priority = int(priority)
         self.deadline = deadline
         self.deadline_s = deadline_s  # original budget (journal replay)
+        # telemetry trace id minted with the request (router-owned, like
+        # the rid): every attempt's spans — across replicas, processes
+        # and failover hops — stitch under it. Journal replays mint a
+        # fresh one (the trace is observability, not request state).
+        self.trace = (telemetry.new_trace_id() if telemetry.enabled()
+                      else None)
         self.emitted = np.zeros((0,), np.int32)  # tokens delivered by
         #                                          failed/drained attempts
         self.live: set = set()       # replica ids where rid is pending
@@ -226,6 +233,11 @@ class ServingRouter:
                              "calls": 0}
         self._counts: dict[str, int] = {}
         self._t0 = time.monotonic()
+        # fleet-metrics state: last merged snapshot (stats() latency
+        # summaries read it) and the previous (tokens_total, ts) pair
+        # the fleet tokens/s rate is computed over
+        self._last_fleet = None
+        self._fm_prev = None
         # ---- durability / hot standby (see module docstring)
         self._journal = journal
         self._journal_root = journal_root
@@ -425,6 +437,11 @@ class ServingRouter:
         if rep.state == "dead":
             return
         rep.state = "dead"
+        # the event rides the ring BEFORE the breaker trip dumps it, so
+        # the post-mortem file names the dead replica and why
+        telemetry.flight_recorder().record(
+            "replica_dead", replica=rep.id, reason=str(reason),
+            stranded=sorted(rep.assigned))
         rep.breaker.trip()
         bump_counter("fleet.replica_dead")
         logger.warning("replica %d marked dead (%s); failing over %d "
@@ -542,7 +559,7 @@ class ServingRouter:
             rep.frontend.submit(prompt, freq.max_new_tokens - k,
                                 priority=freq.priority,
                                 deadline_s=freq.deadline, rid=freq.rid,
-                                token_base=k)
+                                token_base=k, trace=freq.trace)
             self._pump_s += time.monotonic() - t0
         except StaleLeaderError as e:
             self._pump_s += time.monotonic() - t0
@@ -563,6 +580,12 @@ class ServingRouter:
         freq.live.add(rep_id)
         if probe:
             rep.probes.add(freq.rid)
+        if telemetry.enabled():
+            # the hop record a stitched timeline reads the request's
+            # replica placement (and failover path) off
+            telemetry.trace_event("fleet.dispatch", trace=freq.trace,
+                                  rid=freq.rid, replica=rep_id,
+                                  token_base=k)
         return True
 
     def _dispatch(self, freq):
@@ -577,6 +600,11 @@ class ServingRouter:
                 if rep_id not in freq.live and self._submit_to(freq,
                                                                rep_id):
                     bump_counter("fleet.hedged")
+                    if telemetry.enabled():
+                        telemetry.trace_event("fleet.hedge",
+                                              trace=freq.trace,
+                                              rid=freq.rid,
+                                              replica=rep_id)
                     break
         return sent
 
@@ -601,6 +629,12 @@ class ServingRouter:
                           f"failover budget exhausted ({reason})")
             return
         bump_counter("fleet.failover")
+        if telemetry.enabled():
+            telemetry.trace_event("fleet.failover", trace=freq.trace,
+                                  rid=freq.rid, reason=str(reason),
+                                  emitted=len(freq.emitted))
+        telemetry.flight_recorder().record("failover", rid=freq.rid,
+                                           reason=str(reason))
         if not self._dispatch(freq):
             if freq.rid not in self._parked:
                 self._parked.append(freq.rid)
@@ -790,6 +824,10 @@ class ServingRouter:
             "router standing down (%s); %d pending request(s) belong to "
             "the new leader via the journal", reason,
             len(self._requests))
+        # a deposed leader is a post-mortem moment (StaleLeaderError
+        # fencing rejection or a lapsed lease): leave the artifact
+        telemetry.flight_dump("stand_down", detail=str(reason),
+                              pending=len(self._requests))
         if self._llease is not None:
             self._llease.stand_down()
         if self._journal is not None:
@@ -1218,6 +1256,16 @@ class ServingRouter:
                     (rep, int(base)))
         state_n, adopted, resubmitted = self._restore_requests(live_map)
         bump_counter("fleet.takeover")
+        telemetry.flight_recorder().record(
+            "takeover", fence=fence, requests=state_n, adopted=adopted,
+            resubmitted=resubmitted)
+        if telemetry.enabled():
+            for freq in self._requests.values():
+                # hops across the LEADERSHIP boundary stitch too: the new
+                # leader's fresh trace ids are announced against the rids
+                telemetry.trace_event("fleet.takeover_adopt",
+                                      trace=freq.trace, rid=freq.rid,
+                                      fence=fence)
         logger.warning(
             "takeover complete (fence %d): %d journaled request(s) — "
             "%d running cop(ies) adopted, %d resubmitted", fence,
@@ -1353,6 +1401,71 @@ class ServingRouter:
             with contextlib.suppress(Exception):
                 self._llease.release()
 
+    def _member_metric_snapshots(self) -> list:
+        """Registry snapshots the replica PROCESSES published to the
+        gang store on their heartbeat cadence (``replica_main``), for
+        the current remote membership. In-process replicas share this
+        process's registry and need no store hop."""
+        snaps = []
+        if self._store is None:
+            return snaps
+        for rep in list(self._replicas.values()):
+            if rep.state == "dead":
+                continue
+            if not getattr(rep.frontend, "is_remote", False):
+                continue
+            key = f"{self._prefix}/metrics/{rep.id}"
+            try:
+                if self._store.check(key):
+                    snaps.append(
+                        json.loads(self._store.get_now(key).decode()))
+            except (ValueError, KeyError, RuntimeError, ConnectionError,
+                    TimeoutError):
+                bump_counter("fleet.metrics_unreadable")
+        return snaps
+
+    def fleet_metrics(self) -> dict:
+        """ONE fleet-wide observability view: this process's telemetry
+        registry merged with every replica process's store-published
+        snapshot (``telemetry.merge_snapshots``). Answers the operator
+        question in one call:
+
+        * ``latency`` — fleet-wide TTFT / per-token / queue-wait
+          p50/p95/p99 (merged histograms);
+        * ``tokens_total`` and ``tokens_per_sec`` (rate over the window
+          since the previous ``fleet_metrics()`` call);
+        * ``replicas`` — per-replica state + router-side breaker state;
+        * ``metrics`` — the full merged snapshot (counters incl. the
+          whole resilience ledger, gauges, histograms) for export.
+        """
+        merged = telemetry.merge_snapshots(
+            telemetry.registry().snapshot(),
+            *self._member_metric_snapshots())
+        tokens = merged["counters"].get("serving.tokens_total", 0)
+        now = time.monotonic()
+        rate = 0.0
+        if self._fm_prev is not None:
+            pt, pts = self._fm_prev
+            if now > pts and tokens >= pt:
+                rate = (tokens - pt) / (now - pts)
+        self._fm_prev = (tokens, now)
+        self._last_fleet = merged
+        return {
+            "metrics": merged,
+            "latency": latency_summaries(merged),
+            "tokens_total": tokens,
+            "tokens_per_sec": rate,
+            "replicas": {r.id: {"state": r.state,
+                                "breaker": r.breaker.state(),
+                                "breaker_failures": r.breaker.failures,
+                                "assigned": len(r.assigned),
+                                "served": r.served}
+                         for r in self._replicas.values()},
+            "pending": len(self._requests),
+            "role": ("standby" if self._standby
+                     else "deposed" if self._deposed else "leader"),
+        }
+
     def health(self) -> dict:
         """Fleet-level snapshot: per-replica health + aggregate load."""
         reps = {}
@@ -1423,6 +1536,16 @@ class ServingRouter:
                                if r.state == "up"),
             "served_by_replica": {r.id: r.served
                                   for r in self._replicas.values()},
+            # TTFT / per-token / queue-wait p50/p95/p99 from the registry
+            # histograms: in-process fleets observe everything locally;
+            # a fleet with REMOTE replicas reads the last fleet_metrics()
+            # merge (the replica processes own the observations)
+            "latency": latency_summaries(
+                self._last_fleet
+                if self._last_fleet is not None
+                and any(getattr(r.frontend, "is_remote", False)
+                        for r in self._replicas.values())
+                else None),
             **{f"requests_{k}": v for k, v in sorted(self._counts.items())},
         }
 

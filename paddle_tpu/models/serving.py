@@ -45,6 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import telemetry
 from ..core.flags import define_flag, flag
 from ..core.resilience import Deadline, InjectedFault, bump_counter, inject
 from ..core.tensor import Tensor
@@ -65,6 +66,22 @@ define_flag("FLAGS_serving_pipeline", True,
             "segment in ContinuousBatchingEngine (0 = serial fallback: "
             "dispatch, wait, consume, one segment at a time)")
 
+# serving-path metrics (module-level handles: registry reset zeroes them
+# in place, so caching here is safe and keeps the hot-path cost at one
+# lock). Names are documented in README "Observability" and CI-gated
+# against orphaning (tests/test_telemetry_guard.py).
+_M_TTFT = telemetry.histogram(
+    "serving.ttft_s", "submit -> first token (queue wait included; "
+    "fresh attempts only — token_base>0 failover continuations are "
+    "excluded)")
+_M_TOK = telemetry.histogram(
+    "serving.token_latency_s", "mean per-token decode latency, observed "
+    "once per retired request over its post-first-token stream")
+_M_TOKENS = telemetry.counter(
+    "serving.tokens_total", "tokens emitted by the engine scheduler")
+_M_REQS = telemetry.counter(
+    "serving.requests_total", "terminal request verdicts, by status")
+
 
 class Request:
     """One in-flight generation request inside the engine scheduler.
@@ -81,14 +98,22 @@ class Request:
     prompt with ``token_base=k``, so the first token sampled here is
     stream index ``k`` — bit-identical to the continuation the
     uninterrupted run would have produced.
+
+    ``trace`` is the request's telemetry trace id (minted by the router
+    or frontend, riding the RPC envelope across processes); dispatch
+    spans and the retire event carry it so one rid's whole life — queue
+    wait, prefill, every decode segment, failover hops — stitches into
+    one timeline. ``t_submit``/``t_first`` anchor the TTFT and per-token
+    latency histograms (monotonic; ``t_submit`` is overwritten by the
+    frontend with its own admission stamp so queue wait counts).
     """
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "deadline", "tokens",
                  "status", "poisoned", "poison_checked", "error",
-                 "token_base")
+                 "token_base", "trace", "t_submit", "t_first")
 
     def __init__(self, rid, prompt, max_new_tokens, deadline=None,
-                 token_base=0):
+                 token_base=0, trace=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
@@ -99,6 +124,9 @@ class Request:
         self.poison_checked = False
         self.error = None
         self.token_base = int(token_base)
+        self.trace = trace
+        self.t_submit = time.monotonic()
+        self.t_first = None
 
     def output(self):
         return np.asarray(self.tokens[:self.max_new_tokens], np.int32)
@@ -575,7 +603,7 @@ class ContinuousBatchingEngine:
         return self
 
     def submit(self, prompt, max_new_tokens, deadline_s=None, rid=None,
-               token_base=0):
+               token_base=0, trace=None):
         """Enqueue one request (requires a prior ``start()``); raises
         ``ValueError`` if it can never fit a slot. ``deadline_s`` is a
         per-request budget (seconds or a ``Deadline``), measured from
@@ -586,7 +614,8 @@ class ContinuousBatchingEngine:
         emitted elsewhere, and ``max_new_tokens`` the REMAINING budget —
         sampling keys start at stream index ``k``, so the continuation
         is bit-identical to the uninterrupted run's (same engine seed,
-        same rid)."""
+        same rid). ``trace`` tags the request's dispatch spans and
+        retire event with a telemetry trace id."""
         prompt = np.asarray(prompt).astype(np.int32).ravel()
         self._validate(prompt, max_new_tokens)
         if rid is None:
@@ -599,7 +628,7 @@ class ContinuousBatchingEngine:
         deadline = (deadline_s if isinstance(deadline_s, Deadline)
                     else Deadline(deadline_s))
         req = Request(rid, prompt, max_new_tokens, deadline,
-                      token_base=token_base)
+                      token_base=token_base, trace=trace)
         self._queue.append(req)
         return req
 
@@ -651,6 +680,14 @@ class ContinuousBatchingEngine:
             self._lengths[slot] = 1  # slot returns to the idle pool
         req.status = status
         self._counts[status] = self._counts.get(status, 0) + 1
+        if telemetry.enabled():
+            _M_REQS.inc(status=status)
+            if req.t_first is not None and len(req.tokens) > 1:
+                _M_TOK.observe((time.monotonic() - req.t_first)
+                               / (len(req.tokens) - 1))
+            telemetry.trace_event("serving.retire", trace=req.trace,
+                                  rid=req.rid, status=status,
+                                  tokens=len(req.tokens))
         if finished is not None:
             finished.append(req)
 
@@ -691,6 +728,10 @@ class ContinuousBatchingEngine:
                 _, req = group[0]
                 bump_counter("serving.poison_request")
                 req.error = e
+                # a poison retirement is a post-mortem moment: dump the
+                # flight recorder so the offender leaves forensics
+                telemetry.flight_dump("poison_request", rid=req.rid,
+                                      error=repr(e))
                 self._retire(req, "failed", finished)
                 return
         mid = len(group) // 2
@@ -698,6 +739,27 @@ class ContinuousBatchingEngine:
         self._isolate(group[mid:], dispatch, finished)
 
     # ------------------------------------------------------- dispatches
+
+    @staticmethod
+    def _group_trace_args(group):
+        """Span args for a batched admission dispatch: the rids (and any
+        trace ids) riding it, so a per-request timeline can find the
+        shared prefill span. Empty when telemetry is off — the lists are
+        never built on a disabled hot path."""
+        if not telemetry.enabled():
+            return {}
+        return {"rids": [req.rid for _, req in group],
+                "traces": [req.trace for _, req in group
+                           if req.trace is not None]}
+
+    def _mask_trace_args(self, mask):
+        """Span args for a decode-segment dispatch over the slot mask."""
+        if not telemetry.enabled():
+            return {}
+        reqs = [self._slot_req[s] for s in np.flatnonzero(mask)]
+        return {"rids": [r.rid for r in reqs if r is not None],
+                "traces": [r.trace for r in reqs
+                           if r is not None and r.trace is not None]}
 
     def _limits_device(self):
         if self._limits_dev is None:
@@ -711,6 +773,16 @@ class ContinuousBatchingEngine:
         self._slot_req[slot] = req
         req.tokens.append(int(tok))
         self._useful += 1  # the prefill-sampled first token
+        req.t_first = time.monotonic()
+        if telemetry.enabled():
+            if req.token_base == 0:
+                # FRESH attempts only: a failover continuation
+                # (token_base > 0) emitted its real first token long ago
+                # on another replica — an attempt-level sample here
+                # would skew the fleet TTFT percentiles during exactly
+                # the incidents where the SLO number matters
+                _M_TTFT.observe(req.t_first - req.t_submit)
+            _M_TOKENS.inc()
         self._lengths[slot] = req.prompt.size
         self._cur_tok[slot] = int(tok)
         self._limits[slot] = req.prompt.size + req.max_new_tokens - 1
@@ -735,7 +807,7 @@ class ContinuousBatchingEngine:
             padded[i, :req.prompt.size] = req.prompt
             true_lens[i] = req.prompt.size
             rows[i] = slot
-        with annotate("serving.prefill"):
+        with annotate("serving.prefill", **self._group_trace_args(group)):
             tok0, self._ks, self._vs = self._call(
                 ("prefill", bucket, g), self._prefill_p,
                 self._params, self._ks, self._vs, jnp.asarray(padded),
@@ -784,7 +856,8 @@ class ContinuousBatchingEngine:
                     chunk_arr[i] = p[c * chunk_w:(c + 1) * chunk_w]
                     bases[i] = c * chunk_w
                     rows[i] = slot
-            with annotate("serving.chunked_prefill"):
+            with annotate("serving.chunked_prefill",
+                          **self._group_trace_args(live)):
                 self._ks, self._vs = self._call(
                     ("chunk", g), self._chunk_p,
                     self._params, self._ks, self._vs, jnp.asarray(chunk_arr),
@@ -804,7 +877,8 @@ class ContinuousBatchingEngine:
                 bases[i] = done
                 true_rem[i] = rem
                 rows[i] = slot
-            with annotate("serving.chunked_prefill"):
+            with annotate("serving.chunked_prefill",
+                          **self._group_trace_args(live)):
                 tok0, self._ks, self._vs = self._call(
                     ("final", g), self._final_chunk_p,
                     self._params, self._ks, self._vs, jnp.asarray(final_arr),
@@ -836,7 +910,8 @@ class ContinuousBatchingEngine:
             active = jnp.asarray(mask)
         else:
             toks, lengths, active = carry
-        with annotate("serving.segment_dispatch"):
+        with annotate("serving.segment_dispatch",
+                      **self._mask_trace_args(mask)):
             emitted, was_active, tok, new_lengths, still_active, \
                 self._ks, self._vs = self._call(
                     ("segment", self._segment_len), self._segment_p,
@@ -854,6 +929,7 @@ class ContinuousBatchingEngine:
         emitted, was_active, cur_tok, lengths, still_active = \
             jax.device_get((h["emitted"], h["was_active"], h["tok"],
                             h["lengths"], h["active"]))
+        useful0 = self._useful
         with annotate("serving.host_bookkeeping"):
             # slots outside ``mask`` pass through the program unchanged, so
             # wholesale assignment composes across bisected sub-batches
@@ -884,6 +960,9 @@ class ContinuousBatchingEngine:
                         or not bool(still_active[slot]))
                 if done:
                     self._retire(req, "ok", finished, slot=slot)
+        if telemetry.enabled() and self._useful > useful0:
+            # one bump per consumed segment, not per token
+            _M_TOKENS.inc(self._useful - useful0)
         self._t_host0 = time.monotonic()
 
     def _drain_pipeline(self, finished):
@@ -923,6 +1002,8 @@ class ContinuousBatchingEngine:
                 req = self._slot_req[slot]
                 bump_counter("serving.poison_request")
                 req.error = e
+                telemetry.flight_dump("poison_request", rid=req.rid,
+                                      error=repr(e))
                 self._retire(req, "failed", finished, slot=slot)
                 return
             left = mask.copy()

@@ -14,29 +14,36 @@ import json
 import os
 import time
 
+from ..core import telemetry
+
 __all__ = [
-    "Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
-    "annotate", "make_scheduler", "export_chrome_tracing",
-    "load_profiler_result",
+    "Profiler", "ProfilerResult", "RecordEvent", "ProfilerTarget",
+    "ProfilerState", "annotate", "make_scheduler",
+    "export_chrome_tracing", "load_profiler_result",
 ]
 
 
 @contextlib.contextmanager
-def annotate(name):
-    """Hot-loop XLA trace scope: a bare ``jax.profiler.TraceAnnotation``
-    (so the span shows up in a TPU XPlane trace around the host work it
-    brackets) without the host-event ring bookkeeping of ``RecordEvent``.
-    The serving engine wraps its prefill / chunked-prefill / segment
-    dispatches and host bookkeeping in these, which is how a pipelined
-    schedule's host/device overlap is read off a trace."""
+def annotate(name, **span_args):
+    """Hot-loop trace scope: a ``jax.profiler.TraceAnnotation`` (so the
+    span shows up in a TPU XPlane trace around the host work it
+    brackets) that ALSO records into the telemetry span sink — the same
+    sink request tracing writes to — so ``export_chrome_tracing`` shows
+    engine phases and per-request spans on one timeline. The serving
+    engine wraps its prefill / chunked-prefill / segment dispatches and
+    host bookkeeping in these (passing the dispatch's rids/trace ids as
+    ``span_args``), which is how a pipelined schedule's host/device
+    overlap is read off a trace. The sink write is skipped when
+    ``FLAGS_telemetry`` is off; the XLA annotation always applies."""
     try:
         import jax.profiler as jp
 
         ctx = jp.TraceAnnotation(name)
     except Exception:
         ctx = contextlib.nullcontext()
-    with ctx:
-        yield
+    sink = telemetry.maybe_span(name, **span_args)
+    with ctx, sink:
+        yield sink
 
 
 class ProfilerTarget:
@@ -59,16 +66,20 @@ _active = False
 
 class RecordEvent:
     """Host-side span (reference python/paddle/profiler/utils.py
-    RecordEvent; C++ paddle/fluid/platform/profiler/host_tracer.cc). Also
-    annotates the XLA trace so spans show up in the device timeline."""
+    RecordEvent; C++ paddle/fluid/platform/profiler/host_tracer.cc).
+    Annotates the XLA trace AND feeds the telemetry span sink — the one
+    sink ``export_chrome_tracing`` exports, shared with request tracing
+    and ``annotate`` scopes."""
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._t0 = None
+        self._t0_wall = None
         self._ann = None
 
     def begin(self):
         self._t0 = time.perf_counter_ns()
+        self._t0_wall = time.time()  # wall-clock: x-process trace epoch
         try:
             import jax.profiler as jp
 
@@ -80,11 +91,18 @@ class RecordEvent:
     def end(self):
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
-        if _active and self._t0 is not None:
+        if self._t0 is None:
+            return
+        dur_s = (time.perf_counter_ns() - self._t0) / 1e9
+        if telemetry.enabled():
+            telemetry.tracer().add_span(self.name, self._t0_wall, dur_s)
+        elif _active:
+            # telemetry off but a Profiler is recording: keep the legacy
+            # host-event ring so export still sees the span (exactly one
+            # of the two sinks records — export merges both)
             _host_events.append({
                 "name": self.name, "ph": "X", "pid": os.getpid(), "tid": 0,
-                "ts": self._t0 / 1e3,
-                "dur": (time.perf_counter_ns() - self._t0) / 1e3,
+                "ts": self._t0_wall * 1e6, "dur": dur_s * 1e6,  # wall-clock: x-process trace epoch
             })
 
     def __enter__(self):
@@ -132,11 +150,17 @@ class Profiler:
         self._tracing = False
         self._step_times = []
         self._last_step_t = None
+        self._t_start_wall = None
 
     def start(self):
         global _active
         _active = True
         _host_events.clear()
+        # session window anchor: export() filters the (process-lifetime)
+        # telemetry sink to spans recorded after this point, so a
+        # profile of one step is not dominated by pre-session serving
+        # spans already in the ring
+        self._t_start_wall = time.time()  # wall-clock: x-process trace epoch
         self._last_step_t = time.perf_counter()
         if not self.timer_only:
             try:
@@ -184,7 +208,10 @@ class Profiler:
         print(f"host events recorded: {len(_host_events)}")
 
     def export(self, path, format="json"):
-        export_chrome_tracing(path)
+        # scoped to THIS profiler session (start() → now); the
+        # module-level export_chrome_tracing dumps the whole sink
+        return export_chrome_tracing(
+            path, since_wall_s=getattr(self, "_t_start_wall", None))
 
     def __enter__(self):
         return self.start()
@@ -193,15 +220,60 @@ class Profiler:
         self.stop()
 
 
-def export_chrome_tracing(path, dir_name=None):
-    """Dump host RecordEvent spans as a chrome://tracing JSON (reference
-    chrometracing_logger.cc analog; device timeline lives in the XPlane
-    dump under the jax.profiler log dir)."""
+def export_chrome_tracing(path, dir_name=None, since_wall_s=None):
+    """Dump the telemetry span sink (request-trace spans, ``annotate``
+    scopes, RecordEvent spans) plus any legacy host events as ONE
+    chrome://tracing JSON (reference chrometracing_logger.cc analog; the
+    device timeline lives in the XPlane dump under the jax.profiler log
+    dir). ``since_wall_s`` restricts sink events to those recorded at
+    or after that wall-clock time (``Profiler.export`` passes its
+    session start, so one profiled step is not dominated by pre-session
+    serving spans). The file round-trips through
+    :func:`load_profiler_result`."""
+    evs = telemetry.tracer().spans()
+    if since_wall_s is not None:
+        cut = since_wall_s * 1e6
+        evs = [e for e in evs if e.get("ts", 0) >= cut]
     with open(path, "w") as f:
-        json.dump({"traceEvents": list(_host_events)}, f)
+        json.dump({"traceEvents": evs + list(_host_events),
+                   "displayTimeUnit": "ms"}, f)
     return path
 
 
-def load_profiler_result(path):
+class ProfilerResult(dict):
+    """A loaded trace: a plain dict (``result["traceEvents"]`` — the
+    historical surface) plus span accessors, so exported profiles
+    round-trip as REAL span data, not an opaque blob."""
+
+    @property
+    def events(self) -> list:
+        return self.get("traceEvents", [])
+
+    def spans(self, name=None, trace=None) -> list:
+        """Complete (``ph == "X"``) spans, optionally filtered by name
+        and/or by the trace id carried in ``args`` (including batched
+        spans whose ``args['traces']`` list contains it)."""
+        out = [e for e in self.events if e.get("ph") == "X"]
+        if name is not None:
+            out = [e for e in out if e.get("name") == name]
+        if trace is not None:
+            out = [e for e in out
+                   if e.get("args", {}).get("trace") == trace
+                   or trace in (e.get("args", {}).get("traces") or ())]
+        return out
+
+    def span_names(self) -> set:
+        return {e.get("name") for e in self.events}
+
+    def total_dur_us(self, name) -> float:
+        return sum(e.get("dur", 0.0) for e in self.spans(name))
+
+    def save(self, path) -> str:
+        with open(path, "w") as f:
+            json.dump(dict(self), f)
+        return path
+
+
+def load_profiler_result(path) -> ProfilerResult:
     with open(path) as f:
-        return json.load(f)
+        return ProfilerResult(json.load(f))

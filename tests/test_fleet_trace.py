@@ -1,0 +1,227 @@
+"""Cross-process request tracing + flight recorder (ISSUE 9 flagship).
+
+A router fronts 2 replica PROCESSES over real RPC; traffic flows with a
+router-minted trace id riding every submit envelope; one replica is
+SIGKILLed mid-decode. The drill asserts the telemetry layer leaves
+usable artifacts:
+
+* one rid's spans STITCH across the router and replica processes under
+  a consistent trace id — including across the failover resubmit (the
+  survivor's spans carry the same trace the victim was serving);
+* the stitched Chrome trace is one readable timeline (distinct pids,
+  shared wall-clock epoch);
+* the router's flight-recorder dump (triggered by the breaker trip on
+  the death) NAMES the dead replica;
+* ``fleet_metrics()`` merges the replica processes' store-published
+  registry snapshots: fleet-wide TTFT percentiles and tokens/s are
+  answerable from the router process even though it observed no local
+  engine work.
+"""
+import json
+import os
+import signal
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import resilience, telemetry
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.distributed import rpc
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.models.remote import (
+    RPC_MASTER_ENV,
+    TRACE_DIR_ENV,
+    RemoteFrontend,
+)
+from paddle_tpu.models.router import ServingRouter, launch_fleet
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path):
+    resilience.reset_faults()
+    telemetry.reset_telemetry()
+    set_flags({"FLAGS_flight_dir": str(tmp_path / "flight")})
+    yield
+    resilience.reset_faults()
+    telemetry.reset_telemetry()
+    set_flags({"FLAGS_flight_dir": ""})
+
+
+_REPLICA_SCRIPT = """
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.frontend import ServingFrontend
+from paddle_tpu.models.remote import replica_main
+from paddle_tpu.models.serving import ContinuousBatchingEngine
+
+CFG = LlamaConfig(vocab_size=97, hidden_size=16, intermediate_size=32,
+                  num_hidden_layers=1, num_attention_heads=2,
+                  max_position_embeddings=128, tie_word_embeddings=True)
+
+
+def build():
+    paddle.seed(0)
+    model = LlamaForCausalLM(CFG)
+    eng = ContinuousBatchingEngine(model, max_slots=2, max_len=64,
+                                   prompt_buckets=(8, 16), do_sample=True,
+                                   temperature=0.9, seed=13)
+    return ServingFrontend(eng, max_queue=32, segment=4,
+                           breaker_threshold=50)
+
+
+if __name__ == "__main__":
+    raise SystemExit(replica_main(build))
+"""
+
+
+def _prompts(n, rng_seed=3):
+    rng = np.random.RandomState(rng_seed)
+    return [rng.randint(0, 97, (int(rng.randint(4, 10)),)).astype(np.int32)
+            for _ in range(n)]
+
+
+def _stub(rank):
+    return RemoteFrontend(f"replica{rank}", timeout=60.0,
+                          health_timeout=10.0, retry_attempts=2,
+                          resend_after=30.0, results_wait=0.1)
+
+
+def test_cross_process_trace_stitches_across_failover(tmp_path):
+    trace_dir = tmp_path / "traces"
+    script = tmp_path / "replica.py"
+    script.write_text(textwrap.dedent(_REPLICA_SCRIPT))
+    store = rpc.init_rpc("router", rank=0, world_size=3)
+    endpoint = f"127.0.0.1:{store.port}"
+    fleet_store = TCPStore(port=store.port)
+    router = ServingRouter(store=fleet_store, lease=1.5,
+                           heartbeat_interval=0.1, max_failovers=3)
+    rc_box = {}
+    supervisor = threading.Thread(
+        target=lambda: rc_box.update(rc=launch_fleet(
+            str(script), n_replicas=2, max_restarts=2,
+            env={RPC_MASTER_ENV: endpoint,
+                 TRACE_DIR_ENV: str(trace_dir)},
+            backoff_base=0.01, poll_interval=0.05)),
+        daemon=True)
+    supervisor.start()
+    try:
+        for rank in (0, 1):
+            rpc.get_worker_info(f"replica{rank}", timeout=300)
+            router.add_replica(_stub(rank), replica_id=rank)
+        pids = {r: int(fleet_store.get(f"fleet/pid/{r}").decode())
+                for r in (0, 1)}
+
+        # warm pass (first-traffic XLA compiles)
+        warm = [router.submit(p, max_new_tokens=2)
+                for p in _prompts(2, rng_seed=7)]
+        wres = router.results(wait=True, timeout_s=600)
+        assert all(wres[r].status == "ok" for r in warm)
+
+        # ---- live traffic + the kill, traces captured before it
+        rids = [router.submit(p, max_new_tokens=16)
+                for p in _prompts(6, rng_seed=11)]
+        traces = {rid: router._requests[rid].trace for rid in rids}
+        assert all(traces.values())  # router minted every trace id
+        victim = max((0, 1),
+                     key=lambda r: len(router._replicas[r].assigned))
+        survivor = 1 - victim
+        stranded = sorted(set(router._replicas[victim].assigned)
+                          & set(rids))
+        assert stranded, "drill needs in-flight work on the victim"
+        os.kill(pids[victim], signal.SIGKILL)
+        res = router.results(wait=True, timeout_s=600)
+        assert set(res) >= set(rids)
+        assert all(res[r].status == "ok" for r in rids)
+        assert router._replicas[victim].state == "dead"
+
+        # ---- flight recorder: the breaker-trip dump names the victim
+        d = telemetry.FlightRecorder.dump_dir()
+        dump_files = sorted(f for f in os.listdir(d)
+                            if "breaker_trip" in f)
+        assert dump_files, os.listdir(d)
+        named = []
+        for f in dump_files:
+            data = json.load(open(os.path.join(d, f)))
+            named += [e for e in data["events"]
+                      if e["kind"] == "replica_dead"
+                      and e["replica"] == victim]
+        assert named, "no dump names the dead replica"
+        assert all(e.get("reason") for e in named)
+        assert any(e.get("stranded") for e in named)
+
+        # ---- fleet metrics: merged from the replicas' store-published
+        # snapshots (the router process ran no local engine)
+        deadline = time.monotonic() + 30
+        fm = router.fleet_metrics()
+        while (fm["latency"]["ttft_s"]["count"] == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.5)  # next heartbeat-cadence publish
+            fm = router.fleet_metrics()
+        assert fm["latency"]["ttft_s"]["count"] > 0
+        assert fm["latency"]["ttft_s"]["p99"] >= \
+            fm["latency"]["ttft_s"]["p50"] > 0.0
+        assert fm["tokens_total"] > 0
+        assert fm["replicas"][victim]["state"] == "dead"
+        assert fm["replicas"][survivor]["state"] == "up"
+        # stats() sources its summaries from the same merge
+        assert router.stats()["latency"]["ttft_s"]["count"] == \
+            fm["latency"]["ttft_s"]["count"]
+
+        # ---- shut the fleet down cleanly so the survivor (and the
+        # supervisor-respawned victim) export their trace files
+        rpc.get_worker_info(f"replica{victim}", timeout=300)
+        router.add_replica(_stub(victim), replica_id=victim)
+        router.shutdown()
+    finally:
+        if router._replicas:
+            router.shutdown()
+        supervisor.join(120)
+    try:
+        # ---- stitch: router spans + the replica processes' exports
+        router_trace = str(tmp_path / "router-trace.json")
+        telemetry.export_chrome_trace(router_trace)
+        replica_files = [os.path.join(trace_dir, f)
+                         for f in os.listdir(trace_dir)]
+        assert replica_files, "no replica process exported a trace"
+        stitched = telemetry.stitch_chrome_traces(
+            [router_trace] + replica_files,
+            str(tmp_path / "stitched.json"))
+        events = json.load(open(stitched))["traceEvents"]
+
+        def for_trace(t):
+            return [e for e in events
+                    if e.get("args", {}).get("trace") == t
+                    or t in (e.get("args", {}).get("traces") or ())]
+
+        # a request stranded on the SIGKILLed victim: its trace id must
+        # appear in BOTH the router process and the survivor process
+        # (the victim's spans died with it — that gap is the story), and
+        # the router's failover hop events narrate the move
+        rid = stranded[0]
+        t = traces[rid]
+        evs = for_trace(t)
+        pids_seen = {e["pid"] for e in evs}
+        assert len(pids_seen) >= 2, (pids_seen, len(evs))
+        assert os.getpid() in pids_seen
+        names = {e["name"] for e in evs}
+        assert "fleet.dispatch" in names       # placement hops (router)
+        assert "fleet.failover" in names       # the kill-driven resubmit
+        assert "serving.retire" in names       # replica-side completion
+        retires = [e for e in for_trace(t)
+                   if e["name"] == "serving.retire"
+                   and e["args"].get("status") == "ok"]
+        assert retires and all(e["pid"] != os.getpid() for e in retires)
+        dispatch_hops = [e["args"]["replica"] for e in evs
+                         if e["name"] == "fleet.dispatch"]
+        assert victim in dispatch_hops and survivor in dispatch_hops
+        # every request's trace stitches across at least 2 processes
+        for rid2 in rids:
+            assert len({e["pid"] for e in for_trace(traces[rid2])}) >= 2
+    finally:
+        rpc.shutdown()
+        fleet_store.close()
+    assert rc_box.get("rc") == 0

@@ -1,0 +1,511 @@
+"""Fleet-wide telemetry (ISSUE 9): labeled metrics registry, request
+tracing, flight recorder — and their wiring through the serving stack.
+
+Layers:
+
+* registry units — counters/gauges/histograms with labels, snapshots,
+  Prometheus exposition, cross-process snapshot merging, and the
+  ``core.resilience`` counter shim (one source of truth);
+* tracing — a trace id minted at ``ServingFrontend.submit`` stitches
+  submit → queue-wait → prefill → decode segments → retire in the span
+  sink, exports as Chrome-trace JSON, and round-trips through the
+  profiler's ``load_profiler_result``;
+* flight recorder — bounded ring, capped dumps, and the automatic
+  trigger sites (breaker trip, poison retirement);
+* fleet — ``frontend.health()`` / ``router.stats()`` latency summaries
+  and ``router.fleet_metrics()``;
+* the flagship multi-process drill lives in ``test_fleet_trace.py``
+  (real RPC, kill-mid-decode, cross-process stitch).
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import resilience, telemetry
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.core.resilience import CircuitBreaker
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.frontend import ServingFrontend, latency_summaries
+from paddle_tpu.models.router import ServingRouter
+from paddle_tpu.models.serving import ContinuousBatchingEngine
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path):
+    resilience.reset_faults()
+    telemetry.reset_telemetry()
+    set_flags({"FLAGS_flight_dir": str(tmp_path / "flight")})
+    yield
+    resilience.reset_faults()
+    telemetry.reset_telemetry()
+    set_flags({"FLAGS_flight_dir": "", "FLAGS_telemetry": True})
+
+
+_CFG = LlamaConfig(vocab_size=97, hidden_size=16, intermediate_size=32,
+                   num_hidden_layers=1, num_attention_heads=2,
+                   max_position_embeddings=128, tie_word_embeddings=True)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(_CFG)
+
+
+@pytest.fixture(scope="module")
+def engines(model):
+    """Two module-scoped engines (a router test fronts both at once):
+    each ServingFrontend() start() resets the session, so sharing the
+    compiled programs across tests costs nothing but the compiles."""
+    return [ContinuousBatchingEngine(model, max_slots=2, max_len=64,
+                                     prompt_buckets=(8, 16), seed=5)
+            for _ in range(2)]
+
+
+def _frontend(engines, i=0, **kw):
+    kw.setdefault("max_queue", 32)
+    kw.setdefault("segment", 4)
+    return ServingFrontend(engines[i], **kw)
+
+
+def _prompts(n, seed=3):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, _CFG.vocab_size,
+                        (int(rng.randint(4, 10)),)).astype(np.int32)
+            for _ in range(n)]
+
+
+# ------------------------------------------------------------- registry
+
+
+def test_counter_labels_and_snapshot():
+    c = telemetry.counter("t.requests")
+    c.inc()
+    c.inc(2, status="ok")
+    c.inc(status="failed")
+    assert c.value() == 1
+    assert c.value(status="ok") == 2
+    snap = telemetry.registry().snapshot()
+    assert snap["counters"]["t.requests"] == 1
+    assert snap["counters"]["t.requests{status=ok}"] == 2
+    assert snap["counters"]["t.requests{status=failed}"] == 1
+
+
+def test_gauge_set_inc():
+    g = telemetry.gauge("t.depth")
+    g.set(5)
+    g.inc(2)
+    g.dec()
+    assert g.value() == 6
+
+
+def test_histogram_percentiles_and_summary():
+    h = telemetry.histogram("t.lat_s")
+    for i in range(1, 101):
+        h.observe(i / 100.0)
+    p = h.percentiles((50, 95, 99))
+    assert abs(p["p50"] - 0.5) < 0.02
+    assert abs(p["p99"] - 0.99) < 0.02
+    s = h.summary()
+    assert s["count"] == 100
+    assert abs(s["mean"] - 0.505) < 0.01
+
+
+def test_histogram_type_conflict_raises():
+    telemetry.counter("t.conflict")
+    with pytest.raises(TypeError):
+        telemetry.histogram("t.conflict")
+
+
+def test_prometheus_exposition_format():
+    telemetry.counter("t.reqs", "total requests").inc(3, status="ok")
+    telemetry.histogram("t.lat_s").observe(0.02)
+    text = telemetry.registry().to_prometheus()
+    assert "# TYPE t_reqs counter" in text
+    assert 't_reqs{status="ok"} 3' in text
+    assert "# TYPE t_lat_s histogram" in text
+    assert "t_lat_s_count" in text
+    assert 't_lat_s_bucket{le="+Inf"} 1' in text
+
+
+def test_merge_snapshots_sums_and_percentiles():
+    r1, r2 = telemetry.MetricsRegistry(), telemetry.MetricsRegistry()
+    r1.counter("c").inc(3)
+    r2.counter("c").inc(4)
+    for i in range(50):
+        r1.histogram("h").observe(0.1)
+        r2.histogram("h").observe(0.3)
+    merged = telemetry.merge_snapshots(r1.snapshot(), r2.snapshot())
+    assert merged["counters"]["c"] == 7
+    s = telemetry.summary_from_snapshot(merged, "h")
+    assert s["count"] == 100
+    assert 0.1 <= s["p50"] <= 0.3
+    assert abs(s["mean"] - 0.2) < 1e-9
+    # bucket-only fallback (no reservoir shipped)
+    for h in merged["histograms"].values():
+        h["sample"] = []
+    s2 = telemetry.summary_from_snapshot(merged, "h")
+    assert s2["count"] == 100 and s2["p50"] > 0.0
+
+
+def test_merge_snapshots_bounds_mismatch_invalidates_buckets():
+    """Mixed bucket layouts (custom buckets= in one process / rolling
+    code versions) must not sum incompatible buckets under summed
+    counts: the merge invalidates the buckets (counted) and percentiles
+    fall back to the merged reservoir — or zeros, never garbage."""
+    r1, r2 = telemetry.MetricsRegistry(), telemetry.MetricsRegistry()
+    r1.histogram("h", buckets=(0.1, 1.0)).observe(0.05)
+    r2.histogram("h", buckets=(0.2, 2.0)).observe(1.5)
+    merged = telemetry.merge_snapshots(r1.snapshot(), r2.snapshot())
+    assert merged["histograms"]["h"]["buckets"] is None
+    assert telemetry.counter(
+        "telemetry.merge_bounds_mismatch").value() == 1
+    s = telemetry.summary_from_snapshot(merged, "h")
+    assert s["count"] == 2 and s["p50"] > 0.0  # reservoir answers
+    merged["histograms"]["h"]["sample"] = []
+    z = telemetry.summary_from_snapshot(merged, "h")
+    assert z["p99"] == 0.0 and z["count"] == 2
+
+
+def test_requests_total_counts_queue_terminal_verdicts(engines):
+    """Verdicts the engine never sees — queue-expired timeouts and
+    queue cancels — still land in serving.requests_total."""
+    fe = _frontend(engines)
+    hold = fe.submit(_prompts(1)[0], max_new_tokens=4)   # takes a slot
+    # fill both slots so the next submissions stay queued
+    hold2 = fe.submit(_prompts(1)[0], max_new_tokens=4)
+    fe.step()
+    doomed = fe.submit(_prompts(1)[0], max_new_tokens=4,
+                       deadline_s=0.0)                    # expires queued
+    gone = fe.submit(_prompts(1)[0], max_new_tokens=4)    # cancelled queued
+    assert fe.cancel(gone)
+    res = fe.results(wait=True)
+    assert res[doomed].status == "timed_out"
+    assert res[gone].status == "cancelled"
+    c = telemetry.counter("serving.requests_total")
+    assert c.value(status="timed_out") == 1
+    assert c.value(status="cancelled") == 1
+    assert res[hold].status == res[hold2].status == "ok"
+    fe.shutdown()
+
+
+def test_resilience_counters_are_registry_metrics():
+    resilience.bump_counter("t.shim", 2)
+    assert resilience.get_counter("t.shim") == 2
+    assert telemetry.counter("t.shim").value() == 2
+    assert resilience.counters()["t.shim"] == 2
+    # reset zeroes IN PLACE: handles cached before the reset stay wired
+    handle = telemetry.counter("t.shim")
+    resilience.reset_counters()
+    assert resilience.get_counter("t.shim") == 0
+    handle.inc()
+    assert resilience.get_counter("t.shim") == 1
+
+
+# -------------------------------------------------------------- tracing
+
+
+def test_span_and_event_land_in_sink():
+    t = telemetry.new_trace_id()
+    with telemetry.span("t.work", trace=t, rid=7) as s:
+        s.event("t.midpoint", step=3)
+    spans = telemetry.tracer().spans("t.work", trace=t)
+    assert len(spans) == 1
+    assert spans[0]["args"]["rid"] == 7
+    assert spans[0]["dur"] >= 0
+    evs = telemetry.tracer().spans("t.midpoint", trace=t)
+    assert len(evs) == 1 and evs[0]["ph"] == "i"
+
+
+def test_trace_ids_are_unique():
+    ids = {telemetry.new_trace_id() for _ in range(100)}
+    assert len(ids) == 100
+
+
+def test_sink_is_bounded():
+    tr = telemetry.Tracer(capacity=32)
+    for i in range(100):
+        tr.event(f"e{i}")
+    assert len(tr.spans()) == 32
+    assert tr.spans()[0]["name"] == "e68"  # oldest dropped first
+
+
+def test_telemetry_flag_disables_hot_path(engines):
+    set_flags({"FLAGS_telemetry": 0})
+    try:
+        fe = _frontend(engines)
+        rid = fe.submit(_prompts(1)[0], max_new_tokens=4)
+        res = fe.results(wait=True)
+        assert res[rid].status == "ok"
+        assert telemetry.tracer().spans() == []
+        assert telemetry.histogram("serving.ttft_s").summary()["count"] == 0
+    finally:
+        set_flags({"FLAGS_telemetry": 1})
+        fe.shutdown()
+
+
+def test_frontend_mints_trace_and_spans_stitch(engines, tmp_path):
+    """Standalone frontend: submit mints a trace id; the request's whole
+    life (submit event, queue-wait span, prefill span, decode segments,
+    retire event) is findable under it; the Chrome export round-trips
+    through the profiler loader."""
+    import paddle_tpu.profiler as prof
+
+    fe = _frontend(engines)
+    rid = fe.submit(_prompts(1)[0], max_new_tokens=6)
+    res = fe.results(wait=True)
+    assert res[rid].status == "ok"
+    submits = telemetry.tracer().spans("serving.submit")
+    assert len(submits) == 1
+    trace = submits[0]["args"]["trace"]
+    assert trace is not None and submits[0]["args"]["rid"] == rid
+    for name in ("serving.queue_wait", "serving.prefill",
+                 "serving.segment_dispatch", "serving.retire"):
+        assert telemetry.tracer().spans(name, trace=trace), name
+    retire = telemetry.tracer().spans("serving.retire", trace=trace)[0]
+    assert retire["args"]["status"] == "ok"
+    assert retire["args"]["tokens"] == 6
+    # export -> load round-trip as REAL spans
+    path = str(tmp_path / "trace.json")
+    prof.export_chrome_tracing(path)
+    loaded = prof.load_profiler_result(path)
+    assert loaded.spans("serving.prefill", trace=trace)
+    assert loaded.total_dur_us("serving.prefill") > 0
+    assert "serving.retire" in loaded.span_names()
+    fe.shutdown()
+
+
+def test_annotate_feeds_span_sink():
+    import paddle_tpu.profiler as prof
+
+    with prof.annotate("t.scope", rid=9):
+        pass
+    spans = telemetry.tracer().spans("t.scope")
+    assert len(spans) == 1 and spans[0]["args"]["rid"] == 9
+
+
+def test_record_event_round_trips_through_profiler(tmp_path):
+    import paddle_tpu.profiler as prof
+
+    with prof.Profiler(timer_only=True) as p:
+        with prof.RecordEvent("t.fwd"):
+            pass
+        p.step()
+    out = str(tmp_path / "prof.json")
+    p.export(out)
+    data = prof.load_profiler_result(out)
+    assert data["traceEvents"]  # historical dict surface
+    assert data.spans("t.fwd")
+    # save -> reload is lossless
+    out2 = str(tmp_path / "prof2.json")
+    data.save(out2)
+    assert prof.load_profiler_result(out2).spans("t.fwd")
+
+
+def test_profiler_export_scoped_to_session(tmp_path):
+    """Profiler.export covers the session window (start() → export),
+    not the process-lifetime sink; the module-level export keeps the
+    whole sink (the replica-exit trace dump wants everything)."""
+    import time as _time
+
+    import paddle_tpu.profiler as prof
+
+    telemetry.trace_event("t.before")
+    _time.sleep(0.005)
+    with prof.Profiler(timer_only=True) as p:
+        telemetry.trace_event("t.during")
+    out = str(tmp_path / "scoped.json")
+    p.export(out)
+    names = {e["name"] for e in prof.load_profiler_result(out).events}
+    assert "t.during" in names and "t.before" not in names
+    full = prof.export_chrome_tracing(str(tmp_path / "full.json"))
+    full_names = {e["name"]
+                  for e in prof.load_profiler_result(full).events}
+    assert {"t.before", "t.during"} <= full_names
+
+
+def test_stitch_chrome_traces(tmp_path):
+    t = telemetry.new_trace_id()
+    telemetry.trace_event("t.a", trace=t)
+    p1 = telemetry.export_chrome_trace(str(tmp_path / "a.json"))
+    telemetry.tracer().clear()
+    telemetry.trace_event("t.b", trace=t)
+    p2 = telemetry.export_chrome_trace(str(tmp_path / "b.json"))
+    out = telemetry.stitch_chrome_traces(
+        [p1, p2, str(tmp_path / "missing.json")],  # SIGKILLed replica
+        str(tmp_path / "all.json"))
+    evs = json.load(open(out))["traceEvents"]
+    names = {e["name"] for e in evs}
+    assert {"t.a", "t.b"} <= names
+    assert evs == sorted(evs, key=lambda e: e["ts"])
+
+
+# ------------------------------------------------------ flight recorder
+
+
+def test_flight_ring_is_bounded():
+    fr = telemetry.FlightRecorder(capacity=16)
+    for i in range(50):
+        fr.record("tick", i=i)
+    evs = fr.events("tick")
+    assert len(evs) == 16 and evs[0]["i"] == 34
+
+
+def test_flight_dump_writes_postmortem(tmp_path):
+    telemetry.flight_recorder().record("replica_dead", replica=3,
+                                       reason="drill")
+    path = telemetry.flight_dump("test_reason", detail="x")
+    assert path is not None and os.path.exists(path)
+    data = json.load(open(path))
+    assert data["reason"] == "test_reason"
+    kinds = [e["kind"] for e in data["events"]]
+    assert "replica_dead" in kinds and "test_reason" in kinds
+    assert "metrics" in data and "spans" in data
+
+
+def test_flight_dump_cap(tmp_path):
+    set_flags({"FLAGS_flight_max_dumps": 2})
+    try:
+        fr = telemetry.flight_recorder()
+        assert fr.dump("one") is not None
+        assert fr.dump("two") is not None
+        assert fr.dump("three") is None  # capped
+        assert telemetry.counter(
+            "telemetry.flight_dump_skipped").value() == 1
+        assert fr.dump("forced", force=True) is not None
+    finally:
+        set_flags({"FLAGS_flight_max_dumps": 8})
+
+
+def test_breaker_trip_dumps_flight_recorder():
+    br = CircuitBreaker("t.breaker", failure_threshold=2, cooldown_s=60)
+    br.record_failure()
+    br.record_failure()
+    assert br.state() == CircuitBreaker.OPEN
+    d = telemetry.FlightRecorder.dump_dir()
+    dumps = [f for f in os.listdir(d) if "breaker_trip_t.breaker" in f]
+    assert len(dumps) == 1
+    data = json.load(open(os.path.join(d, dumps[0])))
+    assert any(e["kind"] == "circuit_opened"
+               and e["breaker"] == "t.breaker" for e in data["events"])
+
+
+def test_poison_retirement_dumps_flight_recorder(engines):
+    fe = _frontend(engines, breaker_threshold=50)
+    set_flags({"FLAGS_fault_injection": "serving.engine_fault:1"})
+    rid = fe.submit(_prompts(1)[0], max_new_tokens=4)
+    res = fe.results(wait=True)
+    resilience.reset_faults()
+    assert res[rid].status == "failed"
+    d = telemetry.FlightRecorder.dump_dir()
+    dumps = [f for f in os.listdir(d) if "poison_request" in f]
+    assert dumps, os.listdir(d)
+    data = json.load(open(os.path.join(d, dumps[0])))
+    assert any(e["kind"] == "poison_request" and e["rid"] == rid
+               for e in data["events"])
+    fe.shutdown()
+
+
+# ------------------------------------------------- serving-path metrics
+
+
+def test_health_latency_summaries(engines):
+    fe = _frontend(engines)
+    rids = [fe.submit(p, max_new_tokens=6) for p in _prompts(4)]
+    res = fe.results(wait=True)
+    assert all(res[r].status == "ok" for r in rids)
+    lat = fe.health()["latency"]
+    for key in ("ttft_s", "token_s", "queue_wait_s"):
+        assert set(lat[key]) >= {"p50", "p95", "p99", "count", "mean"}
+    assert lat["ttft_s"]["count"] == 4
+    assert lat["ttft_s"]["p50"] > 0.0
+    assert lat["ttft_s"]["p99"] >= lat["ttft_s"]["p50"]
+    assert lat["token_s"]["count"] == 4
+    assert lat["queue_wait_s"]["count"] == 4
+    fe.shutdown()
+
+
+def test_requests_total_by_status(engines):
+    fe = _frontend(engines, max_queue=1)
+    ok = fe.submit(_prompts(1)[0], max_new_tokens=4)
+    bad = fe.submit(np.arange(1000, dtype=np.int32), max_new_tokens=4)
+    res = fe.results(wait=True)
+    assert res[ok].status == "ok" and res[bad].status == "rejected"
+    c = telemetry.counter("serving.requests_total")
+    assert c.value(status="ok") == 1
+    assert c.value(status="rejected") == 1
+    fe.shutdown()
+
+
+def test_router_stats_latency_and_fleet_metrics(engines):
+    router = ServingRouter(max_failovers=1)
+    for _ in range(2):
+        router.add_replica(_frontend(engines))
+    fm0 = router.fleet_metrics()  # rate anchor
+    rids = [router.submit(p, max_new_tokens=6) for p in _prompts(6)]
+    res = router.results(wait=True, timeout_s=300)
+    assert all(res[r].status == "ok" for r in rids)
+    lat = router.stats()["latency"]
+    assert lat["ttft_s"]["count"] == 6
+    assert lat["ttft_s"]["p95"] >= lat["ttft_s"]["p50"] > 0.0
+    fm = router.fleet_metrics()
+    assert fm["tokens_total"] == fm0["tokens_total"] + 6 * 6
+    assert fm["tokens_per_sec"] > 0.0
+    assert fm["latency"]["ttft_s"]["count"] == 6
+    assert fm["role"] == "leader"
+    for rep_id, info in fm["replicas"].items():
+        assert info["state"] == "up"
+        assert info["breaker"] == CircuitBreaker.CLOSED
+    # the merged snapshot carries the resilience ledger too
+    assert "serving.requests_total{status=ok}" in fm["metrics"]["counters"]
+    router.shutdown()
+
+
+def test_router_mints_trace_and_records_failover(engines):
+    """In-process fleet: the router's trace id reaches the engine spans,
+    and a replica death leaves failover trace events + a flight dump
+    naming the dead replica."""
+    router = ServingRouter(max_failovers=2, breaker_threshold=1)
+    a = router.add_replica(_frontend(engines))
+    b = router.add_replica(_frontend(engines))
+    # park work on a, then declare it dead mid-flight
+    rids = [router.submit(p, max_new_tokens=16) for p in _prompts(4)]
+    traces = {rid: router._requests[rid].trace for rid in rids
+              if rid in router._requests}
+    victim = max((a, b),
+                 key=lambda r: len(router._replicas[r].assigned))
+    stranded = [r for r in rids
+                if r in router._replicas[victim].assigned]
+    assert stranded
+    router.fail_replica(victim, "drill")
+    res = router.results(wait=True, timeout_s=300)
+    assert all(res[r].status == "ok" for r in rids)
+    rid = stranded[0]
+    t = traces[rid]
+    dispatches = telemetry.tracer().spans("fleet.dispatch", trace=t)
+    assert len(dispatches) >= 2  # original placement + failover hop
+    assert {d["args"]["replica"] for d in dispatches} == {a, b}
+    assert telemetry.tracer().spans("serving.retire", trace=t)
+    # the flight dump (breaker trip on the kill) names the dead replica
+    d = telemetry.FlightRecorder.dump_dir()
+    dumps = sorted(f for f in os.listdir(d) if "breaker_trip" in f)
+    assert dumps
+    data = json.load(open(os.path.join(d, dumps[0])))
+    assert any(e["kind"] == "replica_dead" and e["replica"] == victim
+               for e in data["events"])
+    router.shutdown()
+
+
+def test_latency_summaries_from_snapshot_matches_registry(engines):
+    fe = _frontend(engines)
+    rids = [fe.submit(p, max_new_tokens=4) for p in _prompts(3)]
+    res = fe.results(wait=True)
+    assert all(res[r].status == "ok" for r in rids)
+    live = latency_summaries()
+    snap = latency_summaries(telemetry.registry().snapshot())
+    assert live["ttft_s"]["count"] == snap["ttft_s"]["count"] == 3
+    assert abs(live["ttft_s"]["p50"] - snap["ttft_s"]["p50"]) < 1e-9
+    fe.shutdown()

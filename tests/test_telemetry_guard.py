@@ -1,0 +1,58 @@
+"""CI guard: no orphan telemetry (ISSUE 9 satellite).
+
+Every metric/counter name emitted anywhere in ``paddle_tpu/`` — literal
+first arguments of ``bump_counter(...)`` and of the registry
+constructors ``telemetry.counter/gauge/histogram(...)`` — must be
+referenced by at least one test OR documented in README's metrics table.
+A counter nobody asserts on and nobody documented is telemetry that
+silently rots: the name drifts, the dashboard goes blank, and the drill
+that needed it finds nothing. (Mirror of the fault-site registry sweep
+in test_no_bare_except.py.)
+
+F-string names (``bump_counter(f"circuit_opened:{name}")``) are
+normalized to their literal prefix before the interpolation; dynamic
+label values don't need documenting, the metric family does.
+"""
+import pathlib
+import re
+
+_PKG = pathlib.Path(__file__).resolve().parents[1] / "paddle_tpu"
+_TESTS = pathlib.Path(__file__).resolve().parent
+_README = _PKG.parent / "README.md"
+
+# literal-name emission sites: the resilience ledger and the telemetry
+# registry constructors (module-level handles and inline calls alike)
+_EMITS = re.compile(
+    r"(?:\bbump_counter|(?:telemetry\.|\b)(?:counter|gauge|histogram))"
+    r"\(\s*f?\"([^\"]+)\"")
+
+# names matching none of our naming families are other call sites the
+# regex happens to hit (e.g. collections.Counter) — the families are
+# dotted or colon-namespaced
+_NAME = re.compile(r"^[a-z0-9_.]+[.:][a-z0-9_.{:]+", re.I)
+
+
+def _normalize(name: str) -> str:
+    # f-string names document their literal family prefix
+    return name.split("{", 1)[0].rstrip(":.")
+
+
+def test_every_metric_name_is_referenced_or_documented():
+    names = set()
+    for py in sorted(_PKG.rglob("*.py")):
+        for m in _EMITS.findall(py.read_text()):
+            if _NAME.match(m):
+                names.add(_normalize(m))
+    assert len(names) > 40, (
+        f"metric sweep found only {len(names)} names: the regex is "
+        "probably broken")
+    haystack = "\n".join(p.read_text() for p in sorted(_TESTS.glob("*.py"))
+                         if p.name != pathlib.Path(__file__).name)
+    readme = _README.read_text()
+    orphans = sorted(n for n in names
+                     if n not in haystack and n not in readme)
+    assert not orphans, (
+        f"metric/counter name(s) {orphans} are emitted in paddle_tpu/ "
+        "but neither referenced by any test nor documented in README's "
+        "metrics table — telemetry nobody reads is telemetry that rots; "
+        "assert on it in a test or add a row to README 'Observability'")
