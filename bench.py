@@ -15,6 +15,8 @@ Weak-1):
       + (e2) continuous batching + (e3) replica-fleet router overhead gate
       + (e4) durable-router write-ahead journal overhead gate
       + (e5) telemetry overhead gate (tracing + metrics registry, default-on)
+      + (e6) perfwatch overhead gate (phase attribution, KV/memory/compile
+        watchdogs, SLO burn-rate monitor, default-on)
   (f) per-op microbench: adaptive iters (no 0.0us clamp readings), compared
       against OPBENCH_BASELINE.json, then the baseline is RE-RECORDED with
       this run's numbers (reference: tools/ci_op_benchmark.sh relative gate)
@@ -888,6 +890,95 @@ except Exception as e:
     log(f"telemetry section FAILED: {type(e).__name__}: {e}")
     tele_metrics = {"telemetry_error": f"{type(e).__name__}: {e}"[:200]}
 
+# ------------------------------------------------- (e6) perfwatch overhead
+# The performance-observability layer (core/perfwatch.py + the jit-layer
+# compile watchdog): per-phase step-time attribution, KV-occupancy
+# accounting, device-memory polling, SLO burn-rate monitoring, and the
+# post-warmup recompile watchdog are all DEFAULT-ON behind
+# FLAGS_telemetry — same A/B methodology as e5, gate < 3% of active
+# processing. The full frontend path is measured (SLO ticks + shed
+# checks live there), and the compile watchdog's serving-compile count
+# across the warmed A/B is recorded as perfwatch_serving_compiles —
+# the zero-recompile invariant, gated nonzero-fails by
+# tools/bench_trend.py (GATES) over the recorded rounds.
+pw_metrics = {}
+try:
+    from paddle_tpu.core import telemetry as _pw_tele
+    from paddle_tpu.core.flags import set_flags as _pw_setf
+    from paddle_tpu.models.frontend import ServingFrontend as _PwFE
+    from paddle_tpu.models.serving import (
+        ContinuousBatchingEngine as _PwCBE,
+    )
+
+    if SMOKE:
+        P_SLOTS, P_LEN, P_REQ, P_NEW, P_SEG = 2, 128, 8, 24, 4
+    else:
+        P_SLOTS, P_LEN, P_REQ, P_NEW, P_SEG = 8, 512, 16, 64, 32
+    log(f"perfwatch overhead: {P_REQ} requests x {P_NEW} tokens through "
+        "the frontend, A/B FLAGS_telemetry off/on...")
+    p_eng = _PwCBE(model, max_slots=P_SLOTS, max_len=P_LEN,
+                   page_size=128, prompt_buckets=(32, 128))
+    p_fe = _PwFE(p_eng, max_queue=2 * P_REQ, segment=P_SEG)
+    p_fe.warmup()  # arms the compile watchdog (serving phase begins)
+    rng_p = np.random.RandomState(29)
+    p_lens = rng_p.randint(8, 28, P_REQ)
+    mk_p = lambda: [rng_p.randint(0, cfg.vocab_size,
+                                  (int(n),)).astype(np.int32)
+                    for n in p_lens]
+    for p in mk_p()[:2]:  # warm pass (first-dispatch/tunnel overheads)
+        p_fe.submit(p, max_new_tokens=2)
+    p_fe.results(wait=True, timeout=600)
+    c_before = _pw_tele.counter("xla.compiles_total").value(
+        phase="serving")
+    p_tok_s = {0: 0.0, 1: 0.0}
+    for rep in range(2):  # interleaved best-of-2 per arm (RTT jitter)
+        for arm in (0, 1):
+            _pw_setf({"FLAGS_telemetry": arm})
+            t_arm = time.time()
+            p_rids = [p_fe.submit(p, max_new_tokens=P_NEW)
+                      for p in mk_p()]
+            p_res = p_fe.results(wait=True, timeout=600)
+            arm_wall = time.time() - t_arm
+            assert all(p_res[r].status == "ok" for r in p_rids), \
+                {r: p_res[r].status for r in p_rids}
+            toks = sum(len(p_res[r].tokens) for r in p_rids)
+            p_tok_s[arm] = max(p_tok_s[arm], toks / arm_wall)
+    _pw_setf({"FLAGS_telemetry": 1})
+    pw_overhead_pct = (100.0 * (1.0 - p_tok_s[1] / p_tok_s[0])
+                       if p_tok_s[0] > 0 else 0.0)
+    serving_compiles = (_pw_tele.counter("xla.compiles_total").value(
+        phase="serving") - c_before)
+    p_phases = p_eng.stats()["phases"]
+    pw_metrics = {
+        "perfwatch_overhead_pct": round(max(pw_overhead_pct, 0.0), 3),
+        "perfwatch_on_tokens_per_sec": round(p_tok_s[1], 1),
+        "perfwatch_off_tokens_per_sec": round(p_tok_s[0], 1),
+        "perfwatch_serving_compiles": int(serving_compiles),
+        "perfwatch_segment_dispatch_us_p50": round(
+            1e6 * p_phases.get("segment_dispatch", {}).get("p50", 0.0), 1),
+        "perfwatch_device_wait_us_p50": round(
+            1e6 * p_phases.get("device_wait", {}).get("p50", 0.0), 1),
+        "perfwatch_host_bookkeeping_us_p50": round(
+            1e6 * p_phases.get("host_bookkeeping", {}).get("p50", 0.0), 1),
+    }
+    p_fe.shutdown(drain=True)
+    if serving_compiles:
+        log(f"perfwatch: INVARIANT VIOLATION — {serving_compiles} "
+            "post-warmup XLA recompile(s) on the serving path (expected "
+            "0; see the flight-*-recompile.json dump; bench_trend gates "
+            "this nonzero)")
+    log(f"perfwatch: {p_tok_s[1]:,.0f} tok/s on vs {p_tok_s[0]:,.0f} off "
+        f"-> overhead {pw_metrics['perfwatch_overhead_pct']}% of active "
+        f"processing (gate: < 3%); post-warmup serving compiles "
+        f"{serving_compiles} (invariant: 0, gated in bench_trend); "
+        f"phase p50s "
+        f"dispatch={pw_metrics['perfwatch_segment_dispatch_us_p50']}us "
+        f"wait={pw_metrics['perfwatch_device_wait_us_p50']}us "
+        f"bookkeep={pw_metrics['perfwatch_host_bookkeeping_us_p50']}us")
+except Exception as e:
+    log(f"perfwatch section FAILED: {type(e).__name__}: {e}")
+    pw_metrics = {"perfwatch_error": f"{type(e).__name__}: {e}"[:200]}
+
 # ------------------------------------------------------- (f) op microbench
 # Per-op regression gate (reference: tools/ci_op_benchmark.sh relative
 # check): ~20 hot ops + eager dispatch overhead, compared against the
@@ -979,6 +1070,7 @@ result = {
     **fleet_metrics,
     **journal_metrics,
     **tele_metrics,
+    **pw_metrics,
     "op_bench_us": op_results,
     "op_bench_vs_baseline": op_vs_baseline,
     "op_bench_regressions": op_regressions,

@@ -1,0 +1,486 @@
+"""Performance observability over the PR 9 telemetry registry.
+
+``core/telemetry.py`` answers "what is the fleet doing"; this layer
+answers the PERFORMANCE questions the ROADMAP's open items need answered
+in production before they can be attacked:
+
+* **Step-time attribution** — where do a decode step's microseconds go?
+  The serving engine observes every scheduler phase into ONE labeled
+  histogram, ``serving.phase_s{phase=...}``:
+
+  - ``prefill`` / ``chunked_prefill`` — admission dispatches (host prep
+    + the synchronous first-token fetch, so device time is included);
+  - ``segment_dispatch`` — host time to build and issue one compiled
+    decode segment (async: the device keeps running after it returns);
+  - ``device_wait`` — the blocking ``device_get`` when a segment's
+    outputs are consumed (device compute not hidden by the pipeline);
+  - ``host_bookkeeping`` — token collection / retirement;
+  - ``host_gap`` — the between-segment host gap ``stats()['host_gap_ms']``
+    already tracks, now with a full distribution.
+
+  :func:`phase_summaries` renders p50/p95/p99 + mean per phase from the
+  live registry or any (fleet-merged) snapshot — the measurement side of
+  the decode-megakernel item (a fused kernel must beat the attributed
+  ``segment_dispatch``+``device_wait`` budget, not a guess).
+
+* **Memory watchdog** — :class:`MemoryWatchdog` polls
+  ``paddle_tpu.device.memory_stats()`` (PJRT) into
+  ``device.bytes_in_use`` / ``device.peak_bytes_in_use`` /
+  ``device.bytes_limit`` gauges and fires a ``memory_hwm`` flight event
+  (+ post-mortem dump, once per crossing with hysteresis) when usage
+  crosses ``FLAGS_memory_hwm_pct`` of the limit. Backends without
+  memory introspection (CPU) degrade GRACEFULLY: the gauges stay ABSENT
+  — never zero/garbage — and
+  ``perfwatch.memory_stats_unavailable`` counts the attempts. The
+  engine adds the logical KV side (per-request bytes, slot occupancy,
+  page fragmentation) in ``models/serving.py`` — the measurement side
+  of the paged-KV item.
+
+* **SLO monitor** — :class:`SLOMonitor` holds declared objectives
+  (TTFT, per-token latency: a threshold in seconds + a target fraction)
+  and computes rolling-window goodput and MULTI-WINDOW BURN RATE from
+  the PR 9 serving histograms: each ``tick()`` snapshots the cumulative
+  (total, good-within-threshold) pair per objective (good counts are
+  interpolated from the histogram buckets at the threshold), and the
+  burn rate over a window is ``error_rate / error_budget`` between the
+  two snapshots bracketing it. The alarm flips when EVERY window burns
+  above ``FLAGS_slo_burn_threshold`` (a short window alone is noise; a
+  long window alone is too slow — the standard multi-window rule).
+  ``ServingFrontend`` exposes the status in ``health()['slo']`` and —
+  only behind ``FLAGS_slo_shedding`` — sheds admissions below
+  ``FLAGS_slo_shed_below_priority`` while the alarm is up
+  (``serving.slo_shed``); ``ServingRouter.fleet_metrics()['slo']``
+  evaluates the same objectives over the fleet-merged histograms.
+
+Everything here is default-on behind ``FLAGS_telemetry`` (the hot paths
+observe only when ``telemetry.enabled()``); bench section (e6) gates the
+whole layer's cost < 3% of active processing, same A/B methodology as
+PR 9's e5.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from . import telemetry
+from .flags import define_flag, flag
+
+__all__ = [
+    "observe_phase", "phase_summaries", "PHASES",
+    "MemoryWatchdog", "memory_watchdog",
+    "SLOMonitor", "Objective", "default_objectives",
+]
+
+define_flag("FLAGS_memory_hwm_pct", 90.0,
+            "Device-memory high watermark (% of bytes_limit) past which "
+            "the memory watchdog records a memory_hwm flight event and "
+            "dumps the flight recorder (once per crossing; re-arms when "
+            "usage falls below ~80% of the watermark)")
+define_flag("FLAGS_memory_poll_interval_s", 0.5,
+            "Min seconds between device.memory_stats() polls on the "
+            "serving path (maybe_poll rate limit)")
+define_flag("FLAGS_slo_ttft_s", 1.0,
+            "TTFT objective threshold (seconds) for the SLO monitor")
+define_flag("FLAGS_slo_token_s", 0.25,
+            "Per-token decode-latency objective threshold (seconds)")
+define_flag("FLAGS_slo_target", 0.99,
+            "SLO target fraction: this share of requests must land "
+            "within the objective threshold (error budget = 1 - target)")
+define_flag("FLAGS_slo_windows", "30,300",
+            "Comma-separated burn-rate window lengths in seconds, "
+            "shortest first (multi-window alarm: ALL must burn)")
+define_flag("FLAGS_slo_burn_threshold", 2.0,
+            "Burn-rate alarm threshold: error_rate/error_budget above "
+            "this on EVERY window flips the alarm")
+define_flag("FLAGS_slo_shedding", False,
+            "When the SLO burn alarm is up, shed frontend admissions "
+            "below FLAGS_slo_shed_below_priority (default OFF: the "
+            "monitor observes; shedding is an explicit operator opt-in)")
+define_flag("FLAGS_slo_shed_below_priority", 1,
+            "Admissions with priority strictly below this are shed "
+            "while the burn alarm is up (with FLAGS_slo_shedding on)")
+
+# ------------------------------------------------------ phase attribution
+
+PHASES = ("prefill", "chunked_prefill", "segment_dispatch", "device_wait",
+          "host_bookkeeping", "host_gap")
+
+# phase durations span ~10us (a pipelined dispatch) to seconds (a cold
+# chunked prefill): finer-than-default low end
+_PHASE_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, 1e-3, 2.5e-3,
+                  5e-3, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+
+_M_PHASE = telemetry.histogram(
+    "serving.phase_s", "engine scheduler time by phase (prefill / "
+    "chunked_prefill / segment_dispatch / device_wait / "
+    "host_bookkeeping / host_gap) — see core/perfwatch.py for the "
+    "device-vs-host semantics of each label", buckets=_PHASE_BUCKETS)
+
+
+def observe_phase(phase, dur_s):
+    """One phase observation (callers gate on ``telemetry.enabled()``)."""
+    _M_PHASE.observe(dur_s, phase=phase)
+
+
+def phase_summaries(snapshot=None) -> dict:
+    """Per-phase p50/p95/p99 + count/mean (seconds) from the live
+    registry, or from a (possibly fleet-merged) snapshot dict. Phases
+    nobody observed are absent."""
+    out = {}
+    if snapshot is None:
+        for key in _M_PHASE.series():
+            phase = dict(key).get("phase")
+            if phase is not None:
+                out[phase] = _M_PHASE.summary(phase=phase)
+        return out
+    prefix = "serving.phase_s{"
+    for name in (snapshot.get("histograms") or {}):
+        if not name.startswith(prefix):
+            continue
+        labels = dict(p.split("=", 1)
+                      for p in name[len(prefix):-1].split(","))
+        phase = labels.get("phase")
+        if phase is not None:
+            out[phase] = telemetry.summary_from_snapshot(snapshot, name)
+    return out
+
+
+# -------------------------------------------------------- memory watchdog
+
+_M_MEM_USE = telemetry.gauge(
+    "device.bytes_in_use", "PJRT allocator bytes in use (absent on "
+    "backends without memory_stats)")
+_M_MEM_PEAK = telemetry.gauge(
+    "device.peak_bytes_in_use", "PJRT allocator peak bytes in use")
+_M_MEM_LIMIT = telemetry.gauge(
+    "device.bytes_limit", "PJRT allocator capacity")
+_M_MEM_UNAVAIL = telemetry.counter(
+    "perfwatch.memory_stats_unavailable", "memory_stats() polls that "
+    "returned nothing (CPU backends) — the gauges stay absent")
+
+
+class MemoryWatchdog:
+    """Poll PJRT memory stats into gauges + a high-watermark flight
+    event. One instance per process is enough (``memory_watchdog()``);
+    ``maybe_poll()`` rate-limits itself so hot loops can call it
+    unconditionally."""
+
+    def __init__(self, device_id=0, hwm_pct=None, min_interval_s=None):
+        self.device_id = int(device_id)
+        self._hwm_pct = hwm_pct
+        self._interval = min_interval_s
+        self._lock = threading.Lock()
+        self._last_poll = None
+        self._hwm_fired = False
+        self.available = None  # unknown until the first poll
+
+    def poll(self):
+        """One ``device.memory_stats()`` read. Returns the stats dict,
+        or None when the backend exposes none — in which case the gauges
+        are left ABSENT (a dashboard must read "no data", not "0 bytes
+        on a 16GB chip")."""
+        from .. import device as _device
+
+        self._last_poll = time.monotonic()
+        try:
+            stats = _device.memory_stats(self.device_id) or {}
+        except Exception:  # noqa: BLE001 — introspection must never
+            # take down the serving path it watches
+            stats = {}
+        in_use = stats.get("bytes_in_use")
+        if in_use is None:
+            self.available = False
+            _M_MEM_UNAVAIL.inc()
+            return None
+        self.available = True
+        _M_MEM_USE.set(int(in_use))
+        peak = stats.get("peak_bytes_in_use")
+        if peak is not None:
+            _M_MEM_PEAK.set(int(peak))
+        limit = stats.get("bytes_limit")
+        if limit:
+            _M_MEM_LIMIT.set(int(limit))
+            self._check_hwm(int(in_use), int(limit))
+        return stats
+
+    def maybe_poll(self):
+        """Rate-limited :meth:`poll` for per-step call sites."""
+        interval = (self._interval if self._interval is not None
+                    else float(flag("FLAGS_memory_poll_interval_s")))
+        with self._lock:
+            now = time.monotonic()
+            if (self._last_poll is not None
+                    and now - self._last_poll < interval):
+                return None
+            self._last_poll = now
+        return self.poll()
+
+    def _check_hwm(self, in_use, limit):
+        hwm = (self._hwm_pct if self._hwm_pct is not None
+               else float(flag("FLAGS_memory_hwm_pct"))) / 100.0
+        pct = in_use / limit
+        if pct >= hwm:
+            if not self._hwm_fired:
+                self._hwm_fired = True
+                telemetry.flight_dump(
+                    "memory_hwm", device=self.device_id,
+                    bytes_in_use=in_use, bytes_limit=limit,
+                    pct=round(100.0 * pct, 1))
+        elif pct < hwm * 0.8:
+            # hysteresis: don't re-dump on every oscillation around the
+            # watermark, but a real second incident after recovery fires
+            self._hwm_fired = False
+
+
+_memwatch = MemoryWatchdog()
+
+
+def memory_watchdog() -> MemoryWatchdog:
+    return _memwatch
+
+
+# ------------------------------------------------------------ SLO monitor
+
+class Objective:
+    """One declared latency objective: ``target`` fraction of samples of
+    histogram ``hist`` must land within ``threshold_s``."""
+
+    __slots__ = ("name", "hist", "threshold_s", "target")
+
+    def __init__(self, name, hist, threshold_s, target):
+        self.name = str(name)
+        self.hist = str(hist)
+        self.threshold_s = float(threshold_s)
+        self.target = float(target)
+        if not 0.0 < self.target < 1.0:
+            raise ValueError(
+                f"SLO target must be in (0, 1), got {self.target}")
+
+
+def default_objectives() -> list:
+    """The declared serving objectives, from flags: TTFT and per-token
+    decode latency over the PR 9 histograms."""
+    target = float(flag("FLAGS_slo_target"))
+    return [
+        Objective("ttft", "serving.ttft_s",
+                  flag("FLAGS_slo_ttft_s"), target),
+        Objective("token_latency", "serving.token_latency_s",
+                  flag("FLAGS_slo_token_s"), target),
+    ]
+
+
+def _count_within(row, threshold) -> float:
+    """Samples <= threshold estimated from one histogram series row
+    (``{count, bounds, buckets, sample}``) — cumulative finite buckets
+    with linear interpolation inside the crossing bucket; the +inf
+    bucket never counts as good. When the buckets are gone (a
+    bounds-mismatched ``merge_snapshots`` invalidates them to None —
+    mixed code versions in a rolling fleet), the merged RESERVOIR
+    estimates the good fraction instead: reading a healthy fleet as
+    0% goodput would flip a false burn alarm, the exact garbage-output
+    case the merge hardening exists to prevent."""
+    bounds = row.get("bounds") or ()
+    buckets = row.get("buckets")
+    if not bounds or not buckets:
+        sample = row.get("sample") or ()
+        if sample:
+            frac = sum(1 for v in sample if v <= threshold) / len(sample)
+            return float(row.get("count", 0)) * frac
+        return 0.0
+    acc = 0.0
+    lo = 0.0
+    for i, b in enumerate(bounds):
+        c = buckets[i]
+        if b <= threshold:
+            acc += c
+            lo = b
+            continue
+        if threshold > lo and b > lo:
+            acc += c * (threshold - lo) / (b - lo)
+        return acc
+    return acc
+
+
+class SLOMonitor:
+    """Rolling-window goodput + multi-window burn rate over the serving
+    latency histograms.
+
+    ``tick(now)`` appends one cumulative ``(now, total, good)`` snapshot
+    per objective (reading the process registry, or ``source()`` — a
+    fleet-merged snapshot provider). ``status(now)`` computes, per
+    objective and per window, the delta between the snapshot bracketing
+    the window start and now:
+
+    * ``goodput`` = good/total over the window (1.0 when idle — no
+      traffic burns no budget);
+    * ``burn`` = (1 - goodput) / (1 - target): 1.0 means errors arrive
+      exactly at the budgeted rate; the alarm threshold (default 2.0)
+      means the budget is burning at least twice too fast.
+
+    The ALARM requires every window above threshold with at least
+    ``min_count`` samples in the shortest one — a single slow request
+    in an idle second must not shed traffic. Time is monotonic;
+    ``now=`` overrides exist for deterministic drills."""
+
+    def __init__(self, objectives=None, windows=None, burn_threshold=None,
+                 min_count=8, source=None, shed_below=None):
+        self.objectives = (list(objectives) if objectives is not None
+                           else default_objectives())
+        self._windows = windows
+        self._burn_threshold = burn_threshold
+        self.min_count = int(min_count)
+        self._source = source
+        self._shed_below = shed_below
+        self._lock = threading.Lock()
+        self._samples: dict[str, list] = {o.name: []
+                                          for o in self.objectives}
+        self._alarm = False
+        self._status_cache = None   # (monotonic ts, status dict)
+
+    def windows(self) -> tuple:
+        if self._windows is not None:
+            return tuple(self._windows)
+        return tuple(sorted(float(w) for w in
+                            str(flag("FLAGS_slo_windows")).split(",") if w))
+
+    def burn_threshold(self) -> float:
+        return (float(self._burn_threshold)
+                if self._burn_threshold is not None
+                else float(flag("FLAGS_slo_burn_threshold")))
+
+    # ------------------------------------------------------------ ticking
+
+    def _row(self, obj):
+        """Cumulative (total, good) for one objective right now."""
+        if self._source is not None:
+            snap = self._source() or {}
+            row = (snap.get("histograms") or {}).get(obj.hist)
+        else:
+            row = telemetry.histogram(obj.hist).snapshot_series().get(())
+        if not row or not row.get("count"):
+            return 0, 0.0
+        return int(row["count"]), _count_within(row, obj.threshold_s)
+
+    def tick(self, now=None):
+        """Record one cumulative snapshot per objective and prune
+        samples older than twice the longest window. Auto-clocked ticks
+        (``now=None`` — health polls, pump turns) rate-limit themselves
+        to ~10 per shortest window so a hot poll loop cannot grow the
+        sample rings; an explicit ``now`` always records (drills)."""
+        windows = self.windows()
+        if now is None:
+            now = time.monotonic()
+            interval = max(min(windows) / 10.0, 0.05) if windows else 1.0
+            with self._lock:
+                rows = next(iter(self._samples.values()), None)
+                if rows and now - rows[-1][0] < interval:
+                    return
+        else:
+            now = float(now)
+        horizon = now - 2.0 * (max(windows) if windows else 300.0)
+        with self._lock:
+            for obj in self.objectives:
+                total, good = self._row(obj)
+                rows = self._samples[obj.name]
+                rows.append((now, total, good))
+                while len(rows) > 1 and rows[0][0] < horizon:
+                    rows.pop(0)
+
+    # ------------------------------------------------------------- status
+
+    def _window_delta(self, rows, now, window):
+        """(d_total, d_good) between the newest snapshot at or before
+        ``now - window`` (falling back to the oldest) and the latest."""
+        if len(rows) < 2:
+            return 0, 0.0
+        cut = now - window
+        base = rows[0]
+        for r in rows:
+            if r[0] <= cut:
+                base = r
+            else:
+                break
+        last = rows[-1]
+        return max(last[1] - base[1], 0), max(last[2] - base[2], 0.0)
+
+    def status(self, now=None) -> dict:
+        """Tick, then evaluate every objective; updates the cached alarm
+        :meth:`should_shed` reads. Plain ints/floats/bools — the dict
+        rides ``health()`` across the RPC wire."""
+        # auto-clocked calls (health polls, every pump turn) are served
+        # from a short-lived cache on the tick cadence: the burn rate
+        # only moves when a tick lands, and a hot pump loop must not pay
+        # a full evaluation per step. Explicit ``now`` (drills) always
+        # evaluates.
+        if now is None:
+            windows = self.windows()
+            ttl = max(min(windows) / 10.0, 0.05) if windows else 1.0
+            cached = self._status_cache
+            t = time.monotonic()
+            if cached is not None and t - cached[0] < ttl:
+                return cached[1]
+        # tick BEFORE resolving now: an auto-clocked call must keep the
+        # tick's rate limiter engaged — appending (and then scanning) a
+        # sample row per pump turn would grow without the traffic moving
+        self.tick(now)
+        now = time.monotonic() if now is None else float(now)
+        threshold = self.burn_threshold()
+        windows = self.windows()
+        out = {"alarm": False, "burn_threshold": threshold,
+               "windows_s": list(windows), "objectives": {}}
+        any_alarm = False
+        with self._lock:
+            for obj in self.objectives:
+                rows = self._samples[obj.name]
+                burns = {}
+                goodputs = {}
+                counts = {}
+                obj_alarm = len(windows) > 0
+                for w in windows:
+                    d_total, d_good = self._window_delta(rows, now, w)
+                    key = f"{w:g}s"
+                    counts[key] = d_total
+                    if d_total <= 0:
+                        goodputs[key] = 1.0
+                        burns[key] = 0.0
+                        obj_alarm = False
+                        continue
+                    gp = min(d_good / d_total, 1.0)
+                    goodputs[key] = gp
+                    burns[key] = (1.0 - gp) / max(1.0 - obj.target, 1e-9)
+                    if burns[key] <= threshold:
+                        obj_alarm = False
+                # volume floor on the SHORTEST window: a single slow
+                # request in an idle second is not an incident
+                if (windows and counts.get(f"{min(windows):g}s", 0)
+                        < self.min_count):
+                    obj_alarm = False
+                out["objectives"][obj.name] = {
+                    "hist": obj.hist,
+                    "threshold_s": obj.threshold_s,
+                    "target": obj.target,
+                    "goodput": goodputs,
+                    "burn": burns,
+                    "window_count": counts,
+                    "alarm": obj_alarm,
+                }
+                any_alarm = any_alarm or obj_alarm
+            self._alarm = any_alarm
+        out["alarm"] = any_alarm
+        self._status_cache = (time.monotonic(), out)
+        return out
+
+    def alarm(self) -> bool:
+        """Cached verdict of the last :meth:`status` evaluation."""
+        with self._lock:
+            return self._alarm
+
+    def should_shed(self, priority) -> bool:
+        """True when burn-rate shedding is ON (``FLAGS_slo_shedding``),
+        the alarm is up, and the admission's priority is below the
+        protected class — the frontend's pre-queue check."""
+        if not flag("FLAGS_slo_shedding") or not self.alarm():
+            return False
+        below = (self._shed_below if self._shed_below is not None
+                 else int(flag("FLAGS_slo_shed_below_priority")))
+        return int(priority) < below

@@ -854,6 +854,16 @@ from .serialization import (  # noqa: E402,F401
 
 __all__ += ["save", "load", "save_generate", "TranslatedLayer"]
 
+from .compile_watch import (  # noqa: E402,F401
+    BACKEND_COMPILE_EVENT,
+    CompileWatchdog,
+    compile_watchdog,
+    count_backend_compiles,
+)
+
+__all__ += ["CompileWatchdog", "compile_watchdog",
+            "count_backend_compiles", "BACKEND_COMPILE_EVENT"]
+
 
 # ---- namespace parity tail (reference python/paddle/jit/__init__.py)
 

@@ -45,7 +45,7 @@ import time
 
 import numpy as np
 
-from ..core import telemetry
+from ..core import perfwatch, telemetry
 from ..core.resilience import CircuitBreaker, Deadline, bump_counter
 from .serving import TERMINAL_STATES as _ENGINE_TERMINAL
 
@@ -67,6 +67,10 @@ _M_QWAIT = telemetry.histogram(
     "serving.queue_wait_s", "frontend admission-queue wait, submit -> "
     "engine admission")
 _M_REQS = telemetry.counter("serving.requests_total")
+_M_SLO_SHED = telemetry.counter(
+    "serving.slo_shed", "admissions shed by the SLO burn-rate monitor "
+    "(FLAGS_slo_shedding on, alarm up, priority below the protected "
+    "class)")
 
 # the latency histograms every health/stats summary reads, keyed by the
 # short name the payloads use
@@ -162,8 +166,13 @@ class ServingFrontend:
     def __init__(self, engine, max_queue=64, max_queued_tokens=None,
                  default_max_new_tokens=64, segment=16, breaker=None,
                  breaker_threshold=5, breaker_cooldown_s=30.0,
-                 watchdog=None, watch_name="serving.step"):
+                 watchdog=None, watch_name="serving.step", slo=None):
         self.engine = engine
+        # SLO monitor (perfwatch): declared TTFT / per-token objectives
+        # evaluated over the process registry histograms. Always present
+        # (status() is cheap and gated); shedding only ever engages
+        # behind FLAGS_slo_shedding.
+        self.slo = slo if slo is not None else perfwatch.SLOMonitor()
         self.max_queue = int(max_queue)
         self.max_queued_tokens = max_queued_tokens
         self.default_max_new_tokens = int(default_max_new_tokens)
@@ -257,6 +266,16 @@ class ServingFrontend:
                     max(rid + 1, next(self._rids)))
         if self._closed or self._draining:
             return self._reject(rid, "shutting down")
+        if telemetry.enabled() and self.slo.should_shed(priority):
+            # burn-rate shedding (FLAGS_slo_shedding): while the SLO
+            # error budget burns past threshold, low-priority admissions
+            # are turned away at the door so the protected classes keep
+            # their latency — the frontend-local form of the same
+            # degrade-don't-collapse policy the queue eviction applies
+            _M_SLO_SHED.inc()
+            return self._reject(
+                rid, "slo burn-rate shed (error budget burning; "
+                     f"priority {int(priority)} below protected class)")
         max_new = (self.default_max_new_tokens if max_new_tokens is None
                    else int(max_new_tokens))
         try:
@@ -369,6 +388,10 @@ class ServingFrontend:
         self._queue = live
 
     def _step(self):
+        if telemetry.enabled():
+            # keep the burn-rate windows current even when nobody polls
+            # health(); rate-limited inside the monitor
+            self.slo.status()
         self._sweep_expired()
         room = self.engine.free_slots() - len(self.engine.queued_requests())
         while room > 0 and self._queue:
@@ -585,4 +608,7 @@ class ServingFrontend:
             "kv_slots": total,
             "kv_occupancy": (active / total) if total else 0.0,
             "latency": latency_summaries(),
+            # perfwatch SLO verdict: objectives, rolling goodput,
+            # multi-window burn rate, the alarm the shedding flag acts on
+            "slo": (self.slo.status() if telemetry.enabled() else {}),
         }

@@ -44,7 +44,7 @@ import uuid
 
 import numpy as np
 
-from ..core import telemetry
+from ..core import perfwatch, telemetry
 from ..core.resilience import (
     Deadline,
     ServingUnavailable,
@@ -200,6 +200,11 @@ class ReplicaServer:
         except Exception:  # noqa: BLE001 — a failed snapshot keeps the
             # previous view; the router's probe still answers
             bump_counter("serving.remote_health_error")
+        if telemetry.enabled():
+            # device-memory gauges ride this REPLICA's registry snapshot
+            # to the store (rate-limited inside the watchdog), so
+            # fleet_metrics() sees every process's HBM, not the router's
+            perfwatch.memory_watchdog().maybe_poll()
 
     def check_fence(self, fence):
         """Leader-fencing gate (HA router): remember the highest fencing

@@ -79,7 +79,7 @@ import time
 
 import numpy as np
 
-from ..core import telemetry
+from ..core import perfwatch, telemetry
 from ..core.resilience import (
     CircuitBreaker,
     Deadline,
@@ -238,6 +238,12 @@ class ServingRouter:
         # the fleet tokens/s rate is computed over
         self._last_fleet = None
         self._fm_prev = None
+        # fleet-level SLO monitor (perfwatch): evaluates the declared
+        # objectives over the MERGED histograms (router + every
+        # replica's store-published snapshot), so the burn rate is the
+        # fleet's, not one process's — built lazily at first
+        # fleet_metrics() call
+        self._slo_fleet = None
         # ---- durability / hot standby (see module docstring)
         self._journal = journal
         self._journal_root = journal_root
@@ -1435,6 +1441,11 @@ class ServingRouter:
         * ``tokens_total`` and ``tokens_per_sec`` (rate over the window
           since the previous ``fleet_metrics()`` call);
         * ``replicas`` — per-replica state + router-side breaker state;
+        * ``phases`` — fleet-wide step-time attribution (perfwatch
+          ``serving.phase_s`` percentiles per scheduler phase);
+        * ``slo`` — the declared TTFT/per-token objectives evaluated
+          over the merged histograms (rolling goodput + multi-window
+          burn rate + alarm);
         * ``metrics`` — the full merged snapshot (counters incl. the
           whole resilience ledger, gauges, histograms) for export.
         """
@@ -1450,9 +1461,18 @@ class ServingRouter:
                 rate = (tokens - pt) / (now - pts)
         self._fm_prev = (tokens, now)
         self._last_fleet = merged
+        if self._slo_fleet is None:
+            self._slo_fleet = perfwatch.SLOMonitor(
+                source=lambda: self._last_fleet)
         return {
             "metrics": merged,
             "latency": latency_summaries(merged),
+            # perfwatch: fleet-wide step-time attribution + SLO verdict
+            # over the merged histograms
+            "phases": (perfwatch.phase_summaries(merged)
+                       if telemetry.enabled() else {}),
+            "slo": (self._slo_fleet.status()
+                    if telemetry.enabled() else {}),
             "tokens_total": tokens,
             "tokens_per_sec": rate,
             "replicas": {r.id: {"state": r.state,

@@ -45,7 +45,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import telemetry
+from ..core import perfwatch, telemetry
 from ..core.flags import define_flag, flag
 from ..core.resilience import Deadline, InjectedFault, bump_counter, inject
 from ..core.tensor import Tensor
@@ -81,6 +81,35 @@ _M_TOKENS = telemetry.counter(
     "serving.tokens_total", "tokens emitted by the engine scheduler")
 _M_REQS = telemetry.counter(
     "serving.requests_total", "terminal request verdicts, by status")
+# KV-occupancy accounting (perfwatch): the measurement side of the
+# paged-KV roadmap item — logical occupancy of the preallocated page
+# pool, not PJRT allocator bytes (the pool is allocated up front; the
+# watchdog gauges device.* cover the allocator).
+_M_KV_BYTES = telemetry.gauge(
+    "serving.kv_bytes_in_use", "KV bytes logically occupied by active "
+    "slots (whole pages, the paged-cache allocation granularity)")
+_M_KV_OCC = telemetry.gauge(
+    "serving.kv_slot_occupancy", "active slots / total slots")
+_M_KV_FRAG = telemetry.gauge(
+    "serving.kv_fragmentation_pct", "interior waste of occupied pages: "
+    "100 * (1 - used tokens / page-granular capacity) over active slots")
+_M_KV_REQ = telemetry.histogram(
+    "serving.kv_request_bytes", "per-request KV footprint at retirement "
+    "(prompt + emitted tokens, page-rounded)",
+    buckets=tuple(float(2 ** p) for p in range(10, 31, 2)))
+
+
+_cwd = None
+
+
+def _compile_watchdog():
+    """Lazy jit-layer import (the jit package imports heavy deps)."""
+    global _cwd
+    if _cwd is None:
+        from ..jit.compile_watch import compile_watchdog
+
+        _cwd = compile_watchdog()
+    return _cwd
 
 
 class Request:
@@ -252,6 +281,11 @@ class ContinuousBatchingEngine:
         self._seed = int(seed)
         self._zeros_cache: dict[tuple, jnp.ndarray] = {}
         self._aot: dict[tuple, object] = {}
+        # KV accounting invariants (perfwatch): bytes one token's K+V
+        # rows cost across all layers, at the cache dtype
+        self._kv_bytes_per_token = int(
+            self._nl * 2 * kv * cfg.head_dim * np.dtype(dtype).itemsize)
+        self._warmed = False
         self._prefill_p = None
         self._segment_p = None
         self._build_programs()
@@ -360,10 +394,24 @@ class ContinuousBatchingEngine:
         """Dispatch through the AOT-compiled executable when ``warmup()``
         built one for this shape, else through the lazily-compiling jitted
         program (``fallback`` is looked up at call time so tests can
-        monkeypatch ``_segment_p``/``_chunk_p``/...)."""
+        monkeypatch ``_segment_p``/``_chunk_p``/...).
+
+        On a WARMED engine the fallback path is itself the anomaly —
+        this shape was not in the warmup set — so it runs inside the
+        compile watchdog's dispatch context: if XLA compiles in there,
+        the watchdog counts ``xla.compiles_total{phase=serving}`` and
+        dumps a flight record naming ``key`` and the operand shapes."""
         exe = self._aot.get(key)
         if exe is not None:
             return exe(*args)
+        if self._warmed and telemetry.enabled():
+            # operand shapes: skip params/ks/vs (their shapes are
+            # engine-static); the trailing args carry the traced shape
+            # that missed the warmup set
+            shapes = [list(a.shape) for a in args[3:]
+                      if hasattr(a, "shape")]
+            with _compile_watchdog().dispatch_context(key, shapes=shapes):
+                return fallback(*args)
         return fallback(*args)
 
     def _group_width(self, n):
@@ -406,6 +454,20 @@ class ContinuousBatchingEngine:
 
             enable_compilation_cache(cache_dir)
         t0 = time.monotonic()
+        # compile watchdog: everything below is warmup-phase compilation;
+        # once done, this engine's non-AOT dispatches become recompile
+        # incidents (see _call)
+        wd = _compile_watchdog().start()
+        with wd.warmup_scope():
+            stats = self._warmup_compile(segment)
+        self._warmed = True
+        wd.arm()
+        stats["seconds"] = time.monotonic() - t0
+        return stats
+
+    def _warmup_compile(self, segment):
+        """The warmup compile loop (split out so :meth:`warmup` can
+        scope it under the compile watchdog)."""
         with self._swap_lock:
             params = {k: p._value
                       for k, p in self.model.named_parameters()}
@@ -451,7 +513,6 @@ class ContinuousBatchingEngine:
                  jax.ShapeDtypeStruct((m,), jnp.bool_),
                  jax.ShapeDtypeStruct((m,), i32),
                  jax.ShapeDtypeStruct((seg, m) + self._key_shape, kdt))
-        stats["seconds"] = time.monotonic() - t0
         return stats
 
     # ------------------------------------------------------- sampling keys
@@ -682,6 +743,15 @@ class ContinuousBatchingEngine:
         self._counts[status] = self._counts.get(status, 0) + 1
         if telemetry.enabled():
             _M_REQS.inc(status=status)
+            if req.t_first is not None:
+                # the request's KV footprint at the page granularity it
+                # actually occupied (what a block allocator would free
+                # here) — only requests that were ADMITTED (prefilled
+                # into a slot); a queue-expired request held no pages
+                used = req.prompt.size + len(req.tokens)
+                pages = -(-used // self.page_size)
+                _M_KV_REQ.observe(pages * self.page_size
+                                  * self._kv_bytes_per_token)
             if req.t_first is not None and len(req.tokens) > 1:
                 _M_TOK.observe((time.monotonic() - req.t_first)
                                / (len(req.tokens) - 1))
@@ -807,6 +877,7 @@ class ContinuousBatchingEngine:
             padded[i, :req.prompt.size] = req.prompt
             true_lens[i] = req.prompt.size
             rows[i] = slot
+        t0 = time.monotonic()
         with annotate("serving.prefill", **self._group_trace_args(group)):
             tok0, self._ks, self._vs = self._call(
                 ("prefill", bucket, g), self._prefill_p,
@@ -814,6 +885,8 @@ class ContinuousBatchingEngine:
                 jnp.asarray(self._tables_np[rows]), jnp.asarray(true_lens),
                 self._prefill_keys(group, g))
             tok0 = np.asarray(tok0)
+        if telemetry.enabled():
+            perfwatch.observe_phase("prefill", time.monotonic() - t0)
         for i, (slot, req) in enumerate(group):
             self._finish_admit(slot, req, tok0[i], finished)
 
@@ -856,12 +929,16 @@ class ContinuousBatchingEngine:
                     chunk_arr[i] = p[c * chunk_w:(c + 1) * chunk_w]
                     bases[i] = c * chunk_w
                     rows[i] = slot
+            t0 = time.monotonic()
             with annotate("serving.chunked_prefill",
                           **self._group_trace_args(live)):
                 self._ks, self._vs = self._call(
                     ("chunk", g), self._chunk_p,
                     self._params, self._ks, self._vs, jnp.asarray(chunk_arr),
                     jnp.asarray(self._tables_np[rows]), jnp.asarray(bases))
+            if telemetry.enabled():
+                perfwatch.observe_phase("chunked_prefill",
+                                        time.monotonic() - t0)
             c += 1
         if live:
             g = self._group_width(len(live))
@@ -877,6 +954,7 @@ class ContinuousBatchingEngine:
                 bases[i] = done
                 true_rem[i] = rem
                 rows[i] = slot
+            t0 = time.monotonic()
             with annotate("serving.chunked_prefill",
                           **self._group_trace_args(live)):
                 tok0, self._ks, self._vs = self._call(
@@ -885,6 +963,9 @@ class ContinuousBatchingEngine:
                     jnp.asarray(self._tables_np[rows]), jnp.asarray(bases),
                     jnp.asarray(true_rem), self._prefill_keys(live, g))
                 tok0 = np.asarray(tok0)
+            if telemetry.enabled():
+                perfwatch.observe_phase("chunked_prefill",
+                                        time.monotonic() - t0)
             for i, (slot, req) in enumerate(live):
                 self._finish_admit(slot, req, tok0[i], finished)
         for _, req in expired:
@@ -900,9 +981,12 @@ class ContinuousBatchingEngine:
         Returns the in-flight handle consumed later by ``_consume``."""
         now = time.monotonic()
         if self._t_host0 is not None:
-            self._gap_sum += now - self._t_host0
+            gap = now - self._t_host0
+            self._gap_sum += gap
             self._gap_n += 1
             self._t_host0 = None
+            if telemetry.enabled():
+                perfwatch.observe_phase("host_gap", gap)
         keys = self._segment_keys(key_offset)
         if carry is None:
             toks = jnp.asarray(self._cur_tok)
@@ -918,6 +1002,11 @@ class ContinuousBatchingEngine:
                     self._params, self._ks, self._vs, self._tables_active,
                     lengths, toks, active, self._limits_device(), keys)
         self._seg_runs += 1
+        if telemetry.enabled():
+            # host-side issue cost only: the call returns while the
+            # device still runs (async dispatch)
+            perfwatch.observe_phase("segment_dispatch",
+                                    time.monotonic() - now)
         return {"emitted": emitted, "was_active": was_active, "tok": tok,
                 "lengths": new_lengths, "active": still_active,
                 "mask": np.asarray(mask)}
@@ -926,9 +1015,15 @@ class ContinuousBatchingEngine:
         """Fetch one dispatched segment's outputs (ONE host round trip for
         all of them) and do the host bookkeeping: mirror lengths/tokens,
         append emissions, retire finished slots."""
+        t0 = time.monotonic()
         emitted, was_active, cur_tok, lengths, still_active = \
             jax.device_get((h["emitted"], h["was_active"], h["tok"],
                             h["lengths"], h["active"]))
+        t1 = time.monotonic()
+        if telemetry.enabled():
+            # the blocking fetch: device compute the pipeline did not
+            # hide (plus transfer) — the device share of a decode step
+            perfwatch.observe_phase("device_wait", t1 - t0)
         useful0 = self._useful
         with annotate("serving.host_bookkeeping"):
             # slots outside ``mask`` pass through the program unchanged, so
@@ -960,10 +1055,13 @@ class ContinuousBatchingEngine:
                         or not bool(still_active[slot]))
                 if done:
                     self._retire(req, "ok", finished, slot=slot)
-        if telemetry.enabled() and self._useful > useful0:
-            # one bump per consumed segment, not per token
-            _M_TOKENS.inc(self._useful - useful0)
         self._t_host0 = time.monotonic()
+        if telemetry.enabled():
+            if self._useful > useful0:
+                # one bump per consumed segment, not per token
+                _M_TOKENS.inc(self._useful - useful0)
+            perfwatch.observe_phase("host_bookkeeping",
+                                    self._t_host0 - t1)
 
     def _drain_pipeline(self, finished):
         """Consume the in-flight segment (if any) so the host view of
@@ -1109,6 +1207,9 @@ class ContinuousBatchingEngine:
                 finished)
 
         active_np = np.array([r is not None for r in self._slot_req])
+        if telemetry.enabled():
+            self._kv_account(active_np)
+            perfwatch.memory_watchdog().maybe_poll()
         if active_np.any():
             self._occ_sum += float(active_np.mean())
             self._occ_n += 1
@@ -1148,6 +1249,41 @@ class ContinuousBatchingEngine:
             self._queue = waiting
         return finished
 
+    def _kv_usage(self, active_idx):
+        """ONE definition of the page-granular KV arithmetic (the gauges
+        and ``kv_stats`` must never desynchronize): occupancy / bytes /
+        interior fragmentation over the active slots' host lengths."""
+        n = len(active_idx)
+        if n:
+            lens = self._lengths[active_idx].astype(np.int64)
+            used = int(lens.sum())
+            pages = int((-(-lens // self.page_size)).sum())
+        else:
+            used = pages = 0
+        cap_tokens = pages * self.page_size
+        return {
+            "bytes_in_use": cap_tokens * self._kv_bytes_per_token,
+            "slot_occupancy": n / self.max_slots if self.max_slots else 0.0,
+            "fragmentation_pct": (100.0 * (1.0 - used / cap_tokens)
+                                  if cap_tokens else 0.0),
+            "bytes_per_token": self._kv_bytes_per_token,
+        }
+
+    def _kv_account(self, active_np):
+        """Refresh the logical KV-occupancy gauges from the host view of
+        the slots (one segment behind the device when pipelined)."""
+        u = self._kv_usage(np.flatnonzero(active_np))
+        _M_KV_BYTES.set(u["bytes_in_use"])
+        _M_KV_OCC.set(u["slot_occupancy"])
+        _M_KV_FRAG.set(u["fragmentation_pct"])
+
+    def kv_stats(self) -> dict:
+        """Point-in-time KV accounting for THIS engine (the gauges are
+        process-level and last-writer-wins across engines)."""
+        return self._kv_usage(
+            [s for s, r in enumerate(getattr(self, "_slot_req", ()))
+             if r is not None])
+
     def note_rejection(self):
         """Count a frontend-level rejection in the session stats, so
         ``stats()['rejected']`` reflects the whole serving stack (the
@@ -1166,9 +1302,20 @@ class ContinuousBatchingEngine:
         segment's bookkeeping and issuing the next dispatch
         (``host_gap_total_s`` is the session total) — with the pipeline
         enabled this work overlaps device compute; a growing value flags
-        host-overhead regressions either way."""
+        host-overhead regressions either way.
+
+        ``phases`` (perfwatch step-time attribution) summarizes the
+        PROCESS-wide ``serving.phase_s`` histogram — p50/p95/p99 + mean
+        per scheduler phase (prefill / chunked_prefill /
+        segment_dispatch / device_wait / host_bookkeeping / host_gap);
+        ``kv`` is this engine's logical KV occupancy (bytes at page
+        granularity, slot occupancy, interior fragmentation). Both are
+        empty with ``FLAGS_telemetry=0``."""
         dt = time.monotonic() - self._t0
         return {
+            "phases": (perfwatch.phase_summaries()
+                       if telemetry.enabled() else {}),
+            "kv": self.kv_stats() if telemetry.enabled() else {},
             "tokens_per_sec": (self._useful / dt
                                if dt > 0 and self._useful else 0.0),
             "useful_tokens": self._useful,

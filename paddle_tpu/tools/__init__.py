@@ -1,0 +1,4 @@
+"""Operator CLIs: ``python -m paddle_tpu.tools.obs`` (metrics / flight
+dumps / bench diffs) and the bench-trend regression harness
+(``tools/bench_trend.py`` at the repo root wraps
+``paddle_tpu.tools.bench_trend`` without importing the framework)."""

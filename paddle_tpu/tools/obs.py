@@ -1,0 +1,209 @@
+"""``python -m paddle_tpu.tools.obs`` — the operator's observability CLI.
+
+Three subcommands over the artifacts the telemetry/perfwatch layers
+leave on disk (and the live process registry, for REPL use):
+
+* ``metrics [PATH]`` — pretty-print a metrics snapshot: counters,
+  gauges, and percentile summaries for every histogram. ``PATH`` is a
+  ``MetricsRegistry.snapshot()`` JSON (a replica's store-published
+  snapshot saved to a file, or a flight dump — its embedded snapshot is
+  used); with no PATH the CURRENT process registry prints (useful from
+  a REPL or a debug hook, not across processes).
+* ``flights [--dir D] [-n N]`` / ``flights PATH`` — tail the flight-
+  recorder dumps: with no PATH, list the N most recent dumps in the
+  flight dir (``FLAGS_flight_dir`` → ``$PADDLE_FLIGHT_DIR`` →
+  ``<tmpdir>/paddle_tpu_flight``) with reason/age/event counts; with a
+  PATH, inspect one dump (event ring tail, span tail, key metrics).
+* ``bench-diff A B`` — metric-by-metric comparison of two ``BENCH_*``
+  records (round files or the baseline), flagging the big movers. The
+  full series harness is ``tools/bench_trend.py``.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+from . import bench_trend as _bt
+
+
+def _fmt_num(v):
+    if isinstance(v, float):
+        if v and (abs(v) < 1e-3 or abs(v) >= 1e7):
+            return f"{v:.3e}"
+        return f"{v:,.4f}".rstrip("0").rstrip(".")
+    return f"{v:,}"
+
+
+def _print_snapshot(snap, out=sys.stdout):
+    from ..core import telemetry
+
+    ts = snap.get("ts")
+    if ts:
+        age = max(time.time() - float(ts), 0.0)  # wall-clock: snapshot age
+        out.write(f"snapshot age: {age:.1f}s\n")
+    counters = snap.get("counters", {})
+    gauges = snap.get("gauges", {})
+    hists = snap.get("histograms", {})
+    if counters:
+        out.write(f"\ncounters ({len(counters)}):\n")
+        for k in sorted(counters):
+            out.write(f"  {k:<56} {_fmt_num(counters[k])}\n")
+    if gauges:
+        out.write(f"\ngauges ({len(gauges)}):\n")
+        for k in sorted(gauges):
+            out.write(f"  {k:<56} {_fmt_num(gauges[k])}\n")
+    if hists:
+        out.write(f"\nhistograms ({len(hists)}):\n")
+        for k in sorted(hists):
+            s = telemetry.summary_from_snapshot(snap, k)
+            out.write(
+                f"  {k:<44} n={s['count']:<8} mean={s['mean']:.6g} "
+                f"p50={s['p50']:.6g} p95={s['p95']:.6g} "
+                f"p99={s['p99']:.6g}\n")
+    if not (counters or gauges or hists):
+        out.write("(empty snapshot)\n")
+
+
+def cmd_metrics(args) -> int:
+    from ..core import telemetry
+
+    if args.path:
+        try:
+            obj = json.load(open(args.path))
+        except (OSError, ValueError) as e:
+            sys.stderr.write(f"cannot read {args.path}: {e}\n")
+            return 2
+        # a flight dump embeds the snapshot under "metrics"
+        snap = obj.get("metrics") if "metrics" in obj else obj
+        if not isinstance(snap, dict) or not (
+                {"counters", "gauges", "histograms"} & set(snap)):
+            sys.stderr.write(
+                f"{args.path} is not a metrics snapshot (expected a "
+                "MetricsRegistry.snapshot() dict or a flight dump)\n")
+            return 2
+    else:
+        snap = telemetry.registry().snapshot()
+    _print_snapshot(snap)
+    return 0
+
+
+def _flight_dir(args):
+    if args.dir:
+        return args.dir
+    from ..core.telemetry import FlightRecorder
+
+    return FlightRecorder.dump_dir()
+
+
+def cmd_flights(args) -> int:
+    if args.path:
+        return _inspect_flight(args.path)
+    d = _flight_dir(args)
+    paths = sorted(glob.glob(os.path.join(d, "flight-*.json")),
+                   key=os.path.getmtime, reverse=True)
+    if not paths:
+        print(f"no flight dumps under {d}")
+        return 0
+    print(f"{len(paths)} dump(s) under {d} (newest first):")
+    for p in paths[:args.n]:
+        try:
+            obj = json.load(open(p))
+        except (OSError, ValueError):
+            print(f"  {os.path.basename(p):<52} <unreadable>")
+            continue
+        age = max(time.time() - obj.get("ts", 0), 0.0)  # wall-clock: dump age
+        kinds = {}
+        for e in obj.get("events", []):
+            kinds[e.get("kind")] = kinds.get(e.get("kind"), 0) + 1
+        top = ",".join(f"{k}x{n}" for k, n in sorted(
+            kinds.items(), key=lambda kv: -kv[1])[:3])
+        print(f"  {os.path.basename(p):<52} {age:8.0f}s ago  "
+              f"reason={obj.get('reason')}  events={top or '-'}")
+    return 0
+
+
+def _inspect_flight(path) -> int:
+    try:
+        obj = json.load(open(path))
+    except (OSError, ValueError) as e:
+        sys.stderr.write(f"cannot read {path}: {e}\n")
+        return 2
+    print(f"reason : {obj.get('reason')}")
+    print(f"pid    : {obj.get('pid')}")
+    evs = obj.get("events", [])
+    print(f"events : {len(evs)} (tail)")
+    for e in evs[-20:]:
+        extra = {k: v for k, v in e.items() if k not in ("ts", "kind")}
+        print(f"  {e.get('kind', '?'):<24} {extra}")
+    spans = obj.get("spans", [])
+    print(f"spans  : {len(spans)} recorded (tail)")
+    for s in spans[-10:]:
+        dur = s.get("dur")
+        print(f"  {s.get('name', '?'):<32} "
+              f"{'%0.3fms' % (dur / 1e3) if dur is not None else 'event'}")
+    snap = obj.get("metrics")
+    if isinstance(snap, dict):
+        print("\nembedded metrics snapshot:")
+        _print_snapshot(snap)
+    return 0
+
+
+def cmd_bench_diff(args) -> int:
+    try:
+        rows = _bt.diff_rounds(args.a, args.b)
+    except (OSError, ValueError) as e:
+        sys.stderr.write(f"bench-diff failed: {e}\n")
+        return 2
+    if not rows:
+        print("no shared metrics between the two records")
+        return 0
+    a_name = os.path.basename(args.a)
+    b_name = os.path.basename(args.b)
+    print(f"{'metric':<44} {a_name:>16} {b_name:>16} {'ratio':>8}")
+    movers = 0
+    for metric, a, b, ratio in rows:
+        mark = ""
+        if ratio is not None and (ratio < 1 / args.factor
+                                  or ratio > args.factor):
+            mark = "  <-- "
+            movers += 1
+        print(f"{metric:<44} {a:>16g} {b:>16g} "
+              f"{ratio if ratio is None else round(ratio, 3)!s:>8}{mark}")
+    print(f"\n{movers} metric(s) moved beyond {args.factor}x")
+    return 1 if movers else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.tools.obs",
+        description="Inspect telemetry snapshots, flight-recorder dumps, "
+                    "and bench records")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    mp = sub.add_parser("metrics", help="pretty-print a metrics snapshot")
+    mp.add_argument("path", nargs="?", default=None,
+                    help="snapshot JSON or flight dump (default: this "
+                         "process's registry)")
+    mp.set_defaults(fn=cmd_metrics)
+    fp = sub.add_parser("flights", help="tail/inspect flight dumps")
+    fp.add_argument("path", nargs="?", default=None,
+                    help="one dump to inspect (default: list the dir)")
+    fp.add_argument("--dir", default=None, help="flight-dump directory")
+    fp.add_argument("-n", type=int, default=10, help="list at most N")
+    fp.set_defaults(fn=cmd_flights)
+    bp = sub.add_parser("bench-diff",
+                        help="diff two BENCH_*.json records")
+    bp.add_argument("a")
+    bp.add_argument("b")
+    bp.add_argument("--factor", type=float, default=1.5,
+                    help="flag ratios beyond this factor either way")
+    bp.set_defaults(fn=cmd_bench_diff)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,442 @@
+"""Performance observability (ISSUE 10): step-time attribution, compile
+& memory watchdogs, SLO burn-rate monitor.
+
+Layers:
+
+* phase attribution — the engine observes every scheduler phase into
+  ``serving.phase_s{phase=...}``; summaries surface in ``stats()`` and
+  ``fleet_metrics()``;
+* compile watchdog — the jit-layer util counts
+  ``xla.compiles_total{phase=warmup|serving}``; the DRILL induces a
+  post-warmup recompile (a segment length outside the warmed set — the
+  AOT cache misses and the lazily-compiling fallback runs) and asserts
+  the count AND a flight dump naming the recompiled program and traced
+  shapes; a clean warmed run keeps the serving count at 0;
+* memory watchdog — PJRT stats into ``device.*`` gauges, ABSENT (not
+  zero) on stat-less backends, high-watermark flight event with
+  hysteresis;
+* KV accounting — logical page-pool occupancy/fragmentation gauges +
+  per-request footprint histogram;
+* SLO monitor — rolling-window goodput, multi-window burn rate, the
+  alarm drill (slow traffic flips it, recovery clears it), and the
+  flag-gated low-priority admission shedding drill.
+"""
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import perfwatch, resilience, telemetry
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.jit.compile_watch import compile_watchdog
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.frontend import ServingFrontend
+from paddle_tpu.models.router import ServingRouter
+from paddle_tpu.models.serving import ContinuousBatchingEngine
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path):
+    resilience.reset_faults()
+    telemetry.reset_telemetry()
+    compile_watchdog().reset()
+    set_flags({"FLAGS_flight_dir": str(tmp_path / "flight")})
+    yield
+    resilience.reset_faults()
+    telemetry.reset_telemetry()
+    compile_watchdog().reset()
+    set_flags({"FLAGS_flight_dir": "", "FLAGS_telemetry": True,
+               "FLAGS_slo_shedding": False})
+
+
+_CFG = LlamaConfig(vocab_size=97, hidden_size=16, intermediate_size=32,
+                   num_hidden_layers=1, num_attention_heads=2,
+                   max_position_embeddings=128, tie_word_embeddings=True)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(_CFG)
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("page_size", 16)
+    kw.setdefault("prompt_buckets", (8, 16))
+    return ContinuousBatchingEngine(model, **kw)
+
+
+def _prompts(ns, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, 97, (n,)).astype(np.int32) for n in ns]
+
+
+def _flight_files(pattern="*"):
+    from paddle_tpu.core.flags import flag
+
+    return sorted(glob.glob(os.path.join(flag("FLAGS_flight_dir"),
+                                         f"flight-*{pattern}*.json")))
+
+
+# ------------------------------------------------------ phase attribution
+
+
+def test_phase_attribution_covers_scheduler_phases(model):
+    """A run with short + chunked admissions observes every phase; the
+    summaries surface in stats() and render from snapshots too."""
+    eng = _engine(model)
+    outs, stats = eng.run(_prompts((5, 30, 7)), max_new_tokens=6,
+                          segment=3)
+    assert stats["statuses"] == ["ok"] * 3
+    phases = stats["phases"]
+    for phase in ("prefill", "chunked_prefill", "segment_dispatch",
+                  "device_wait", "host_bookkeeping"):
+        assert phase in phases, f"phase {phase} never observed"
+        assert phases[phase]["count"] > 0
+        assert phases[phase]["mean"] > 0.0
+    # pipelined runs have at least one between-segment gap observation
+    assert "host_gap" in phases
+    # snapshot-side rendering (what fleet_metrics uses on merged views)
+    snap = telemetry.registry().snapshot()
+    from_snap = perfwatch.phase_summaries(snap)
+    assert set(from_snap) == set(phases)
+    assert from_snap["prefill"]["count"] == phases["prefill"]["count"]
+
+
+def test_phase_attribution_off_with_telemetry_disabled(model):
+    set_flags({"FLAGS_telemetry": 0})
+    eng = _engine(model)
+    _, stats = eng.run(_prompts((5,)), max_new_tokens=4, segment=2)
+    assert stats["phases"] == {} and stats["kv"] == {}
+    assert perfwatch.phase_summaries() == {}
+
+
+# ------------------------------------------------------- compile watchdog
+
+
+def test_clean_warmed_run_counts_zero_serving_compiles(model):
+    """The PR 5 invariant, production-monitored: warmup compiles count
+    as phase=warmup; a post-warmup run over warmed shapes adds ZERO
+    phase=serving compiles and dumps nothing."""
+    eng = _engine(model)
+    eng.warmup(segment=3)
+    c = telemetry.counter("xla.compiles_total")
+    assert c.value(phase="warmup") > 0
+    before = c.value(phase="serving")
+    outs, stats = eng.run(_prompts((5, 30, 7), seed=1), max_new_tokens=6,
+                          segment=3)
+    assert stats["statuses"] == ["ok"] * 3
+    assert c.value(phase="serving") == before == 0
+    assert not _flight_files("recompile")
+
+
+def test_post_warmup_recompile_drill_counts_and_dumps(model):
+    """DRILL: a warmed engine is driven with a segment length outside
+    the warmed set — the AOT cache is bypassed and the fallback jit
+    compiles mid-serving. The watchdog must count it under
+    phase=serving and leave a flight dump NAMING the program and the
+    traced shapes."""
+    eng = _engine(model)
+    eng.warmup(segment=3)
+    c = telemetry.counter("xla.compiles_total")
+    outs, stats = eng.run(_prompts((5,), seed=2), max_new_tokens=6,
+                          segment=5)  # 5 not warmed: recompile
+    assert stats["statuses"] == ["ok"]
+    assert c.value(phase="serving") >= 1
+    dumps = _flight_files("recompile")
+    assert dumps, "recompile left no flight dump"
+    payload = json.load(open(dumps[-1]))
+    evs = [e for e in payload["events"] if e["kind"] == "recompile"]
+    assert evs, "dump does not carry the recompile event"
+    assert "segment" in evs[-1]["program"] and "5" in evs[-1]["program"]
+    assert evs[-1]["shapes"], "dump does not carry the traced shapes"
+    assert evs[-1]["seconds"] > 0
+    # the counter survives in the dump's embedded snapshot too
+    assert payload["metrics"]["counters"][
+        "xla.compiles_total{phase=serving}"] >= 1
+
+
+def test_second_engine_warmup_counts_as_warmup_not_serving(model):
+    """scale_out path: warming ANOTHER engine after the process is
+    armed stays phase=warmup (warmup_scope), not a recompile alarm."""
+    compile_watchdog().start().arm()  # the process already served
+    c = telemetry.counter("xla.compiles_total")
+    warm0 = c.value(phase="warmup")
+    # minimal shape set (1 slot, 1 bucket, no chunking): 2 programs
+    eng2 = _engine(model, max_slots=1, max_len=8, prompt_buckets=(8,))
+    assert eng2.warmup(segment=2)["programs"] == 2
+    assert c.value(phase="warmup") == warm0 + 2
+    assert c.value(phase="serving") == 0
+
+
+def test_count_backend_compiles_shared_util(model):
+    """The promoted listener: counts compiles in scope, nothing out of
+    scope (the one implementation test_serving_pipeline also uses)."""
+    from paddle_tpu.jit import count_backend_compiles
+
+    eng = _engine(model, max_slots=1, prompt_buckets=(8,), max_len=32)
+    with count_backend_compiles() as compiles:
+        eng.warmup(segment=2)
+    assert len(compiles) > 0 and all(d >= 0 for d in compiles)
+    with count_backend_compiles() as compiles2:
+        eng.run(_prompts((5,), seed=3), max_new_tokens=3, segment=2)
+    assert compiles2 == []
+
+
+# -------------------------------------------------------- memory watchdog
+
+
+def test_memory_watchdog_polls_gauges(monkeypatch):
+    stats = {"bytes_in_use": 1000, "peak_bytes_in_use": 2000,
+             "bytes_limit": 10_000}
+    import paddle_tpu.device as device
+
+    monkeypatch.setattr(device, "memory_stats", lambda *a, **k: stats)
+    wd = perfwatch.MemoryWatchdog()
+    assert wd.poll() == stats
+    assert wd.available is True
+    snap = telemetry.registry().snapshot()
+    assert snap["gauges"]["device.bytes_in_use"] == 1000
+    assert snap["gauges"]["device.peak_bytes_in_use"] == 2000
+    assert snap["gauges"]["device.bytes_limit"] == 10_000
+
+
+def test_memory_watchdog_degrades_gracefully_without_stats(monkeypatch):
+    """CPU backends expose no memory_stats: the gauges must stay ABSENT
+    — a dashboard must read 'no data', never '0 bytes in use'."""
+    import paddle_tpu.device as device
+
+    monkeypatch.setattr(device, "memory_stats", lambda *a, **k: {})
+    wd = perfwatch.MemoryWatchdog()
+    assert wd.poll() is None
+    assert wd.available is False
+    snap = telemetry.registry().snapshot()
+    assert "device.bytes_in_use" not in snap["gauges"]
+    assert "device.peak_bytes_in_use" not in snap["gauges"]
+    assert "device.bytes_limit" not in snap["gauges"]
+    assert snap["counters"]["perfwatch.memory_stats_unavailable"] >= 1
+    # the rate limiter still works on the unavailable path
+    assert wd.maybe_poll() is None
+
+
+def test_memory_watchdog_high_watermark_fires_once(monkeypatch):
+    import paddle_tpu.device as device
+
+    use = {"v": 9_500}
+    monkeypatch.setattr(
+        device, "memory_stats",
+        lambda *a, **k: {"bytes_in_use": use["v"],
+                         "bytes_limit": 10_000})
+    wd = perfwatch.MemoryWatchdog(hwm_pct=90.0, min_interval_s=0.0)
+    wd.poll()
+    assert len(_flight_files("memory_hwm")) == 1
+    wd.poll()  # still above: no second dump (hysteresis)
+    assert len(_flight_files("memory_hwm")) == 1
+    payload = json.load(open(_flight_files("memory_hwm")[0]))
+    ev = [e for e in payload["events"] if e["kind"] == "memory_hwm"][-1]
+    assert ev["bytes_in_use"] == 9_500 and ev["pct"] == 95.0
+    use["v"] = 1_000  # recover below 80% of the watermark: re-arm
+    wd.poll()
+    use["v"] = 9_900  # second incident fires again
+    wd.poll()
+    assert len(_flight_files("memory_hwm")) == 2
+
+
+# ----------------------------------------------------------- KV accounting
+
+
+def test_kv_accounting_gauges_and_per_request_bytes(model):
+    eng = _engine(model)
+    eng.start(segment=2)
+    # bytes/token = layers * 2 * kv_heads * head_dim * dtype
+    cfg = model.config
+    expect_bpt = (cfg.num_hidden_layers * 2 * cfg.num_attention_heads
+                  * cfg.head_dim * 4)
+    assert eng.kv_stats()["bytes_per_token"] == expect_bpt
+    p = _prompts((10,), seed=4)[0]
+    eng.submit(p, 20)
+    eng.step()
+    kv = eng.kv_stats()
+    assert kv["slot_occupancy"] == 0.5      # 1 of 2 slots
+    # ~11 tokens in a page_size-16 slot: one page occupied, ~5/16 waste
+    assert kv["bytes_in_use"] == 16 * expect_bpt
+    assert 0.0 < kv["fragmentation_pct"] < 100.0
+    # the gauges mirror the engine view after a step
+    snap = telemetry.registry().snapshot()
+    assert snap["gauges"]["serving.kv_bytes_in_use"] == kv["bytes_in_use"]
+    assert snap["gauges"]["serving.kv_slot_occupancy"] == 0.5
+    while eng.has_work():
+        eng.step()
+    # retirement observed the request's page-rounded footprint
+    h = telemetry.histogram("serving.kv_request_bytes").summary()
+    assert h["count"] == 1
+    assert h["mean"] == 2 * 16 * expect_bpt  # 10+20 tokens -> 2 pages
+    assert eng.kv_stats()["bytes_in_use"] == 0  # all slots free again
+
+
+# ------------------------------------------------------------ SLO monitor
+
+
+def _slow_then_status(mon, hist, t0):
+    for _ in range(20):
+        hist.observe(2.0)  # way past the objective
+    return mon.status(now=t0)
+
+
+def test_slo_monitor_burn_rate_flips_and_recovers():
+    hist = telemetry.histogram("serving.ttft_s")
+    obj = perfwatch.Objective("ttft", "serving.ttft_s", threshold_s=0.05,
+                              target=0.9)
+    mon = perfwatch.SLOMonitor(objectives=[obj], windows=(10.0, 30.0),
+                               burn_threshold=2.0, min_count=8)
+    for _ in range(20):
+        hist.observe(0.01)  # healthy traffic
+    st = mon.status(now=0.0)
+    assert st["alarm"] is False
+    # a slow replica: every request blows the objective
+    st = _slow_then_status(mon, hist, 11.0)
+    o = st["objectives"]["ttft"]
+    assert o["goodput"]["10s"] < 0.1
+    assert o["burn"]["10s"] > 2.0 and o["burn"]["30s"] > 2.0
+    assert st["alarm"] is True and mon.alarm() is True
+    # recovery: fast traffic again, the short window clears first
+    for _ in range(40):
+        hist.observe(0.01)
+    st = mon.status(now=22.0)
+    assert st["objectives"]["ttft"]["burn"]["10s"] < 2.0
+    assert st["alarm"] is False and mon.alarm() is False
+
+
+def test_slo_monitor_bucket_invalidated_merge_uses_reservoir():
+    """A rolling-fleet merge with mismatched bucket layouts invalidates
+    the merged buckets (telemetry.merge_bounds_mismatch); the SLO
+    monitor must then estimate goodput from the merged RESERVOIR — a
+    healthy fleet must not read as 0% goodput and flip a false alarm."""
+    snap = {"histograms": {"serving.ttft_s": {
+        "count": 40, "sum": 0.4, "bounds": [0.05, 0.1],
+        "buckets": None,                  # bounds-mismatched merge
+        "sample": [0.01] * 30 + [0.2] * 2}}}
+    obj = perfwatch.Objective("ttft", "serving.ttft_s", threshold_s=0.05,
+                              target=0.9)
+    mon = perfwatch.SLOMonitor(objectives=[obj], windows=(10.0,),
+                               burn_threshold=2.0, min_count=8,
+                               source=lambda: snap)
+    mon.status(now=0.0)
+    snap["histograms"]["serving.ttft_s"]["count"] = 80
+    st = mon.status(now=11.0)
+    o = st["objectives"]["ttft"]
+    assert o["goodput"]["10s"] > 0.8      # reservoir: ~94% good
+    assert st["alarm"] is False
+    # reservoir gone too: degrade to zeros, still no spurious math error
+    snap["histograms"]["serving.ttft_s"]["sample"] = []
+    snap["histograms"]["serving.ttft_s"]["count"] = 120
+    mon.status(now=22.0)
+
+
+def test_slo_monitor_idle_window_does_not_alarm():
+    obj = perfwatch.Objective("ttft", "serving.ttft_s", threshold_s=0.05,
+                              target=0.9)
+    mon = perfwatch.SLOMonitor(objectives=[obj], windows=(10.0,),
+                               burn_threshold=2.0, min_count=8)
+    hist = telemetry.histogram("serving.ttft_s")
+    mon.status(now=0.0)
+    for _ in range(3):  # below min_count: noise, not an incident
+        hist.observe(5.0)
+    st = mon.status(now=11.0)
+    assert st["alarm"] is False
+    assert st["objectives"]["ttft"]["window_count"]["10s"] == 3
+
+
+def test_slo_shedding_drill_flag_gated(model):
+    """The burn alarm + FLAGS_slo_shedding sheds LOW-priority
+    admissions at the door; protected priorities keep serving; the flag
+    off never sheds."""
+    import time as _time
+
+    hist = telemetry.histogram("serving.ttft_s")
+    obj = perfwatch.Objective("ttft", "serving.ttft_s", threshold_s=0.05,
+                              target=0.9)
+    mon = perfwatch.SLOMonitor(objectives=[obj], windows=(10.0,),
+                               burn_threshold=2.0, min_count=8,
+                               shed_below=1)
+    eng = _engine(model)
+    fe = ServingFrontend(eng, max_queue=8, segment=2, slo=mon)
+    # drive the alarm on the REAL monotonic timeline (the frontend's
+    # own rate-limited ticks ride it during the pump below): a slow
+    # replica's TTFTs blow the objective over the 10s window
+    t0 = _time.monotonic()
+    for _ in range(20):
+        hist.observe(0.01)
+    mon.status(now=t0 - 11.0)
+    st = _slow_then_status(mon, hist, t0)
+    assert st["alarm"] is True and mon.alarm()
+    assert fe.health()["slo"]["alarm"] is True
+    p = _prompts((5,), seed=5)[0]
+    # flag OFF (default): the alarm observes, nothing sheds
+    r0 = fe.submit(p, max_new_tokens=3, priority=0)
+    set_flags({"FLAGS_slo_shedding": 1})
+    r1 = fe.submit(p, max_new_tokens=3, priority=0)   # shed
+    r2 = fe.submit(p, max_new_tokens=3, priority=1)   # protected
+    res = fe.results(wait=True)
+    assert res[r0].status == "ok"
+    assert res[r1].status == "rejected" and "slo" in res[r1].reason
+    assert res[r2].status == "ok"
+    assert telemetry.counter("serving.slo_shed").value() == 1
+    fe.shutdown(drain=True)
+
+
+# -------------------------------------------------------------- obs CLI
+
+
+def test_obs_cli_metrics_flights_and_diff(model, capsys, tmp_path):
+    """`python -m paddle_tpu.tools.obs`: snapshot pretty-print (live +
+    from a flight dump), flight-dir listing/inspection, bench diff."""
+    from paddle_tpu.tools import obs
+
+    eng = _engine(model)
+    eng.run(_prompts((5,)), max_new_tokens=4, segment=2)
+    assert obs.main(["metrics"]) == 0
+    out = capsys.readouterr().out
+    assert "serving.tokens_total" in out and "serving.phase_s" in out
+    # a dump: list, inspect, and read its embedded snapshot
+    path = telemetry.flight_dump("obs_drill", detail="x")
+    assert obs.main(["flights", "--dir", os.path.dirname(path)]) == 0
+    assert "obs_drill" in capsys.readouterr().out
+    assert obs.main(["flights", path]) == 0
+    assert "obs_drill" in capsys.readouterr().out
+    assert obs.main(["metrics", path]) == 0
+    assert "serving.tokens_total" in capsys.readouterr().out
+    # bench diff over two checked-in rounds flags the big movers
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parents[1]
+    rc = obs.main(["bench-diff", str(root / "BENCH_r04.json"),
+                   str(root / "BENCH_r05.json")])
+    assert rc == 1  # movers exist between r04 and r05
+    assert "decode_vs_streaming_floor" in capsys.readouterr().out
+    # unreadable input: clean error, not a traceback
+    assert obs.main(["metrics", str(tmp_path / "nope.json")]) == 2
+
+
+# ------------------------------------------------------------------ fleet
+
+
+def test_fleet_metrics_carries_phases_and_slo(model):
+    router = ServingRouter()
+    eng = _engine(model)
+    router.add_replica(ServingFrontend(eng, max_queue=8, segment=2))
+    rid = router.submit(_prompts((6,), seed=6)[0], max_new_tokens=4)
+    res = router.results(wait=True, timeout_s=60)
+    assert res[rid].status == "ok"
+    fm = router.fleet_metrics()
+    assert fm["phases"].get("segment_dispatch", {}).get("count", 0) > 0
+    assert "ttft" in fm["slo"]["objectives"]
+    assert fm["slo"]["alarm"] is False
+    # the merged snapshot carries the kv gauges the engine exported
+    assert "serving.kv_slot_occupancy" in fm["metrics"]["gauges"]
+    router.shutdown()

@@ -306,8 +306,9 @@ def test_chunked_prefill_width_specialized():
 def test_warmup_precompiles_every_shape_zero_compiles_after():
     """After ``warmup()``, a full run (mixed buckets, chunked prefill,
     every admission width, decode segments) triggers ZERO XLA backend
-    compilations — measured with JAX's own compilation event counter."""
-    from jax._src import monitoring
+    compilations — measured with the shared jit-layer compile listener
+    (``count_backend_compiles``, the production watchdog's test form)."""
+    from paddle_tpu.jit import count_backend_compiles
 
     m = _model()
     eng = _engine(m, max_slots=2, max_len=64, prompt_buckets=(8, 16))
@@ -316,20 +317,11 @@ def test_warmup_precompiles_every_shape_zero_compiles_after():
     assert info["programs"] == 2 * 2 + 2 * 2 + 1
     again = eng.warmup(segment=3)          # idempotent: everything cached
     assert again["programs"] == 0 and again["cached"] == 9
-    compiles = []
-
-    def listener(name, dur, **kw):
-        if name == "/jax/core/compile/backend_compile_duration":
-            compiles.append(name)
-
-    monitoring.register_event_duration_secs_listener(listener)
-    try:
+    with count_backend_compiles() as compiles:
         rng = np.random.RandomState(8)
         prompts = [rng.randint(0, 211, (n,)).astype(np.int32)
                    for n in (5, 30, 12, 7, 20)]  # 30/20: chunked (>16)
         outs, stats = eng.run(prompts, max_new_tokens=6, segment=3)
-    finally:
-        monitoring._unregister_event_duration_listener_by_callback(listener)
     assert stats["statuses"] == ["ok"] * 5
     assert compiles == [], f"post-warmup run compiled {len(compiles)} programs"
 

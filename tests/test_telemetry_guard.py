@@ -37,12 +37,38 @@ def _normalize(name: str) -> str:
     return name.split("{", 1)[0].rstrip(":.")
 
 
-def test_every_metric_name_is_referenced_or_documented():
+def _swept_names():
     names = set()
     for py in sorted(_PKG.rglob("*.py")):
         for m in _EMITS.findall(py.read_text()):
             if _NAME.match(m):
                 names.add(_normalize(m))
+    return names
+
+
+def test_sweep_sees_the_perfwatch_families():
+    """The ISSUE-10 perfwatch layer emits through module-level registry
+    handles; if a refactor moved them to an emission style the sweep
+    regex misses, every one of its metrics would silently leave the
+    guard's coverage — pin the families here."""
+    names = _swept_names()
+    expected = {
+        "serving.phase_s", "xla.compiles_total",
+        "device.bytes_in_use", "device.peak_bytes_in_use",
+        "device.bytes_limit", "perfwatch.memory_stats_unavailable",
+        "serving.kv_bytes_in_use", "serving.kv_slot_occupancy",
+        "serving.kv_fragmentation_pct", "serving.kv_request_bytes",
+        "serving.slo_shed",
+    }
+    missing = expected - names
+    assert not missing, (
+        f"perfwatch metric families {sorted(missing)} no longer visible "
+        "to the orphan sweep — emit them via literal "
+        "telemetry.counter/gauge/histogram names")
+
+
+def test_every_metric_name_is_referenced_or_documented():
+    names = _swept_names()
     assert len(names) > 40, (
         f"metric sweep found only {len(names)} names: the regex is "
         "probably broken")

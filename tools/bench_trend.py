@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Repo-root entry for the bench-trend regression harness.
+
+Loads ``paddle_tpu/tools/bench_trend.py`` by FILE PATH (not package
+import) so CI can run the series check without importing the framework —
+no jax import, no device contact, just JSON parsing over the checked-in
+``BENCH_*`` rounds.
+
+    python tools/bench_trend.py [--root DIR] [--json OUT] [--md OUT]
+
+Exit codes: 0 clean, 1 regressions/gate violations, 2 unparseable rounds.
+"""
+import importlib.util
+import os
+import sys
+
+_IMPL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "paddle_tpu", "tools", "bench_trend.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("_bench_trend", _IMPL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if __name__ == "__main__":
+    sys.exit(_load().main())
