@@ -59,16 +59,20 @@ PR 9's e5.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 
 from . import telemetry
 from .flags import define_flag, flag
 
+logger = logging.getLogger("paddle_tpu.perfwatch")
+
 __all__ = [
     "observe_phase", "phase_summaries", "PHASES",
     "MemoryWatchdog", "memory_watchdog",
     "SLOMonitor", "Objective", "default_objectives",
+    "BrownoutController", "BROWNOUT_STAGES",
 ]
 
 define_flag("FLAGS_memory_hwm_pct", 90.0,
@@ -99,6 +103,28 @@ define_flag("FLAGS_slo_shedding", False,
 define_flag("FLAGS_slo_shed_below_priority", 1,
             "Admissions with priority strictly below this are shed "
             "while the burn alarm is up (with FLAGS_slo_shedding on)")
+define_flag("FLAGS_brownout", False,
+            "Enable the staged brownout ladder (BrownoutController): "
+            "under a sustained SLO burn alarm the frontend degrades in "
+            "stages (cap max_new_tokens -> shed low priority -> shed "
+            "over-share tenants -> protected class only) instead of the "
+            "binary FLAGS_slo_shedding switch. Default OFF: degradation "
+            "is an explicit operator opt-in. Requires FLAGS_telemetry: "
+            "the burn-rate SENSOR reads the serving latency histograms, "
+            "which are only observed with telemetry on (the ladder "
+            "warns and stays at stage 0 otherwise).")
+define_flag("FLAGS_brownout_token_cap", 0.25,
+            "Brownout stage >= 1 multiplies each admission's requested "
+            "max_new_tokens by this fraction (floor 1 token): shorter "
+            "answers for everyone before anyone is turned away")
+define_flag("FLAGS_brownout_hold_s", 30.0,
+            "Min seconds between brownout stage transitions (both "
+            "directions): the ladder escalates one stage per hold while "
+            "the burn alarm stays up, and de-escalates one stage per "
+            "hold once it clears — hysteresis against alarm flapping")
+define_flag("FLAGS_brownout_protected_priority", 2,
+            "Brownout stage 4 (protected_only) rejects every admission "
+            "with priority strictly below this class")
 
 # ------------------------------------------------------ phase attribution
 
@@ -240,6 +266,18 @@ def memory_watchdog() -> MemoryWatchdog:
 
 
 # ------------------------------------------------------------ SLO monitor
+
+# SLO status exported as gauges so ANY registry snapshot (a replica's
+# store-published one, a flight dump's embedded one) carries the burn
+# verdict — the `obs slo` CLI renders these without a live monitor
+_M_SLO_BURN = telemetry.gauge(
+    "slo.burn", "burn rate (error_rate / error_budget) per objective "
+    "and window, from the last SLOMonitor.status() evaluation")
+_M_SLO_GOOD = telemetry.gauge(
+    "slo.goodput", "rolling-window goodput per objective and window")
+_M_SLO_ALARM = telemetry.gauge(
+    "slo.alarm", "1 while the multi-window burn alarm is up, else 0")
+
 
 class Objective:
     """One declared latency objective: ``target`` fraction of samples of
@@ -467,6 +505,14 @@ class SLOMonitor:
                 any_alarm = any_alarm or obj_alarm
             self._alarm = any_alarm
         out["alarm"] = any_alarm
+        if telemetry.enabled():
+            for oname, o in out["objectives"].items():
+                for key, burn in o["burn"].items():
+                    _M_SLO_BURN.set(round(burn, 4), objective=oname,
+                                    window=key)
+                    _M_SLO_GOOD.set(round(o["goodput"][key], 4),
+                                    objective=oname, window=key)
+            _M_SLO_ALARM.set(1 if any_alarm else 0)
         self._status_cache = (time.monotonic(), out)
         return out
 
@@ -484,3 +530,217 @@ class SLOMonitor:
         below = (self._shed_below if self._shed_below is not None
                  else int(flag("FLAGS_slo_shed_below_priority")))
         return int(priority) < below
+
+    def burning_windows(self) -> dict:
+        """``{objective: {window: burn}}`` for the windows currently
+        above threshold in the LAST evaluated status — the trigger
+        detail autoscaler/brownout flight events name, so a post-mortem
+        says WHICH windows fired the actuator, not just that one did."""
+        cached = self._status_cache
+        if cached is None:
+            return {}
+        threshold = cached[1].get("burn_threshold", 0.0)
+        out = {}
+        for oname, o in cached[1].get("objectives", {}).items():
+            hot = {w: round(b, 3) for w, b in o.get("burn", {}).items()
+                   if b > threshold}
+            if hot:
+                out[oname] = hot
+        return out
+
+
+# --------------------------------------------------------- brownout ladder
+
+# Degradation stages, in escalation order. Stage semantics are
+# CUMULATIVE: stage 3 also applies stages 1-2's measures.
+BROWNOUT_STAGES = ("normal", "token_cap", "shed_low_priority",
+                   "shed_over_share", "protected_only")
+
+_M_BROWNOUT_STAGE = telemetry.gauge(
+    "serving.brownout_stage", "current brownout ladder stage (0=normal "
+    "... 4=protected_only)")
+_M_BROWNOUT_TRANS = telemetry.counter(
+    "serving.brownout_transitions", "brownout stage transitions, by "
+    "direction (up=escalate, down=recover)")
+_M_BROWNOUT_SHED = telemetry.counter(
+    "serving.brownout_shed", "admissions shed by the brownout ladder, "
+    "by stage measure / tenant / priority")
+_M_BROWNOUT_CAP = telemetry.counter(
+    "serving.brownout_capped", "admissions whose max_new_tokens was "
+    "shrunk by brownout stage >= 1 (token_cap)")
+
+
+class BrownoutController:
+    """Staged overload degradation driven by the SLO burn alarm.
+
+    Instead of the binary ``FLAGS_slo_shedding`` switch, the ladder
+    degrades (and recovers) one stage at a time, at most one transition
+    per ``hold_s`` in either direction (hysteresis against alarm flap):
+
+    == =================== ============================================
+    0  normal              admit everything unchanged
+    1  token_cap           shrink each admission's ``max_new_tokens``
+                           to ``FLAGS_brownout_token_cap`` of the ask
+    2  shed_low_priority   + shed priority < ``shed_below``
+    3  shed_over_share     + shed tenants over their weight-fair share
+                           of the outstanding work (``QoSPolicy``)
+    4  protected_only      + reject everything below the protected
+                           priority class
+    == =================== ============================================
+
+    Every transition bumps ``serving.brownout_transitions{direction=}``,
+    moves the ``serving.brownout_stage`` gauge, and leaves a flight-
+    recorder dump naming the burning windows — the ladder's history IS
+    the incident's post-mortem. ``maybe_step()`` rate-limits itself on
+    the monitor's tick cadence so pump loops call it unconditionally;
+    an explicit ``now=`` (drills) always evaluates and uses the same
+    virtual clock for the hold timers.
+
+    The controller is inert (stage pinned 0, ``admit`` passes through)
+    unless ``FLAGS_brownout`` is on or ``enabled=True`` is passed —
+    same opt-in discipline as ``FLAGS_slo_shedding``.
+    """
+
+    def __init__(self, slo: SLOMonitor, qos=None, hold_s=None,
+                 enabled=None, shed_below=None, protected=None,
+                 token_cap=None, max_stage=None):
+        self.slo = slo
+        self.qos = qos
+        self._hold_s = hold_s
+        self._enabled = enabled
+        self._shed_below = shed_below
+        self._protected = protected
+        self._token_cap = token_cap
+        self.max_stage = int(max_stage if max_stage is not None
+                             else len(BROWNOUT_STAGES) - 1)
+        self.stage = 0
+        self.transitions = 0
+        self._last_change = None   # clock of the last transition
+        self._last_eval = None
+        self._warned_blind = False
+
+    # ------------------------------------------------------------ config
+
+    def enabled(self) -> bool:
+        return (bool(flag("FLAGS_brownout")) if self._enabled is None
+                else bool(self._enabled))
+
+    def hold_s(self) -> float:
+        return (float(flag("FLAGS_brownout_hold_s"))
+                if self._hold_s is None else float(self._hold_s))
+
+    def shed_below(self) -> int:
+        return (int(flag("FLAGS_slo_shed_below_priority"))
+                if self._shed_below is None else int(self._shed_below))
+
+    def protected(self) -> int:
+        return (int(flag("FLAGS_brownout_protected_priority"))
+                if self._protected is None else int(self._protected))
+
+    def token_cap(self) -> float:
+        return (float(flag("FLAGS_brownout_token_cap"))
+                if self._token_cap is None else float(self._token_cap))
+
+    def stage_name(self) -> str:
+        return BROWNOUT_STAGES[min(self.stage,
+                                   len(BROWNOUT_STAGES) - 1)]
+
+    # ---------------------------------------------------------- stepping
+
+    def maybe_step(self, now=None) -> int:
+        """Evaluate the alarm and move at most one stage. Auto-clocked
+        calls (``now=None``) ride the SLO status cache, so a hot pump
+        loop pays ~a dict read; explicit ``now`` always evaluates on
+        that virtual clock (deterministic drills)."""
+        if not self.enabled():
+            return self.stage
+        if not telemetry.enabled():
+            # the ladder's SENSOR is the latency histograms, which are
+            # only fed with telemetry on: an enabled ladder with a
+            # blind sensor must say so instead of silently never acting
+            if not self._warned_blind:
+                self._warned_blind = True
+                logger.warning(
+                    "brownout ladder is enabled but FLAGS_telemetry=0: "
+                    "the burn-rate sensor has no data — no degradation "
+                    "will engage until telemetry is re-enabled")
+            return self.stage
+        status = self.slo.status(now=now)
+        t = time.monotonic() if now is None else float(now)
+        if self._last_eval is not None and t < self._last_eval:
+            t = self._last_eval  # a virtual clock never runs backward
+        self._last_eval = t
+        alarm = bool(status.get("alarm"))
+        if self._last_change is not None \
+                and t - self._last_change < self.hold_s():
+            return self.stage
+        if alarm and self.stage < self.max_stage:
+            self._transition(self.stage + 1, t, "up")
+        elif not alarm and self.stage > 0:
+            self._transition(self.stage - 1, t, "down")
+        return self.stage
+
+    def _transition(self, new_stage, t, direction):
+        old, self.stage = self.stage, int(new_stage)
+        self.transitions += 1
+        self._last_change = t
+        _M_BROWNOUT_STAGE.set(self.stage)
+        _M_BROWNOUT_TRANS.inc(direction=direction)
+        # every transition is a post-mortem moment: the dump's event
+        # ring + metrics snapshot show what the ladder saw when it moved
+        telemetry.flight_dump(
+            "brownout", stage=self.stage, prev=old,
+            stage_name=self.stage_name(), direction=direction,
+            windows=self.slo.burning_windows())
+
+    # ----------------------------------------------------------- verdict
+
+    def admit(self, tenant, priority, max_new_tokens, over_share=None):
+        """Admission verdict at the current stage: ``(action,
+        max_new_tokens, reason)`` where action is ``"admit"`` or
+        ``"shed"``. ``over_share`` is the caller's answer to "is this
+        tenant over its fair share" (the frontend knows its usage map) —
+        a bool, or a zero-arg callable evaluated only when stage >= 3
+        actually needs it (the fair-share scan must not run per submit
+        in the steady state); None means unknown — stage 3 then sheds
+        nothing extra."""
+        if self.stage <= 0 or not self.enabled():
+            return "admit", max_new_tokens, None
+        if self.stage >= 3 and callable(over_share):
+            over_share = over_share()
+        priority = int(priority)
+        # local label form (models/qos.py tenant_label): core must not
+        # import the models package (heavy, and layered above core)
+        label = "-" if tenant is None else str(tenant)
+        if self.stage >= 4 and priority < self.protected():
+            _M_BROWNOUT_SHED.inc(measure="protected_only", tenant=label,
+                                 priority=priority)
+            return ("shed", max_new_tokens,
+                    f"brownout stage {self.stage} (protected_only): "
+                    f"priority {priority} below protected class "
+                    f"{self.protected()}")
+        if self.stage >= 3 and over_share:
+            _M_BROWNOUT_SHED.inc(measure="over_share", tenant=label,
+                                 priority=priority)
+            return ("shed", max_new_tokens,
+                    f"brownout stage {self.stage} (shed_over_share): "
+                    f"tenant {label} is over its fair share")
+        if self.stage >= 2 and priority < self.shed_below():
+            _M_BROWNOUT_SHED.inc(measure="low_priority", tenant=label,
+                                 priority=priority)
+            return ("shed", max_new_tokens,
+                    f"brownout stage {self.stage} (shed_low_priority): "
+                    f"priority {priority} below {self.shed_below()}")
+        capped = max(1, int(int(max_new_tokens) * self.token_cap()))
+        if capped < int(max_new_tokens):
+            _M_BROWNOUT_CAP.inc(tenant=label)
+            return ("admit", capped,
+                    f"brownout stage {self.stage}: max_new_tokens "
+                    f"capped {max_new_tokens} -> {capped}")
+        return "admit", max_new_tokens, None
+
+    def status(self) -> dict:
+        """Plain-JSON view for health payloads and the obs CLI."""
+        return {"enabled": self.enabled(), "stage": self.stage,
+                "stage_name": self.stage_name(),
+                "transitions": self.transitions}

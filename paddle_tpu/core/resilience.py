@@ -47,6 +47,7 @@ __all__ = [
     "RetryPolicy", "Deadline", "CircuitBreaker",
     "CommTimeoutError", "InjectedFault", "CheckpointCorruptionError",
     "PeerFailureError", "ServingUnavailable", "StaleLeaderError",
+    "TenantQuotaExceeded",
     "inject", "fault_remaining", "reset_faults",
     "bump_counter", "get_counter", "counters", "reset_counters",
 ]
@@ -115,6 +116,24 @@ class StaleLeaderError(RuntimeError):
     which would make the zombie leader fail the request over and
     double-dispatch it. Travels typed across the RPC wire
     (distributed/rpc.py) like the other resilience errors."""
+
+
+class TenantQuotaExceeded(RuntimeError):
+    """A tenant's token-budget quota is exhausted: admitting this
+    request would push the tenant's OUTSTANDING token cost (queued +
+    in-flight prompt and decode budgets) past its configured
+    ``quota_tokens`` (``models/qos.py``). Raised at the fleet router's
+    client surface (the one place a client talks to) so an over-quota
+    tenant gets a TYPED verdict it can back off on — deliberately NOT a
+    ConnectionError/TimeoutError: nothing is broken, the tenant is out
+    of budget, and a transport retry would just burn the quota check
+    again. Carries ``tenant`` so multi-tenant clients can tell whose
+    budget tripped. Travels typed across the RPC wire
+    (distributed/rpc.py) like the other resilience errors."""
+
+    def __init__(self, message, tenant=None):
+        super().__init__(message)
+        self.tenant = tenant
 
 
 class PeerFailureError(Exception):

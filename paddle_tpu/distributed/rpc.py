@@ -234,6 +234,7 @@ _TYPED_ERRORS = {
         _res.CommTimeoutError, _res.InjectedFault,
         _res.CheckpointCorruptionError, _res.PeerFailureError,
         _res.ServingUnavailable, _res.StaleLeaderError,
+        _res.TenantQuotaExceeded,
     )
 }
 

@@ -58,6 +58,9 @@ from .frontend import RequestResult, ServingFrontend  # noqa: F401
 from .serving import ContinuousBatchingEngine  # noqa: F401
 from .router import ServingRouter, launch_fleet  # noqa: F401
 from .remote import RemoteFrontend, ReplicaServer, replica_main  # noqa: F401
+from .autoscale import AutoScaler  # noqa: F401
+from .qos import FairClock, QoSPolicy, TenantPolicy  # noqa: F401
 
 __all__ += ["generate", "ContinuousBatchingEngine", "ServingFrontend",
-            "RequestResult", "ServingRouter", "launch_fleet"]
+            "RequestResult", "ServingRouter", "launch_fleet",
+            "AutoScaler", "QoSPolicy", "TenantPolicy", "FairClock"]

@@ -1,0 +1,318 @@
+"""SLO-driven autoscaler: the actuator that closes the overload loop.
+
+PR 10 shipped the sensors (multi-window burn rate, goodput, phase
+attribution); this module is the control loop that ACTS on them, so a
+flash crowd warms a replica instead of burning the SLO until a human
+calls ``scale_out``:
+
+* **Scale OUT on sustained burn** — when the SLO monitor's multi-window
+  alarm holds for ``burn_consecutive`` evaluations (one window alone is
+  noise), the scaler builds a replica from the pluggable ``factory`` and
+  admits it through ``ServingRouter.scale_out`` — which WARMS it before
+  it takes traffic, so the new capacity's compile time never lands in
+  live requests.
+* **Scale IN on sustained idle** — a fleet with nothing pending and
+  nothing assigned for ``idle_after_s`` drains its least-loaded replica
+  (``ServingRouter.scale_in``: in-flight work finishes, queued work
+  requeues onto survivors, token streams bit-identical).
+* **Refusal under pressure** — ``scale_in`` (auto OR operator-invoked)
+  is REFUSED while the burn alarm is up or the brownout ladder is
+  engaged: a fleet already missing its SLO must never shrink
+  (``autoscale.scale_in_refused``). This is the guard the ISSUE's
+  regression test pins.
+* **Hysteresis** — consecutive-alarm requirement on the way out,
+  idle-hold on the way in, independent cooldowns after each action, and
+  hard ``min_replicas``/``max_replicas`` bounds. A flapping alarm moves
+  the fleet at most once per cooldown.
+* **Every decision is a flight event** naming the trigger windows (the
+  exact ``{objective: {window: burn}}`` that fired), so the flight
+  recorder's ring tells the incident story: burn -> scale_out ->
+  recovered -> scale_in; ``decisions()`` keeps the same history
+  in-process and the ``obs slo`` CLI renders both.
+
+The scaler has no thread of its own: ``router.attach_autoscaler(s)``
+gives it a rate-limited turn on every router pump, or a driver calls
+``maybe_step()`` / ``step(now=...)`` directly (drills pass a virtual
+clock — decisions, holds, and cooldowns all ride it, making the loop
+deterministic under test).
+
+Fault site ``autoscale.stall``: armed via ``FLAGS_fault_injection``, the
+replica factory call fails mid-scale-out (the production analogue: the
+provisioner hangs or the new process dies during warmup). The scaler
+counts it (``autoscale.factory_error``), records the failed decision,
+keeps the fleet serving on the survivors, and retries after the
+cooldown — a broken factory must degrade the SPEED of scaling, not the
+serving fleet.
+"""
+from __future__ import annotations
+
+import collections
+import time
+
+from ..core import telemetry
+from ..core.resilience import bump_counter, inject, logger
+
+__all__ = ["AutoScaler"]
+
+_M_REPLICAS = telemetry.gauge(
+    "fleet.replicas_up", "live replicas in the fleet, from the "
+    "autoscaler's last evaluation")
+
+
+class AutoScaler:
+    """Closed-loop fleet sizing over a ``ServingRouter``.
+
+    Usage::
+
+        scaler = AutoScaler(router, factory=make_frontend,
+                            min_replicas=1, max_replicas=4)
+        router.attach_autoscaler(scaler)   # rides every router.step()
+
+    ``factory`` is any zero-arg callable returning a started frontend
+    (local ``ServingFrontend`` or ``RemoteFrontend`` stub) — the
+    deployment owns HOW capacity appears; the scaler owns WHEN.
+    """
+
+    def __init__(self, router, factory, min_replicas=1, max_replicas=4,
+                 slo=None, interval_s=0.25, burn_consecutive=2,
+                 scale_out_cooldown_s=10.0, idle_after_s=10.0,
+                 scale_in_cooldown_s=10.0, brownout=None, warmup=True,
+                 history=64):
+        from ..core import perfwatch
+
+        self.router = router
+        self.factory = factory
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = int(max_replicas)
+        if not 0 < self.min_replicas <= self.max_replicas:
+            raise ValueError(
+                f"need 0 < min_replicas ({self.min_replicas}) <= "
+                f"max_replicas ({self.max_replicas})")
+        # the sensor: a fleet-level SLOMonitor (the router's
+        # fleet_metrics one, or a process-local default — in an
+        # in-process fleet the process registry IS the fleet view)
+        self.slo = slo if slo is not None else perfwatch.SLOMonitor()
+        # brownout ladder to consult for the scale-in refusal guard
+        # (optional: pass the frontend's controller, or leave None and
+        # only the burn alarm guards)
+        self.brownout = brownout
+        self.interval_s = float(interval_s)
+        self.burn_consecutive = int(burn_consecutive)
+        self.scale_out_cooldown_s = float(scale_out_cooldown_s)
+        self.idle_after_s = float(idle_after_s)
+        self.scale_in_cooldown_s = float(scale_in_cooldown_s)
+        self.warmup = bool(warmup)
+        self._decisions = collections.deque(maxlen=int(history))
+        self._alarm_streak = 0
+        self._idle_since = None
+        self._out_ok_at = 0.0      # cooldown gates (virtual clock)
+        self._in_ok_at = 0.0
+        self._last_eval = None
+        # overhead accounting: eval_s is the decision loop's own cost;
+        # action_s (factory + warmup + drain) is useful fleet work and
+        # is EXCLUDED from the < 3% overhead gate
+        self.eval_s = 0.0
+        self.action_s = 0.0
+        self.scale_outs = 0
+        self.scale_ins = 0
+        self.refused = 0
+        self.factory_errors = 0
+
+    # ------------------------------------------------------------ sensing
+
+    def _ups(self) -> int:
+        return sum(1 for r in self.router._replicas.values()
+                   if r.state == "up")
+
+    def _fleet_idle(self) -> bool:
+        """Nothing pending at the router and nothing assigned on any
+        live replica — the ONLY state scale-in considers. Router-side
+        bookkeeping, no wire round-trips."""
+        if self.router.pending():
+            return False
+        return all(not r.assigned for r in self.router._replicas.values()
+                   if r.state == "up")
+
+    # ----------------------------------------------------------- stepping
+
+    def maybe_step(self, now=None):
+        """Rate-limited :meth:`step` for pump-loop call sites (an
+        explicit ``now`` always evaluates — deterministic drills)."""
+        if now is None:
+            t = time.monotonic()
+            if (self._last_eval is not None
+                    and t - self._last_eval < self.interval_s):
+                return None
+        return self.step(now=now)
+
+    def step(self, now=None):
+        """One control-loop evaluation on clock ``now`` (monotonic when
+        None). Returns the action taken (``"scale_out" | "scale_in" |
+        None``)."""
+        t_real0 = time.monotonic()
+        t = t_real0 if now is None else float(now)
+        self._last_eval = t_real0
+        act0 = self.action_s
+        action = None
+        try:
+            status = self.slo.status(now=now)
+            alarm = bool(status.get("alarm"))
+            self._alarm_streak = self._alarm_streak + 1 if alarm else 0
+            ups = self._ups()
+            if telemetry.enabled():
+                _M_REPLICAS.set(ups)
+            if alarm:
+                self._idle_since = None
+                if (self._alarm_streak >= self.burn_consecutive
+                        and t >= self._out_ok_at):
+                    if self.scale_out(now=t) is not None:
+                        action = "scale_out"
+            elif self._fleet_idle():
+                if self._idle_since is None:
+                    self._idle_since = t
+                elif (t - self._idle_since >= self.idle_after_s
+                      and t >= self._in_ok_at
+                      and self._ups() > self.min_replicas):
+                    if self.scale_in(now=t) is not None:
+                        action = "scale_in"
+            else:
+                self._idle_since = None
+            if action is not None and telemetry.enabled():
+                _M_REPLICAS.set(self._ups())
+        finally:
+            self.eval_s += max((time.monotonic() - t_real0)
+                               - (self.action_s - act0), 0.0)
+        return action
+
+    # ------------------------------------------------------------ actions
+
+    def _decide(self, action, outcome, reason, windows=None, **extra):
+        d = {"ts": time.time(),  # wall-clock: x-process decision history
+             "action": action, "outcome": outcome, "reason": str(reason),
+             "windows": windows or {}, "replicas_up": self._ups(),
+             **extra}
+        self._decisions.append(d)
+        # the flight event IS the audit trail: the ring (and any dump
+        # taken during the incident) names the trigger windows
+        telemetry.flight_recorder().record(f"autoscale.{action}",
+                                           **{k: v for k, v in d.items()
+                                              if k != "action"})
+        return d
+
+    def scale_out(self, now=None, reason="sustained slo burn"):
+        """Grow the fleet by one replica (bounded by ``max_replicas``),
+        warm-before-admit. Returns the new replica id, or None when
+        refused (at bound) or the factory failed (counted, cooled down,
+        retried on a later evaluation)."""
+        t = time.monotonic() if now is None else float(now)
+        windows = self.slo.burning_windows()
+        ups = self._ups()
+        if ups >= self.max_replicas:
+            bump_counter("autoscale.at_max")
+            self._decide("scale_out", "refused",
+                         f"at max_replicas ({self.max_replicas})",
+                         windows)
+            # cooldown anyway: re-deciding "still at max" every
+            # evaluation would spam the flight ring during the incident
+            self._out_ok_at = t + self.scale_out_cooldown_s
+            return None
+        t_act = time.monotonic()
+        try:
+            # fault site: the replica factory hangs/dies mid scale-out
+            # (provisioner outage). The fleet must keep serving on the
+            # survivors and retry after the cooldown.
+            inject("autoscale.stall")
+            frontend = self.factory()
+            rep_id = self.router.scale_out(frontend, warmup=self.warmup)
+        except Exception as e:  # noqa: BLE001 — a broken factory slows
+            # scaling, it must not take down the control loop
+            self.action_s += time.monotonic() - t_act
+            self.factory_errors += 1
+            bump_counter("autoscale.factory_error")
+            logger.warning("autoscale: replica factory failed (%s); "
+                           "retrying after cooldown", e)
+            self._decide("scale_out", "factory_error", repr(e), windows)
+            self._out_ok_at = t + self.scale_out_cooldown_s
+            return None
+        self.action_s += time.monotonic() - t_act
+        self.scale_outs += 1
+        bump_counter("autoscale.scale_out")
+        self._out_ok_at = t + self.scale_out_cooldown_s
+        # a just-grown fleet must not immediately shrink on the next
+        # quiet moment: restart the idle hold too
+        self._idle_since = None
+        self._in_ok_at = max(self._in_ok_at, t + self.scale_in_cooldown_s)
+        self._decide("scale_out", "ok", reason, windows, replica=rep_id)
+        logger.warning("autoscale: scaled OUT to %d replicas "
+                       "(replica %d; %s; burning windows %s)",
+                       self._ups(), rep_id, reason, windows)
+        return rep_id
+
+    def scale_in(self, replica_id=None, now=None, reason="sustained idle"):
+        """Drain one replica (the least-loaded live one unless named).
+        REFUSED — counted, recorded, deferred — while the burn alarm is
+        up or the brownout ladder is engaged: a fleet already missing
+        its SLO must never shrink. Returns the drained replica id or
+        None."""
+        t = time.monotonic() if now is None else float(now)
+        guard = None
+        if self.slo.alarm():
+            guard = "slo burn alarm is up"
+        elif self.brownout is not None and self.brownout.stage > 0:
+            guard = (f"brownout ladder engaged (stage "
+                     f"{self.brownout.stage})")
+        if guard is not None:
+            self.refused += 1
+            bump_counter("autoscale.scale_in_refused")
+            self._decide("scale_in", "refused", guard,
+                         self.slo.burning_windows())
+            logger.warning("autoscale: scale_in refused (%s)", guard)
+            # cool down like the at-max scale_out path: while the
+            # alarm/ladder stays engaged, re-refusing every evaluation
+            # would spam the flight ring and evict the incident's real
+            # history from the decision deque
+            self._in_ok_at = max(self._in_ok_at,
+                                 t + self.scale_in_cooldown_s)
+            return None
+        ups = [r for r in self.router._replicas.values()
+               if r.state == "up"]
+        if len(ups) <= self.min_replicas:
+            self._decide("scale_in", "refused",
+                         f"at min_replicas ({self.min_replicas})")
+            return None
+        if replica_id is None:
+            replica_id = min(ups, key=lambda r: (len(r.assigned),
+                                                 -r.id)).id
+        t_act = time.monotonic()
+        try:
+            self.router.scale_in(replica_id)
+        finally:
+            self.action_s += time.monotonic() - t_act
+        self.scale_ins += 1
+        bump_counter("autoscale.scale_in")
+        self._in_ok_at = t + self.scale_in_cooldown_s
+        self._idle_since = None
+        self._decide("scale_in", "ok", reason, replica=replica_id)
+        logger.warning("autoscale: scaled IN to %d replicas "
+                       "(drained replica %d; %s)", self._ups(),
+                       replica_id, reason)
+        return replica_id
+
+    # ------------------------------------------------------------- views
+
+    def decisions(self) -> list:
+        """The decision history, oldest first (bounded ring)."""
+        return list(self._decisions)
+
+    def stats(self) -> dict:
+        """Control-loop accounting. ``eval_s`` is the decision loop's
+        own cost (the bench e7 overhead gate input:
+        ``autoscale_overhead_pct`` < 3% of active processing);
+        ``action_s`` — factory, warmup, drains — is useful fleet work,
+        split out."""
+        return {"eval_s": self.eval_s, "action_s": self.action_s,
+                "scale_outs": self.scale_outs,
+                "scale_ins": self.scale_ins, "refused": self.refused,
+                "factory_errors": self.factory_errors,
+                "replicas_up": self._ups(),
+                "decisions": len(self._decisions)}

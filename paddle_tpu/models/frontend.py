@@ -47,6 +47,7 @@ import numpy as np
 
 from ..core import perfwatch, telemetry
 from ..core.resilience import CircuitBreaker, Deadline, bump_counter
+from .qos import FairClock, QoSPolicy, tenant_label
 from .serving import TERMINAL_STATES as _ENGINE_TERMINAL
 
 __all__ = ["ServingFrontend", "RequestResult", "TERMINAL_STATES",
@@ -71,6 +72,14 @@ _M_SLO_SHED = telemetry.counter(
     "serving.slo_shed", "admissions shed by the SLO burn-rate monitor "
     "(FLAGS_slo_shedding on, alarm up, priority below the protected "
     "class)")
+# the admission-verdict counters also carry {tenant, priority}
+# attribution series (label-less series = historical totals; labeled
+# series answer WHOSE traffic was turned away during an incident)
+_M_REJECTED = telemetry.counter("serving.rejected")
+_M_SHED = telemetry.counter("serving.shed")
+_M_QUOTA = telemetry.counter(
+    "serving.quota_rejected", "admissions rejected because the tenant's "
+    "outstanding token cost would exceed its QoS quota_tokens")
 
 # the latency histograms every health/stats summary reads, keyed by the
 # short name the payloads use
@@ -119,13 +128,19 @@ class RequestResult:
 
 
 class _Pending:
-    """A queued admission, ordered by (priority DESC, arrival ASC)."""
+    """A queued admission, ordered by (priority DESC, WFQ virtual
+    finish tag ASC, arrival ASC). ``vft`` is the start-time-fair-queue
+    tag (``qos.FairClock``): within one priority class tenants
+    interleave by weighted share instead of raw arrival order — for a
+    single tenant the tags are arrival-monotonic, so the historical
+    FIFO-within-priority order is preserved bit-for-bit."""
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "priority", "deadline",
-                 "cost", "seq", "token_base", "trace", "t0m", "t0w")
+                 "cost", "seq", "token_base", "trace", "tenant", "vft",
+                 "t0m", "t0w")
 
     def __init__(self, rid, prompt, max_new_tokens, priority, deadline,
-                 seq, token_base=0, trace=None):
+                 seq, token_base=0, trace=None, tenant=None, vft=0.0):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -136,11 +151,14 @@ class _Pending:
         self.seq = seq
         self.token_base = token_base
         self.trace = trace              # telemetry trace id
+        self.tenant = tenant
+        self.vft = float(vft)           # WFQ virtual finish tag
         self.t0m = time.monotonic()     # queue-wait anchor
         self.t0w = time.time()  # wall-clock: x-process trace epoch
 
     def __lt__(self, other):
-        return (-self.priority, self.seq) < (-other.priority, other.seq)
+        return ((-self.priority, self.vft, self.seq)
+                < (-other.priority, other.vft, other.seq))
 
 
 class ServingFrontend:
@@ -166,13 +184,29 @@ class ServingFrontend:
     def __init__(self, engine, max_queue=64, max_queued_tokens=None,
                  default_max_new_tokens=64, segment=16, breaker=None,
                  breaker_threshold=5, breaker_cooldown_s=30.0,
-                 watchdog=None, watch_name="serving.step", slo=None):
+                 watchdog=None, watch_name="serving.step", slo=None,
+                 qos=None, brownout=None):
         self.engine = engine
         # SLO monitor (perfwatch): declared TTFT / per-token objectives
         # evaluated over the process registry histograms. Always present
         # (status() is cheap and gated); shedding only ever engages
         # behind FLAGS_slo_shedding.
         self.slo = slo if slo is not None else perfwatch.SLOMonitor()
+        # multi-tenant QoS: tenant weights feed the WFQ admission order,
+        # quota_tokens bounds each tenant's outstanding cost. The
+        # default policy has no quotas and uniform weights — tenant-less
+        # traffic behaves exactly as before.
+        self.qos = qos if qos is not None else QoSPolicy()
+        self._fair = FairClock(self.qos)
+        self._tenant_out: dict = {}   # tenant -> outstanding token cost
+        self._req_cost: dict = {}     # rid -> (tenant, cost)
+        # brownout ladder (perfwatch): staged degradation under a
+        # sustained burn alarm. Inert unless FLAGS_brownout (or an
+        # explicitly enabled controller) — same opt-in discipline as
+        # FLAGS_slo_shedding.
+        self.brownout = (brownout if brownout is not None
+                         else perfwatch.BrownoutController(self.slo,
+                                                           qos=self.qos))
         self.max_queue = int(max_queue)
         self.max_queued_tokens = max_queued_tokens
         self.default_max_new_tokens = int(default_max_new_tokens)
@@ -214,12 +248,25 @@ class ServingFrontend:
                 token_base=0):
         self._results[rid] = RequestResult(rid, status, tokens, reason,
                                            token_base=token_base)
+        # quota accounting: a terminal verdict releases the tenant's
+        # outstanding token cost (single release point — every path,
+        # admission reject included, lands here)
+        held = self._req_cost.pop(rid, None)
+        if held is not None:
+            tenant, cost = held
+            left = self._tenant_out.get(tenant, 0) - cost
+            if left > 0:
+                self._tenant_out[tenant] = left
+            else:
+                self._tenant_out.pop(tenant, None)
         return rid
 
-    def _reject(self, rid, reason):
+    def _reject(self, rid, reason, tenant=None, priority=0):
         bump_counter("serving.rejected")
         if telemetry.enabled():
             _M_REQS.inc(status="rejected")  # engine never saw it
+            _M_REJECTED.inc(tenant=tenant_label(tenant),
+                            priority=int(priority))
         self.engine.note_rejection()  # stats()['rejected'] sees shedding
         return self._finish(rid, "rejected", reason=reason)
 
@@ -236,11 +283,15 @@ class ServingFrontend:
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
                deadline_s=None, rid=None, token_base=0,
-               trace=None) -> int:
+               trace=None, tenant=None) -> int:
         """Admit one request; returns its rid. Never raises for a bad or
         shed request — the verdict lands in ``results()`` as status
-        ``rejected`` (admission control / malformed), ``unavailable``
-        (circuit open), or a terminal decode status later.
+        ``rejected`` (admission control / malformed / tenant over
+        quota), ``unavailable`` (circuit open), or a terminal decode
+        status later. ``tenant`` selects the QoS lane: the tenant's WFQ
+        weight orders it within its priority class, its ``quota_tokens``
+        bounds the outstanding cost it may hold here, and its metrics
+        series attribute the latency it sees.
 
         ``rid`` lets a caller that owns the request-id space (the fleet
         ``ServingRouter`` — sampling streams are keyed on the rid, so a
@@ -265,19 +316,33 @@ class ServingFrontend:
                 self._rids = itertools.count(
                     max(rid + 1, next(self._rids)))
         if self._closed or self._draining:
-            return self._reject(rid, "shutting down")
-        if telemetry.enabled() and self.slo.should_shed(priority):
-            # burn-rate shedding (FLAGS_slo_shedding): while the SLO
-            # error budget burns past threshold, low-priority admissions
-            # are turned away at the door so the protected classes keep
-            # their latency — the frontend-local form of the same
-            # degrade-don't-collapse policy the queue eviction applies
-            _M_SLO_SHED.inc()
-            return self._reject(
-                rid, "slo burn-rate shed (error budget burning; "
-                     f"priority {int(priority)} below protected class)")
+            return self._reject(rid, "shutting down", tenant, priority)
         max_new = (self.default_max_new_tokens if max_new_tokens is None
                    else int(max_new_tokens))
+        if telemetry.enabled():
+            # brownout ladder (FLAGS_brownout): staged degradation —
+            # cap budgets, then shed low priority, then over-share
+            # tenants, then everything below the protected class. Inert
+            # at stage 0 / flag off. over_share is a thunk: the
+            # fair-share scan only runs at stage >= 3, not per submit.
+            act, max_new, why = self.brownout.admit(
+                tenant, priority, max_new,
+                over_share=lambda: self.qos.over_share(tenant,
+                                                       self._tenant_out))
+            if act == "shed":
+                return self._reject(rid, why, tenant, priority)
+            if self.slo.should_shed(priority):
+                # legacy binary burn-rate shedding (FLAGS_slo_shedding):
+                # while the SLO error budget burns past threshold,
+                # low-priority admissions are turned away at the door so
+                # the protected classes keep their latency
+                _M_SLO_SHED.inc()
+                _M_SLO_SHED.inc(tenant=tenant_label(tenant),
+                                priority=int(priority))
+                return self._reject(
+                    rid, "slo burn-rate shed (error budget burning; "
+                         f"priority {int(priority)} below protected "
+                         "class)", tenant, priority)
         try:
             prompt = np.asarray(prompt).astype(np.int32).ravel()
             self.engine._validate(prompt, max_new)
@@ -285,7 +350,23 @@ class ServingFrontend:
             # a request the engine could NEVER schedule is a poison pill
             # caught at the door — admission is where it must die, not
             # inside a co-batched dispatch
-            return self._reject(rid, str(e))
+            return self._reject(rid, str(e), tenant, priority)
+        # tenant token-budget quota: outstanding cost (queued + admitted,
+        # prompt tokens + decode budget) may not exceed quota_tokens.
+        # The frontend's submit never raises — the typed
+        # TenantQuotaExceeded surface is the ROUTER's client API; here
+        # the verdict is a "rejected" result with the same accounting.
+        cost = int(prompt.size) + int(max_new)
+        if not self.qos.check_quota(tenant,
+                                    self._tenant_out.get(tenant, 0), cost):
+            bump_counter("serving.quota_rejected")
+            if telemetry.enabled():
+                _M_QUOTA.inc(tenant=tenant_label(tenant))
+            return self._reject(
+                rid, f"tenant {tenant_label(tenant)} over quota "
+                     f"({self._tenant_out.get(tenant, 0)} outstanding + "
+                     f"{cost} > {self.qos.quota_tokens(tenant)} tokens)",
+                tenant, priority)
         probe = False
         if self.breaker.state() != CircuitBreaker.CLOSED:
             # half-open admission goes through the breaker's own probe
@@ -302,7 +383,8 @@ class ServingFrontend:
         entry = _Pending(rid, prompt, max_new, int(priority),
                          (deadline_s if isinstance(deadline_s, Deadline)
                           else Deadline(deadline_s)), next(self._seq),
-                         token_base=int(token_base), trace=trace)
+                         token_base=int(token_base), trace=trace,
+                         tenant=tenant)
         if telemetry.enabled():
             telemetry.trace_event("serving.submit", trace=trace, rid=rid,
                                   prompt_tokens=int(prompt.size),
@@ -317,15 +399,29 @@ class ServingFrontend:
                 self.breaker.release_probe()
             return self._reject(
                 rid, f"admission queue full "
-                     f"(depth {len(self._queue)}/{self.max_queue})")
+                     f"(depth {len(self._queue)}/{self.max_queue})",
+                tenant, priority)
         while self._over_budget(entry):
             # _feasible guarantees the tail outranks nothing: every
             # remaining over-budget token/slot is held by a lower-priority
             # entry, so the victim is always evictable
             victim = self._queue.pop()
             bump_counter("serving.shed")
-            self._reject(victim.rid, "shed by higher-priority admission")
+            if telemetry.enabled():
+                _M_SHED.inc(tenant=tenant_label(victim.tenant),
+                            priority=int(victim.priority))
+            self._reject(victim.rid, "shed by higher-priority admission",
+                         victim.tenant, victim.priority)
             self._resolve_probe(victim.rid, "rejected")
+        # the WFQ tag is charged to the tenant's lane only once the
+        # entry is ACCEPTED: a queue-full rejection must not push the
+        # tenant's virtual start time into the future, or a burst of
+        # rejections would deprioritize its post-overload traffic
+        entry.vft = self._fair.tag(entry.priority, tenant, entry.cost)
+        # quota accounting: the entry now holds its cost until terminal
+        self._req_cost[rid] = (tenant, entry.cost)
+        self._tenant_out[tenant] = (self._tenant_out.get(tenant, 0)
+                                    + entry.cost)
         bisect.insort(self._queue, entry)
         if probe:
             self._probe_rids.add(rid)
@@ -390,23 +486,34 @@ class ServingFrontend:
     def _step(self):
         if telemetry.enabled():
             # keep the burn-rate windows current even when nobody polls
-            # health(); rate-limited inside the monitor
+            # health(); rate-limited inside the monitor — and let the
+            # brownout ladder step with the alarm (inert unless enabled)
             self.slo.status()
+            self.brownout.maybe_step()
         self._sweep_expired()
         room = self.engine.free_slots() - len(self.engine.queued_requests())
         while room > 0 and self._queue:
             entry = self._queue.pop(0)
+            # WFQ: dispatching advances the class's virtual clock so
+            # late-arriving tenants start at the present
+            self._fair.advance(entry.priority, entry.vft)
             req = self.engine.submit(entry.prompt, entry.max_new_tokens,
                                      deadline_s=entry.deadline,
                                      rid=entry.rid,
                                      token_base=entry.token_base,
-                                     trace=entry.trace)
+                                     trace=entry.trace,
+                                     tenant=entry.tenant)
             # TTFT anchors at frontend SUBMIT time, not engine admission
             # — queue wait is part of the latency a client sees
             req.t_submit = entry.t0m
             if telemetry.enabled():
                 wait = time.monotonic() - entry.t0m
                 _M_QWAIT.observe(wait)
+                if entry.tenant is not None:
+                    # per-tenant series: the WFQ fairness bound ("a hot
+                    # tenant cannot blow a quiet tenant's queue wait")
+                    # is asserted on exactly this attribution
+                    _M_QWAIT.observe(wait, tenant=str(entry.tenant))
                 telemetry.tracer().add_span(
                     "serving.queue_wait", entry.t0w, wait,
                     trace=entry.trace, rid=entry.rid)
@@ -587,10 +694,14 @@ class ServingFrontend:
         else:
             state = "ok"
         by_prio: dict[int, list] = {}
+        by_tenant: dict[str, list] = {}
         for e in self._queue:
             row = by_prio.setdefault(int(e.priority), [0, 0])
             row[0] += 1
             row[1] += e.cost
+            trow = by_tenant.setdefault(tenant_label(e.tenant), [0, 0])
+            trow[0] += 1
+            trow[1] += e.cost
         active = len(self.engine.active_requests())
         total = int(self.engine.max_slots)
         return {
@@ -602,6 +713,11 @@ class ServingFrontend:
             "queue_depth": len(self._queue),
             "queued_tokens": self.queued_tokens(),
             "queue_by_priority": by_prio,
+            "queue_by_tenant": by_tenant,
+            # per-tenant OUTSTANDING token cost (queued + in-flight):
+            # the quantity quota_tokens bounds
+            "tenant_outstanding": {tenant_label(t): int(c)
+                                   for t, c in self._tenant_out.items()},
             "inflight": len(self._inflight),
             "active_slots": active,
             "free_slots": self.engine.free_slots(),
@@ -611,4 +727,6 @@ class ServingFrontend:
             # perfwatch SLO verdict: objectives, rolling goodput,
             # multi-window burn rate, the alarm the shedding flag acts on
             "slo": (self.slo.status() if telemetry.enabled() else {}),
+            # brownout ladder stage (0 unless FLAGS_brownout engaged it)
+            "brownout": self.brownout.status(),
         }

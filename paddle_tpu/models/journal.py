@@ -212,7 +212,7 @@ class RequestJournal:
         return True
 
     def admit(self, rid, prompt, max_new_tokens, priority=0,
-              deadline_s=None, hedge=False) -> bool:
+              deadline_s=None, hedge=False, tenant=None) -> bool:
         """Journal one admission. Idempotent per rid (a failover replay
         or client resubmit must not duplicate the record)."""
         rid = int(rid)
@@ -227,6 +227,10 @@ class RequestJournal:
                                else float(deadline_s)),
                 "admit_wall": time.time(),  # wall-clock: x-process replay
                 "hedge": bool(hedge),
+                # QoS lane: the standby's replay must re-dispatch the
+                # request in the SAME tenant lane (quota hold, WFQ
+                # weight, metrics attribution)
+                "tenant": tenant,
             }
             if not self._append(rec):
                 return False
@@ -401,6 +405,8 @@ class RequestJournal:
             state = {k: rec[k] for k in ("prompt", "max_new", "prio",
                                          "deadline_s", "admit_wall",
                                          "hedge")}
+            # absent in pre-QoS epoch files: replay them tenant-less
+            state["tenant"] = rec.get("tenant")
             state["rid"] = rid
             state["prompt"] = np.asarray(state["prompt"], np.int32)
             state["emitted"] = np.zeros((0,), np.int32)

@@ -1,0 +1,221 @@
+"""Multi-tenant QoS policy: token-budget quotas and weighted fair
+queueing across tenants.
+
+One engine slot pool serves MANY tenants; without a policy layer the
+loudest tenant owns the queue. This module is the policy the serving
+stack shares (``ServingFrontend`` for per-replica admission,
+``ServingRouter`` for the fleet-wide client surface):
+
+* **Token-budget quotas** — each tenant may hold at most
+  ``quota_tokens`` of OUTSTANDING cost (queued + in-flight prompt
+  tokens plus decode budgets). The fleet router rejects an over-quota
+  ``submit(tenant=...)`` with the typed
+  :class:`~paddle_tpu.core.resilience.TenantQuotaExceeded`; a
+  standalone frontend (whose ``submit`` never raises) records the same
+  verdict as a ``"rejected"`` result. Both count
+  ``serving.quota_rejected{tenant=...}``.
+* **Weighted fair queueing** — :class:`FairClock` implements start-time
+  fair queueing over the admission queue: WITHIN a priority class,
+  entries are ordered by per-tenant virtual finish tags
+  (``start + cost / weight``), so a tenant flooding the queue advances
+  its own virtual time and interleaves behind the quiet tenants' next
+  requests instead of starving them. Priority classes still dominate
+  (the existing shed-last contract); tenant fairness applies inside
+  each class. Requests with no tenant share one default lane, which
+  keeps the historical FIFO-within-priority order for single-tenant
+  callers bit-for-bit.
+* **Fair-share accounting** — :meth:`QoSPolicy.over_share` tells the
+  brownout ladder (``core/perfwatch.py``) which tenants exceed their
+  weight-proportional share of the outstanding work, so stage-3
+  brownout sheds the tenants CAUSING the overload and keeps the
+  within-share ones served.
+
+The policy object is deliberately plain (no locks: the frontend and
+router mutate their own usage maps under their existing single-threaded
+pump discipline) and cheap — one dict lookup per admission.
+"""
+from __future__ import annotations
+
+__all__ = ["TenantPolicy", "QoSPolicy", "FairClock", "DEFAULT_TENANT",
+           "tenant_label", "tenant_summaries"]
+
+# label value used for requests submitted without a tenant — metrics
+# labels must be strings, and "-" keeps dashboards readable
+DEFAULT_TENANT = "-"
+
+
+def tenant_label(tenant) -> str:
+    """The metrics-label form of a tenant id (None -> ``"-"``)."""
+    return DEFAULT_TENANT if tenant is None else str(tenant)
+
+
+class TenantPolicy:
+    """Per-tenant knobs: ``weight`` is the WFQ share (2.0 drains twice
+    as fast as 1.0 inside a priority class); ``quota_tokens`` bounds the
+    tenant's outstanding token cost (None = unlimited)."""
+
+    __slots__ = ("tenant", "weight", "quota_tokens")
+
+    def __init__(self, tenant, weight=1.0, quota_tokens=None):
+        self.tenant = tenant
+        self.weight = float(weight)
+        if self.weight <= 0.0:
+            raise ValueError(f"tenant {tenant!r} weight must be > 0, "
+                             f"got {self.weight}")
+        self.quota_tokens = (None if quota_tokens is None
+                             else int(quota_tokens))
+
+    def __repr__(self):
+        return (f"TenantPolicy({self.tenant!r}, weight={self.weight:g}, "
+                f"quota_tokens={self.quota_tokens})")
+
+
+class QoSPolicy:
+    """Tenant policy table with defaults for unknown tenants.
+
+    Usage::
+
+        qos = QoSPolicy({"alpha": TenantPolicy("alpha", weight=2.0,
+                                               quota_tokens=4096),
+                         "beta": TenantPolicy("beta")},
+                        default_quota_tokens=1024)
+    """
+
+    def __init__(self, tenants=None, default_weight=1.0,
+                 default_quota_tokens=None):
+        self._tenants: dict = {}
+        self.default_weight = float(default_weight)
+        self.default_quota_tokens = (
+            None if default_quota_tokens is None
+            else int(default_quota_tokens))
+        for t in (tenants or {}).values() if isinstance(tenants, dict) \
+                else (tenants or ()):
+            self.add(t)
+
+    def add(self, policy: TenantPolicy):
+        self._tenants[policy.tenant] = policy
+        return policy
+
+    def weight(self, tenant) -> float:
+        p = self._tenants.get(tenant)
+        return p.weight if p is not None else self.default_weight
+
+    def quota_tokens(self, tenant):
+        p = self._tenants.get(tenant)
+        return (p.quota_tokens if p is not None
+                else self.default_quota_tokens)
+
+    def check_quota(self, tenant, outstanding, cost) -> bool:
+        """True when ``tenant`` (currently holding ``outstanding``
+        tokens of cost) may admit ``cost`` more within its quota."""
+        quota = self.quota_tokens(tenant)
+        return quota is None or outstanding + cost <= quota
+
+    def over_share(self, tenant, usage: dict) -> bool:
+        """Is ``tenant`` using MORE than its weight-proportional share
+        of the total outstanding work in ``usage`` (``{tenant: cost}``)?
+        The brownout ladder's stage-3 question: shed the tenants causing
+        the overload, keep the within-share ones. A sole tenant is never
+        over-share (there is nobody to be unfair to)."""
+        total = sum(usage.values())
+        if total <= 0:
+            return False
+        active = [t for t, c in usage.items() if c > 0]
+        if len(active) <= 1:
+            return False
+        wsum = sum(self.weight(t) for t in active)
+        fair = total * self.weight(tenant) / wsum if wsum > 0 else 0.0
+        return usage.get(tenant, 0) > fair
+
+
+class FairClock:
+    """Start-time fair queueing virtual clock, one per admission queue.
+
+    ``tag(priority, tenant, cost)`` assigns the entry's virtual finish
+    time inside its priority class: ``start = max(class virtual time,
+    tenant's last finish)``, ``finish = start + cost / weight``. The
+    queue sorts by ``(-priority, finish_tag, seq)``; ``advance()`` moves
+    the class clock forward when an entry is dispatched so newly
+    arriving tenants start at the present, not at zero."""
+
+    def __init__(self, qos: QoSPolicy | None = None):
+        self.qos = qos or QoSPolicy()
+        self._vtime: dict = {}     # priority class -> virtual time
+        self._finish: dict = {}    # (priority, tenant) -> last finish tag
+
+    def tag(self, priority, tenant, cost) -> float:
+        v = self._vtime.get(priority, 0.0)
+        start = max(v, self._finish.get((priority, tenant), 0.0))
+        fin = start + float(cost) / self.qos.weight(tenant)
+        self._finish[(priority, tenant)] = fin
+        return fin
+
+    def advance(self, priority, finish_tag):
+        if finish_tag > self._vtime.get(priority, 0.0):
+            self._vtime[priority] = float(finish_tag)
+
+
+# -------------------------------------------------- per-tenant metrics view
+
+def _split_series(series_name):
+    """``"name{k=v,k2=v2}"`` -> ``(name, {k: v, ...})`` (the registry's
+    flattened series-name format; our label values never contain
+    commas)."""
+    if "{" not in series_name:
+        return series_name, {}
+    fam, rest = series_name.split("{", 1)
+    labels = dict(p.split("=", 1) for p in rest[:-1].split(","))
+    return fam, labels
+
+
+# histogram families carrying {tenant=...} attribution series
+_TENANT_HISTS = {"serving.ttft_s": "ttft",
+                 "serving.token_latency_s": "token_latency",
+                 "serving.queue_wait_s": "queue_wait"}
+# counter families summed per tenant (across their other labels)
+_TENANT_COUNTERS = {"serving.tokens_total": "tokens_total",
+                    "serving.shed": "shed",
+                    "serving.rejected": "rejected",
+                    "serving.slo_shed": "slo_shed",
+                    "serving.quota_rejected": "quota_rejected",
+                    "serving.brownout_shed": "brownout_shed"}
+
+
+def tenant_summaries(snapshot, ttft_threshold_s=None) -> dict:
+    """Per-tenant QoS view out of a (possibly fleet-merged) registry
+    snapshot: latency percentile summaries per tenant-labeled histogram
+    series, goodput at the TTFT objective threshold, and the admission-
+    verdict counters summed per tenant. This is
+    ``ServingRouter.fleet_metrics()['tenants']`` — the "which tenant is
+    hurting / which tenant is hurting US" answer in one dict."""
+    from ..core import perfwatch, telemetry
+    from ..core.flags import flag
+
+    if ttft_threshold_s is None:
+        ttft_threshold_s = float(flag("FLAGS_slo_ttft_s"))
+    out: dict = {}
+
+    def row(tenant):
+        return out.setdefault(tenant, {
+            "goodput_ttft": 1.0,
+            **{v: 0 for v in _TENANT_COUNTERS.values()}})
+
+    for name, h in (snapshot.get("histograms") or {}).items():
+        fam, labels = _split_series(name)
+        tenant = labels.get("tenant")
+        key = _TENANT_HISTS.get(fam)
+        if tenant is None or key is None or len(labels) != 1:
+            continue
+        r = row(tenant)
+        r[key] = telemetry.summary_from_snapshot(snapshot, name)
+        if fam == "serving.ttft_s" and h.get("count"):
+            good = perfwatch._count_within(h, ttft_threshold_s)
+            r["goodput_ttft"] = round(min(good / h["count"], 1.0), 4)
+    for name, v in (snapshot.get("counters") or {}).items():
+        fam, labels = _split_series(name)
+        tenant = labels.get("tenant")
+        key = _TENANT_COUNTERS.get(fam)
+        if tenant is None or key is None:
+            continue
+        row(tenant)[key] += int(v)
+    return out

@@ -246,12 +246,14 @@ class ReplicaServer:
                 for rid, (base, toks) in prog.items()]
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
-               deadline_s=None, rid=None, token_base=0, trace=None):
+               deadline_s=None, rid=None, token_base=0, trace=None,
+               tenant=None):
         """Rid-idempotent admission: a rid still LIVE here (pending or
         finished-but-unfetched) is a duplicate of a retried/redelivered
         send — acknowledge it without double-enqueueing. ``trace`` is
         the router-minted telemetry trace id off the RPC envelope; the
-        frontend's spans in THIS process stitch under it."""
+        frontend's spans in THIS process stitch under it. ``tenant``
+        rides the same envelope into the frontend's QoS lane."""
         with self._lock:
             if rid is not None and rid in self._live:
                 bump_counter("serving.dup_submit")
@@ -260,7 +262,7 @@ class ReplicaServer:
                 np.asarray(prompt, np.int32),
                 max_new_tokens=max_new_tokens, priority=priority,
                 deadline_s=deadline_s, rid=rid, token_base=token_base,
-                trace=trace)
+                trace=trace, tenant=tenant)
             self._live.add(got)
             return got
 
@@ -449,11 +451,13 @@ class RemoteFrontend:
     # ------------------------------------------- ServingFrontend surface
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
-               deadline_s=None, rid=None, token_base=0, trace=None):
+               deadline_s=None, rid=None, token_base=0, trace=None,
+               tenant=None):
         # a Deadline is monotonic and process-local: ship the REMAINING
         # seconds; the replica re-anchors it on its own clock (queue wait
         # there still counts against the budget). The telemetry trace id
-        # rides the same envelope — the replica's spans stitch under it.
+        # (and QoS tenant) ride the same envelope — the replica's spans
+        # and tenant lanes stitch under them.
         if isinstance(deadline_s, Deadline):
             rem = deadline_s.remaining()
             deadline_s = None if rem == float("inf") else max(rem, 0.0)
@@ -461,7 +465,7 @@ class RemoteFrontend:
                          max_new_tokens=max_new_tokens,
                          priority=int(priority), deadline_s=deadline_s,
                          rid=rid, token_base=int(token_base),
-                         trace=trace)
+                         trace=trace, tenant=tenant)
 
     def results(self, wait=False, timeout=None) -> dict:
         """Pop terminal results. ``wait=True`` polls until the replica

@@ -85,10 +85,12 @@ from ..core.resilience import (
     Deadline,
     ServingUnavailable,
     StaleLeaderError,
+    TenantQuotaExceeded,
     bump_counter,
     logger,
 )
 from .frontend import RequestResult, latency_summaries
+from .qos import QoSPolicy, tenant_label, tenant_summaries
 
 __all__ = ["ServingRouter", "launch_fleet"]
 
@@ -124,16 +126,17 @@ class _FleetRequest:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "priority", "deadline",
                  "emitted", "live", "excluded", "failovers", "hedged",
-                 "discard", "deadline_s", "trace")
+                 "discard", "deadline_s", "trace", "tenant")
 
     def __init__(self, rid, prompt, max_new_tokens, priority, deadline,
-                 hedged, deadline_s=None):
+                 hedged, deadline_s=None, tenant=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.priority = int(priority)
         self.deadline = deadline
         self.deadline_s = deadline_s  # original budget (journal replay)
+        self.tenant = tenant          # QoS lane, rides every attempt
         # telemetry trace id minted with the request (router-owned, like
         # the rid): every attempt's spans — across replicas, processes
         # and failover hops — stitch under it. Journal replays mint a
@@ -177,7 +180,7 @@ class ServingRouter:
                  heartbeat_interval=None, breaker_threshold=3,
                  breaker_cooldown_s=30.0, health_ttl=0.05,
                  journal=None, journal_root=None, leader_lease=None,
-                 standby=False):
+                 standby=False, qos=None):
         from ..core.flags import flag
 
         self.max_failovers = int(max_failovers)
@@ -187,6 +190,17 @@ class ServingRouter:
         self.token_unit = float(token_unit)
         self.breaker_threshold = int(breaker_threshold)
         self.breaker_cooldown_s = float(breaker_cooldown_s)
+        # multi-tenant QoS at the CLIENT surface: quota_tokens bounds a
+        # tenant's outstanding fleet-wide cost here (typed
+        # TenantQuotaExceeded — the one submit surface that raises);
+        # the same policy object is usually shared with the replica
+        # frontends, whose WFQ weights it also drives. The default has
+        # no quotas: tenant-less traffic is unchanged.
+        self.qos = qos if qos is not None else QoSPolicy()
+        self._tenant_out: dict = {}   # tenant -> outstanding token cost
+        # autoscaler (models/autoscale.py), attached via
+        # attach_autoscaler(): its control loop rides step()
+        self._autoscaler = None
         self._replicas: dict[int, _Replica] = {}
         self._requests: dict[int, _FleetRequest] = {}
         self._results: dict[int, RequestResult] = {}
@@ -565,7 +579,8 @@ class ServingRouter:
             rep.frontend.submit(prompt, freq.max_new_tokens - k,
                                 priority=freq.priority,
                                 deadline_s=freq.deadline, rid=freq.rid,
-                                token_base=k, trace=freq.trace)
+                                token_base=k, trace=freq.trace,
+                                tenant=freq.tenant)
             self._pump_s += time.monotonic() - t0
         except StaleLeaderError as e:
             self._pump_s += time.monotonic() - t0
@@ -668,11 +683,19 @@ class ServingRouter:
     # ------------------------------------------------------ client API
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
-               deadline_s=None, hedge=None, rid=None) -> int:
+               deadline_s=None, hedge=None, rid=None,
+               tenant=None) -> int:
         """Admit one request to the fleet; returns its rid. The verdict
         lands in ``results()``. ``hedge=True`` (or the router-wide
         default) duplicates the request onto the two least-loaded
         replicas; the first terminal result wins.
+
+        ``tenant`` selects the QoS lane: it rides every attempt to the
+        replica frontends (WFQ weight, per-tenant metrics), and the
+        router enforces the tenant's fleet-wide ``quota_tokens`` HERE —
+        an over-quota admission raises the typed
+        :class:`TenantQuotaExceeded` (the one submit surface that
+        raises; clients back off on it instead of retrying blind).
 
         ``rid`` is the IDEMPOTENT client surface: a client that owns its
         request ids can resubmit after a leader change and get the SAME
@@ -699,6 +722,21 @@ class ServingRouter:
         prompt = np.asarray(prompt).astype(np.int32).ravel()
         max_new = (self.default_max_new_tokens if max_new_tokens is None
                    else int(max_new_tokens))
+        # tenant token-budget quota, BEFORE the journal sees the admit:
+        # an over-quota request must not become durable state the
+        # standby would replay
+        cost = int(prompt.size) + max_new
+        held = self._tenant_out.get(tenant, 0)
+        if not self.qos.check_quota(tenant, held, cost):
+            bump_counter("serving.quota_rejected")
+            if telemetry.enabled():
+                telemetry.counter("serving.quota_rejected").inc(
+                    tenant=tenant_label(tenant))
+            raise TenantQuotaExceeded(
+                f"tenant {tenant_label(tenant)} over quota: {held} "
+                f"outstanding + {cost} > "
+                f"{self.qos.quota_tokens(tenant)} tokens",
+                tenant=tenant)
         # leadership is re-checked at ADMISSION, not just in step(): a
         # leader whose lease lapsed mid-partition (renewal thread stood
         # down, no step() since) must not ack an ADMIT into a journal
@@ -720,8 +758,10 @@ class ServingRouter:
                              self.hedge_default if hedge is None else hedge,
                              deadline_s=(None if isinstance(deadline_s,
                                                             Deadline)
-                                         else deadline_s))
+                                         else deadline_s),
+                             tenant=tenant)
         self._requests[rid] = freq
+        self._tenant_out[tenant] = held + cost
         t0 = time.monotonic()
         pump0 = self._pump_s  # frontend.submit time lands in pump_s
         if self._journal is not None:
@@ -730,7 +770,7 @@ class ServingRouter:
             self._journal.admit(rid, prompt, max_new,
                                 priority=freq.priority,
                                 deadline_s=freq.deadline_s,
-                                hedge=freq.hedged)
+                                hedge=freq.hedged, tenant=freq.tenant)
             self._journal.flush()
         if not self._dispatch(freq):
             self._parked.append(rid)
@@ -783,6 +823,12 @@ class ServingRouter:
         land the journal's batched records."""
         if not self._check_leadership():
             return
+        if self._autoscaler is not None:
+            # OUTSIDE the route_s window: the autoscaler's decision loop
+            # has its own overhead accounting (autoscale_overhead_pct,
+            # gated < 3% in bench e7), and a scale-out's warmup is
+            # useful work, not routing overhead
+            self._autoscaler.maybe_step()
         t_start = time.monotonic()
         pump0 = self._pump_s  # every frontend call below adds to pump_s
         self._sweep_liveness()
@@ -1083,7 +1129,15 @@ class ServingRouter:
         self._results[freq.rid] = RequestResult(
             freq.rid, status, tokens, reason)
         self._counts[status] = self._counts.get(status, 0) + 1
-        self._requests.pop(freq.rid, None)
+        if self._requests.pop(freq.rid, None) is not None:
+            # release the tenant's outstanding quota hold (the single
+            # terminal point every delivery path funnels through)
+            left = (self._tenant_out.get(freq.tenant, 0)
+                    - (int(freq.prompt.size) + freq.max_new_tokens))
+            if left > 0:
+                self._tenant_out[freq.tenant] = left
+            else:
+                self._tenant_out.pop(freq.tenant, None)
         if self._journal is not None:
             # terminal verdict journaled: GCs the live record and backs
             # the exactly-once resubmit cache (flushed at step/submit
@@ -1299,9 +1353,15 @@ class ServingRouter:
             freq = _FleetRequest(rid, rec["prompt"], rec["max_new"],
                                  rec["prio"], Deadline(remaining),
                                  rec["hedge"],
-                                 deadline_s=rec["deadline_s"])
+                                 deadline_s=rec["deadline_s"],
+                                 tenant=rec.get("tenant"))
             freq.emitted = np.asarray(rec["emitted"], np.int32)
             self._requests[rid] = freq
+            # re-establish the tenant's quota hold for the recovered
+            # request (released again at _deliver)
+            self._tenant_out[freq.tenant] = (
+                self._tenant_out.get(freq.tenant, 0)
+                + int(freq.prompt.size) + freq.max_new_tokens)
             for rep, base in live_map.get(rid, ()):
                 if base <= len(freq.emitted):
                     # the running copy's stream offset is inside our
@@ -1342,6 +1402,14 @@ class ServingRouter:
         return len(state), adopted, resubmitted
 
     # ------------------------------------------------------------ admin
+
+    def attach_autoscaler(self, scaler):
+        """Wire an ``models/autoscale.AutoScaler`` into the pump: every
+        ``step()`` gives its (rate-limited) control loop a turn, so a
+        fleet that is being pumped sizes itself without a separate
+        driver thread. Returns the scaler for chaining."""
+        self._autoscaler = scaler
+        return scaler
 
     def warmup(self, cache_dir=None):
         """AOT-warm every replica's compiled serving shapes. A replica
@@ -1446,6 +1514,12 @@ class ServingRouter:
         * ``slo`` — the declared TTFT/per-token objectives evaluated
           over the merged histograms (rolling goodput + multi-window
           burn rate + alarm);
+        * ``tenants`` — per-tenant QoS view (TTFT/token/queue-wait
+          percentiles, goodput at the TTFT objective, tokens served,
+          shed/rejected/quota counts) from the tenant-labeled series;
+        * ``brownout_stage`` — the brownout ladder stage from the
+          merged ``serving.brownout_stage`` gauge (freshest snapshot
+          wins — in an in-process fleet this is THE stage);
         * ``metrics`` — the full merged snapshot (counters incl. the
           whole resilience ledger, gauges, histograms) for export.
         """
@@ -1473,6 +1547,10 @@ class ServingRouter:
                        if telemetry.enabled() else {}),
             "slo": (self._slo_fleet.status()
                     if telemetry.enabled() else {}),
+            "tenants": (tenant_summaries(merged)
+                        if telemetry.enabled() else {}),
+            "brownout_stage": int(merged["gauges"].get(
+                "serving.brownout_stage", 0)),
             "tokens_total": tokens,
             "tokens_per_sec": rate,
             "replicas": {r.id: {"state": r.state,

@@ -139,10 +139,10 @@ class Request:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "deadline", "tokens",
                  "status", "poisoned", "poison_checked", "error",
-                 "token_base", "trace", "t_submit", "t_first")
+                 "token_base", "trace", "t_submit", "t_first", "tenant")
 
     def __init__(self, rid, prompt, max_new_tokens, deadline=None,
-                 token_base=0, trace=None):
+                 token_base=0, trace=None, tenant=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
@@ -154,6 +154,7 @@ class Request:
         self.error = None
         self.token_base = int(token_base)
         self.trace = trace
+        self.tenant = tenant
         self.t_submit = time.monotonic()
         self.t_first = None
 
@@ -664,7 +665,7 @@ class ContinuousBatchingEngine:
         return self
 
     def submit(self, prompt, max_new_tokens, deadline_s=None, rid=None,
-               token_base=0, trace=None):
+               token_base=0, trace=None, tenant=None):
         """Enqueue one request (requires a prior ``start()``); raises
         ``ValueError`` if it can never fit a slot. ``deadline_s`` is a
         per-request budget (seconds or a ``Deadline``), measured from
@@ -676,7 +677,10 @@ class ContinuousBatchingEngine:
         sampling keys start at stream index ``k``, so the continuation
         is bit-identical to the uninterrupted run's (same engine seed,
         same rid). ``trace`` tags the request's dispatch spans and
-        retire event with a telemetry trace id."""
+        retire event with a telemetry trace id. ``tenant`` attributes
+        the request's latency/token metrics to a tenant label (QoS is
+        enforced ABOVE the engine — frontend quotas/WFQ, router typed
+        rejections; the scheduler itself stays tenant-blind)."""
         prompt = np.asarray(prompt).astype(np.int32).ravel()
         self._validate(prompt, max_new_tokens)
         if rid is None:
@@ -689,7 +693,7 @@ class ContinuousBatchingEngine:
         deadline = (deadline_s if isinstance(deadline_s, Deadline)
                     else Deadline(deadline_s))
         req = Request(rid, prompt, max_new_tokens, deadline,
-                      token_base=token_base, trace=trace)
+                      token_base=token_base, trace=trace, tenant=tenant)
         self._queue.append(req)
         return req
 
@@ -753,8 +757,15 @@ class ContinuousBatchingEngine:
                 _M_KV_REQ.observe(pages * self.page_size
                                   * self._kv_bytes_per_token)
             if req.t_first is not None and len(req.tokens) > 1:
-                _M_TOK.observe((time.monotonic() - req.t_first)
-                               / (len(req.tokens) - 1))
+                per_tok = ((time.monotonic() - req.t_first)
+                           / (len(req.tokens) - 1))
+                _M_TOK.observe(per_tok)
+                if req.tenant is not None:
+                    _M_TOK.observe(per_tok, tenant=str(req.tenant))
+            if req.tenant is not None and req.tokens:
+                # tenant-attributed emission total (labeled series only;
+                # the unlabeled serving.tokens_total counts at emission)
+                _M_TOKENS.inc(len(req.tokens), tenant=str(req.tenant))
             telemetry.trace_event("serving.retire", trace=req.trace,
                                   rid=req.rid, status=status,
                                   tokens=len(req.tokens))
@@ -852,6 +863,12 @@ class ContinuousBatchingEngine:
                 # would skew the fleet TTFT percentiles during exactly
                 # the incidents where the SLO number matters
                 _M_TTFT.observe(req.t_first - req.t_submit)
+                if req.tenant is not None:
+                    # per-tenant attribution SERIES (the unlabeled
+                    # series above stays the total; these answer "whose
+                    # latency" in fleet_metrics()['tenants'])
+                    _M_TTFT.observe(req.t_first - req.t_submit,
+                                    tenant=str(req.tenant))
             _M_TOKENS.inc()
         self._lengths[slot] = req.prompt.size
         self._cur_tok[slot] = int(tok)

@@ -54,6 +54,17 @@ GATES = {
     # not a percentage: ANY post-warmup XLA recompile in the bench
     # workload (bench e6 records the count) breaks the PR 5 invariant
     "perfwatch_serving_compiles": 1.0,
+    # overload-control plane (bench e7, flash-crowd drill): the
+    # autoscaler's decision loop must stay cheap, the fleet must not
+    # overshoot the needed capacity by more than one replica, and the
+    # brownout ladder must hold the goodput floor, never lose the
+    # protected class, and fully recover after the crowd passes
+    "autoscale_overhead_pct": 3.0,
+    "autoscale_reaction_s": 120.0,   # alarm -> new replica SERVING
+    "autoscale_overshoot_replicas": 2.0,
+    "brownout_protected_loss_pct": 1.0,
+    "brownout_floor_breach": 1.0,    # 0/1: goodput floor under target
+    "brownout_unrecovered": 1.0,     # 0/1: stage did not return to 0
 }
 
 DEFAULT_RATIO_THRESHOLD = 0.9   # per-round e2e_vs_baseline alarm

@@ -14,6 +14,11 @@ leave on disk (and the live process registry, for REPL use):
   flight dir (``FLAGS_flight_dir`` → ``$PADDLE_FLIGHT_DIR`` →
   ``<tmpdir>/paddle_tpu_flight``) with reason/age/event counts; with a
   PATH, inspect one dump (event ring tail, span tail, key metrics).
+* ``slo [PATH]`` — the overload-control view: SLO burn-rate windows
+  (the ``slo.*`` gauges the monitor exports), brownout ladder stage and
+  transitions, the shed/reject counters with their ``{tenant,
+  priority}`` attribution, and the autoscaler/brownout decision history
+  (flight events) — from the live process or any snapshot/flight dump.
 * ``bench-diff A B`` — metric-by-metric comparison of two ``BENCH_*``
   records (round files or the baseline), flagging the big movers. The
   full series harness is ``tools/bench_trend.py``.
@@ -38,7 +43,11 @@ def _fmt_num(v):
     return f"{v:,}"
 
 
-def _print_snapshot(snap, out=sys.stdout):
+def _print_snapshot(snap, out=None):
+    # resolve sys.stdout at CALL time: binding it as a def-time default
+    # captures whatever stream was installed when the module was first
+    # imported (e.g. a test harness's since-closed capture)
+    out = out if out is not None else sys.stdout
     from ..core import telemetry
 
     ts = snap.get("ts")
@@ -152,6 +161,96 @@ def _inspect_flight(path) -> int:
     return 0
 
 
+def cmd_slo(args) -> int:
+    """Overload-control view: burn-rate windows, brownout stage, shed
+    counts, and the autoscaler's decision history — from the live
+    process (registry + flight ring) or a snapshot/flight-dump file."""
+    from ..core import telemetry
+
+    events = None
+    if args.path:
+        try:
+            obj = json.load(open(args.path))
+        except (OSError, ValueError) as e:
+            sys.stderr.write(f"cannot read {args.path}: {e}\n")
+            return 2
+        if "metrics" in obj:          # a flight dump
+            snap = obj.get("metrics") or {}
+            events = obj.get("events", [])
+        else:                         # a bare registry snapshot
+            snap = obj
+        if not isinstance(snap, dict) or not (
+                {"counters", "gauges", "histograms"} & set(snap)):
+            sys.stderr.write(
+                f"{args.path} is not a metrics snapshot or flight "
+                "dump\n")
+            return 2
+    else:
+        snap = telemetry.registry().snapshot()
+        events = [{"kind": e["kind"],
+                   **{k: v for k, v in e.items() if k != "kind"}}
+                  for e in telemetry.flight_recorder().events()]
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+
+    # --- burn-rate windows (slo.* gauges set by SLOMonitor.status())
+    alarm = gauges.get("slo.alarm")
+    print(f"slo alarm : "
+          f"{'UP' if alarm else 'clear' if alarm is not None else '(no evaluation recorded)'}")
+    burns = sorted(k for k in gauges if k.startswith("slo.burn{"))
+    if burns:
+        print("burn rate (error budget burn per objective/window):")
+        for k in burns:
+            labels = dict(p.split("=", 1)
+                          for p in k.split("{", 1)[1][:-1].split(","))
+            gkey = f"slo.goodput{{{k.split('{', 1)[1]}"
+            gp = gauges.get(gkey)
+            print(f"  {labels.get('objective', '?'):<16} "
+                  f"{labels.get('window', '?'):>8}  "
+                  f"burn={gauges[k]:<8g} "
+                  f"goodput={gp if gp is not None else '-'}")
+
+    # --- brownout ladder
+    stage = gauges.get("serving.brownout_stage", 0)
+    ups = sum(v for k, v in counters.items()
+              if k.startswith("serving.brownout_transitions")
+              and "direction=up" in k)
+    downs = sum(v for k, v in counters.items()
+                if k.startswith("serving.brownout_transitions")
+                and "direction=down" in k)
+    print(f"brownout  : stage {int(stage)} "
+          f"({ups} escalation(s), {downs} recover(ies))")
+
+    # --- shed / reject accounting (labeled {tenant, priority} series)
+    fams = ("serving.shed", "serving.rejected", "serving.slo_shed",
+            "serving.quota_rejected", "serving.brownout_shed")
+    rows = [(k, v) for k, v in sorted(counters.items())
+            if k.split("{", 1)[0] in fams]
+    if rows:
+        print("shed/reject counters:")
+        for k, v in rows:
+            print(f"  {k:<56} {v}")
+
+    # --- replicas + autoscaler decisions (flight events)
+    reps = gauges.get("fleet.replicas_up")
+    if reps is not None:
+        print(f"replicas  : {int(reps)} up")
+    decisions = [e for e in (events or ())
+                 if str(e.get("kind", "")).startswith(("autoscale.",
+                                                       "brownout"))]
+    if decisions:
+        print(f"decision history ({len(decisions)} event(s), "
+              "oldest first):")
+        for e in decisions[-args.n:]:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("kind", "ts")}
+            print(f"  {e.get('kind'):<24} {extra}")
+    elif events is not None:
+        print("decision history: (no autoscaler/brownout events "
+              "recorded)")
+    return 0
+
+
 def cmd_bench_diff(args) -> int:
     try:
         rows = _bt.diff_rounds(args.a, args.b)
@@ -194,6 +293,14 @@ def main(argv=None) -> int:
     fp.add_argument("--dir", default=None, help="flight-dump directory")
     fp.add_argument("-n", type=int, default=10, help="list at most N")
     fp.set_defaults(fn=cmd_flights)
+    sp = sub.add_parser("slo", help="burn rate, brownout stage, shed "
+                                    "counts, autoscaler decisions")
+    sp.add_argument("path", nargs="?", default=None,
+                    help="snapshot JSON or flight dump (default: this "
+                         "process's registry + flight ring)")
+    sp.add_argument("-n", type=int, default=20,
+                    help="show at most N decision events")
+    sp.set_defaults(fn=cmd_slo)
     bp = sub.add_parser("bench-diff",
                         help="diff two BENCH_*.json records")
     bp.add_argument("a")

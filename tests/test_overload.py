@@ -1,0 +1,716 @@
+"""Closed-loop overload robustness (ISSUE 11): SLO-driven autoscaler,
+multi-tenant QoS (quotas + weighted fair queueing), staged brownout
+ladder, and the chaos traffic generator.
+
+The flagship drill: a flash crowd against a 1-replica fleet flips the
+burn alarm; the autoscaler warms and admits a second replica (decision
+flight event naming the trigger windows) with ZERO lost and bit-exact
+accepted requests; the brownout ladder steps up during the crowd and
+fully recovers (stage 0, shedding stops) after it passes; ``scale_in``
+during the burn is refused. Fault drills: ``autoscale.stall`` (replica
+factory dies mid scale-out) and ``traffic.flash_crowd`` (the generator
+grows a surprise, unmodeled crowd).
+"""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import perfwatch, telemetry
+from paddle_tpu.core import resilience
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.core.resilience import TenantQuotaExceeded
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.autoscale import AutoScaler
+from paddle_tpu.models.frontend import ServingFrontend
+from paddle_tpu.models.qos import (
+    FairClock,
+    QoSPolicy,
+    TenantPolicy,
+    tenant_summaries,
+)
+from paddle_tpu.models.router import ServingRouter
+from paddle_tpu.models.serving import ContinuousBatchingEngine
+from paddle_tpu.tools.trafficgen import TrafficGen, TrafficProfile
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path):
+    resilience.reset_faults()
+    telemetry.reset_telemetry()
+    set_flags({"FLAGS_flight_dir": str(tmp_path / "flight")})
+    yield
+    resilience.reset_faults()
+    telemetry.reset_telemetry()
+    set_flags({"FLAGS_flight_dir": "", "FLAGS_brownout": 0,
+               "FLAGS_slo_shedding": 0})
+
+
+_CFG = LlamaConfig(vocab_size=97, hidden_size=16, intermediate_size=32,
+                   num_hidden_layers=1, num_attention_heads=2,
+                   max_position_embeddings=128, tie_word_embeddings=True)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(_CFG)
+
+
+def _frontend(model, max_slots=2, segment=4, **fe_kwargs):
+    eng = ContinuousBatchingEngine(model, max_slots=max_slots, max_len=64,
+                                   prompt_buckets=(8, 16),
+                                   do_sample=True, temperature=0.9,
+                                   seed=13)
+    fe_kwargs.setdefault("breaker_threshold", 50)
+    fe_kwargs.setdefault("max_queue", 128)
+    return ServingFrontend(eng, segment=segment, **fe_kwargs)
+
+
+def _prompts(n, rng_seed=3, lo=4, hi=10):
+    rng = np.random.RandomState(rng_seed)
+    return [rng.randint(0, _CFG.vocab_size,
+                        (int(rng.randint(lo, hi)),)).astype(np.int32)
+            for _ in range(n)]
+
+
+def _reference(model, by_rid):
+    """Uninterrupted single-frontend run with the fleet's rids:
+    ``by_rid`` maps rid -> (prompt, max_new)."""
+    fe = _frontend(model)
+    for rid, (p, max_new) in by_rid.items():
+        fe.submit(p, max_new_tokens=max_new, rid=rid)
+    out = fe.results(wait=True)
+    fe.shutdown()
+    return {rid: out[rid].tokens for rid in by_rid}
+
+
+def _burn_monitor(windows=(60.0, 180.0), threshold_s=0.05, target=0.9):
+    """Window lengths deliberately LONG (60/180s): several tests mix a
+    virtually-clocked burn (explicit ``now=``) with real-clock pump
+    turns, and the bad samples must not age out of the shortest window
+    while a cold engine compiles. Burn/recovery flips are driven by
+    sample floods, not by waiting out windows."""
+    obj = perfwatch.Objective("ttft", "serving.ttft_s", threshold_s,
+                              target)
+    return perfwatch.SLOMonitor(objectives=[obj], windows=windows,
+                                burn_threshold=2.0, min_count=8)
+
+
+def _force_burn(mon, t_bad, n_good=20, n_bad=20):
+    """Deterministic alarm: baseline snapshot in the past, then a flood
+    of objective-blowing TTFTs (test_perfwatch idiom)."""
+    hist = telemetry.histogram("serving.ttft_s")
+    for _ in range(n_good):
+        hist.observe(0.01)
+    mon.status(now=t_bad - 11.0)
+    for _ in range(n_bad):
+        hist.observe(2.0)
+    return mon.status(now=t_bad)
+
+
+def _clear_burn(mon, now=None, n_good=400):
+    hist = telemetry.histogram("serving.ttft_s")
+    for _ in range(n_good):
+        hist.observe(0.001)
+    return mon.status(now=now if now is not None else time.monotonic())
+
+
+# ------------------------------------------------------------- QoS units
+
+
+def test_fair_clock_interleaves_tenants_within_priority():
+    fc = FairClock(QoSPolicy())
+    hog = [fc.tag(0, "hog", 10) for _ in range(4)]   # 10,20,30,40
+    mouse = [fc.tag(0, "mouse", 10) for _ in range(2)]  # 10,20
+    assert hog == [10.0, 20.0, 30.0, 40.0]
+    assert mouse == [10.0, 20.0]
+    # a weighted tenant drains proportionally faster
+    fc2 = FairClock(QoSPolicy([TenantPolicy("vip", weight=2.0)]))
+    assert fc2.tag(0, "vip", 10) == 5.0
+    # dispatch advances the class clock: a late arrival starts at the
+    # present instead of back-filling the past
+    fc.advance(0, 40.0)
+    assert fc.tag(0, "late", 10) == 50.0
+
+
+def test_qos_over_share_and_quota():
+    qos = QoSPolicy([TenantPolicy("hog", quota_tokens=32)])
+    assert qos.check_quota("hog", 0, 32)
+    assert not qos.check_quota("hog", 20, 13)
+    assert qos.check_quota("mouse", 10 ** 6, 1)  # no quota -> unlimited
+    assert qos.over_share("hog", {"hog": 30, "mouse": 3})
+    assert not qos.over_share("mouse", {"hog": 30, "mouse": 3})
+    assert not qos.over_share("hog", {"hog": 30})  # sole tenant: never
+
+
+def test_wfq_hot_tenant_cannot_starve_quiet_tenant(model):
+    """The fairness invariant: a hot tenant flooding one priority class
+    cannot push a quiet tenant's queue position (or its queue-wait p95)
+    behind its own backlog — WFQ interleaves by virtual finish tag."""
+    fe = _frontend(model)
+    hog_rids = [fe.submit(p, max_new_tokens=3, tenant="hog")
+                for p in _prompts(8, rng_seed=1, lo=6, hi=7)]
+    mouse_rids = [fe.submit(p, max_new_tokens=3, tenant="mouse")
+                  for p in _prompts(2, rng_seed=2, lo=6, hi=7)]
+    order = [e.tenant for e in fe._queue]
+    # the quiet tenant's two requests sit interleaved near the head,
+    # not parked behind the hog's backlog
+    assert order.index("mouse") <= 2
+    assert [i for i, t in enumerate(order) if t == "mouse"][1] <= 4
+    res = fe.results(wait=True)
+    assert all(res[r].status == "ok" for r in hog_rids + mouse_rids)
+    # per-tenant queue-wait attribution: the quiet tenant's p95 must not
+    # exceed the hot tenant's (it was interleaved ahead of the backlog)
+    qw = telemetry.histogram("serving.queue_wait_s")
+    assert (qw.percentiles(tenant="mouse")["p95"]
+            <= qw.percentiles(tenant="hog")["p95"] + 1e-9)
+    fe.shutdown()
+
+
+def test_wfq_single_tenant_keeps_fifo_order(model):
+    """Tenant-less traffic shares one WFQ lane: admission order within a
+    priority class stays arrival FIFO, bit-for-bit the historical
+    behavior."""
+    fe = _frontend(model)
+    rids = [fe.submit(p, max_new_tokens=2) for p in _prompts(6)]
+    assert [e.rid for e in fe._queue] == rids
+    fe.shutdown(drain=False)
+
+
+def test_frontend_quota_rejects_with_accounting(model):
+    qos = QoSPolicy([TenantPolicy("hog", quota_tokens=24)])
+    fe = _frontend(model, qos=qos)
+    p = _prompts(1, lo=6, hi=7)[0]   # cost 6 + max_new
+    r1 = fe.submit(p, max_new_tokens=10, tenant="hog")     # cost 16
+    r2 = fe.submit(p, max_new_tokens=10, tenant="hog")     # would be 32
+    res = fe.results()
+    assert r2 in res and res[r2].status == "rejected"
+    assert "quota" in res[r2].reason
+    assert telemetry.counter("serving.quota_rejected").value(
+        tenant="hog") == 1
+    # the labeled rejected counter carries {tenant, priority}
+    assert telemetry.counter("serving.rejected").value(
+        tenant="hog", priority=0) == 1
+    # quota frees as requests retire: the tenant can submit again
+    out = fe.results(wait=True)
+    assert out[r1].status == "ok"
+    r3 = fe.submit(p, max_new_tokens=10, tenant="hog")
+    assert fe.results(wait=True)[r3].status == "ok"
+    fe.shutdown()
+
+
+def test_router_quota_is_typed_and_released_on_delivery(model):
+    qos = QoSPolicy([TenantPolicy("hog", quota_tokens=24)])
+    router = ServingRouter(qos=qos)
+    router.add_replica(_frontend(model))
+    p = _prompts(1, lo=6, hi=7)[0]
+    r1 = router.submit(p, max_new_tokens=10, tenant="hog")
+    with pytest.raises(TenantQuotaExceeded) as ei:
+        router.submit(p, max_new_tokens=10, tenant="hog")
+    assert ei.value.tenant == "hog"
+    assert resilience.get_counter("serving.quota_rejected") == 1
+    res = router.results(wait=True, timeout_s=120)
+    assert res[r1].status == "ok"
+    # delivery released the hold: the tenant is admissible again
+    r3 = router.submit(p, max_new_tokens=10, tenant="hog")
+    assert router.results(wait=True, timeout_s=120)[r3].status == "ok"
+    router.shutdown()
+
+
+def test_tenant_quota_error_crosses_the_rpc_wire_typed():
+    from paddle_tpu.distributed.rpc import _TYPED_ERRORS
+
+    assert _TYPED_ERRORS["TenantQuotaExceeded"] is TenantQuotaExceeded
+
+
+def test_fleet_metrics_per_tenant_view(model):
+    router = ServingRouter()
+    router.add_replica(_frontend(model))
+    rids = {t: router.submit(_prompts(1, rng_seed=9)[0],
+                             max_new_tokens=4, tenant=t)
+            for t in ("alpha", "beta")}
+    res = router.results(wait=True, timeout_s=120)
+    assert all(res[r].status == "ok" for r in rids.values())
+    fm = router.fleet_metrics()
+    assert {"alpha", "beta"} <= set(fm["tenants"])
+    a = fm["tenants"]["alpha"]
+    assert a["tokens_total"] == len(res[rids["alpha"]].tokens)
+    assert a["ttft"]["count"] == 1
+    assert 0.0 <= a["goodput_ttft"] <= 1.0
+    # pure-function check on a synthetic merged snapshot too
+    snap = {"histograms": {"serving.ttft_s{tenant=x}": {
+        "count": 4, "sum": 0.08, "bounds": [0.05, 1.0],
+        "buckets": [3, 1, 0], "sample": [0.01, 0.01, 0.01, 0.4]}},
+        "counters": {"serving.shed{priority=0,tenant=x}": 2,
+                     "serving.quota_rejected{tenant=x}": 1}}
+    view = tenant_summaries(snap, ttft_threshold_s=0.05)
+    assert view["x"]["shed"] == 2 and view["x"]["quota_rejected"] == 1
+    assert view["x"]["goodput_ttft"] == 0.75
+    router.shutdown()
+
+
+# ------------------------------------------------------- brownout ladder
+
+
+def test_brownout_ladder_steps_and_admits():
+    mon = _burn_monitor()
+    bo = perfwatch.BrownoutController(mon, hold_s=1.0, enabled=True,
+                                      shed_below=1, protected=2)
+    assert bo.maybe_step(now=0.0) == 0        # healthy: stays normal
+    _force_burn(mon, 11.0)
+    assert bo.maybe_step(now=11.0) == 1       # token_cap
+    act, capped, why = bo.admit("t", 0, 16, over_share=False)
+    assert act == "admit" and capped == 4 and "capped" in why
+    assert bo.maybe_step(now=12.1) == 2       # shed_low_priority
+    assert bo.admit("t", 0, 16)[0] == "shed"
+    assert bo.admit("t", 1, 16, over_share=False)[0] == "admit"
+    assert bo.maybe_step(now=13.2) == 3       # shed_over_share
+    assert bo.admit("hog", 1, 16, over_share=True)[0] == "shed"
+    assert bo.admit("mouse", 1, 16, over_share=False)[0] == "admit"
+    assert bo.maybe_step(now=14.3) == 4       # protected_only
+    assert bo.admit("mouse", 1, 16, over_share=False)[0] == "shed"
+    assert bo.admit("mouse", 2, 16, over_share=False)[0] == "admit"
+    # hysteresis: within the hold nothing moves
+    assert bo.maybe_step(now=14.9) == 4
+    # recovery walks DOWN one stage per hold
+    _clear_burn(mon, now=40.0)
+    for t, want in ((41.0, 3), (42.1, 2), (43.2, 1), (44.3, 0)):
+        assert bo.maybe_step(now=t) == want
+    st = bo.status()
+    assert st["stage"] == 0 and st["transitions"] == 8
+    up = telemetry.counter("serving.brownout_transitions")
+    assert up.value(direction="up") == 4
+    assert up.value(direction="down") == 4
+    assert telemetry.gauge("serving.brownout_stage").value() == 0
+    assert telemetry.counter("serving.brownout_shed").value(
+        measure="low_priority", tenant="t", priority=0) == 1
+    # capped twice: the stage-1 admit and the stage-2 priority-1 admit
+    assert telemetry.counter("serving.brownout_capped").value(
+        tenant="t") == 2
+
+
+def test_brownout_transitions_leave_flight_dumps(tmp_path):
+    import glob
+    import os
+
+    mon = _burn_monitor()
+    bo = perfwatch.BrownoutController(mon, hold_s=1.0, enabled=True)
+    _force_burn(mon, 11.0)
+    assert bo.maybe_step(now=11.0) == 1
+    dumps = glob.glob(os.path.join(
+        str(tmp_path / "flight"), "flight-*brownout*.json"))
+    assert dumps, "a brownout transition must dump the flight recorder"
+    import json
+
+    obj = json.load(open(dumps[0]))
+    evs = [e for e in obj["events"] if e["kind"] == "brownout"]
+    assert evs and evs[-1]["stage"] == 1
+    assert evs[-1]["windows"]  # names the burning windows
+
+
+def test_brownout_disabled_is_inert():
+    mon = _burn_monitor()
+    bo = perfwatch.BrownoutController(mon, hold_s=0.0)  # flag off
+    _force_burn(mon, 11.0)
+    assert bo.maybe_step(now=11.0) == 0
+    assert bo.admit("t", 0, 16)[0] == "admit"
+
+
+def test_brownout_sheds_at_the_frontend_door(model):
+    mon = _burn_monitor()
+    bo = perfwatch.BrownoutController(mon, hold_s=0.0, enabled=True,
+                                      shed_below=1)
+    fe = _frontend(model, slo=mon, brownout=bo)
+    _force_burn(mon, time.monotonic())
+    assert mon.alarm()
+    bo.maybe_step(now=time.monotonic())
+    bo.maybe_step(now=time.monotonic() + 0.01)
+    assert bo.stage >= 2
+    p = _prompts(1, lo=5, hi=6)[0]
+    r_low = fe.submit(p, max_new_tokens=3, priority=0, tenant="t")
+    r_hi = fe.submit(p, max_new_tokens=3, priority=1, tenant="t")
+    res = fe.results(wait=True)
+    assert res[r_low].status == "rejected"
+    assert "brownout" in res[r_low].reason
+    assert res[r_hi].status == "ok"
+    assert fe.health()["brownout"]["stage"] >= 2
+    fe.shutdown()
+
+
+def test_brownout_token_cap_produces_bit_exact_prefix(model):
+    """Stage 1 shrinks budgets: the capped stream must be the exact
+    PREFIX of the uncapped run (same rid, same keys) — degradation
+    never changes the tokens, only how many."""
+    ref = _reference(model, {7: (_prompts(1, rng_seed=4)[0], 8)})
+    mon = _burn_monitor()
+    bo = perfwatch.BrownoutController(mon, hold_s=0.0, enabled=True,
+                                      token_cap=0.5)
+    fe = _frontend(model, slo=mon, brownout=bo)
+    _force_burn(mon, time.monotonic())
+    bo.maybe_step(now=time.monotonic())
+    assert bo.stage == 1
+    rid = fe.submit(_prompts(1, rng_seed=4)[0], max_new_tokens=8, rid=7,
+                    tenant="t")
+    res = fe.results(wait=True)
+    assert res[rid].status == "ok" and len(res[rid].tokens) == 4
+    np.testing.assert_array_equal(res[rid].tokens, ref[7][:4])
+    fe.shutdown()
+
+
+# ------------------------------------------------------------ autoscaler
+
+
+def test_autoscaler_scales_out_on_sustained_burn(model):
+    mon = _burn_monitor()
+    router = ServingRouter()
+    router.add_replica(_frontend(model))
+    scaler = AutoScaler(router, lambda: _frontend(model),
+                        min_replicas=1, max_replicas=2, slo=mon,
+                        burn_consecutive=2, scale_out_cooldown_s=5.0,
+                        warmup=False)
+    router.attach_autoscaler(scaler)
+    _force_burn(mon, 11.0)
+    assert scaler.step(now=11.0) is None          # one alarm = noise
+    assert scaler.step(now=11.3) == "scale_out"   # sustained = act
+    assert scaler.stats()["replicas_up"] == 2
+    d = scaler.decisions()[-1]
+    assert d["action"] == "scale_out" and d["outcome"] == "ok"
+    assert d["windows"]["ttft"]  # the trigger windows, named
+    # the flight event rides the ring for post-mortems
+    evs = telemetry.flight_recorder().events("autoscale.scale_out")
+    assert evs and evs[-1]["windows"]
+    assert resilience.get_counter("autoscale.scale_out") == 1
+    # cooldown: still burning, but the fleet moves once per cooldown
+    assert scaler.step(now=11.6) is None
+    # at max_replicas: refused, counted
+    assert scaler.scale_out(now=20.0) is None
+    assert resilience.get_counter("autoscale.at_max") == 1
+    # the new replica actually serves
+    rid = router.submit(_prompts(1)[0], max_new_tokens=3)
+    assert router.results(wait=True, timeout_s=120)[rid].status == "ok"
+    router.shutdown()
+
+
+def test_autoscaler_scale_in_refused_during_burn_or_brownout(model):
+    """ISSUE satellite regression: scale_in during an active burn alarm
+    or brownout must be REFUSED — a fleet already missing its SLO never
+    shrinks."""
+    mon = _burn_monitor()
+    bo = perfwatch.BrownoutController(mon, hold_s=0.0, enabled=True)
+    router = ServingRouter()
+    router.add_replica(_frontend(model))
+    router.add_replica(_frontend(model))
+    scaler = AutoScaler(router, lambda: _frontend(model),
+                        min_replicas=1, max_replicas=3, slo=mon,
+                        brownout=bo, warmup=False)
+    _force_burn(mon, 11.0)
+    assert mon.alarm()
+    assert scaler.scale_in(now=11.5) is None
+    assert resilience.get_counter("autoscale.scale_in_refused") == 1
+    assert scaler.decisions()[-1]["outcome"] == "refused"
+    assert scaler.stats()["replicas_up"] == 2    # nothing shrank
+    # alarm cleared but the ladder still engaged: still refused
+    bo.maybe_step(now=11.6)
+    assert bo.stage >= 1
+    _clear_burn(mon, now=45.0)
+    assert not mon.alarm()
+    assert scaler.scale_in(now=46.0) is None
+    assert resilience.get_counter("autoscale.scale_in_refused") == 2
+    # fully recovered: the drain proceeds
+    bo.maybe_step(now=47.0)
+    assert bo.stage == 0
+    assert scaler.scale_in(now=48.0) is not None
+    assert scaler.stats()["replicas_up"] == 1
+    assert resilience.get_counter("autoscale.scale_in") == 1
+    router.shutdown()
+
+
+def test_autoscaler_idle_scale_in_waits_out_the_hold(model):
+    mon = _burn_monitor()
+    router = ServingRouter()
+    router.add_replica(_frontend(model))
+    router.add_replica(_frontend(model))
+    scaler = AutoScaler(router, lambda: _frontend(model),
+                        min_replicas=1, max_replicas=2, slo=mon,
+                        idle_after_s=5.0, scale_in_cooldown_s=1.0,
+                        warmup=False)
+    mon.status(now=0.0)
+    assert scaler.step(now=1.0) is None     # idle observed, hold starts
+    assert scaler.step(now=3.0) is None     # still holding
+    assert scaler.step(now=6.5) == "scale_in"
+    assert scaler.stats()["replicas_up"] == 1
+    # min bound: never drains the last replica
+    assert scaler.step(now=20.0) is None
+    assert scaler.stats()["replicas_up"] == 1
+    router.shutdown()
+
+
+def test_autoscale_stall_fault_drill(model):
+    """``autoscale.stall``: the replica factory dies mid scale-out. The
+    control loop counts it, keeps serving on the survivors, and the
+    NEXT attempt (after cooldown) succeeds."""
+    mon = _burn_monitor()
+    router = ServingRouter()
+    router.add_replica(_frontend(model))
+    scaler = AutoScaler(router, lambda: _frontend(model),
+                        min_replicas=1, max_replicas=2, slo=mon,
+                        burn_consecutive=1, scale_out_cooldown_s=2.0,
+                        warmup=False)
+    _force_burn(mon, 11.0)
+    set_flags({"FLAGS_fault_injection": "autoscale.stall:1"})
+    assert scaler.step(now=11.0) is None     # factory blew up
+    assert resilience.get_counter("fault_injected:autoscale.stall") == 1
+    assert resilience.get_counter("autoscale.factory_error") == 1
+    assert scaler.decisions()[-1]["outcome"] == "factory_error"
+    assert scaler.stats()["replicas_up"] == 1
+    # the fleet keeps serving through the stalled scale-out
+    rid = router.submit(_prompts(1)[0], max_new_tokens=3)
+    assert router.results(wait=True, timeout_s=120)[rid].status == "ok"
+    # budget exhausted: the retry after cooldown admits the replica
+    assert mon.status(now=13.5)["alarm"]
+    assert scaler.step(now=13.5) == "scale_out"
+    assert scaler.stats()["replicas_up"] == 2
+    router.shutdown()
+
+
+# ------------------------------------------------------ traffic generator
+
+
+def test_trafficgen_is_deterministic_and_shaped():
+    prof = dict(duration_s=20.0, base_rps=4.0, diurnal_amplitude=0.4,
+                diurnal_period_s=20.0, flash_at_s=8.0,
+                flash_duration_s=4.0, flash_multiplier=8.0,
+                tenants={"web": 2.0, "batch": 1.0}, hot_tenant="batch",
+                hot_at_s=8.0, hot_duration_s=4.0, hot_multiplier=8.0,
+                priorities={0: 0.6, 1: 0.4})
+    a1 = TrafficGen(TrafficProfile(**prof), seed=11).arrivals()
+    a2 = TrafficGen(TrafficProfile(**prof), seed=11).arrivals()
+    assert len(a1) == len(a2) > 40
+    for x, y in zip(a1, a2):
+        assert (x.t, x.tenant, x.priority, x.max_new_tokens) == \
+            (y.t, y.tenant, y.priority, y.max_new_tokens)
+        np.testing.assert_array_equal(x.prompt, y.prompt)
+    # the flash window carries multiplied traffic
+    in_flash = sum(1 for a in a1 if 8.0 <= a.t < 12.0)
+    calm = sum(1 for a in a1 if 0.0 <= a.t < 4.0)
+    assert in_flash > 3 * calm
+    # the hot tenant dominates its window, not the calm phase
+    hot = [a for a in a1 if 8.0 <= a.t < 12.0]
+    hot_share = sum(1 for a in hot if a.tenant == "batch") / len(hot)
+    pre = [a for a in a1 if a.t < 8.0]
+    calm_share = (sum(1 for a in pre if a.tenant == "batch")
+                  / max(len(pre), 1))
+    assert hot_share > 0.6 > calm_share
+
+
+def test_trafficgen_flash_crowd_fault_site_grows_surprise_crowd():
+    prof = TrafficProfile(duration_s=20.0, base_rps=4.0,
+                          flash_at_s=None, flash_multiplier=8.0,
+                          flash_duration_s=4.0)
+    baseline = TrafficGen(prof, seed=3).arrivals()
+    set_flags({"FLAGS_fault_injection": "traffic.flash_crowd:1"})
+    gen = TrafficGen(TrafficProfile(duration_s=20.0, base_rps=4.0,
+                                    flash_at_s=None,
+                                    flash_multiplier=8.0,
+                                    flash_duration_s=4.0), seed=3)
+    surprised = gen.arrivals()
+    assert resilience.get_counter(
+        "fault_injected:traffic.flash_crowd") == 1
+    assert gen.flash_windows == [(10.0, 4.0)]  # the unmodeled spike
+    assert len(surprised) > 1.5 * len(baseline)
+
+
+def test_trafficgen_drive_replays_in_compressed_time():
+    gen = TrafficGen(TrafficProfile(duration_s=2.0, base_rps=10.0),
+                     seed=1)
+    seen = []
+    pumps = [0]
+
+    def pump():
+        pumps[0] += 1
+
+    t0 = time.monotonic()
+    n = gen.drive(lambda a: seen.append(a), pump=pump, time_scale=0.05)
+    assert n == len(seen) == len(gen.arrivals())
+    assert time.monotonic() - t0 < 2.0   # 2s schedule @ 0.05x
+    assert pumps[0] > 0
+    assert seen == sorted(seen, key=lambda a: a.t)
+
+
+# --------------------------------------------------------------- obs CLI
+
+
+def test_obs_slo_subcommand_live_and_from_dump(model, capsys, tmp_path):
+    from paddle_tpu.tools import obs
+
+    mon = _burn_monitor()
+    bo = perfwatch.BrownoutController(mon, hold_s=0.0, enabled=True)
+    router = ServingRouter()
+    # the frontends SHARE the drill's monitor: a per-frontend default
+    # monitor would re-evaluate on pump turns and overwrite the slo.*
+    # gauges the CLI renders
+    router.add_replica(_frontend(model, slo=mon))
+    scaler = AutoScaler(router, lambda: _frontend(model, slo=mon),
+                        min_replicas=1, max_replicas=2, slo=mon,
+                        burn_consecutive=1, warmup=False)
+    # real-clock anchoring: pump turns tick the shared monitor on the
+    # monotonic clock, so the burn must be anchored around real now
+    t0 = time.monotonic()
+    _force_burn(mon, t0)
+    bo.maybe_step(now=t0)
+    assert scaler.step(now=t0 + 0.2) == "scale_out"
+    rid = router.submit(_prompts(1)[0], max_new_tokens=3, tenant="web",
+                        priority=2)
+    assert router.results(wait=True, timeout_s=120)[rid].status == "ok"
+    assert obs.main(["slo"]) == 0
+    out = capsys.readouterr().out
+    assert "slo alarm : UP" in out
+    assert "burn=" in out and "ttft" in out
+    assert "brownout  : stage 1" in out
+    assert "autoscale.scale_out" in out
+    assert "replicas  : 2 up" in out
+    # same view reconstructed from a flight dump on disk
+    path = telemetry.flight_dump("drill")
+    assert obs.main(["slo", path]) == 0
+    out = capsys.readouterr().out
+    assert "autoscale.scale_out" in out and "burn=" in out
+    router.shutdown()
+
+
+# ----------------------------------------------- requeue / failover QoS
+
+
+def test_scale_in_requeues_tenant_work_bit_exact(model):
+    """Draining a replica requeues its queued work onto survivors with
+    tenant lanes intact and token streams bit-identical to the
+    uninterrupted run (the shed/requeue half of the WFQ invariant)."""
+    prompts = _prompts(6, rng_seed=8, lo=5, hi=9)
+    router = ServingRouter()
+    a = router.add_replica(_frontend(model))
+    b = router.add_replica(_frontend(model))
+    rids = [router.submit(p, max_new_tokens=5,
+                          tenant=("web" if i % 2 else "batch"))
+            for i, p in enumerate(prompts)]
+    by_rid = {r: (p, 5) for r, p in zip(rids, prompts)}
+    ref = _reference(model, by_rid)
+    # drain whichever replica holds queued/in-flight work
+    victim = b if router._replicas[b].assigned else a
+    router.scale_in(victim)
+    res = router.results(wait=True, timeout_s=300)
+    assert all(res[r].status == "ok" for r in rids)
+    for r in rids:
+        np.testing.assert_array_equal(res[r].tokens, ref[r])
+    assert len(router._replicas) == 1
+    router.shutdown()
+
+
+# ------------------------------------------------------ the flagship drill
+
+
+def test_flash_crowd_drill_scale_out_brownout_recover(model):
+    """ISSUE acceptance: flash crowd -> burn alarm -> autoscaler warms
+    and admits a replica with ZERO lost and bit-identical accepted
+    requests; the brownout ladder steps up during the crowd and fully
+    recovers (stage 0, shedding stops, fleet drains back) after it
+    passes."""
+    mon = _burn_monitor()
+    bo = perfwatch.BrownoutController(mon, hold_s=0.05, enabled=True,
+                                      shed_below=1, protected=2)
+
+    def make_fe():
+        return _frontend(model, slo=mon, brownout=bo)
+
+    router = ServingRouter()
+    router.add_replica(make_fe())
+    scaler = AutoScaler(router, make_fe, min_replicas=1, max_replicas=2,
+                        slo=mon, brownout=bo, burn_consecutive=2,
+                        scale_out_cooldown_s=5.0, idle_after_s=0.2,
+                        scale_in_cooldown_s=0.2, warmup=False)
+    router.attach_autoscaler(scaler)
+    # deterministic synthetic workload: diurnal baseline + flash crowd
+    # + hot tenant, two priority classes (0 sheddable, 2 protected)
+    gen = TrafficGen(TrafficProfile(
+        duration_s=3.0, base_rps=2.0, diurnal_amplitude=0.3,
+        diurnal_period_s=3.0, flash_at_s=1.0, flash_duration_s=1.5,
+        flash_multiplier=5.0, tenants={"web": 2.0, "batch": 1.0},
+        hot_tenant="batch", hot_at_s=1.0, hot_duration_s=1.5,
+        hot_multiplier=4.0, priorities={0: 0.5, 2: 0.5},
+        prompt_len=(4, 8), max_new=(3, 5),
+        vocab_size=_CFG.vocab_size), seed=7)
+    arrivals = gen.arrivals()
+    assert len(arrivals) >= 10
+    rids = [router.submit(a.prompt, max_new_tokens=a.max_new_tokens,
+                          priority=a.priority, tenant=a.tenant)
+            for a in arrivals]
+    by_rid = {r: (a.prompt, a.max_new_tokens)
+              for r, a in zip(rids, arrivals)}
+    # the crowd burns the SLO (deterministic alarm, perfwatch idiom)
+    t0 = time.monotonic()
+    _force_burn(mon, t0)
+    assert mon.alarm()
+    # sustained burn -> scale out, warm-before-admit, windows named
+    assert scaler.step(now=t0) is None
+    assert scaler.step(now=t0 + 0.3) == "scale_out"
+    assert sum(1 for r in router._replicas.values()
+               if r.state == "up") == 2
+    assert scaler.decisions()[-1]["windows"]["ttft"]
+    # the ladder engages while the alarm is up
+    bo.maybe_step(now=t0 + 0.4)
+    assert bo.stage >= 1
+    # ... and scale-in is refused mid-incident
+    assert scaler.scale_in(now=t0 + 0.5) is None
+    assert resilience.get_counter("autoscale.scale_in_refused") == 1
+    # a second wave lands on the NEW (least-loaded) replica, with the
+    # stage-1 token cap applied at its door
+    new_rep = next(d["replica"] for d in reversed(scaler.decisions())
+                   if d["action"] == "scale_out"
+                   and d["outcome"] == "ok")
+    wave2 = {router.submit(p, max_new_tokens=4, priority=2,
+                           tenant="web"): p
+             for p in _prompts(3, rng_seed=21, lo=4, hi=7)}
+    assert router._replicas[new_rep].assigned & set(wave2), \
+        "the warmed replica must take traffic"
+    # drain the crowd across BOTH replicas: zero lost, bit-identical
+    res = router.results(wait=True, timeout_s=600)
+    assert set(rids) <= set(res), "lost requests"
+    assert all(res[r].status == "ok" for r in rids), \
+        {r: res[r].status for r in rids if res[r].status != "ok"}
+    ref = _reference(model, by_rid)
+    for r in rids:
+        np.testing.assert_array_equal(res[r].tokens, ref[r])
+    # wave-2: ok, and the CAPPED stream is the exact prefix of the
+    # uncapped reference run (degradation shortens, never changes)
+    ref2 = _reference(model, {r: (p, 4) for r, p in wave2.items()})
+    for r in wave2:
+        assert res[r].status == "ok"
+        assert len(res[r].tokens) >= 1
+        np.testing.assert_array_equal(
+            res[r].tokens, ref2[r][:len(res[r].tokens)])
+    assert router._replicas and len(router._replicas) == 2
+    # the crowd passes: alarm clears, the ladder walks back to 0
+    _clear_burn(mon)
+    assert not mon.status(now=time.monotonic())["alarm"]
+    deadline = time.monotonic() + 30.0
+    while bo.stage > 0 and time.monotonic() < deadline:
+        bo.maybe_step(now=time.monotonic())
+        time.sleep(0.06)
+    assert bo.stage == 0, "brownout must fully recover after the crowd"
+    # shedding stopped: a low-priority admission serves normally again
+    r_low = router.submit(_prompts(1, rng_seed=5)[0], max_new_tokens=3,
+                          priority=0, tenant="web")
+    assert router.results(wait=True,
+                          timeout_s=120)[r_low].status == "ok"
+    # idle fleet drains back within bounds (hysteresis holds observed)
+    t1 = time.monotonic()
+    assert scaler.step(now=t1) is None          # idle hold starts
+    assert scaler.step(now=t1 + 0.3) == "scale_in"
+    assert scaler.stats()["replicas_up"] == 1
+    # the whole incident is reconstructable from telemetry alone
+    fm = router.fleet_metrics()
+    assert fm["brownout_stage"] == 0
+    assert {"web", "batch"} <= set(fm["tenants"])
+    assert resilience.get_counter("autoscale.scale_out") == 1
+    assert resilience.get_counter("autoscale.scale_in") == 1
+    router.shutdown()

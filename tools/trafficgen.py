@@ -1,0 +1,27 @@
+#!/usr/bin/env python
+"""Repo-root entry for the chaos traffic generator.
+
+Loads ``paddle_tpu/tools/trafficgen.py`` by FILE PATH (not package
+import) so the schedule summary runs without importing the framework —
+numpy only, no jax, no device contact (same trick as
+``tools/bench_trend.py``).
+
+    python tools/trafficgen.py --duration 30 --flash-at 10 --flash-mult 8
+"""
+import importlib.util
+import os
+import sys
+
+_IMPL = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "paddle_tpu", "tools", "trafficgen.py")
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("_trafficgen", _IMPL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+if __name__ == "__main__":
+    sys.exit(_load().main())
