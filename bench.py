@@ -1165,6 +1165,131 @@ except Exception as e:
     log(f"overload control section FAILED: {type(e).__name__}: {e}")
     ov_metrics = {"overload_error": f"{type(e).__name__}: {e}"[:200]}
 
+# ------------------------------------------- (e8) tensor-parallel serving
+# One replica spans a TP gang over a ProcessMesh (models/tp_serving.py):
+# params + paged KV pools sharded, AOT warmup per mesh, token streams
+# bit-identical to the single-chip engine. Gated numbers: the host cost
+# of committing dispatch operands onto the mesh (tp_dispatch_overhead_pct
+# < 10% of active serving), and the member-death drill — a TP-group
+# replica dies mid-decode, the router trips its breaker and fails over to
+# the single-chip replica; recovery must land all results (zero lost)
+# bit-identical to the uninterrupted reference inside 60s.
+tp_metrics = {}
+try:
+    from paddle_tpu.models.frontend import ServingFrontend as _TpFE
+    from paddle_tpu.models.router import ServingRouter as _TpRouter
+    from paddle_tpu.models.serving import (
+        ContinuousBatchingEngine as _TpCBE,
+    )
+    from paddle_tpu.models.tp_serving import TPShardedEngine, serving_mesh
+
+    TP_DEG = min(2, len(jax.devices()))
+    if SMOKE:
+        TP_SLOTS, TP_SEG, TP_REQ, TP_NEW = 2, 4, 6, 24
+    else:
+        TP_SLOTS, TP_SEG, TP_REQ, TP_NEW = 4, 8, 12, 48
+    log(f"tensor-parallel serving: TP degree {TP_DEG} "
+        f"({len(jax.devices())} visible device(s)), {TP_REQ} requests...")
+    tp_mesh = serving_mesh(TP_DEG)
+
+    def _tp_fe():
+        return _TpFE(TPShardedEngine(model, max_slots=TP_SLOTS,
+                                     max_len=256, page_size=128,
+                                     prompt_buckets=(32,), seed=0,
+                                     mesh=tp_mesh),
+                     max_queue=64, segment=TP_SEG)
+
+    def _sc_fe():
+        return _TpFE(_TpCBE(model, max_slots=TP_SLOTS, max_len=256,
+                            page_size=128, prompt_buckets=(32,), seed=0),
+                     max_queue=64, segment=TP_SEG)
+
+    rng_tp = np.random.RandomState(23)
+    tp_prompts = [rng_tp.randint(0, cfg.vocab_size,
+                                 (int(rng_tp.randint(8, 28)),))
+                  .astype(np.int32) for _ in range(TP_REQ)]
+
+    # ---- dispatch-overhead gate: the same warmed workload through the
+    # TP engine; overhead is the host time spent committing operands
+    # onto the mesh as a share of the serving wall
+    # explicit rids: sampling keys are rid-keyed, so the TP run, the
+    # member-death drill, and the single-chip reference must share them
+    # for their streams to be comparable
+    tp_rids = [100 + i for i in range(TP_REQ)]
+    tp_fe = _tp_fe()
+    tp_fe.warmup()
+    warm_r = tp_fe.submit(tp_prompts[0][:8], max_new_tokens=2)
+    tp_fe.results(wait=True, timeout=600)
+    put0 = tp_fe.engine.tp_stats()["put_s"]
+    t_tp = time.time()
+    for r, p in zip(tp_rids, tp_prompts):
+        tp_fe.submit(p, max_new_tokens=TP_NEW, rid=r)
+    tp_res = tp_fe.results(wait=True, timeout=600)
+    tp_wall = time.time() - t_tp
+    assert all(tp_res[r].status == "ok" for r in tp_rids), \
+        {r: tp_res[r].status for r in tp_rids}
+    tp_put = tp_fe.engine.tp_stats()["put_s"] - put0
+    tp_tokens = sum(len(tp_res[r].tokens) for r in tp_rids)
+    tp_metrics = {
+        "tp_degree": TP_DEG,
+        "tp_tokens_per_sec": round(tp_tokens / tp_wall, 1)
+            if tp_wall > 0 else None,
+        "tp_dispatch_overhead_pct": round(
+            100.0 * tp_put / tp_wall if tp_wall > 0 else 0.0, 3),
+    }
+    # the single-chip reference streams for the SAME rids (the failover
+    # bit-exactness oracle below)
+    sc_ref = _sc_fe()
+    for r, p in zip(tp_rids, tp_prompts):
+        sc_ref.submit(p, max_new_tokens=TP_NEW, rid=r)
+    ref_res = sc_ref.results(wait=True, timeout=600)
+    sc_ref.shutdown()
+    diverged = sum(
+        1 for r in tp_rids
+        if not np.array_equal(tp_res[r].tokens, ref_res[r].tokens))
+    tp_metrics["tp_stream_divergence"] = int(diverged > 0)
+    tp_fe.shutdown()
+    log(f"tensor-parallel serving: {tp_metrics['tp_tokens_per_sec']} "
+        f"tok/s at degree {TP_DEG}, dispatch overhead "
+        f"{tp_metrics['tp_dispatch_overhead_pct']}% of serving wall "
+        f"(gate < 10%), {diverged} stream(s) diverged from the "
+        "single-chip reference (gate: 0)")
+
+    # ---- member-death recovery drill: a mixed fleet (TP group + single
+    # chip); the TP replica dies mid-decode; every stranded request must
+    # fail over bit-identically and nothing may be lost
+    d_router = _TpRouter(max_failovers=2)
+    tp_id = d_router.add_replica(_tp_fe(), warmup=True)
+    d_router.add_replica(_sc_fe(), warmup=True)
+    d_rids = [d_router.submit(p, max_new_tokens=TP_NEW, rid=r)
+              for r, p in zip(tp_rids, tp_prompts)]
+    for _ in range(2):  # let decode start so the kill lands mid-stream
+        d_router.step()
+    t_kill = time.time()
+    d_router.fail_replica(tp_id, "bench e8 member-death drill")
+    d_res = d_router.results(wait=True, timeout_s=600)
+    recovery_s = time.time() - t_kill
+    lost = sum(1 for r in d_rids if r not in d_res
+               or d_res[r].status != "ok")
+    d_diverged = sum(
+        1 for r in d_rids if r in d_res
+        and not np.array_equal(d_res[r].tokens, ref_res[r].tokens))
+    tp_metrics.update({
+        "tp_member_death_recovery_s": round(recovery_s, 2),
+        "tp_lost_requests": lost,
+    })
+    tp_metrics["tp_stream_divergence"] = int(
+        tp_metrics["tp_stream_divergence"] or d_diverged > 0)
+    d_router.shutdown()
+    log(f"tp member-death drill: group breaker tripped, {len(d_rids)} "
+        f"request(s) recovered in {recovery_s:.2f}s (gate < 60), "
+        f"{lost} lost (gate: 0), {d_diverged} diverged after failover "
+        "(gate: 0)")
+except Exception as e:
+    log(f"tensor-parallel serving section FAILED: "
+        f"{type(e).__name__}: {e}")
+    tp_metrics = {"tp_error": f"{type(e).__name__}: {e}"[:200]}
+
 # ------------------------------------------------------- (f) op microbench
 # Per-op regression gate (reference: tools/ci_op_benchmark.sh relative
 # check): ~20 hot ops + eager dispatch overhead, compared against the
@@ -1258,6 +1383,7 @@ result = {
     **tele_metrics,
     **pw_metrics,
     **ov_metrics,
+    **tp_metrics,
     "op_bench_us": op_results,
     "op_bench_vs_baseline": op_vs_baseline,
     "op_bench_regressions": op_regressions,

@@ -56,6 +56,7 @@ __all__ += [
 from .generation import generate  # noqa: F401
 from .frontend import RequestResult, ServingFrontend  # noqa: F401
 from .serving import ContinuousBatchingEngine  # noqa: F401
+from .tp_serving import TPShardedEngine  # noqa: F401
 from .router import ServingRouter, launch_fleet  # noqa: F401
 from .remote import RemoteFrontend, ReplicaServer, replica_main  # noqa: F401
 from .autoscale import AutoScaler  # noqa: F401

@@ -47,6 +47,7 @@ import numpy as np
 from ..core import perfwatch, telemetry
 from ..core.resilience import (
     Deadline,
+    PeerFailureError,
     ServingUnavailable,
     StaleLeaderError,
     bump_counter,
@@ -558,14 +559,24 @@ class RemoteFrontend:
 
 def replica_main(build_frontend, rank=None, master_endpoint=None,
                  worker_name=None, server_name=None, fleet_prefix="fleet",
-                 hb_interval=None, warmup=False, num_workers=4):
+                 hb_interval=None, warmup=False, num_workers=4,
+                 group=None):
     """Entry point for one replica worker process under
     ``launch_fleet``: join the RPC group at ``master_endpoint`` (default
     ``$PADDLE_RPC_MASTER``), host ``build_frontend()`` behind a
     :class:`ReplicaServer`, heartbeat under ``{fleet_prefix}/hb/{rank}``
     so the router's lease detector covers silent death, publish this
     pid at ``{fleet_prefix}/pid/{rank}`` (kill drills target it), and
-    serve until a ``shutdown`` RPC or SIGTERM. Returns 0."""
+    serve until a ``shutdown`` RPC or SIGTERM. Returns 0.
+
+    ``group`` (a ``tp_serving.TPGroupMembership``) makes this process a
+    TP-GROUP LEADER: the serve loop checks gang membership every
+    membership interval, and a member death is GROUP-fatal — flight
+    dump, hard stop, exit 1 for the supervisor to respawn (the fleet
+    heartbeat lapses with this process, so the router sees exactly ONE
+    replica death for the whole gang). A clean shutdown announces
+    itself on the group store so the other members exit 0 instead of
+    reading the leader's silence as a crash."""
     import signal
     import sys
 
@@ -655,7 +666,34 @@ def replica_main(build_frontend, rank=None, master_endpoint=None,
     _publish_metrics()
     rc = 0
     misses = 0
-    while not server.stopped.wait(max(hb_interval * 2, 1.0)):
+    pub_every = max(hb_interval * 2, 1.0)
+    # a TP-group leader polls at the MEMBERSHIP cadence (a member death
+    # must surface within ~one membership lease, not one publish
+    # cadence); metric publishing keeps its own slower clock
+    wait_s = (pub_every if group is None
+              else min(pub_every, max(group.interval, 0.05)))
+    last_pub = time.monotonic()
+    while not server.stopped.wait(wait_s):
+        if group is not None:
+            try:
+                group.check("leader-serve")
+            except PeerFailureError as e:
+                # the gang is broken: the GROUP dies as one unit — this
+                # process stops serving (its fleet heartbeat lapses, so
+                # the router sees ONE replica death) and exits for the
+                # supervisor to respawn the gang
+                telemetry.flight_dump("tp_member_death", worker=worker,
+                                      group=group.group_id,
+                                      error=str(e))
+                bump_counter("tp.group_collapsed")
+                logger.error("replica %r: TP gang broken (%s); exiting "
+                             "for respawn", worker, e)
+                server.shutdown(drain=False)
+                rc = 1
+                break
+        if time.monotonic() - last_pub < pub_every:
+            continue
+        last_pub = time.monotonic()
         _publish_metrics()
         try:
             hb_store.check(f"{fleet_prefix}/pid/{rank}")
@@ -670,6 +708,12 @@ def replica_main(build_frontend, rank=None, master_endpoint=None,
                 server.shutdown(drain=False)
                 rc = 1
                 break
+    if group is not None:
+        if rc == 0:
+            # deliberate exit: members must read the leader's silence as
+            # a release, not a crash to respawn from
+            group.announce_shutdown()
+        group.stop()
     _publish_metrics()  # final snapshot: a drained exit still reports
     hb.stop(hb_interval + 1)
     with contextlib.suppress(Exception):

@@ -94,6 +94,29 @@ from .qos import QoSPolicy, tenant_label, tenant_summaries
 
 __all__ = ["ServingRouter", "launch_fleet"]
 
+# per-replica membership gauges, exported on every fleet_metrics() call
+# so ANY registry snapshot (and every flight dump embedding one) carries
+# the fleet view — the data source `obs fleet` renders from a live
+# registry, a saved snapshot, or a post-mortem dump alike. Documented in
+# README "Observability"; CI-gated against orphaning.
+_M_REP_STATE = telemetry.gauge(
+    "fleet.replica_state", "per-replica membership state "
+    "(1 up / 2 draining / 0 dead)")
+_M_REP_BREAKER = telemetry.gauge(
+    "fleet.replica_breaker", "router-side breaker state per replica "
+    "(0 closed / 1 half-open / 2 open)")
+_M_REP_ASSIGNED = telemetry.gauge(
+    "fleet.replica_assigned", "requests currently assigned per replica")
+_M_REP_SERVED = telemetry.gauge(
+    "fleet.replica_served", "requests served per replica")
+_M_REP_HB_AGE = telemetry.gauge(
+    "fleet.replica_hb_age_s", "age of each replica's last fleet "
+    "heartbeat (store-backed fleets only)")
+_M_REP_INC = telemetry.gauge(
+    "fleet.replica_incarnation", "per-replica incarnation marker: the "
+    "{inc=} label carries the replica server's pinned incarnation "
+    "prefix (value is always 1)")
+
 # a call into a replica failing with one of these is REPLICA-level
 # evidence (process dead, transport down, server deregistered), not a
 # request-level verdict: the router kills the replica and fails over.
@@ -409,6 +432,8 @@ class ServingRouter:
             self._collect(rep)
             self._deregister(rep)
         self._absorb_rpc_stats(rep)
+        if telemetry.enabled():
+            self._retire_replica_gauges(rep)
         del self._replicas[replica_id]
         self._publish_members()
         self._route_parked()
@@ -454,6 +479,14 @@ class ServingRouter:
             self._kill_replica(rep, reason)
 
     def _kill_replica(self, rep, reason):
+        # ONE death per replica, however many signals report it (lease
+        # sweep, transport errors on submit/collect/cancel, operator
+        # fail_replica) and however many member PROCESSES back the
+        # replica — a TP gang (models/tp_serving.py) registers as one
+        # replica id, so a group collapse is one breaker trip, one
+        # replica_dead flight event, and one failover charge per
+        # stranded rid, not one per member (regression-pinned in
+        # tests/test_tp_serving.py)
         if rep.state == "dead":
             return
         rep.state = "dead"
@@ -1450,6 +1483,8 @@ class ServingRouter:
                           "fleet shutdown")
         for rep in self._replicas.values():
             self._absorb_rpc_stats(rep)
+            if telemetry.enabled():
+                self._retire_replica_gauges(rep)
         self._replicas.clear()
         if self._detector is not None:
             with contextlib.suppress(Exception):
@@ -1498,6 +1533,46 @@ class ServingRouter:
                 bump_counter("fleet.metrics_unreadable")
         return snaps
 
+    _STATE_CODE = {"up": 1, "draining": 2, "dead": 0}
+    _BREAKER_CODE = {CircuitBreaker.CLOSED: 0, CircuitBreaker.HALF_OPEN: 1,
+                     CircuitBreaker.OPEN: 2}
+
+    def _retire_replica_gauges(self, rep):
+        """Final gauge export for a replica LEAVING the table (scale-in,
+        shutdown): without it the last exported state ('up') freezes in
+        every later snapshot and the roster lists the departed replica
+        as alive forever."""
+        rid = str(rep.id)
+        _M_REP_STATE.set(0, replica=rid)
+        _M_REP_ASSIGNED.set(0, replica=rid)
+
+    def _export_replica_gauges(self):
+        """Mirror the per-replica membership view (state, breaker,
+        assignment, heartbeat age, incarnation) into labeled gauges so
+        any snapshot of this registry carries the fleet roster — what
+        ``obs fleet`` renders offline from a saved snapshot or a flight
+        dump, when the live router is exactly the thing that died."""
+        for rep in list(self._replicas.values()):
+            rid = str(rep.id)
+            _M_REP_STATE.set(self._STATE_CODE.get(rep.state, -1),
+                             replica=rid)
+            _M_REP_BREAKER.set(
+                self._BREAKER_CODE.get(rep.breaker.state(), -1),
+                replica=rid)
+            _M_REP_ASSIGNED.set(len(rep.assigned), replica=rid)
+            _M_REP_SERVED.set(rep.served, replica=rid)
+            inc = (rep.h_cache or {}).get("_inc")
+            if inc:
+                _M_REP_INC.set(1, replica=rid, inc=str(inc)[:8])
+            if self._store is not None and rep.state != "dead":
+                with contextlib.suppress(Exception):
+                    t = self._store.last_heartbeat(
+                        rep.id, prefix=f"{self._prefix}/hb")
+                    if t is not None:
+                        _M_REP_HB_AGE.set(
+                            max(time.time() - t, 0.0),  # wall-clock: x-process store beats
+                            replica=rid)
+
     def fleet_metrics(self) -> dict:
         """ONE fleet-wide observability view: this process's telemetry
         registry merged with every replica process's store-published
@@ -1523,6 +1598,10 @@ class ServingRouter:
         * ``metrics`` — the full merged snapshot (counters incl. the
           whole resilience ledger, gauges, histograms) for export.
         """
+        if telemetry.enabled():
+            # refresh the roster gauges BEFORE snapshotting, so the
+            # merged view (and anything that saves it) carries them
+            self._export_replica_gauges()
         merged = telemetry.merge_snapshots(
             telemetry.registry().snapshot(),
             *self._member_metric_snapshots())

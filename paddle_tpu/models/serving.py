@@ -466,13 +466,32 @@ class ContinuousBatchingEngine:
         stats["seconds"] = time.monotonic() - t0
         return stats
 
+    def _sds(self, x):
+        """Warmup aval for an EXISTING engine array (params / KV pools).
+        The TP engine (models/tp_serving.py) overrides this to carry the
+        array's committed mesh sharding into the AOT lowering — an
+        executable compiled without shardings refuses sharded inputs."""
+        return jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+
+    def _op_aval(self, shape, dtype):
+        """Warmup aval for an operand fabricated host-side per dispatch
+        (prompts, table rows, sampling keys). The TP engine overrides
+        this to pin them replicated over its mesh."""
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    def _param_snapshot(self):
+        """The param dict a session (or warmup lowering) runs against.
+        The TP engine overrides this to serve MESH-SHARDED copies
+        without mutating the model — a collocated single-chip engine
+        sharing the same model must keep seeing unsharded params."""
+        return {k: p._value for k, p in self.model.named_parameters()}
+
     def _warmup_compile(self, segment):
         """The warmup compile loop (split out so :meth:`warmup` can
         scope it under the compile watchdog)."""
         with self._swap_lock:
-            params = {k: p._value
-                      for k, p in self.model.named_parameters()}
-        sds = lambda x: jax.ShapeDtypeStruct(tuple(x.shape), x.dtype)
+            params = self._param_snapshot()
+        sds = self._sds
         p_s = jax.tree_util.tree_map(sds, params)
         ks_s = [sds(k) for k in self._ks]
         vs_s = [sds(v) for v in self._vs]
@@ -490,16 +509,16 @@ class ContinuousBatchingEngine:
 
         chunk_w = self.prompt_buckets[-1]
         for g in self.group_widths():
-            rows_s = jax.ShapeDtypeStruct((g, cols), i32)
-            lens_s = jax.ShapeDtypeStruct((g,), i32)
-            keys_s = jax.ShapeDtypeStruct((g,) + self._key_shape, kdt)
+            rows_s = self._op_aval((g, cols), i32)
+            lens_s = self._op_aval((g,), i32)
+            keys_s = self._op_aval((g,) + self._key_shape, kdt)
             for bucket in self.prompt_buckets:
                 compile_(("prefill", bucket, g), self._prefill_p,
-                         jax.ShapeDtypeStruct((g, bucket), i32),
+                         self._op_aval((g, bucket), i32),
                          rows_s, lens_s, keys_s)
             if self.max_len > chunk_w and self.max_len % chunk_w == 0:
-                chunk_s = jax.ShapeDtypeStruct((g, chunk_w), i32)
-                bases_s = jax.ShapeDtypeStruct((g,), i32)
+                chunk_s = self._op_aval((g, chunk_w), i32)
+                bases_s = self._op_aval((g,), i32)
                 compile_(("chunk", g), self._chunk_p, chunk_s, rows_s,
                          bases_s)
                 compile_(("final", g), self._final_chunk_p, chunk_s, rows_s,
@@ -508,12 +527,12 @@ class ContinuousBatchingEngine:
                   else getattr(self, "_segment_len", 16))
         m = self.max_slots
         compile_(("segment", seg), self._segment_p,
-                 jax.ShapeDtypeStruct((m, cols), i32),
-                 jax.ShapeDtypeStruct((m,), i32),
-                 jax.ShapeDtypeStruct((m,), i32),
-                 jax.ShapeDtypeStruct((m,), jnp.bool_),
-                 jax.ShapeDtypeStruct((m,), i32),
-                 jax.ShapeDtypeStruct((seg, m) + self._key_shape, kdt))
+                 self._op_aval((m, cols), i32),
+                 self._op_aval((m,), i32),
+                 self._op_aval((m,), i32),
+                 self._op_aval((m,), jnp.bool_),
+                 self._op_aval((m,), i32),
+                 self._op_aval((seg, m) + self._key_shape, kdt))
         return stats
 
     # ------------------------------------------------------- sampling keys
@@ -626,8 +645,7 @@ class ContinuousBatchingEngine:
         decode window per ``step()``; ``run_deadline`` bounds the whole
         session (unfinished requests retire as ``timed_out`` past it)."""
         with self._swap_lock:
-            self._params = {k: p._value
-                            for k, p in self.model.named_parameters()}
+            self._params = self._param_snapshot()
         self._segment_len = int(segment)
         self._run_deadline = run_deadline or Deadline.never()
         self._queue: deque[Request] = deque()

@@ -65,6 +65,17 @@ GATES = {
     "brownout_protected_loss_pct": 1.0,
     "brownout_floor_breach": 1.0,    # 0/1: goodput floor under target
     "brownout_unrecovered": 1.0,     # 0/1: stage did not return to 0
+    # tensor-parallel serving (bench e8): the host cost of committing
+    # dispatch operands onto the TP mesh must stay a small share of
+    # active serving time, a group member death must recover (breaker
+    # trip + bit-exact failover + all results delivered) well inside a
+    # minute, and any lost request or stream divergence is a hard fail.
+    # Older rounds lack the section entirely — absent metrics are
+    # skipped, so the series stays parseable end to end.
+    "tp_dispatch_overhead_pct": 10.0,
+    "tp_member_death_recovery_s": 60.0,
+    "tp_lost_requests": 1.0,         # 0/1+: requests lost in the drill
+    "tp_stream_divergence": 1.0,     # 0/1: failover stream != reference
 }
 
 DEFAULT_RATIO_THRESHOLD = 0.9   # per-round e2e_vs_baseline alarm

@@ -19,6 +19,12 @@ leave on disk (and the live process registry, for REPL use):
   transitions, the shed/reject counters with their ``{tenant,
   priority}`` attribution, and the autoscaler/brownout decision history
   (flight events) — from the live process or any snapshot/flight dump.
+* ``fleet [PATH]`` — the membership view: per-replica (and per-TP-group)
+  state, breaker, assignment, last-heartbeat age, and incarnation from
+  the ``fleet.replica_*`` / ``tp.*`` series the router and group members
+  export, plus the death / lease / takeover event history — from the
+  live process or any snapshot/flight dump (the offline path matters:
+  the live router is exactly the thing that died).
 * ``bench-diff A B`` — metric-by-metric comparison of two ``BENCH_*``
   records (round files or the baseline), flagging the big movers. The
   full series harness is ``tools/bench_trend.py``.
@@ -201,8 +207,7 @@ def cmd_slo(args) -> int:
     if burns:
         print("burn rate (error budget burn per objective/window):")
         for k in burns:
-            labels = dict(p.split("=", 1)
-                          for p in k.split("{", 1)[1][:-1].split(","))
+            labels = _labels_of(k)
             gkey = f"slo.goodput{{{k.split('{', 1)[1]}"
             gp = gauges.get(gkey)
             print(f"  {labels.get('objective', '?'):<16} "
@@ -248,6 +253,118 @@ def cmd_slo(args) -> int:
     elif events is not None:
         print("decision history: (no autoscaler/brownout events "
               "recorded)")
+    return 0
+
+
+def _labels_of(key):
+    """``name{k=v,k2=v2}`` → dict of labels (the snapshot's flattened
+    series-key format)."""
+    if "{" not in key:
+        return {}
+    return dict(p.split("=", 1)
+                for p in key.split("{", 1)[1][:-1].split(",") if "=" in p)
+
+
+def cmd_fleet(args) -> int:
+    """Fleet membership view: per-replica state / breaker / assignment /
+    heartbeat age / incarnation from the ``fleet.replica_*`` gauges the
+    router exports, per-TP-group membership from the ``tp.*`` series,
+    and the death / lease / takeover event history — from the live
+    process (registry + flight ring) or any snapshot / flight dump."""
+    from ..core import telemetry
+
+    events = None
+    if args.path:
+        try:
+            obj = json.load(open(args.path))
+        except (OSError, ValueError) as e:
+            sys.stderr.write(f"cannot read {args.path}: {e}\n")
+            return 2
+        if "metrics" in obj:          # a flight dump
+            snap = obj.get("metrics") or {}
+            events = obj.get("events", [])
+        else:                         # a bare registry snapshot
+            snap = obj
+        if not isinstance(snap, dict) or not (
+                {"counters", "gauges", "histograms"} & set(snap)):
+            sys.stderr.write(
+                f"{args.path} is not a metrics snapshot or flight "
+                "dump\n")
+            return 2
+    else:
+        snap = telemetry.registry().snapshot()
+        events = telemetry.flight_recorder().events()
+    gauges = snap.get("gauges", {})
+    counters = snap.get("counters", {})
+
+    # --- per-replica roster (fleet.replica_* labeled gauges)
+    state_names = {1: "up", 2: "draining", 0: "dead"}
+    breaker_names = {0: "closed", 1: "half-open", 2: "open"}
+    rows: dict[str, dict] = {}
+    for k, v in gauges.items():
+        fam = k.split("{", 1)[0]
+        if not fam.startswith("fleet.replica_"):
+            continue
+        labels = _labels_of(k)
+        rep = labels.get("replica")
+        if rep is None:
+            continue
+        row = rows.setdefault(rep, {})
+        if fam == "fleet.replica_incarnation":
+            row["inc"] = labels.get("inc", "?")
+        else:
+            row[fam[len("fleet.replica_"):]] = v
+    if rows:
+        print(f"replicas ({len(rows)}):")
+        print(f"  {'id':<6} {'state':<9} {'breaker':<10} "
+              f"{'assigned':>8} {'served':>7} {'hb age':>8}  inc")
+        for rep in sorted(rows, key=lambda r: (len(r), r)):
+            row = rows[rep]
+            hb = row.get("hb_age_s")
+            print(f"  {rep:<6} "
+                  f"{state_names.get(row.get('state'), '?'):<9} "
+                  f"{breaker_names.get(row.get('breaker'), '?'):<10} "
+                  f"{int(row.get('assigned', 0)):>8} "
+                  f"{int(row.get('served', 0)):>7} "
+                  f"{(f'{hb:.2f}s' if hb is not None else '-'):>8}  "
+                  f"{row.get('inc', '-')}")
+    else:
+        print("replicas: (no fleet.replica_* gauges recorded — the "
+              "router exports them at every fleet_metrics() call)")
+
+    # --- TP groups (tp.* series from the group member processes)
+    groups = {_labels_of(k).get("group", "?"): v
+              for k, v in gauges.items()
+              if k.split("{", 1)[0] == "tp.group_members" and "{" in k}
+    degree = gauges.get("tp.engine_degree")
+    if groups or degree is not None:
+        print("tp groups:")
+        if degree is not None:
+            print(f"  engine TP degree: {int(degree)}")
+        for g in sorted(groups):
+            print(f"  group {g}: {int(groups[g])} member(s)")
+        for name in ("tp.member_dead", "tp.collective_timeout",
+                     "tp.group_collapsed", "tp.member_rejoined",
+                     "tp.group_form_timeout", "tp.member_store_lost"):
+            if counters.get(name):
+                print(f"  {name:<24} {counters[name]}")
+
+    # --- death / lease / takeover history (flight events)
+    fams = ("replica_dead", "tp_member_death", "takeover",
+            "lease_acquired", "lease_superseded", "stand_down",
+            "failover")
+    history = [e for e in (events or ())
+               if str(e.get("kind", "")) in fams]
+    if history:
+        shown = min(args.n, len(history))
+        print(f"event history (last {shown} of {len(history)} event(s), "
+              "oldest first):")
+        for e in history[-args.n:]:
+            extra = {k: v for k, v in e.items()
+                     if k not in ("kind", "ts")}
+            print(f"  {e.get('kind'):<18} {extra}")
+    elif events is not None:
+        print("event history: (no membership events recorded)")
     return 0
 
 
@@ -301,6 +418,15 @@ def main(argv=None) -> int:
     sp.add_argument("-n", type=int, default=20,
                     help="show at most N decision events")
     sp.set_defaults(fn=cmd_slo)
+    flp = sub.add_parser("fleet", help="per-replica (and per-TP-group) "
+                                       "membership, breaker state, "
+                                       "incarnation, heartbeat age")
+    flp.add_argument("path", nargs="?", default=None,
+                     help="snapshot JSON or flight dump (default: this "
+                          "process's registry + flight ring)")
+    flp.add_argument("-n", type=int, default=20,
+                     help="show at most N membership events")
+    flp.set_defaults(fn=cmd_fleet)
     bp = sub.add_parser("bench-diff",
                         help="diff two BENCH_*.json records")
     bp.add_argument("a")

@@ -15,3 +15,13 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # the tier-1 invocation deselects these (-m 'not slow'); registering
+    # the marker makes that contract explicit instead of an unknown-mark
+    # warning
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process flagship drills excluded from the tier-1 "
+        "run (-m 'not slow'); run them explicitly with -m slow")

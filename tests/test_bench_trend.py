@@ -83,7 +83,7 @@ def test_reports_known_decode_floor_regression(bt):
 
 def _fixture_root(tmp_path, extra_round=None):
     root = tmp_path / "bench"
-    root.mkdir()
+    root.mkdir(parents=True)
     for name in ("BENCH_BASELINE.json", "BENCH_r04.json",
                  "BENCH_r05.json"):
         shutil.copy(_ROOT / name, root / name)
@@ -126,6 +126,45 @@ def test_gate_violation_detected(bt, tmp_path):
     assert hits and hits[0]["value"] == 7.5 and hits[0]["limit"] == 3.0
     assert bt.main(["--root",
                     str(tmp_path / "bench"), "-q"]) == 1
+
+
+def test_tp_gates_cover_e8_and_tolerate_old_rounds(bt, tmp_path):
+    """The e8 TP-serving gates (dispatch overhead, member-death
+    recovery, lost requests, stream divergence) are declared in GATES,
+    fire on an over-limit round, and — critically — the checked-in
+    OLDER rounds that predate the section stay clean (absent metrics
+    are skipped, not treated as violations)."""
+    for gate in ("tp_dispatch_overhead_pct", "tp_member_death_recovery_s",
+                 "tp_lost_requests", "tp_stream_divergence"):
+        assert gate in bt.GATES, f"e8 gate {gate} missing from GATES"
+    # rounds r04/r05 predate e8 entirely: no tp_* keys, no violations
+    report = bt.analyze(str(_fixture_root(tmp_path)))
+    assert not any(e["metric"].startswith("tp_")
+                   for e in report["gate_violations"])
+    # a round carrying the new section: in-gate numbers stay clean...
+    ok = {"n": 8, "cmd": "python bench.py", "rc": 0, "tail": "",
+          "parsed": {"platform": "cpu", "device": "cpu",
+                     "tp_degree": 2, "tp_dispatch_overhead_pct": 1.2,
+                     "tp_member_death_recovery_s": 4.5,
+                     "tp_lost_requests": 0, "tp_stream_divergence": 0}}
+    report = bt.analyze(str(_fixture_root(tmp_path / "ok", ok)))
+    assert not any(e["metric"].startswith("tp_")
+                   for e in report["gate_violations"])
+    # ...and an over-limit round trips every tp gate it violates
+    bad = {"n": 8, "cmd": "python bench.py", "rc": 0, "tail": "",
+           "parsed": {"platform": "cpu", "device": "cpu",
+                      "tp_dispatch_overhead_pct": 35.0,
+                      "tp_member_death_recovery_s": 120.0,
+                      "tp_lost_requests": 2,
+                      "tp_stream_divergence": 1}}
+    report = bt.analyze(str(_fixture_root(tmp_path / "bad", bad)))
+    tripped = {e["metric"] for e in report["gate_violations"]
+               if e["metric"].startswith("tp_")}
+    assert tripped == {"tp_dispatch_overhead_pct",
+                       "tp_member_death_recovery_s", "tp_lost_requests",
+                       "tp_stream_divergence"}
+    assert bt.main(["--root", str(tmp_path / "bad" / "bench"),
+                    "-q"]) == 1
 
 
 def test_unreadable_round_is_a_parse_error(bt, tmp_path):

@@ -53,9 +53,15 @@ _WALL_CLOCK_ALIAS = re.compile(
     r"|from[ \t]+time[ \t]+import[ \t]+(?:\(?[\w \t,]*\btime\b))",
     re.M)
 
-_NO_BARE_EXCEPT_DIRS = ("distributed", "io", "amp", "hapi", "models")
+# NOTE: "distributed" covers its whole subtree (rglob), so
+# paddle_tpu/distributed/fleet/ rides the same sweep; "tools" joined at
+# the TP-serving PR (the obs/bench_trend/trafficgen CLIs run in CI and
+# operator hands — they get the same failure-swallowing and wall-clock
+# discipline as the runtime trees)
+_NO_BARE_EXCEPT_DIRS = ("distributed", "io", "amp", "hapi", "models",
+                        "tools")
 _MONOTONIC_ONLY_DIRS = ("core", "io", "amp", "hapi", "models",
-                        "distributed")
+                        "distributed", "tools")
 
 # the one sanctioned wall-clock use: timestamps that cross hosts via the
 # store must be wall-clock (no shared monotonic epoch) and say so inline
