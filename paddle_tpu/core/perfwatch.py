@@ -207,7 +207,10 @@ class MemoryWatchdog:
         on a 16GB chip")."""
         from .. import device as _device
 
-        self._last_poll = time.monotonic()
+        with self._lock:
+            # maybe_poll() rate-limits on this stamp from other threads;
+            # an unlocked write here could tear against its read-compare
+            self._last_poll = time.monotonic()
         try:
             stats = _device.memory_stats(self.device_id) or {}
         except Exception:  # noqa: BLE001 — introspection must never

@@ -385,13 +385,18 @@ class RequestJournal:
 
     def _replay_file(self, path, truncate=False):
         gen = _scan_frames(path)
-        while True:
-            try:
-                rec = next(gen)
-            except StopIteration as stop:
-                good = stop.value
-                break
-            self._apply_record(rec)
+        with self._lock:
+            # replay normally runs pre-publication (recover() builds the
+            # journal before any other thread sees it), but the live
+            # tables it rewrites are the ones every public method guards
+            # — same discipline here keeps the write sites uniform
+            while True:
+                try:
+                    rec = next(gen)
+                except StopIteration as stop:
+                    good = stop.value
+                    break
+                self._apply_record(rec)
         if truncate and good is not None and good < os.path.getsize(path):
             with open(path, "r+b") as f:
                 f.truncate(good)

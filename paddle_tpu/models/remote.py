@@ -297,11 +297,14 @@ class ReplicaServer:
         of the row format ``RemoteFrontend`` unpacks), retiring each rid
         from the live set."""
         rows = []
-        for rid, res in fetched.items():
-            self._live.discard(rid)
-            rows.append([rid, res.status,
-                         np.asarray(res.tokens, np.int32), res.reason,
-                         int(getattr(res, "token_base", 0))])
+        with self._lock:
+            # the live set also gates submit()'s duplicate check — a
+            # discard racing that check could re-admit a retiring rid
+            for rid, res in fetched.items():
+                self._live.discard(rid)
+                rows.append([rid, res.status,
+                             np.asarray(res.tokens, np.int32), res.reason,
+                             int(getattr(res, "token_base", 0))])
         return rows
 
     def cancel(self, rid) -> bool:

@@ -34,6 +34,11 @@ def build_library(name: str, sources: list[str] | None = None,
         cmd = ["g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread",
                *(extra_flags or []), "-o", out, *sources]
         try:
+            # blocking UNDER the build lock is the contract here: the
+            # lock exists to serialize the one-time g++ build, and a
+            # second caller MUST park until the .so exists (tpu-lint's
+            # usual "snapshot then block" fix would race the compiler)
+            # tpu-lint: disable=lock-blocking-call
             proc = subprocess.run(cmd, capture_output=True, text=True,
                                   timeout=120)
         except (OSError, subprocess.TimeoutExpired):

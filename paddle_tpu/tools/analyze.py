@@ -1,0 +1,1800 @@
+"""tpu-lint — whole-repo static analysis for the TPU-native serving stack.
+
+``python -m paddle_tpu.tools.analyze [--json] [--baseline FILE] [paths...]``
+
+The runtime side of this discipline already exists: the compile watchdog
+(jit/compile_watch.py) counts post-warmup recompiles, the resilience
+ledger counts swallowed failures, the fleet drills kill replicas
+mid-decode. All of it observes damage AFTER the bad edit landed. This
+module is the static side: one shared AST parse per file, a pipeline of
+visitor passes over it, and a CI gate that keeps the tree clean — the
+recompile storm is rejected at review time, not diagnosed at 3am.
+
+Passes and rules
+----------------
+
+**tracer-safety** — a jit-entry call graph is built over the package
+(functions wrapped by ``jax.jit`` / ``pjit`` / ``shard_map`` /
+``pl.pallas_call``, by value or decorator, plus everything reachable
+from them by name). Inside that traced region:
+
+* ``tracer-concretize`` — ``.item()``, or ``float()/int()/bool()`` on a
+  value derived from a traced argument: a silent host sync per call.
+* ``tracer-np-host`` — ``np.*`` applied to a traced value: the tracer
+  is concretized onto the host and the op falls out of the program.
+* ``tracer-host-branch`` — ``if``/``while`` on a traced value (``is
+  None`` structure checks are exempt — they resolve at trace time).
+  Fix: ``jnp.where``/``lax.cond``, or mark the arg static.
+* ``tracer-wall-clock`` — ``time.time/monotonic/perf_counter`` inside
+  traced code: burned into the compiled program as a constant.
+* ``tracer-py-rng`` — Python/NumPy RNG inside traced code: one value
+  baked in at trace time; use ``jax.random`` with a threaded key.
+
+**recompile-hygiene**
+
+* ``recompile-churn`` — a call to a known-jitted callable passing an
+  f-string / ``str(...)`` / ``repr(...)`` / ``len(...)`` argument:
+  every distinct value is a new cache entry (strings are static by
+  necessity; a ``len`` of a growing structure respecializes forever).
+* ``recompile-unhashable-static`` — a dict/list/set literal passed in a
+  position the wrap site marked static (``static_argnums`` /
+  ``static_argnames``): unhashable, so every call misses the cache (or
+  raises).
+* ``pytree-dict-order`` — iterating a locally-built plain ``dict``
+  inside traced code without ``sorted()``: pytree flattening order
+  follows insertion order, so two call sites building the same dict in
+  different orders silently produce different programs.
+
+**lock-discipline** — a static lock registry (module-level and
+``self.X = threading.Lock()/RLock()/Condition()`` attributes, plus
+aliases) and an acquisition graph over ``with`` blocks, propagated
+through same-module/self-method calls:
+
+* ``lock-order-cycle`` — two locks acquired in inconsistent order on
+  different paths (the classic deadlock), or a non-reentrant lock
+  re-acquired while held.
+* ``lock-blocking-call`` — ``time.sleep`` / ``.join()`` / ``.recv()`` /
+  ``rpc_sync`` / ``subprocess.run`` / collective ops / ``.wait()``
+  executed while holding a lock (``Condition.wait`` on the held
+  condition is exempt: it releases). A blocked holder stalls every
+  other thread at the lock.
+* ``lock-mixed-mutation`` — in a lock-owning class, a ``self``
+  attribute written both under the lock and outside it (``__init__``
+  and private methods only ever called under the lock are exempt).
+
+**exception/status hygiene** — the generalization of the historical
+regex guards (tests/test_no_bare_except.py now runs on this engine):
+
+* ``bare-except-pass`` — ``except [Exception]: pass`` under the
+  resilience-covered trees silently swallows exactly the failures the
+  resilience runtime is supposed to count or surface.
+* ``wall-clock`` — ``time.time()`` where deadline/elapsed math lives;
+  an NTP step must not expire every in-flight budget. The one
+  sanctioned use (cross-host timestamps) carries ``# wall-clock``.
+* ``wall-clock-alias`` — ``import time as X`` / ``from time import
+  time``: hides wall-clock calls from the guard above.
+
+Pragmas, baseline, scoping
+--------------------------
+
+* ``# tpu-lint: disable=rule[,rule2]`` on the offending line (or alone
+  on the line above) suppresses those rules there; ``disable=all``
+  suppresses everything. The legacy ``# wall-clock`` pragma is honored
+  for the wall-clock rules.
+* ``--baseline FILE`` (default: ``TPU_LINT_BASELINE.json`` at the repo
+  root when present) suppresses grandfathered findings; every entry
+  MUST carry a non-empty ``reason``. New code gets pragmas with
+  justifications, not baseline entries.
+* The hygiene rules keep their historical directory scopes inside
+  ``paddle_tpu/`` (see ``BARE_EXCEPT_DIRS`` / ``MONOTONIC_DIRS``);
+  files outside a ``paddle_tpu`` tree (e.g. test fixtures) get every
+  rule. The analysis passes themselves are pure AST — no JAX import —
+  so this module is loadable standalone (``importlib`` from file) and
+  the CI gate runs without a backend.
+
+The ``--json`` report also carries the artifacts the passes build —
+the jit-entry list and the fleet lock graph (every lock, every ordering
+edge with its site, every cycle) — rendered as a table by
+``python -m paddle_tpu.tools.obs lint``.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+
+__all__ = [
+    "Finding", "analyze_paths", "run", "build_report",
+    "collect_metric_names", "collect_fault_sites",
+    "load_baseline", "main",
+    "RULES", "BARE_EXCEPT_DIRS", "MONOTONIC_DIRS",
+]
+
+RULES = {
+    "tracer-concretize":
+        "host concretization (.item()/float()/int()/bool()) of a traced "
+        "value inside jitted code",
+    "tracer-np-host":
+        "numpy host op applied to a traced value inside jitted code",
+    "tracer-host-branch":
+        "Python if/while on a traced value inside jitted code",
+    "tracer-wall-clock":
+        "wall/monotonic clock read inside jitted code",
+    "tracer-py-rng":
+        "Python/NumPy RNG inside jitted code",
+    "recompile-churn":
+        "churning static argument (f-string/str()/len()) at a jitted "
+        "call site",
+    "recompile-unhashable-static":
+        "unhashable literal in a static_argnums/static_argnames "
+        "position",
+    "pytree-dict-order":
+        "unsorted iteration over a locally-built dict inside jitted "
+        "code",
+    "lock-order-cycle":
+        "inconsistent lock-acquisition order (deadlock risk)",
+    "lock-blocking-call":
+        "blocking call while holding a lock",
+    "lock-mixed-mutation":
+        "attribute written both under a lock and outside it",
+    "bare-except-pass":
+        "bare 'except: pass' swallows failures silently",
+    "wall-clock":
+        "time.time() where deadline/elapsed math lives",
+    "wall-clock-alias":
+        "aliased time import hides wall-clock calls from the guard",
+}
+
+# severity is structured metadata on every finding (report/table/JSON):
+# "error" = the defect class has bitten this codebase or is a certain
+# bug (deadlock, silent host sync, swallowed failure); "warn" = strong
+# heuristic that occasionally has a justified exemption (the pragma
+# workflow). BOTH gate CI — the tree ships clean of each.
+WARN_RULES = ("recompile-churn", "pytree-dict-order",
+              "lock-mixed-mutation")
+
+
+def severity_of(rule):
+    return "warn" if rule in WARN_RULES else "error"
+
+
+# historical scopes of the hygiene guards (tests/test_no_bare_except.py)
+BARE_EXCEPT_DIRS = ("distributed", "io", "amp", "hapi", "models", "tools")
+MONOTONIC_DIRS = ("core", "io", "amp", "hapi", "models", "distributed",
+                  "tools")
+
+_PRAGMA_RE = re.compile(r"#\s*tpu-lint:\s*disable=([a-zA-Z0-9_,\- ]+)")
+_LEGACY_WALL = "# wall-clock"
+_WALL_RULES = ("wall-clock", "wall-clock-alias", "tracer-wall-clock")
+
+_JIT_WRAPPERS = ("jit", "pjit", "pallas_call", "shard_map")
+_CLOCK_ATTRS = ("time", "monotonic", "perf_counter", "process_time",
+                "time_ns", "monotonic_ns", "perf_counter_ns")
+_LOCK_CTORS = ("Lock", "RLock", "Condition")
+# call names that park the calling thread (the list the lock pass
+# checks under a held lock); ".join"/".wait"/".recv" match as attributes
+_BLOCKING_ATTRS = ("join", "wait", "recv", "recv_into", "accept",
+                   "connect", "sleep", "acquire")
+_BLOCKING_NAMES = ("rpc_sync", "barrier", "all_reduce", "all_gather",
+                   "all_to_all", "broadcast", "ppermute", "psum",
+                   "send_kv", "recv_kv", "sleep")
+_MUTATORS = ("append", "appendleft", "extend", "insert", "add", "update",
+             "remove", "discard", "pop", "popleft", "clear",
+             "setdefault")
+
+
+class Finding:
+    __slots__ = ("rule", "path", "line", "col", "why", "hint")
+
+    def __init__(self, rule, path, line, col, why, hint=""):
+        self.rule = rule
+        self.path = path
+        self.line = line
+        self.col = col
+        self.why = why
+        self.hint = hint
+
+    @property
+    def severity(self):
+        return severity_of(self.rule)
+
+    def to_dict(self):
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line,
+                "col": self.col, "why": self.why, "hint": self.hint}
+
+    def __repr__(self):
+        return f"{self.path}:{self.line}: [{self.rule}] {self.why}"
+
+
+def _dotted(expr):
+    """``a.b.c`` attribute chain as a string, else None."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = _dotted(expr.value)
+        return f"{base}.{expr.attr}" if base else None
+    return None
+
+
+def _own_nodes(fn_node):
+    """Walk a function body WITHOUT descending into nested function /
+    class definitions (those are their own analysis units). Lambdas are
+    inlined — they trace as part of this function."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class Module:
+    """One parsed file: source, AST, pragma map, import map."""
+
+    def __init__(self, path, relpath):
+        self.path = path
+        self.relpath = relpath
+        with open(path, "r", encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source, filename=path)
+        self.pragmas = self._scan_pragmas()
+        # local name -> dotted module path it refers to
+        self.imports = {}
+        # local name -> (dotted module path, original name)
+        self.import_from = {}
+        self._scan_imports()
+
+    # pragma map: line -> set of suppressed rules; a comment-only pragma
+    # line also covers the following line
+    def _scan_pragmas(self):
+        out = {}
+        for i, line in enumerate(self.lines, 1):
+            rules = set()
+            m = _PRAGMA_RE.search(line)
+            if m:
+                rules |= {r.strip() for r in m.group(1).split(",")
+                          if r.strip()}
+            if _LEGACY_WALL in line:
+                rules |= set(_WALL_RULES)
+            if not rules:
+                continue
+            out.setdefault(i, set()).update(rules)
+            if line.lstrip().startswith("#"):
+                out.setdefault(i + 1, set()).update(rules)
+        return out
+
+    def suppressed(self, rule, line):
+        rules = self.pragmas.get(line, ())
+        return rule in rules or "all" in rules
+
+    def _scan_imports(self):
+        pkg_parts = self.relpath.replace(os.sep, "/").split("/")[:-1]
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                if node.level:
+                    base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+                    mod = ".".join(base + ([node.module]
+                                           if node.module else []))
+                else:
+                    mod = node.module or ""
+                for a in node.names:
+                    self.import_from[a.asname or a.name] = (mod, a.name)
+
+    def alias_of(self, dotted_module):
+        """Local names bound to ``dotted_module`` (e.g. 'np' for
+        'numpy')."""
+        return {k for k, v in self.imports.items() if v == dotted_module}
+
+
+_PARSE_CACHE = {}
+
+
+def parse_module(path):
+    """Parse with a cross-call cache — every pass (and every migrated
+    guard test) shares ONE parse per file."""
+    path = os.path.abspath(path)
+    st = os.stat(path)
+    key = (path, st.st_mtime_ns, st.st_size)
+    mod = _PARSE_CACHE.get(key)
+    if mod is None:
+        mod = _PARSE_CACHE[key] = Module(path, _relpath_of(path))
+    return mod
+
+
+def _relpath_of(path):
+    """Path relative to the repo root, detected as the parent of the
+    last ``paddle_tpu`` directory component; paths outside any
+    ``paddle_tpu`` tree keep their basename-anchored tail (fixtures)."""
+    parts = path.replace(os.sep, "/").split("/")
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] == "paddle_tpu" and i < len(parts) - 1:
+            return "/".join(parts[i:])
+    return parts[-1]
+
+
+def _scope_subdir(relpath):
+    """``paddle_tpu/<subdir>/...`` -> subdir; None when the file is not
+    under a package tree (fixtures: every rule applies)."""
+    parts = relpath.split("/")
+    if parts[0] == "paddle_tpu" and len(parts) > 1:
+        return parts[1] if len(parts) > 2 else "."
+    return None
+
+
+def iter_py_files(paths):
+    out = []
+    for p in paths:
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+        elif p.endswith(".py"):
+            out.append(p)
+    seen, uniq = set(), []
+    for p in out:
+        a = os.path.abspath(p)
+        if a not in seen:
+            seen.add(a)
+            uniq.append(a)
+    return uniq
+
+
+class FuncInfo:
+    __slots__ = ("module", "node", "name", "qualname", "cls",
+                 "static_names")
+
+    def __init__(self, module, node, qualname, cls):
+        self.module = module
+        self.node = node
+        self.name = node.name
+        self.qualname = qualname
+        self.cls = cls
+        self.static_names = set()   # params excluded from tracing
+
+    @property
+    def key(self):
+        return (self.module.relpath, self.qualname)
+
+    def param_names(self):
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if self.cls and names and names[0] in ("self", "cls"):
+            names = names[1:]
+        return names
+
+
+class LockInfo:
+    __slots__ = ("id", "kind", "relpath", "line")
+
+    def __init__(self, id, kind, relpath, line):
+        self.id = id
+        self.kind = kind            # Lock | RLock | Condition
+        self.relpath = relpath
+        self.line = line
+
+
+class RepoIndex:
+    """Everything the passes share: functions, imports, the jit-entry
+    call graph, and the lock registry."""
+
+    def __init__(self, modules):
+        self.modules = modules
+        self.by_dotted = {}
+        for m in modules:
+            dotted = m.relpath[:-3].replace("/", ".")
+            if dotted.endswith(".__init__"):
+                dotted = dotted[:-len(".__init__")]
+            self.by_dotted[dotted] = m
+        self.functions = []          # all FuncInfo
+        self.func_index = {}         # (relpath, qualname) -> FuncInfo
+        self.module_funcs = {}       # relpath -> {simple name: FuncInfo}
+        self.methods = {}            # (relpath, cls, name) -> FuncInfo
+        self.class_bases = {}        # (relpath, cls) -> [base names]
+        self.locks = {}              # lock id -> LockInfo
+        self.class_locks = {}        # (relpath, cls) -> {attr: lock id}
+        self.module_locks = {}       # relpath -> {name: lock id}
+        self.lock_attr_names = {}    # attr -> set of lock ids
+        self.jit_entries = []        # (FuncInfo, wrapper, line)
+        self.jit_bindings = {}       # (relpath, scope, name) -> wrap Call
+        self.traced = set()          # FuncInfo.key reachable from a jit
+        self._collect_functions()
+        self._collect_locks()
+        self._collect_jit()
+        self._build_traced_set()
+
+    # ----------------------------------------------------- collection
+
+    def _collect_functions(self):
+        for m in self.modules:
+            simple = {}
+            self.module_funcs[m.relpath] = simple
+
+            def visit(node, prefix, cls):
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef)):
+                        qn = f"{prefix}{child.name}"
+                        fi = FuncInfo(m, child, qn, cls)
+                        self.functions.append(fi)
+                        self.func_index[fi.key] = fi
+                        # module-level defs win the simple-name slot
+                        if prefix == "" or child.name not in simple:
+                            simple[child.name] = fi
+                        if cls:
+                            self.methods[(m.relpath, cls,
+                                          child.name)] = fi
+                        visit(child, f"{qn}.", cls)
+                    elif isinstance(child, ast.ClassDef):
+                        self.class_bases[(m.relpath, child.name)] = [
+                            b.id for b in child.bases
+                            if isinstance(b, ast.Name)]
+                        visit(child, f"{prefix}{child.name}.",
+                              child.name)
+
+            visit(m.tree, "", None)
+
+    def _is_lock_ctor(self, m, call):
+        if not isinstance(call, ast.Call):
+            return None
+        dotted = _dotted(call.func)
+        if not dotted:
+            return None
+        last = dotted.split(".")[-1]
+        if last not in _LOCK_CTORS:
+            return None
+        if "." in dotted:
+            root = dotted.split(".")[0]
+            if m.imports.get(root) != "threading":
+                return None
+        else:
+            src = m.import_from.get(last)
+            if not src or src[0] != "threading":
+                return None
+        return last
+
+    def _collect_locks(self):
+        for m in self.modules:
+            mod_locks = self.module_locks.setdefault(m.relpath, {})
+            for node in ast.iter_child_nodes(m.tree):
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    kind = self._is_lock_ctor(m, node.value)
+                    if kind:
+                        name = node.targets[0].id
+                        lid = f"{m.relpath}::{name}"
+                        self.locks[lid] = LockInfo(lid, kind, m.relpath,
+                                                   node.lineno)
+                        mod_locks[name] = lid
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                attrs = self.class_locks.setdefault(
+                    (m.relpath, node.name), {})
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) \
+                            and len(sub.targets) == 1 \
+                            and isinstance(sub.targets[0], ast.Attribute) \
+                            and isinstance(sub.targets[0].value, ast.Name) \
+                            and sub.targets[0].value.id == "self":
+                        kind = self._is_lock_ctor(m, sub.value)
+                        if kind:
+                            attr = sub.targets[0].attr
+                            lid = f"{m.relpath}::{node.name}.{attr}"
+                            self.locks[lid] = LockInfo(
+                                lid, kind, m.relpath, sub.lineno)
+                            attrs[attr] = lid
+        # second phase: aliases — ``self.X = <name bound to a module
+        # lock, possibly imported>`` shares the SAME lock node
+        for m in self.modules:
+            for node in ast.walk(m.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                attrs = self.class_locks.setdefault(
+                    (m.relpath, node.name), {})
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) \
+                            and len(sub.targets) == 1 \
+                            and isinstance(sub.targets[0], ast.Attribute) \
+                            and isinstance(sub.targets[0].value, ast.Name) \
+                            and sub.targets[0].value.id == "self" \
+                            and isinstance(sub.value, ast.Name):
+                        lid = self._module_lock(m, sub.value.id)
+                        if lid:
+                            attrs.setdefault(sub.targets[0].attr, lid)
+        for lid in self.locks:
+            tail = lid.split("::", 1)[1]
+            attr = tail.split(".")[-1]
+            self.lock_attr_names.setdefault(attr, set()).add(lid)
+
+    def _module_lock(self, m, name):
+        """A local name (module-level lock, or one imported from a
+        sibling module) resolved to a lock id."""
+        lid = self.module_locks.get(m.relpath, {}).get(name)
+        if lid:
+            return lid
+        src = m.import_from.get(name)
+        if src:
+            target = self.by_dotted.get(src[0])
+            if target:
+                return self.module_locks.get(
+                    target.relpath, {}).get(src[1])
+        return None
+
+    def _class_lock(self, relpath, cls, attr):
+        seen = set()
+        while cls and (relpath, cls) not in seen:
+            seen.add((relpath, cls))
+            lid = self.class_locks.get((relpath, cls), {}).get(attr)
+            if lid:
+                return lid
+            bases = self.class_bases.get((relpath, cls), [])
+            cls = bases[0] if bases else None
+        return None
+
+    def resolve_lock(self, fi, expr):
+        """A ``with <expr>`` context resolved to a lock id, or None."""
+        m = fi.module
+        if isinstance(expr, ast.Name):
+            return self._module_lock(m, expr.id)
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            recv, attr = expr.value.id, expr.attr
+            if recv == "self" and fi.cls:
+                lid = self._class_lock(m.relpath, fi.cls, attr)
+                if lid:
+                    return lid
+            # receiver typed by a param annotation -> that class's attr
+            ann = self._param_annotation(fi, recv)
+            if ann:
+                for (rel, cls), attrs in self.class_locks.items():
+                    if cls == ann and attr in attrs:
+                        return attrs[attr]
+            cands = self.lock_attr_names.get(attr, ())
+            if len(cands) == 1:
+                return next(iter(cands))
+        return None
+
+    @staticmethod
+    def _param_annotation(fi, name):
+        a = fi.node.args
+        for p in a.posonlyargs + a.args + a.kwonlyargs:
+            if p.arg == name and p.annotation is not None:
+                ann = p.annotation
+                if isinstance(ann, ast.Constant) \
+                        and isinstance(ann.value, str):
+                    return ann.value.split(".")[-1]
+                d = _dotted(ann)
+                return d.split(".")[-1] if d else None
+        return None
+
+    # ------------------------------------------------------ jit graph
+
+    def _jit_wrapper_name(self, expr):
+        """The jit-entry wrapper a call/decorator expression names, or
+        None. Handles ``jax.jit``, bare ``jit``/``pjit``/``shard_map``,
+        ``pl.pallas_call`` and ``partial(jax.jit, ...)``."""
+        d = _dotted(expr)
+        if d:
+            last = d.split(".")[-1]
+            if last in _JIT_WRAPPERS:
+                return last
+        if isinstance(expr, ast.Call):
+            d = _dotted(expr.func)
+            if d and d.split(".")[-1] == "partial" and expr.args:
+                return self._jit_wrapper_name(expr.args[0])
+        return None
+
+    @staticmethod
+    def _static_names_of(call, fn):
+        """Params a wrap call marks static (best-effort literal read of
+        static_argnums/static_argnames)."""
+        names = set()
+        if not isinstance(call, ast.Call):
+            return names
+        a = fn.node.args
+        positional = [p.arg for p in a.posonlyargs + a.args]
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) \
+                            and isinstance(n.value, str):
+                        names.add(n.value)
+            elif kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) \
+                            and isinstance(n.value, int) \
+                            and not isinstance(n.value, bool):
+                        if 0 <= n.value < len(positional):
+                            names.add(positional[n.value])
+        return names
+
+    def _collect_jit(self):
+        for m in self.modules:
+            simple = self.module_funcs[m.relpath]
+            # decorator form
+            for fi in self.functions:
+                if fi.module is not m:
+                    continue
+                for dec in fi.node.decorator_list:
+                    w = self._jit_wrapper_name(dec)
+                    if w:
+                        fi.static_names |= self._static_names_of(dec, fi)
+                        self.jit_entries.append((fi, w, fi.node.lineno))
+            # value form: jax.jit(fn, ...) anywhere in the module;
+            # the binding target (name or self attribute) becomes a
+            # known-jitted callable for the recompile pass
+            class Scope(ast.NodeVisitor):
+                def __init__(self, idx):
+                    self.idx = idx
+
+                def visit_Call(self, node):
+                    w = self.idx._jit_wrapper_name(node.func)
+                    if w and node.args and isinstance(node.args[0],
+                                                      ast.Name):
+                        fi = simple.get(node.args[0].id)
+                        if fi is not None:
+                            fi.static_names |= \
+                                self.idx._static_names_of(node, fi)
+                            self.idx.jit_entries.append(
+                                (fi, w, node.lineno))
+                    self.generic_visit(node)
+
+            Scope(self).visit(m.tree)
+            # jitted-callable bindings: x = jax.jit(f); self._p = jit(f)
+            for node in ast.walk(m.tree):
+                if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    value = node.value
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    if value is None:
+                        continue
+                    w = (self._jit_wrapper_name(value.func)
+                         if isinstance(value, ast.Call) else None)
+                    if not w:
+                        continue
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            self.jit_bindings[
+                                (m.relpath, None, t.id)] = value
+                        elif isinstance(t, ast.Attribute) \
+                                and isinstance(t.value, ast.Name) \
+                                and t.value.id == "self":
+                            self.jit_bindings[
+                                (m.relpath, "self", t.attr)] = value
+
+    def resolve_call(self, fi, func_expr):
+        """Name-based callee resolution: same-module functions, self
+        methods (with same-module base classes), and ``from x import
+        f`` package imports. Returns a list of FuncInfo."""
+        m = fi.module
+        if isinstance(func_expr, ast.Name):
+            name = func_expr.id
+            target = self.module_funcs[m.relpath].get(name)
+            if target is not None:
+                return [target]
+            src = m.import_from.get(name)
+            if src:
+                tm = self.by_dotted.get(src[0])
+                if tm:
+                    t = self.module_funcs[tm.relpath].get(src[1])
+                    if t is not None:
+                        return [t]
+            return []
+        if isinstance(func_expr, ast.Attribute):
+            if isinstance(func_expr.value, ast.Name):
+                recv = func_expr.value.id
+                if recv == "self" and fi.cls:
+                    cls, seen = fi.cls, set()
+                    while cls and cls not in seen:
+                        seen.add(cls)
+                        t = self.methods.get(
+                            (m.relpath, cls, func_expr.attr))
+                        if t is not None:
+                            return [t]
+                        bases = self.class_bases.get(
+                            (m.relpath, cls), [])
+                        cls = bases[0] if bases else None
+                    return []
+                mod = m.imports.get(recv)
+                if mod is None and recv in m.import_from:
+                    src = m.import_from[recv]
+                    mod = (src[0] + "." + src[1]) if src[0] else src[1]
+                if mod:
+                    tm = self.by_dotted.get(mod)
+                    if tm:
+                        t = self.module_funcs[tm.relpath].get(
+                            func_expr.attr)
+                        if t is not None:
+                            return [t]
+        return []
+
+    def _build_traced_set(self):
+        queue = [fi for fi, _, _ in self.jit_entries]
+        seen = set()
+        while queue:
+            fi = queue.pop()
+            if fi.key in seen:
+                continue
+            seen.add(fi.key)
+            for node in _own_nodes(fi.node):
+                if isinstance(node, ast.Call):
+                    for t in self.resolve_call(fi, node.func):
+                        if t.key not in seen:
+                            queue.append(t)
+                    # function-valued arguments (lax.scan bodies,
+                    # cond branches) trace too
+                    for arg in list(node.args) + \
+                            [k.value for k in node.keywords]:
+                        if isinstance(arg, ast.Name):
+                            t = self.module_funcs[
+                                fi.module.relpath].get(arg.id)
+                            if t is not None and t.key not in seen:
+                                queue.append(t)
+        self.traced = seen
+
+
+# =============================================================== passes
+
+def _walk_skip_is_none(test, tainted):
+    """Tainted names used in a branch test, EXCEPT inside trace-time
+    structural checks: ``is``/``is not`` comparisons, ``isinstance()``,
+    and container-membership ``in`` (dict/pytree keys are Python
+    values; only a tainted LEFT operand concretizes)."""
+    if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+        return set()
+    if isinstance(test, ast.Compare) and all(
+            isinstance(op, (ast.In, ast.NotIn)) for op in test.ops):
+        return _walk_skip_is_none(test.left, tainted)
+    if isinstance(test, ast.Call) and isinstance(test.func, ast.Name) \
+            and test.func.id in ("isinstance", "hasattr", "len"):
+        return set()
+    if isinstance(test, ast.Name):
+        return {test.id} & tainted
+    out = set()
+    for child in ast.iter_child_nodes(test):
+        out |= _walk_skip_is_none(child, tainted)
+    return out
+
+
+class TracerPass:
+    """Rules inside the jit-traced region of the call graph."""
+
+    name = "tracer"
+    rules = ("tracer-concretize", "tracer-np-host", "tracer-host-branch",
+             "tracer-wall-clock", "tracer-py-rng")
+
+    def run(self, index, findings):
+        entry_keys = {fi.key for fi, _, _ in index.jit_entries}
+        for fi in index.functions:
+            if fi.key not in index.traced:
+                continue
+            tainted = self._taint(fi) if fi.key in entry_keys else set()
+            self._check(index, fi, tainted, findings)
+
+    @staticmethod
+    def _taint(fi):
+        tainted = set(fi.param_names()) - fi.static_names
+        # propagate through simple assignments (two fixpoint passes
+        # cover the straight-line chains that matter)
+        for _ in range(2):
+            for node in _own_nodes(fi.node):
+                if isinstance(node, ast.Assign):
+                    used = {n.id for n in ast.walk(node.value)
+                            if isinstance(n, ast.Name)}
+                    if used & tainted:
+                        for t in node.targets:
+                            for n in ast.walk(t):
+                                if isinstance(n, ast.Name):
+                                    tainted.add(n.id)
+        return tainted
+
+    def _check(self, index, fi, tainted, findings):
+        m = fi.module
+        np_names = m.alias_of("numpy")
+        has_random = "random" in m.imports \
+            and m.imports["random"] == "random"
+        has_time = "time" in m.imports and m.imports["time"] == "time"
+        where = f"jit-traced function {fi.qualname!r}"
+        for node in _own_nodes(fi.node):
+            if isinstance(node, ast.Call):
+                self._check_call(node, fi, tainted, np_names,
+                                 has_random, has_time, where, findings)
+            elif isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                hits = _walk_skip_is_none(node.test, tainted)
+                if hits:
+                    findings.append(Finding(
+                        "tracer-host-branch", m.relpath, node.lineno,
+                        node.col_offset,
+                        f"{where} branches on traced value(s) "
+                        f"{sorted(hits)} — the tracer is concretized "
+                        "to decide the branch",
+                        "use jnp.where/lax.cond, or mark the argument "
+                        "static (static_argnums) if it is config"))
+
+    def _check_call(self, node, fi, tainted, np_names, has_random,
+                    has_time, where, findings):
+        m = fi.module
+        func = node.func
+        args_names = {n.id for a in list(node.args)
+                      + [k.value for k in node.keywords]
+                      for n in ast.walk(a) if isinstance(n, ast.Name)}
+        if isinstance(func, ast.Attribute):
+            d = _dotted(func)
+            if func.attr == "item" and not node.args:
+                recv = {n.id for n in ast.walk(func.value)
+                        if isinstance(n, ast.Name)}
+                if not tainted or (recv & tainted):
+                    findings.append(Finding(
+                        "tracer-concretize", m.relpath, node.lineno,
+                        node.col_offset,
+                        f"{where} calls .item() — a device sync per "
+                        "step, and a tracer error under jit",
+                        "keep the value on-device (jnp scalar) or "
+                        "compute it outside the jitted segment"))
+                    return
+            if d and has_time and d.split(".")[0] == "time" \
+                    and func.attr in _CLOCK_ATTRS:
+                findings.append(Finding(
+                    "tracer-wall-clock", m.relpath, node.lineno,
+                    node.col_offset,
+                    f"{where} reads the {func.attr}() clock — traced "
+                    "once, burned into the compiled program as a "
+                    "constant",
+                    "time outside the jitted segment (the perfwatch "
+                    "layer owns step timing)"))
+                return
+            if d and has_random and d.split(".")[0] == "random":
+                findings.append(Finding(
+                    "tracer-py-rng", m.relpath, node.lineno,
+                    node.col_offset,
+                    f"{where} calls random.{func.attr}() — one sample "
+                    "taken at trace time, constant thereafter",
+                    "use jax.random with an explicitly threaded key"))
+                return
+            if d and d.split(".")[0] in np_names:
+                if len(d.split(".")) > 1 and d.split(".")[1] == "random":
+                    findings.append(Finding(
+                        "tracer-py-rng", m.relpath, node.lineno,
+                        node.col_offset,
+                        f"{where} calls {d}() — NumPy RNG runs on the "
+                        "host at trace time, constant thereafter",
+                        "use jax.random with an explicitly threaded "
+                        "key"))
+                    return
+                if args_names & tainted:
+                    findings.append(Finding(
+                        "tracer-np-host", m.relpath, node.lineno,
+                        node.col_offset,
+                        f"{where} applies {d}() to traced value(s) "
+                        f"{sorted(args_names & tainted)} — concretizes "
+                        "the tracer onto the host",
+                        "use the jnp equivalent so the op stays in "
+                        "the compiled program"))
+                    return
+        elif isinstance(func, ast.Name) \
+                and func.id in ("float", "int", "bool") \
+                and node.args:
+            used = {n.id for n in ast.walk(node.args[0])
+                    if isinstance(n, ast.Name)}
+            if used & tainted:
+                findings.append(Finding(
+                    "tracer-concretize", m.relpath, node.lineno,
+                    node.col_offset,
+                    f"{where} calls {func.id}() on traced value(s) "
+                    f"{sorted(used & tainted)} — host concretization",
+                    "keep it as a jnp scalar, or mark the argument "
+                    "static if it is config"))
+
+
+class RecompilePass:
+    name = "recompile"
+    rules = ("recompile-churn", "recompile-unhashable-static",
+             "pytree-dict-order")
+
+    def run(self, index, findings):
+        self._call_sites(index, findings)
+        self._dict_iteration(index, findings)
+
+    def _call_sites(self, index, findings):
+        for fi in index.functions:
+            m = fi.module
+            for node in _own_nodes(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                wrap = self._jitted_binding(index, fi, node.func)
+                if wrap is None:
+                    continue
+                static = self._static_positions(index, fi, wrap)
+                for pos, arg in enumerate(node.args):
+                    self._check_arg(m, node, arg, pos in static[0]
+                                    or None, findings)
+                for kw in node.keywords:
+                    self._check_arg(m, node, kw.value,
+                                    kw.arg in static[1] or None,
+                                    findings)
+
+    @staticmethod
+    def _jitted_binding(index, fi, func_expr):
+        m = fi.module
+        if isinstance(func_expr, ast.Name):
+            return index.jit_bindings.get(
+                (m.relpath, None, func_expr.id))
+        if isinstance(func_expr, ast.Attribute) \
+                and isinstance(func_expr.value, ast.Name) \
+                and func_expr.value.id == "self":
+            return index.jit_bindings.get(
+                (m.relpath, "self", func_expr.attr))
+        return None
+
+    @staticmethod
+    def _static_positions(index, fi, wrap_call):
+        nums, names = set(), set()
+        if isinstance(wrap_call, ast.Call):
+            for kw in wrap_call.keywords:
+                if kw.arg == "static_argnums":
+                    for n in ast.walk(kw.value):
+                        if isinstance(n, ast.Constant) \
+                                and isinstance(n.value, int) \
+                                and not isinstance(n.value, bool):
+                            nums.add(n.value)
+                elif kw.arg == "static_argnames":
+                    for n in ast.walk(kw.value):
+                        if isinstance(n, ast.Constant) \
+                                and isinstance(n.value, str):
+                            names.add(n.value)
+        return nums, names
+
+    @staticmethod
+    def _check_arg(m, call, arg, is_static, findings):
+        churn = None
+        if isinstance(arg, ast.JoinedStr):
+            churn = "an f-string"
+        elif isinstance(arg, ast.Call) and isinstance(arg.func, ast.Name) \
+                and arg.func.id in ("str", "repr", "len"):
+            churn = f"{arg.func.id}(...)"
+        if churn:
+            findings.append(Finding(
+                "recompile-churn", m.relpath, arg.lineno,
+                arg.col_offset,
+                f"jitted call receives {churn} — every distinct value "
+                "is a fresh compile cache entry (recompile churn)",
+                "hoist it to a bounded/static value, or bucket it "
+                "(e.g. pad lengths to power-of-two)"))
+            return
+        if is_static and isinstance(arg, (ast.Dict, ast.List, ast.Set)):
+            findings.append(Finding(
+                "recompile-unhashable-static", m.relpath, arg.lineno,
+                arg.col_offset,
+                "unhashable literal passed in a static_argnums/"
+                "static_argnames position — every call misses the jit "
+                "cache (or raises)",
+                "pass a hashable frozen form (tuple / frozenset / "
+                "NamedTuple) for static arguments"))
+
+    def _dict_iteration(self, index, findings):
+        for fi in index.functions:
+            if fi.key not in index.traced:
+                continue
+            m = fi.module
+            local_dicts = set()
+            for node in _own_nodes(fi.node):
+                if isinstance(node, ast.Assign) \
+                        and isinstance(node.value, (ast.Dict,
+                                                    ast.DictComp)):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            local_dicts.add(t.id)
+            if not local_dicts:
+                continue
+            for node in _own_nodes(fi.node):
+                # DictComps are exempt: rebuilding a dict from its own
+                # items is order-preserving, and dict pytrees flatten
+                # key-sorted anyway — the hazard is key order feeding a
+                # SEQUENCE (list/tuple/stack), which loops and
+                # list/set/generator comps build
+                target = None
+                if isinstance(node, ast.For):
+                    target = self._dict_iter_name(node.iter, local_dicts)
+                elif isinstance(node, (ast.ListComp, ast.SetComp,
+                                       ast.GeneratorExp)):
+                    for gen in node.generators:
+                        target = target or self._dict_iter_name(
+                            gen.iter, local_dicts)
+                if target:
+                    findings.append(Finding(
+                        "pytree-dict-order", m.relpath, node.lineno,
+                        node.col_offset,
+                        f"jit-traced function {fi.qualname!r} iterates "
+                        f"plain dict {target!r} — insertion order feeds "
+                        "the traced structure, so equal dicts built in "
+                        "different orders produce different programs",
+                        "iterate sorted(d) / sorted(d.items()), or use "
+                        "a canonical (sorted) construction"))
+
+    @staticmethod
+    def _dict_iter_name(it, local_dicts):
+        if isinstance(it, ast.Name) and it.id in local_dicts:
+            return it.id
+        if isinstance(it, ast.Call) and isinstance(it.func,
+                                                   ast.Attribute) \
+                and it.func.attr in ("items", "keys", "values") \
+                and isinstance(it.func.value, ast.Name) \
+                and it.func.value.id in local_dicts:
+            return it.func.value.id
+        return None
+
+
+class LockPass:
+    """The fleet lock graph: registry, ordering edges, cycles, blocking
+    calls under a lock, and mixed locked/unlocked mutation."""
+
+    name = "locks"
+    rules = ("lock-order-cycle", "lock-blocking-call",
+             "lock-mixed-mutation")
+
+    def run(self, index, findings):
+        acquired = {}     # key -> [(lock id, line)]
+        calls = {}        # key -> [(callee FuncInfo, held ids, line)]
+        blocking = {}     # key -> [(desc, held ids, line)]
+        mutations = {}    # (relpath, cls, attr) -> {"locked": [...],
+        #                    "unlocked": [(funcinfo, line)]}
+        edges = []        # (from, to, relpath, line)
+        for fi in index.functions:
+            self._scan(index, fi, acquired, calls, blocking,
+                       mutations, edges)
+        reach = self._transitive(index, acquired, calls)
+        # interprocedural ordering edges: holding L, a call whose
+        # transitive closure acquires M => L -> M
+        # self-edges included: re-acquiring a held non-reentrant lock
+        # through a helper call deadlocks exactly like lexical nesting
+        # (_cycles applies the RLock exemption either way)
+        for key, sites in calls.items():
+            for callee, held, line in sites:
+                for m_lock in reach.get(callee.key, ()):
+                    for h in held:
+                        edges.append((
+                            h, m_lock,
+                            index.func_index[key].module.relpath,
+                            line))
+        self.edges = edges
+        self.cycles = self._cycles(index, edges, findings)
+        self._report_blocking(index, blocking, calls, findings)
+        self._report_mutation(index, acquired, calls, mutations,
+                              findings)
+
+    # ------------------------------------------------------- scanning
+
+    def _scan(self, index, fi, acquired, calls, blocking, mutations,
+              edges):
+        key = fi.key
+        acq = acquired.setdefault(key, [])
+        fcalls = calls.setdefault(key, [])
+        fblock = blocking.setdefault(key, [])
+        m = fi.module
+
+        def visit(node, held):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return
+            if isinstance(node, ast.With):
+                new = []
+                for item in node.items:
+                    lid = index.resolve_lock(fi, item.context_expr)
+                    if lid:
+                        acq.append((lid, node.lineno))
+                        for h, _ in held:
+                            edges.append((h, lid, m.relpath,
+                                          node.lineno))
+                        new.append((lid, node.lineno))
+                inner = held + new
+                for child in node.body:
+                    visit(child, inner)
+                return
+            if isinstance(node, ast.Call):
+                desc = self._blocking_desc(index, fi, node)
+                if desc:
+                    # held may be empty: a bare blocking site is fine
+                    # HERE but matters when a lock-holding caller calls
+                    # this function (one level up, reported below)
+                    fblock.append((desc, [h for h, _ in held],
+                                   node.lineno))
+                for t in index.resolve_call(fi, node.func):
+                    fcalls.append((t, [h for h, _ in held],
+                                   node.lineno))
+            self._scan_mutation(fi, node, held, mutations)
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for child in fi.node.body:
+            visit(child, [])
+
+    def _blocking_desc(self, index, fi, node):
+        func = node.func
+        if isinstance(func, ast.Name):
+            if func.id in _BLOCKING_NAMES:
+                return f"{func.id}()"
+            return None
+        if not isinstance(func, ast.Attribute):
+            return None
+        d = _dotted(func)
+        root = d.split(".")[0] if d else ""
+        if fi.module.imports.get(root) == "subprocess" \
+                and func.attr in ("run", "check_call", "check_output",
+                                  "call"):
+            return f"subprocess.{func.attr}()"
+        if func.attr == "communicate":
+            return ".communicate()"
+        if func.attr == "sleep":
+            if fi.module.imports.get(root) == "time":
+                return "time.sleep()"
+            return None
+        if func.attr in _BLOCKING_ATTRS or func.attr in _BLOCKING_NAMES:
+            # ``"sep".join`` and ``os.path.join`` are string/path ops
+            if func.attr == "join":
+                if isinstance(func.value, ast.Constant):
+                    return None
+                if d and d.rsplit(".", 1)[0] in ("os.path", "posixpath",
+                                                 "ntpath"):
+                    return None
+            if func.attr in ("wait", "acquire"):
+                # Condition.wait releases the lock it is called on;
+                # ``lock.acquire`` on a resolvable lock is an
+                # acquisition, not a block (ordering covers it)
+                lid = index.resolve_lock(fi, func.value)
+                if lid:
+                    return None
+            return f".{func.attr}()"
+        return None
+
+    def _scan_mutation(self, fi, node, held, mutations):
+        if not fi.cls or fi.name == "__init__":
+            return
+        attr = None
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute) \
+                        and isinstance(t.value, ast.Name) \
+                        and t.value.id == "self":
+                    attr = t.attr
+                elif isinstance(t, ast.Subscript) \
+                        and isinstance(t.value, ast.Attribute) \
+                        and isinstance(t.value.value, ast.Name) \
+                        and t.value.value.id == "self":
+                    attr = t.value.attr
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATORS \
+                and isinstance(node.func.value, ast.Attribute) \
+                and isinstance(node.func.value.value, ast.Name) \
+                and node.func.value.value.id == "self":
+            attr = node.func.value.attr
+        if attr is None:
+            return
+        rec = mutations.setdefault(
+            (fi.module.relpath, fi.cls, attr),
+            {"locked": [], "unlocked": []})
+        rec["locked" if held else "unlocked"].append((fi, node.lineno))
+
+    # ----------------------------------------------------- transitive
+
+    @staticmethod
+    def _transitive(index, acquired, calls):
+        """Locks transitively acquired per function, by fixpoint — a
+        memoized DFS would cache truncated sets inside call cycles
+        (recursive a<->b chains) and silently drop the very edges that
+        close an ordering cycle."""
+        reach = {k: {lid for lid, _ in v} for k, v in acquired.items()}
+        changed = True
+        while changed:
+            changed = False
+            for key, sites in calls.items():
+                cur = reach.setdefault(key, set())
+                before = len(cur)
+                for callee, _, _ in sites:
+                    cur.update(reach.get(callee.key, ()))
+                if len(cur) != before:
+                    changed = True
+        return reach
+
+    def _cycles(self, index, edges, findings):
+        graph = {}
+        sites = {}
+        for a, b, rel, line in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+            sites.setdefault((a, b), (rel, line))
+        cycles = []
+        # self-edges on non-reentrant locks are immediate deadlocks
+        for (a, b), (rel, line) in sorted(sites.items()):
+            if a == b and index.locks[a].kind != "RLock":
+                cycles.append([a])
+                findings.append(Finding(
+                    "lock-order-cycle", rel, line, 0,
+                    f"non-reentrant lock {a} re-acquired while already "
+                    "held — self-deadlock",
+                    "make it an RLock, or hoist the inner acquisition "
+                    "out of the locked region"))
+        # general cycles: every SCC with >= 2 locks is an inconsistent
+        # ordering (length-2 inversions AND longer A->B->C->A chains —
+        # pairwise checks alone would miss the latter)
+        for scc in self._sccs(graph):
+            if len(scc) < 2:
+                continue
+            nodes = sorted(scc)
+            in_scc = [((a, b), s) for (a, b), s in sorted(sites.items())
+                      if a in scc and b in scc and a != b]
+            edge_desc = ", ".join(
+                f"{a} -> {b} ({rel}:{line})"
+                for (a, b), (rel, line) in in_scc)
+            rel, line = in_scc[0][1]
+            cycles.append(nodes)
+            findings.append(Finding(
+                "lock-order-cycle", rel, line, 0,
+                f"lock-order cycle over {len(nodes)} lock(s): "
+                f"{edge_desc} — threads taking these paths "
+                "concurrently deadlock",
+                "pick one global order for the set and restructure "
+                "the violating path(s) to honor it"))
+        return cycles
+
+    @staticmethod
+    def _sccs(graph):
+        """Tarjan's strongly-connected components, iterative (lock
+        graphs are small, but recursion depth must not depend on
+        them)."""
+        idx = {}
+        low = {}
+        on_stack = set()
+        stack = []
+        out = []
+        counter = [0]
+        for root in sorted(graph):
+            if root in idx:
+                continue
+            work = [(root, iter(sorted(graph.get(root, ()))))]
+            idx[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt not in idx:
+                        idx[nxt] = low[nxt] = counter[0]
+                        counter[0] += 1
+                        stack.append(nxt)
+                        on_stack.add(nxt)
+                        work.append(
+                            (nxt, iter(sorted(graph.get(nxt, ())))))
+                        advanced = True
+                        break
+                    if nxt in on_stack:
+                        low[node] = min(low[node], idx[nxt])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == idx[node]:
+                    scc = set()
+                    while True:
+                        v = stack.pop()
+                        on_stack.discard(v)
+                        scc.add(v)
+                        if v == node:
+                            break
+                    out.append(scc)
+        return out
+
+    # ------------------------------------------------------ reporting
+
+    def _report_blocking(self, index, blocking, calls, findings):
+        # direct sites: blocking while this function itself holds
+        for key, sites in blocking.items():
+            fi = index.func_index[key]
+            for desc, held, line in sites:
+                if not held:
+                    continue
+                findings.append(Finding(
+                    "lock-blocking-call", fi.module.relpath, line, 0,
+                    f"{desc} while holding {', '.join(sorted(set(held)))}"
+                    " — every other thread contending the lock stalls "
+                    "for the full blocking duration",
+                    "move the blocking call outside the locked region "
+                    "(snapshot state under the lock, then block)"))
+        # one call level deep: holding L, calling a function whose BARE
+        # blocking sites (no lock of their own — those were reported
+        # above, at the callee) now run under L. Deeper chains get
+        # noisy; the ordering edges already propagate transitively.
+        for key, sites in calls.items():
+            fi = index.func_index[key]
+            for callee, held, line in sites:
+                if not held:
+                    continue
+                for desc, chold, bline in blocking.get(callee.key, ()):
+                    if chold:
+                        continue   # reported at the callee itself
+                    findings.append(Finding(
+                        "lock-blocking-call", fi.module.relpath, line, 0,
+                        f"call to {callee.qualname}() while holding "
+                        f"{', '.join(sorted(set(held)))} blocks: it "
+                        f"calls {desc} at "
+                        f"{callee.module.relpath}:{bline}",
+                        "move the call outside the locked region, or "
+                        "split the callee's blocking part out"))
+
+    def _report_mutation(self, index, acquired, calls, mutations,
+                         findings):
+        # lock-context inference (fixpoint): a private method whose
+        # every in-class call site holds the class lock — directly, or
+        # by being inside another inferred-locked method — is itself a
+        # locked context ("caller holds the lock" helpers)
+        locked_methods = set()
+        changed = True
+        while changed:
+            changed = False
+            for (relpath, cls), attrs in index.class_locks.items():
+                if not attrs:
+                    continue
+                lock_ids = set(attrs.values())
+                for (rp, c, name), fi in index.methods.items():
+                    if rp != relpath or c != cls \
+                            or not name.startswith("_") \
+                            or name == "__init__" \
+                            or fi.key in locked_methods:
+                        continue
+                    in_sites = []
+                    for key, sites in calls.items():
+                        caller = index.func_index[key]
+                        if caller.module.relpath != relpath \
+                                or caller.cls != cls:
+                            continue
+                        in_sites.extend(
+                            (key, held) for callee, held, _ in sites
+                            if callee.key == fi.key)
+                    if in_sites and all(
+                            set(h) & lock_ids or k in locked_methods
+                            for k, h in in_sites):
+                        locked_methods.add(fi.key)
+                        changed = True
+        for (relpath, cls, attr), rec in sorted(mutations.items()):
+            lock_ids = set(
+                index.class_locks.get((relpath, cls), {}).values())
+            if not lock_ids:
+                continue
+            locked = rec["locked"] + [
+                (fi, line) for fi, line in rec["unlocked"]
+                if fi.key in locked_methods]
+            unlocked = [(fi, line) for fi, line in rec["unlocked"]
+                        if fi.key not in locked_methods]
+            if not locked or not unlocked:
+                continue
+            fi, line = unlocked[0]
+            lfi, lline = locked[0]
+            findings.append(Finding(
+                "lock-mixed-mutation", relpath, line, 0,
+                f"self.{attr} of {cls} is written here without the "
+                f"class lock, but under it at {lfi.module.relpath}:"
+                f"{lline} — readers under the lock can observe torn "
+                "state",
+                "take the lock here too, or document single-threaded "
+                "ownership with a pragma"))
+
+
+class HygienePass:
+    """The generalized regex guards: bare-except-pass + wall-clock,
+    with their historical directory scopes."""
+
+    name = "hygiene"
+    rules = ("bare-except-pass", "wall-clock", "wall-clock-alias")
+
+    def run(self, index, findings):
+        for m in index.modules:
+            sub = _scope_subdir(m.relpath)
+            bare = sub is None or sub in BARE_EXCEPT_DIRS
+            wall = sub is None or sub in MONOTONIC_DIRS
+            if bare:
+                self._bare_except(m, findings)
+            if wall:
+                self._wall_clock(m, findings)
+
+    @staticmethod
+    def _bare_except(m, findings):
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            t = node.type
+            broad = t is None or (isinstance(t, ast.Name) and t.id in
+                                  ("Exception", "BaseException"))
+            if broad and len(node.body) == 1 \
+                    and isinstance(node.body[0], ast.Pass):
+                findings.append(Finding(
+                    "bare-except-pass", m.relpath, node.lineno,
+                    node.col_offset,
+                    "bare 'except: pass' swallows failures the "
+                    "resilience runtime is supposed to count, retry, "
+                    "or surface",
+                    "count/log via core.resilience.bump_counter, or "
+                    "use contextlib.suppress in cleanup paths"))
+
+    @staticmethod
+    def _wall_clock(m, findings):
+        has_time = m.imports.get("time") == "time"
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "time" \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "time" and has_time:
+                findings.append(Finding(
+                    "wall-clock", m.relpath, node.lineno,
+                    node.col_offset,
+                    "time.time() where deadline/elapsed math lives — "
+                    "an NTP step expires every in-flight budget",
+                    "use time.monotonic(); cross-host store "
+                    "timestamps may opt out with '# wall-clock'"))
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time" and a.asname:
+                        findings.append(Finding(
+                            "wall-clock-alias", m.relpath, node.lineno,
+                            node.col_offset,
+                            f"'import time as {a.asname}' hides "
+                            "wall-clock calls from the time.time() "
+                            "guard",
+                            "import the module plainly so every "
+                            "wall-clock use is greppable"))
+            elif isinstance(node, ast.ImportFrom) \
+                    and node.module == "time" and not node.level:
+                if any(a.name == "time" for a in node.names):
+                    findings.append(Finding(
+                        "wall-clock-alias", m.relpath, node.lineno,
+                        node.col_offset,
+                        "'from time import time' hides wall-clock "
+                        "calls from the time.time() guard",
+                        "import the module plainly so every "
+                        "wall-clock use is greppable"))
+
+
+_PASSES = (TracerPass, RecompilePass, LockPass, HygienePass)
+
+
+# ============================================================ pipeline
+
+def _uniquify_relpaths(modules):
+    """Out-of-tree files display as their basename (``_relpath_of``);
+    when one run holds two same-named files, extend their display paths
+    with parent components until distinct — a shared key would merge
+    their pragma maps (one file's pragma suppressing the other's
+    finding, or being ignored)."""
+    groups = {}
+    for m in modules:
+        groups.setdefault(m.relpath, []).append(m)
+    for rel, grp in groups.items():
+        if len(grp) == 1 or rel.split("/")[0] == "paddle_tpu":
+            continue
+        n = len(rel.split("/")) + 1
+        while n < 64:
+            cands = {"/".join(m.path.replace(os.sep, "/").split("/")[-n:])
+                     for m in grp}
+            if len(cands) == len(grp):
+                break
+            n += 1
+        for m in grp:
+            m.relpath = "/".join(
+                m.path.replace(os.sep, "/").split("/")[-n:])
+
+
+def analyze_paths(paths, rules=None):
+    """Parse + index + run every pass. Returns (findings, index,
+    lock_pass) with pragma suppression already applied (baseline is the
+    caller's concern: see :func:`run` / :func:`main`)."""
+    files = iter_py_files(paths)
+    # the SyntaxError of an unparsable file propagates: a broken
+    # analysis run must be distinguishable from "findings present"
+    # (main()/obs exit 2 on it, library callers catch it normally)
+    modules = [parse_module(f) for f in files]
+    _uniquify_relpaths(modules)
+    index = RepoIndex(modules)
+    raw = []
+    lock_pass = None
+    for cls in _PASSES:
+        if rules is not None and not set(cls.rules) & set(rules):
+            continue
+        p = cls()
+        p.run(index, raw)
+        if isinstance(p, LockPass):
+            lock_pass = p
+    by_rel = {m.relpath: m for m in modules}
+    findings, pragma_suppressed = [], 0
+    for f in raw:
+        if rules is not None and f.rule not in rules:
+            continue
+        m = by_rel.get(f.path)
+        if m is not None and m.suppressed(f.rule, f.line):
+            pragma_suppressed += 1
+            continue
+        findings.append(f)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings, index, lock_pass, pragma_suppressed
+
+
+def run(paths, rules=None):
+    """The migrated guard tests' entry point: findings only."""
+    return analyze_paths(paths, rules=rules)[0]
+
+
+def load_baseline(path):
+    """Baseline entries, validated: every entry names a rule, a path,
+    and a non-empty reason (grandfathered findings must say WHY they
+    are grandfathered)."""
+    with open(path) as f:
+        data = json.load(f)
+    entries = data if isinstance(data, list) else data.get("entries", [])
+    for e in entries:
+        if not e.get("rule") or not e.get("path"):
+            raise ValueError(
+                f"baseline entry {e!r} must name a rule and a path")
+        if not str(e.get("reason", "")).strip():
+            raise ValueError(
+                f"baseline entry for {e.get('path')}:{e.get('line', '*')}"
+                f" [{e.get('rule')}] has no reason — every "
+                "grandfathered finding must explain itself")
+    return entries
+
+
+def apply_baseline(findings, entries):
+    kept, suppressed = [], 0
+    for f in findings:
+        hit = False
+        for e in entries:
+            if e["rule"] == f.rule and e["path"] == f.path \
+                    and ("line" not in e or e["line"] == f.line):
+                hit = True
+                break
+        if hit:
+            suppressed += 1
+        else:
+            kept.append(f)
+    return kept, suppressed
+
+
+def build_report(findings, index, lock_pass, pragma_suppressed=0,
+                 baseline_suppressed=0):
+    locks = {
+        lid: {"kind": li.kind, "path": li.relpath, "line": li.line}
+        for lid, li in sorted(index.locks.items())}
+    edges = []
+    seen = set()
+    for a, b, rel, line in (lock_pass.edges if lock_pass else ()):
+        k = (a, b, rel, line)
+        if k in seen:
+            continue
+        seen.add(k)
+        edges.append({"from": a, "to": b, "path": rel, "line": line})
+    return {
+        "version": 1,
+        "files": len(index.modules),
+        "findings": [f.to_dict() for f in findings],
+        "suppressed": {"pragma": pragma_suppressed,
+                       "baseline": baseline_suppressed},
+        "jit_entries": [
+            {"path": fi.module.relpath, "name": fi.qualname,
+             "wrapper": w, "line": line}
+            for fi, w, line in sorted(
+                index.jit_entries,
+                key=lambda e: (e[0].module.relpath, e[2]))],
+        "lock_graph": {
+            "locks": locks,
+            "edges": edges,
+            "cycles": lock_pass.cycles if lock_pass else [],
+        },
+    }
+
+
+# ----------------------------------------------- engine-backed sweeps
+# (registry collectors the CI guard tests run on the shared parse —
+# the metric-name and fault-site sweeps that used to be regexes)
+
+_METRIC_CALLS = ("bump_counter", "counter", "gauge", "histogram")
+_FAULT_CALLS = ("inject", "consume_fault", "_retrying")
+
+
+def _literal_prefix(arg):
+    """A literal str arg as itself; an f-string as its leading literal
+    text (the metric FAMILY, per the orphan-sweep contract)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        out = ""
+        for part in arg.values:
+            if isinstance(part, ast.Constant) \
+                    and isinstance(part.value, str):
+                out += part.value
+            else:
+                break
+        return out or None
+    return None
+
+
+def _collect_first_args(paths, names):
+    out = set()
+    for f in iter_py_files(paths):
+        m = parse_module(f)
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            func = node.func
+            called = None
+            if isinstance(func, ast.Name):
+                called = func.id
+            elif isinstance(func, ast.Attribute):
+                called = func.attr
+            if called not in names:
+                continue
+            lit = _literal_prefix(node.args[0])
+            if lit:
+                out.add(lit)
+    return out
+
+
+def collect_metric_names(paths):
+    """Every literal metric-family name emitted under ``paths`` via
+    ``bump_counter(...)`` / ``telemetry.counter/gauge/histogram(...)``
+    (f-strings contribute their literal prefix)."""
+    return _collect_first_args(paths, _METRIC_CALLS)
+
+
+def collect_fault_sites(paths):
+    """Every literal ``FLAGS_fault_injection`` site name registered
+    under ``paths`` (``inject(...)`` / ``consume_fault(...)`` / store
+    ``_retrying(...)`` call sites)."""
+    return _collect_first_args(paths, _FAULT_CALLS)
+
+
+# ================================================================= CLI
+
+def _default_paths():
+    here = os.getcwd()
+    pkg = os.path.join(here, "paddle_tpu")
+    return [pkg] if os.path.isdir(pkg) else [here]
+
+
+def make_report(paths, baseline=None, rules=None):
+    """The one analyze→baseline→report sequence BOTH CLIs run
+    (``analyze.main`` and ``obs lint``). Returns (report, exit_code);
+    raises ValueError for an unusable baseline and FileNotFoundError
+    when the paths contain no Python files — a typo'd path must not
+    read as a clean tree."""
+    for p in paths:
+        p = os.fspath(p)
+        if not os.path.exists(p):
+            raise FileNotFoundError(
+                f"no such path: {p} — a typo'd gate path must fail "
+                "loudly, not read as a clean tree")
+        if os.path.isfile(p) and not p.endswith(".py"):
+            raise FileNotFoundError(f"not a Python file: {p}")
+    if not iter_py_files(paths):
+        raise FileNotFoundError(
+            f"no Python files under {[os.fspath(p) for p in paths]} — "
+            "nothing analyzed is not the same as nothing found")
+    findings, index, lock_pass, n_pragma = analyze_paths(paths,
+                                                         rules=rules)
+    baseline_path = baseline or _default_baseline(paths)
+    n_base = 0
+    if baseline_path:
+        entries = load_baseline(baseline_path)   # ValueError on bad
+        findings, n_base = apply_baseline(findings, entries)
+    report = build_report(findings, index, lock_pass,
+                          pragma_suppressed=n_pragma,
+                          baseline_suppressed=n_base)
+    return report, (1 if findings else 0)
+
+
+def _default_baseline(paths):
+    for p in paths:
+        d = os.path.abspath(os.fspath(p))
+        for _ in range(8):
+            cand = os.path.join(d, "TPU_LINT_BASELINE.json")
+            if os.path.isfile(cand) and os.path.isdir(
+                    os.path.join(d, "paddle_tpu")):
+                return cand
+            nxt = os.path.dirname(d)
+            if nxt == d:
+                break
+            d = nxt
+    return None
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.tools.analyze",
+        description="tpu-lint: tracer safety, recompile hygiene, lock "
+                    "discipline, exception hygiene")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to analyze (default: ./paddle_tpu)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the machine-readable report (findings + "
+                         "jit entries + lock graph)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline suppression file (default: "
+                         "TPU_LINT_BASELINE.json at the repo root)")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule filter")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+    if args.list_rules:
+        for rule, doc in sorted(RULES.items()):
+            print(f"{rule:<28} {doc}")
+        return 0
+    paths = args.paths or _default_paths()
+    rules = None
+    if args.rules:
+        rules = {r.strip() for r in args.rules.split(",") if r.strip()}
+        unknown = rules - set(RULES)
+        if unknown:
+            sys.stderr.write(
+                f"tpu-lint: unknown rule(s) {sorted(unknown)}; see "
+                "--list-rules\n")
+            return 2
+    try:
+        report, rc = make_report(paths, baseline=args.baseline,
+                                 rules=rules)
+    except FileNotFoundError as e:
+        sys.stderr.write(f"tpu-lint: {e}\n")
+        return 2
+    except SyntaxError as e:
+        sys.stderr.write(f"tpu-lint: cannot parse: {e}\n")
+        return 2
+    except (OSError, ValueError) as e:
+        sys.stderr.write(f"tpu-lint: bad baseline: {e}\n")
+        return 2
+    if args.as_json:
+        json.dump(report, sys.stdout, indent=1)
+        sys.stdout.write("\n")
+    else:
+        for f in report["findings"]:
+            print(f"{f['path']}:{f['line']}:{f['col']}: "
+                  f"{f['severity']}[{f['rule']}] {f['why']}")
+            if f["hint"]:
+                print(f"    hint: {f['hint']}")
+        sup = report["suppressed"]
+        tail = (f"{report['files']} file(s), "
+                f"{len(report['jit_entries'])} jit entr(ies), "
+                f"{len(report['lock_graph']['locks'])} lock(s); "
+                f"{len(report['findings'])} finding(s)")
+        if sup["pragma"] or sup["baseline"]:
+            tail += (f" ({sup['pragma']} pragma-suppressed, "
+                     f"{sup['baseline']} baseline-suppressed)")
+        print(tail)
+    return rc
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe — not an analysis error
+        os._exit(0)

@@ -28,6 +28,12 @@ leave on disk (and the live process registry, for REPL use):
 * ``bench-diff A B`` — metric-by-metric comparison of two ``BENCH_*``
   records (round files or the baseline), flagging the big movers. The
   full series harness is ``tools/bench_trend.py``.
+* ``lint [REPORT.json | paths...]`` — render a tpu-lint ``--json``
+  report (or run the analyzer in-process over paths) as the table
+  incident runbooks and CI logs share: findings by rule/site, the
+  jit-entry inventory, and the fleet lock graph with its ordering
+  edges and any cycles. The analyzer itself is
+  ``python -m paddle_tpu.tools.analyze``.
 """
 from __future__ import annotations
 
@@ -368,6 +374,71 @@ def cmd_fleet(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """Render tpu-lint output as a table: from a ``--json`` report file
+    when the one argument is a .json path, else by running the analyzer
+    in-process over the given paths (default: the installed package)."""
+    from . import analyze
+
+    if len(args.paths) == 1 and args.paths[0].endswith(".json"):
+        try:
+            report = json.load(open(args.paths[0]))
+        except (OSError, ValueError) as e:
+            sys.stderr.write(f"cannot read {args.paths[0]}: {e}\n")
+            return 2
+        if "findings" not in report or "lock_graph" not in report:
+            sys.stderr.write(
+                f"{args.paths[0]} is not a tpu-lint --json report\n")
+            return 2
+    else:
+        paths = args.paths or analyze._default_paths()
+        try:
+            report, _ = analyze.make_report(paths)
+        except (OSError, ValueError, SyntaxError) as e:
+            sys.stderr.write(f"tpu-lint: {e}\n")
+            return 2
+
+    findings = report.get("findings", [])
+    if findings:
+        print(f"findings ({len(findings)}):")
+        print(f"  {'severity':<9} {'rule':<28} {'site':<40} why")
+        for f in findings:
+            site = f"{f['path']}:{f['line']}"
+            print(f"  {f.get('severity', 'error'):<9} {f['rule']:<28} "
+                  f"{site:<40} {f['why']}")
+            if f.get("hint"):
+                print(f"  {'':<9} {'':<28} {'':<40} hint: {f['hint']}")
+    else:
+        print("findings: none")
+    sup = report.get("suppressed", {})
+    if sup.get("pragma") or sup.get("baseline"):
+        print(f"suppressed: {sup.get('pragma', 0)} by pragma, "
+              f"{sup.get('baseline', 0)} by baseline")
+    entries = report.get("jit_entries", [])
+    print(f"\njit entries ({len(entries)}):")
+    for e in entries:
+        print(f"  {e['wrapper']:<12} {e['path']}:{e['line']:<5} "
+              f"{e['name']}")
+    lg = report.get("lock_graph", {})
+    locks = lg.get("locks", {})
+    print(f"\nlock graph ({len(locks)} lock(s), "
+          f"{len(lg.get('edges', []))} ordering edge(s)):")
+    for lid in sorted(locks):
+        li = locks[lid]
+        print(f"  {li['kind']:<10} {lid}")
+    for e in lg.get("edges", []):
+        print(f"  order: {e['from']} -> {e['to']} "
+              f"({e['path']}:{e['line']})")
+    cycles = lg.get("cycles", [])
+    if cycles:
+        print(f"  CYCLES ({len(cycles)} — deadlock risk):")
+        for c in cycles:
+            print(f"    {' -> '.join(c + [c[0]])}")
+    else:
+        print("  cycles: none")
+    return 1 if findings else 0
+
+
 def cmd_bench_diff(args) -> int:
     try:
         rows = _bt.diff_rounds(args.a, args.b)
@@ -427,6 +498,13 @@ def main(argv=None) -> int:
     flp.add_argument("-n", type=int, default=20,
                      help="show at most N membership events")
     flp.set_defaults(fn=cmd_fleet)
+    lp = sub.add_parser("lint",
+                        help="render a tpu-lint --json report (or run "
+                             "the analyzer) as a table")
+    lp.add_argument("paths", nargs="*", default=None,
+                    help="a tpu-lint --json report file, or files/dirs "
+                         "to analyze (default: ./paddle_tpu)")
+    lp.set_defaults(fn=cmd_lint)
     bp = sub.add_parser("bench-diff",
                         help="diff two BENCH_*.json records")
     bp.add_argument("a")
@@ -439,4 +517,8 @@ def main(argv=None) -> int:
 
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe — not a command failure
+        os._exit(0)

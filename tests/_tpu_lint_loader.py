@@ -1,0 +1,26 @@
+"""The ONE standalone loader for the tpu-lint engine
+(paddle_tpu/tools/analyze.py), shared by every guard test that runs on
+it (test_tpu_lint / test_no_bare_except / test_telemetry_guard).
+
+Loaded from its FILE, not the package: the engine is pure AST, so the
+guards run without importing paddle_tpu (and therefore without jax).
+One module instance per session (sys.modules singleton) means one parse
+cache — every guard shares ONE parse per package file.
+"""
+import importlib.util
+import pathlib
+import sys
+
+_ENGINE_PATH = (pathlib.Path(__file__).resolve().parents[1]
+                / "paddle_tpu" / "tools" / "analyze.py")
+
+
+def lint_engine():
+    mod = sys.modules.get("_tpu_lint_engine")
+    if mod is None:
+        spec = importlib.util.spec_from_file_location(
+            "_tpu_lint_engine", str(_ENGINE_PATH))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules["_tpu_lint_engine"] = mod
+        spec.loader.exec_module(mod)
+    return mod

@@ -1,0 +1,7 @@
+"""tpu-lint fixture: aliased wall-clock imports (wall-clock-alias)."""
+import time as _t                     # -> rule: wall-clock-alias
+from time import time                 # -> rule: wall-clock-alias
+
+
+def now():
+    return _t.time() + time()

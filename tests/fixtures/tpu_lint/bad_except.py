@@ -1,0 +1,29 @@
+"""tpu-lint fixture: exception/status hygiene violations (the
+generalized historical regex guards)."""
+import time
+
+
+def swallow():
+    try:
+        risky()
+    except Exception:
+        pass                          # bare-except-pass
+
+
+def swallow_bare():
+    try:
+        risky()
+    except:                           # noqa: E722
+        pass                          # bare-except-pass
+
+
+def deadline():
+    return time.time() + 5.0          # -> rule: wall-clock
+
+
+def sanctioned():
+    return time.time()  # wall-clock: cross-host store timestamp
+
+
+def risky():
+    raise RuntimeError("boom")

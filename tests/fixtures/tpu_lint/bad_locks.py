@@ -1,0 +1,70 @@
+"""tpu-lint fixture: lock-discipline violations — a deliberate
+lock-order inversion (deadlock), blocking calls under a lock, and an
+attribute mutated both under and outside its class lock."""
+import subprocess
+import threading
+import time
+
+
+class Inverted:
+    """m1 takes a then b; m2 takes b then a — the classic cycle."""
+
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+
+    def m1(self):
+        with self.lock_a:
+            with self.lock_b:         # lock-order-cycle (a -> b)
+                return 1
+
+    def m2(self):
+        with self.lock_b:
+            with self.lock_a:         # lock-order-cycle (b -> a)
+                return 2
+
+
+class BlocksWhileLocked:
+    def __init__(self, worker):
+        self._lock = threading.Lock()
+        self._worker = worker
+
+    def stall(self):
+        with self._lock:
+            time.sleep(0.5)           # lock-blocking-call
+            self._worker.join(1.0)    # lock-blocking-call
+            subprocess.run(["true"])  # lock-blocking-call
+
+
+class MixedMutation:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self.count = 0
+
+    def locked_add(self, v):
+        with self._lock:
+            self._items.append(v)
+            self.count += 1
+
+    def racy_add(self, v):
+        self._items.append(v)         # lock-mixed-mutation
+        self.count += 1               # lock-mixed-mutation
+
+    def _helper_under_lock(self):
+        # called only under the lock -> inferred locked context, OK
+        self._items.clear()
+
+    def locked_reset(self):
+        with self._lock:
+            self._helper_under_lock()
+
+
+class SelfDeadlock:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def outer(self):
+        with self._lock:
+            with self._lock:          # lock-order-cycle (self-edge)
+                return 0

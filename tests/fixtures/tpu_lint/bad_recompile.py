@@ -1,0 +1,41 @@
+"""tpu-lint fixture: recompile-hygiene violations — churning static
+args at jitted call sites, unhashable static literals, and dict-order
+pytree hazards inside traced code."""
+import jax
+
+
+def compute(x, tag):
+    return x
+
+
+def gather(x):
+    d = {"w": x, "v": x * 2}
+    out = []
+    for k in d:                       # pytree-dict-order (For loop)
+        out.append(d[k])
+    return out
+
+
+def traced(x):
+    table = {"b": x, "a": x + 1}
+    vals = [table[k] for k in table]  # pytree-dict-order (comprehension)
+    return gather(x), vals
+
+
+compute_j = jax.jit(compute, static_argnums=(1,),
+                    static_argnames=("tag",))
+traced_j = jax.jit(traced)
+
+
+def caller(batch, step):
+    compute_j(batch, f"step-{step}")          # recompile-churn
+    compute_j(batch, len(batch))              # recompile-churn
+    compute_j(batch, ["not", "hashable"])     # recompile-unhashable-static
+    compute_j(batch, tag={"cfg": 1})          # recompile-unhashable-static
+    return compute_j(batch, "stable-tag")     # ok: one literal, one entry
+
+
+def ok_caller(batch):
+    srt = {"b": 1, "a": 2}
+    keys = [k for k in sorted(srt)]           # ok: sorted iteration
+    return compute_j(batch, "fixed"), keys

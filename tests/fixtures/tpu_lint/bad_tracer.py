@@ -1,0 +1,42 @@
+"""tpu-lint fixture: every tracer-safety rule violated inside a fake
+jit entry (and a helper reachable from it through the call graph).
+NOT importable production code — the analyzer only parses it."""
+import random
+import time
+
+import jax
+import numpy as np
+
+
+def entry(x, y, mode):
+    t = time.time()                   # tracer-wall-clock
+    r = random.random()               # tracer-py-rng
+    n = np.random.uniform()           # tracer-py-rng (numpy)
+    v = x.item()                      # tracer-concretize
+    f = float(y)                      # tracer-concretize
+    host = np.asarray(x)              # tracer-np-host
+    if x > 0:                         # tracer-host-branch
+        return helper(y)
+    while y < t:                      # tracer-host-branch
+        y = y + r + f + n + host
+    return y + mode
+
+
+def helper(y):
+    time.monotonic()                  # tracer-wall-clock (reachable)
+    return y
+
+
+entry_j = jax.jit(entry, static_argnames=("mode",))
+
+
+def ok_entry(x, mask):
+    # trace-time structural checks are NOT findings
+    if mask is None:
+        return x
+    if isinstance(x, tuple):
+        return x[0]
+    return x + mask
+
+
+ok_j = jax.jit(ok_entry)

@@ -1,0 +1,59 @@
+"""tpu-lint fixture: the clean mirror of the bad snippets — a real jit
+entry, consistently-ordered locks, sorted dict iteration, counted
+failures. The analyzer must report NOTHING here."""
+import contextlib
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+def entry(x, y):
+    z = jnp.where(x > 0, x, y)
+    return z * jnp.float32(2.0)
+
+
+entry_j = jax.jit(entry)
+
+
+def traced_sorted(x):
+    table = {"b": x, "a": x + 1}
+    return [table[k] for k in sorted(table)]
+
+
+traced_j = jax.jit(traced_sorted)
+
+
+class Ordered:
+    """Both paths honor one global order: a before b."""
+
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+        self._items = []
+
+    def m1(self):
+        with self.lock_a:
+            with self.lock_b:
+                return 1
+
+    def m2(self):
+        with self.lock_a:
+            with self.lock_b:
+                self._items.append(2)
+
+    def sleepy(self):
+        with self.lock_a:
+            snapshot = list(self._items)
+        time.sleep(0.01)              # blocking OUTSIDE the lock: fine
+        return snapshot
+
+
+def cleanup(handle):
+    with contextlib.suppress(OSError):
+        handle.close()
+
+
+def elapsed(t0):
+    return time.monotonic() - t0

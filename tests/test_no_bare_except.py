@@ -31,60 +31,53 @@
   the router's retirement switch, and the stub must expose the full
   frontend surface the router dispatches on.
 """
+import functools
 import pathlib
 import re
 
 import pytest
 
+from _tpu_lint_loader import lint_engine as _lint
+
 _PKG = pathlib.Path(__file__).resolve().parents[1] / "paddle_tpu"
 
-_BARE = re.compile(
-    r"except(\s+(BaseException|Exception))?\s*(as\s+\w+\s*)?:"
-    r"\s*(#[^\n]*)?\n\s*pass\b")
 
-_WALL_CLOCK = re.compile(r"\btime\.time\(\)")
+@functools.lru_cache(maxsize=None)
+def _findings(rule):
+    return tuple(_lint().run([_PKG], rules={rule}))
 
-# aliased forms evade the time.time() grep — `import time as _t` then
-# `_t.time()` (the historical spawn.py offender), or `from time import
-# time` then a bare `time()`. Banning the import forms themselves keeps
-# every wall-clock call greppable as literal `time.time()`.
-_WALL_CLOCK_ALIAS = re.compile(
-    r"^[ \t]*(?:import[ \t]+time[ \t]+as[ \t]+\w+"
-    r"|from[ \t]+time[ \t]+import[ \t]+(?:\(?[\w \t,]*\btime\b))",
-    re.M)
+# NOTE: the subdir scopes live in the engine (analyze.BARE_EXCEPT_DIRS
+# / analyze.MONOTONIC_DIRS — "distributed" covers its whole subtree;
+# "tools" joined at the TP-serving PR): the rules below run ON the
+# shared tpu-lint engine (one AST parse per file), these tests just
+# attribute failures per subtree. The sanctioned wall-clock opt-out is
+# the inline `# wall-clock` pragma, honored by the engine.
 
-# NOTE: "distributed" covers its whole subtree (rglob), so
-# paddle_tpu/distributed/fleet/ rides the same sweep; "tools" joined at
-# the TP-serving PR (the obs/bench_trend/trafficgen CLIs run in CI and
-# operator hands — they get the same failure-swallowing and wall-clock
-# discipline as the runtime trees)
+
+def _offenders(subdir, rule):
+    prefix = f"paddle_tpu/{subdir}/"
+    return [f"{f.path}:{f.line}" for f in _findings(rule)
+            if f.path.startswith(prefix)]
+
+
+def test_lint_scopes_match_engine():
+    """The per-subdir parametrization below must cover exactly the
+    trees the engine scopes its hygiene rules to — a subdir added in
+    one place but not the other silently un-guards it."""
+    eng = _lint()
+    assert set(_NO_BARE_EXCEPT_DIRS) == set(eng.BARE_EXCEPT_DIRS)
+    assert set(_MONOTONIC_ONLY_DIRS) == set(eng.MONOTONIC_DIRS)
+
+
 _NO_BARE_EXCEPT_DIRS = ("distributed", "io", "amp", "hapi", "models",
                         "tools")
 _MONOTONIC_ONLY_DIRS = ("core", "io", "amp", "hapi", "models",
                         "distributed", "tools")
 
-# the one sanctioned wall-clock use: timestamps that cross hosts via the
-# store must be wall-clock (no shared monotonic epoch) and say so inline
-_PRAGMA = "# wall-clock"
-
-
-def _offenders(subdir, pattern, pragma=None):
-    root = _PKG / subdir
-    out = []
-    for py in sorted(root.rglob("*.py")):
-        text = py.read_text()
-        lines = text.splitlines()
-        for m in pattern.finditer(text):
-            line = text.count("\n", 0, m.start()) + 1
-            if pragma is not None and pragma in lines[line - 1]:
-                continue
-            out.append(f"{py.relative_to(_PKG.parent)}:{line}")
-    return out
-
 
 @pytest.mark.parametrize("subdir", _NO_BARE_EXCEPT_DIRS)
 def test_no_bare_except_pass(subdir):
-    offenders = _offenders(subdir, _BARE)
+    offenders = _offenders(subdir, "bare-except-pass")
     assert not offenders, (
         f"bare 'except: pass' under paddle_tpu/{subdir}/ swallows "
         "failures silently — count/log via core.resilience (or use "
@@ -93,17 +86,17 @@ def test_no_bare_except_pass(subdir):
 
 @pytest.mark.parametrize("subdir", _MONOTONIC_ONLY_DIRS)
 def test_no_wall_clock_for_deadline_math(subdir):
-    offenders = _offenders(subdir, _WALL_CLOCK, pragma=_PRAGMA)
+    offenders = _offenders(subdir, "wall-clock")
     assert not offenders, (
         f"time.time() under paddle_tpu/{subdir}/ — deadline/elapsed math "
         "must use time.monotonic() so an NTP step can't expire every "
         "in-flight budget (cross-host store timestamps may opt out with "
-        f"a '{_PRAGMA}' pragma): {offenders}")
+        "a '# wall-clock' pragma): {0}".format(offenders))
 
 
 @pytest.mark.parametrize("subdir", _MONOTONIC_ONLY_DIRS)
 def test_no_aliased_wall_clock_imports(subdir):
-    offenders = _offenders(subdir, _WALL_CLOCK_ALIAS, pragma=_PRAGMA)
+    offenders = _offenders(subdir, "wall-clock-alias")
     assert not offenders, (
         f"aliased time import under paddle_tpu/{subdir}/ (`import time "
         "as ...` / `from time import time`) hides wall-clock calls from "
@@ -113,23 +106,17 @@ def test_no_aliased_wall_clock_imports(subdir):
 
 _TESTS_DIR = pathlib.Path(__file__).resolve().parent
 
-# fault-site call forms whose FIRST literal argument is a site name; the
-# store's `_retrying(site, ...)` wrapper is its per-op inject() point
-_FAULT_SITE_CALLS = re.compile(
-    r"(?:\binject|\bconsume_fault|self\._retrying)\(\s*\"([^\"]+)\"")
-
 
 def test_every_fault_site_is_exercised_by_a_test():
     """Registry sweep: every ``FLAGS_fault_injection`` site registered
     anywhere in ``paddle_tpu/`` (literal ``inject("...")`` /
-    ``consume_fault("...")`` / store ``_retrying("...")`` call sites)
-    must appear in at least one test file — a new fault site cannot
-    ship untested, because an unexercised recovery path is the one that
+    ``consume_fault("...")`` / store ``_retrying("...")`` call sites —
+    collected by the tpu-lint engine on the shared AST parse) must
+    appear in at least one test file — a new fault site cannot ship
+    untested, because an unexercised recovery path is the one that
     fails in the real outage."""
-    sites = set()
-    for py in sorted(_PKG.rglob("*.py")):
-        sites.update(_FAULT_SITE_CALLS.findall(py.read_text()))
-    assert sites, "fault-site sweep found nothing: the regex is broken"
+    sites = _lint().collect_fault_sites([_PKG])
+    assert sites, "fault-site sweep found nothing: the collector is broken"
     haystack = "\n".join(p.read_text()
                          for p in sorted(_TESTS_DIR.glob("*.py")))
     unexercised = sorted(

@@ -12,23 +12,25 @@ in test_no_bare_except.py.)
 F-string names (``bump_counter(f"circuit_opened:{name}")``) are
 normalized to their literal prefix before the interpolation; dynamic
 label values don't need documenting, the metric family does.
+
+The emission-site sweep runs on the shared tpu-lint AST engine
+(``paddle_tpu/tools/analyze.py collect_metric_names`` — one parse per
+file, shared with every other guard in the suite) instead of a private
+regex; the naming-family filter and prefix normalization stay here.
 """
 import pathlib
 import re
+
+from _tpu_lint_loader import lint_engine as _lint
 
 _PKG = pathlib.Path(__file__).resolve().parents[1] / "paddle_tpu"
 _TESTS = pathlib.Path(__file__).resolve().parent
 _README = _PKG.parent / "README.md"
 
-# literal-name emission sites: the resilience ledger and the telemetry
-# registry constructors (module-level handles and inline calls alike)
-_EMITS = re.compile(
-    r"(?:\bbump_counter|(?:telemetry\.|\b)(?:counter|gauge|histogram))"
-    r"\(\s*f?\"([^\"]+)\"")
 
 # names matching none of our naming families are other call sites the
-# regex happens to hit (e.g. collections.Counter) — the families are
-# dotted or colon-namespaced
+# collector happens to hit (e.g. dict ``.update("...")``) — the
+# families are dotted or colon-namespaced
 _NAME = re.compile(r"^[a-z0-9_.]+[.:][a-z0-9_.{:]+", re.I)
 
 
@@ -38,12 +40,9 @@ def _normalize(name: str) -> str:
 
 
 def _swept_names():
-    names = set()
-    for py in sorted(_PKG.rglob("*.py")):
-        for m in _EMITS.findall(py.read_text()):
-            if _NAME.match(m):
-                names.add(_normalize(m))
-    return names
+    return {_normalize(m)
+            for m in _lint().collect_metric_names([_PKG])
+            if _NAME.match(m)}
 
 
 def test_sweep_sees_the_perfwatch_families():
