@@ -1290,6 +1290,135 @@ except Exception as e:
         f"{type(e).__name__}: {e}")
     tp_metrics = {"tp_error": f"{type(e).__name__}: {e}"[:200]}
 
+# --------------------------- (e9) dynamic paged KV + prefix caching
+# The static slot->page map is gone: the engine grants pages from a
+# free-list pool at admission and as decode grows, and shares prompt
+# prefixes copy-on-write. Gated numbers: at FIXED pool bytes a
+# mixed-length workload must hold >= 2x more concurrent requests than
+# the static one-full-sequence-per-slot layout (kv_admit_gain), the
+# granted-tail fragmentation stays bounded (kv_fragmentation_pct),
+# shared-prefix prefill is measurably faster than the cold path
+# (prefix_prefill_speedup >= 1 with prefix_hit_rate > 0), and the
+# whole allocator path stays at ZERO post-warmup compiles
+# (kv_serving_compiles).
+kv_metrics = {}
+try:
+    from paddle_tpu.jit import count_backend_compiles
+    from paddle_tpu.models.serving import (
+        ContinuousBatchingEngine as _KvCBE,
+    )
+
+    if SMOKE:
+        KV_LEN, KV_PAGE, KV_REQ, KV_NEW = 256, 64, 16, 8
+    else:
+        KV_LEN, KV_PAGE, KV_REQ, KV_NEW = 512, 128, 48, 16
+    per_seq_pages = KV_LEN // KV_PAGE
+    pool_pages = 4 * per_seq_pages  # the STATIC layout fits 4 slots
+    rng_kv = np.random.RandomState(31)
+    # mixed-length, mostly-short traffic: the shape the static map
+    # wastes a full slot tail on
+    kv_prompts = [rng_kv.randint(0, cfg.vocab_size,
+                                 (int(rng_kv.choice([6, 10, 18, 40])),))
+                  .astype(np.int32) for _ in range(KV_REQ)]
+
+    def _kv_run(max_slots, pool=None):
+        eng = _KvCBE(model, max_slots=max_slots, max_len=KV_LEN,
+                     page_size=KV_PAGE, prompt_buckets=(16, 64),
+                     seed=0, pool_pages=pool)
+        eng.start(segment=4)
+        for i, p in enumerate(kv_prompts):
+            eng.submit(p, KV_NEW, rid=i)
+        peak, frag, static_frag = 0, 0.0, 0.0
+        while eng.has_work():
+            eng.step()
+            active = len(eng.active_requests())
+            if active >= peak:
+                peak = active
+                st = eng.kv_stats()
+                frag = st["fragmentation_pct"]
+                # what the static one-full-sequence-per-slot layout
+                # would waste on this same snapshot: every active slot
+                # pins per_seq pages regardless of its length
+                cap = st["bytes_in_use"] / st["bytes_per_token"]
+                used = cap * (1.0 - frag / 100.0)
+                static_cap = active * per_seq_pages * KV_PAGE
+                static_frag = (100.0 * (1.0 - used / static_cap)
+                               if static_cap else 0.0)
+        return peak, frag, static_frag, eng
+
+    log(f"dynamic paged KV: {KV_REQ} mixed-length requests over a "
+        f"{pool_pages}-page pool ({KV_PAGE}-token pages)...")
+    # static arm: the historical layout — every slot permanently owns a
+    # full sequence of pages, so the same pool bytes cap concurrency at
+    # pool/per_seq slots
+    static_peak, _, _, _ = _kv_run(pool_pages // per_seq_pages)
+    dyn_peak, dyn_frag, static_frag, dyn_eng = _kv_run(
+        4 * pool_pages // per_seq_pages, pool=pool_pages)
+    kv_metrics = {
+        "kv_pool_pages": pool_pages,
+        "kv_static_peak_admitted": static_peak,
+        "kv_dynamic_peak_admitted": dyn_peak,
+        "kv_admit_gain": round(dyn_peak / static_peak, 2)
+            if static_peak else None,
+        "kv_fragmentation_pct": round(dyn_frag, 2),
+        "kv_static_fragmentation_pct": round(static_frag, 2),
+        "kv_frag_vs_static": round(dyn_frag / static_frag, 3)
+            if static_frag else None,
+    }
+    log(f"dynamic paged KV: peak concurrency {dyn_peak} vs {static_peak} "
+        f"static at the same pool bytes "
+        f"(gain {kv_metrics['kv_admit_gain']}x, gate >= 2x), granted "
+        f"fragmentation {dyn_frag:.1f}% vs {static_frag:.1f}% static "
+        f"(ratio {kv_metrics['kv_frag_vs_static']}, gate < 1)")
+
+    # ---- prefix-hit sweep: all requests share a long system prompt;
+    # the cached arm prefills only each request's divergent tail
+    sys_p = rng_kv.randint(0, cfg.vocab_size,
+                           (3 * KV_PAGE,)).astype(np.int32)
+    px_prompts = [np.concatenate(
+        [sys_p, rng_kv.randint(0, cfg.vocab_size, (12,)).astype(np.int32)])
+        for _ in range(KV_REQ // 2)]
+
+    def _px_run(cache_on):
+        eng = _KvCBE(model, max_slots=4, max_len=2 * KV_LEN,
+                     page_size=KV_PAGE, prompt_buckets=(16, 64),
+                     seed=0, prefix_cache=cache_on)
+        eng.warmup(segment=4)
+        eng.start(segment=4)
+        # seed request: its prompt pages populate (or would populate)
+        # the cache before timing starts
+        eng.submit(px_prompts[0], 2, rid=1000)
+        while eng.has_work():
+            eng.step()
+        t0 = time.time()
+        with count_backend_compiles() as compiles:
+            for i, p in enumerate(px_prompts):
+                eng.submit(p, 2, rid=i)
+            while eng.has_work():
+                eng.step()
+        return time.time() - t0, len(compiles), eng
+
+    cold_s, _, _ = _px_run(False)
+    warm_s, px_compiles, px_eng = _px_run(True)
+    px_stats = px_eng.kv_stats()
+    kv_metrics.update({
+        "prefix_prefill_speedup": round(cold_s / warm_s, 3)
+            if warm_s > 0 else None,
+        "prefix_hit_rate": round(px_stats["prefix_hit_rate"], 4),
+        "prefix_tokens_saved": int(px_stats["prefix_tokens_saved"]),
+        "kv_serving_compiles": int(px_compiles),
+    })
+    log(f"prefix caching: shared-prefix prefill {cold_s:.3f}s cold vs "
+        f"{warm_s:.3f}s cached (speedup "
+        f"{kv_metrics['prefix_prefill_speedup']}x, gate >= 1), hit rate "
+        f"{kv_metrics['prefix_hit_rate']}, "
+        f"{kv_metrics['prefix_tokens_saved']} prompt tokens saved, "
+        f"{px_compiles} post-warmup compile(s) through the allocator "
+        "path (gate: 0)")
+except Exception as e:
+    log(f"dynamic paged KV section FAILED: {type(e).__name__}: {e}")
+    kv_metrics = {"kv_error": f"{type(e).__name__}: {e}"[:200]}
+
 # ------------------------------------------------------- (f) op microbench
 # Per-op regression gate (reference: tools/ci_op_benchmark.sh relative
 # check): ~20 hot ops + eager dispatch overhead, compared against the
@@ -1384,6 +1513,7 @@ result = {
     **pw_metrics,
     **ov_metrics,
     **tp_metrics,
+    **kv_metrics,
     "op_bench_us": op_results,
     "op_bench_vs_baseline": op_vs_baseline,
     "op_bench_regressions": op_regressions,

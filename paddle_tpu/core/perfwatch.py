@@ -70,6 +70,7 @@ logger = logging.getLogger("paddle_tpu.perfwatch")
 
 __all__ = [
     "observe_phase", "phase_summaries", "PHASES",
+    "kv_pool_summary",
     "MemoryWatchdog", "memory_watchdog",
     "SLOMonitor", "Objective", "default_objectives",
     "BrownoutController", "BROWNOUT_STAGES",
@@ -169,6 +170,39 @@ def phase_summaries(snapshot=None) -> dict:
         if phase is not None:
             out[phase] = telemetry.summary_from_snapshot(snapshot, name)
     return out
+
+
+def kv_pool_summary(snapshot=None) -> dict:
+    """KV page-pool pressure from the ``serving.kv_*`` / ``prefix_*``
+    gauges and counters the engine exports — live registry or any
+    (possibly fleet-merged) snapshot dict. The backend of ``obs kv``:
+    pool occupancy, fragmentation, prefix-cache effectiveness, and
+    per-slot granted-page counts (``serving.kv_slot_pages{slot=}``)."""
+    if snapshot is None:
+        snapshot = telemetry.registry().snapshot()
+    gauges = snapshot.get("gauges") or {}
+    counters = snapshot.get("counters") or {}
+    slot_pages = {}
+    prefix = "serving.kv_slot_pages{"
+    for name, v in gauges.items():
+        if name.startswith(prefix):
+            labels = dict(p.split("=", 1)
+                          for p in name[len(prefix):-1].split(","))
+            if "slot" in labels:
+                slot_pages[int(labels["slot"])] = int(v)
+    return {
+        "pages_total": gauges.get("serving.kv_pages_total"),
+        "pages_free": gauges.get("serving.kv_pages_free"),
+        "bytes_in_use": gauges.get("serving.kv_bytes_in_use"),
+        "slot_occupancy": gauges.get("serving.kv_slot_occupancy"),
+        "fragmentation_pct": gauges.get("serving.kv_fragmentation_pct"),
+        "prefix_hit_rate": gauges.get("serving.prefix_hit_rate"),
+        "prefix_tokens_saved": counters.get(
+            "serving.prefix_tokens_saved", 0),
+        "pool_exhausted": counters.get("serving.kv_pool_exhausted", 0),
+        "preempted": counters.get("serving.kv_preempted", 0),
+        "slot_pages": slot_pages,
+    }
 
 
 # -------------------------------------------------------- memory watchdog

@@ -492,6 +492,12 @@ class ServingFrontend:
             self.brownout.maybe_step()
         self._sweep_expired()
         room = self.engine.free_slots() - len(self.engine.queued_requests())
+        if getattr(self.engine, "admission_blocked", False):
+            # the engine's KV page pool deferred its queue head last
+            # step: hold admissions HERE, in the priority/WFQ queue,
+            # instead of spilling them into the engine's FIFO where
+            # priority ordering no longer applies
+            room = 0
         while room > 0 and self._queue:
             entry = self._queue.pop(0)
             # WFQ: dispatching advances the class's virtual clock so
@@ -674,7 +680,11 @@ class ServingFrontend:
           queued_tokens]}``), and ``inflight`` (admitted to the engine,
           not yet terminal);
         * KV-slot occupancy: ``active_slots`` / ``free_slots`` /
-          ``kv_slots`` (total) / ``kv_occupancy`` (active/total);
+          ``kv_slots`` (total) / ``kv_occupancy`` (active/total); page
+          POOL pressure: ``kv_pages_free`` / ``kv_pages_total`` /
+          ``kv_fragmentation_pct`` / ``prefix_hit_rate`` (the dynamic
+          allocator's admission headroom — a router can prefer replicas
+          with page headroom, not just free slots);
         * ``latency``: recent-window percentile summaries (p50/p95/p99 +
           count/mean, seconds) for TTFT, per-token decode latency, and
           admission-queue wait — sourced from the telemetry registry
@@ -704,6 +714,8 @@ class ServingFrontend:
             trow[1] += e.cost
         active = len(self.engine.active_requests())
         total = int(self.engine.max_slots)
+        kv = (self.engine.kv_stats()
+              if hasattr(self.engine, "kv_stats") else {})
         return {
             "state": state,
             "ready": self.ready(),
@@ -723,6 +735,13 @@ class ServingFrontend:
             "free_slots": self.engine.free_slots(),
             "kv_slots": total,
             "kv_occupancy": (active / total) if total else 0.0,
+            "kv_pages_free": int(kv.get("pages_free", 0)),
+            "kv_pages_total": int(kv.get("pages_total", 0)),
+            "kv_fragmentation_pct": float(
+                kv.get("fragmentation_pct", 0.0)),
+            "prefix_hit_rate": float(kv.get("prefix_hit_rate", 0.0)),
+            "kv_admission_blocked": bool(
+                getattr(self.engine, "admission_blocked", False)),
             "latency": latency_summaries(),
             # perfwatch SLO verdict: objectives, rolling goodput,
             # multi-window burn rate, the alarm the shedding flag acts on

@@ -70,13 +70,17 @@ def _make_static_cache(k, v, length):
 
 
 def _make_paged_cache(kp, vp, tables, page_size, length,
-                      aligned_bases=False):
+                      aligned_bases=False, attn_pages=None):
     from .llama import PagedKVCache
 
     c = PagedKVCache.__new__(PagedKVCache)
     c.k_pages, c.v_pages, c.tables = kp, vp, tables
     c.page_size, c.length = page_size, length
     c.aligned_bases = aligned_bases
+    # serving tables carry trailing write-scratch columns past max_len;
+    # attn_pages caps how many table columns attention READS (the
+    # ragged paged-attention kernel's pages-per-sequence bound)
+    c.attn_pages = attn_pages
     return c
 
 

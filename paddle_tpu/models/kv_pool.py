@@ -1,0 +1,263 @@
+"""Dynamic paged-KV allocation: free-list page pool + CoW prefix cache.
+
+Host-side bookkeeping for the serving engine's paged KV cache
+(``models/serving.py``). The device arrays are a flat pool of pages; who
+owns which page is pure host state:
+
+* :class:`PagePool` — a refcounted free-list allocator over the page
+  ids. Pages are GRANTED to a slot at admission (prompt coverage) and
+  appended lazily as decode crosses page boundaries; retirement returns
+  them. A page shared by several slots (prefix sharing) carries one
+  reference per mapping and returns to the free list only when the last
+  reference drops. ``decref`` deliberately does NOT recycle: the engine
+  owns recycling because a page freed while a dispatched-but-unconsumed
+  decode segment may still write it must be quarantined until that
+  program provably executed (see ``ContinuousBatchingEngine._recycle``).
+* :class:`PrefixCache` — a page-granular content cache over prompt
+  prefixes (the vLLM/SGLang prefix-sharing discipline, grounded in
+  PAPERS.md "Ragged Paged Attention"): each FULL prompt page is keyed by
+  the chained hash of every token up to and including it, so a lookup
+  walks the chain page by page and a hit maps the already-computed KV
+  page read-only instead of re-prefilling it. Entries VERIFY token
+  content on match (a hash collision must never map foreign KV). The
+  cache holds its own pool reference per entry, so shared pages survive
+  their original owner's retirement; eviction (LRU, leaf-first so the
+  chain stays walkable) releases that reference under pool pressure.
+
+Copy-on-write lives in the ENGINE: a matched prefix that ends mid-page
+maps the covering page's content into a fresh private page (one device
+page-copy) because the new request must append into it — the cache only
+answers "which cached page covers these tokens".
+"""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+__all__ = ["PagePool", "PrefixCache", "PartialHit"]
+
+
+class PagePool:
+    """Refcounted free-list allocator over ``n_pages`` physical page ids.
+
+    ``alloc(n)`` pops n pages (refcount 1 each) or returns ``None`` when
+    the free list is short — the caller decides between deferral,
+    eviction, and preemption. ``decref`` returns the page ids whose last
+    reference dropped WITHOUT putting them back on the free list; the
+    caller recycles them when it is safe (``recycle``).
+    """
+
+    __slots__ = ("n_pages", "_free", "_refs")
+
+    def __init__(self, n_pages):
+        self.n_pages = int(n_pages)
+        self._free = list(range(self.n_pages - 1, -1, -1))  # LIFO: pop()
+        self._refs = np.zeros((self.n_pages,), np.int32)
+
+    def available(self) -> int:
+        return len(self._free)
+
+    def allocated(self) -> int:
+        return self.n_pages - len(self._free)
+
+    def alloc(self, n) -> list | None:
+        if n > len(self._free):
+            return None
+        out = [self._free.pop() for _ in range(n)]
+        for p in out:
+            self._refs[p] = 1
+        return out
+
+    def incref(self, page):
+        self._refs[page] += 1
+
+    def decref(self, pages) -> list:
+        """Drop one reference per page id; returns the ids that hit
+        zero (NOT recycled — see class docstring)."""
+        dead = []
+        for p in pages:
+            self._refs[p] -= 1
+            if self._refs[p] <= 0:
+                self._refs[p] = 0
+                dead.append(p)
+        return dead
+
+    def recycle(self, pages):
+        """Return zero-ref pages to the free list (engine-gated: only
+        after every program that may still write them has executed)."""
+        self._free.extend(pages)
+
+    def refcount(self, page) -> int:
+        return int(self._refs[page])
+
+
+class PartialHit:
+    """A cached page whose first ``r`` tokens match the tail of a lookup
+    prompt (the match DIVERGES mid-page): the engine may map its content
+    via a copy-on-write page copy and skip recomputing those tokens."""
+
+    __slots__ = ("page", "r")
+
+    def __init__(self, page, r):
+        self.page = int(page)
+        self.r = int(r)
+
+
+class _Entry:
+    __slots__ = ("page", "tokens", "key", "parent", "children",
+                 "last_used")
+
+    def __init__(self, page, tokens, key, parent):
+        self.page = int(page)
+        self.tokens = tokens            # np.int32 copy, page_size long
+        self.key = key
+        self.parent = parent            # parent chain key (b"" at root)
+        self.children: set = set()
+        self.last_used = 0
+
+
+class PrefixCache:
+    """Chained page-granular prompt-prefix cache (see module docstring).
+
+    The cache never touches device memory: entries record page IDS whose
+    KV content was fully written by a completed prefill. All pool
+    references taken here are released through ``recycle_cb`` (the
+    engine's quarantine-aware recycler).
+    """
+
+    def __init__(self, pool: PagePool, page_size, recycle_cb):
+        self.pool = pool
+        self.page_size = int(page_size)
+        self._recycle_cb = recycle_cb
+        self._entries: dict = {}          # chain key -> _Entry
+        self._roots: set = set()          # chain keys with parent b""
+        self._clock = 0
+
+    def __len__(self):
+        return len(self._entries)
+
+    @staticmethod
+    def _chain(parent_key, tokens) -> bytes:
+        h = hashlib.blake2b(digest_size=16)
+        h.update(parent_key)
+        h.update(np.ascontiguousarray(tokens, np.int32).tobytes())
+        return h.digest()
+
+    def _tick(self) -> int:
+        self._clock += 1
+        return self._clock
+
+    def _children_of(self, parent_key):
+        if parent_key == b"":
+            return self._roots
+        e = self._entries.get(parent_key)
+        return e.children if e is not None else ()
+
+    # -------------------------------------------------------------- lookup
+
+    def match(self, prompt):
+        """Longest cached prefix of ``prompt``: returns ``(pages,
+        matched_tokens, partial)`` where ``pages`` maps the matched FULL
+        pages in order, ``matched_tokens == len(pages) * page_size``, and
+        ``partial`` is a :class:`PartialHit` for the next page when a
+        cached child's head matches part of the remaining tail (None
+        otherwise). Token content is verified on every hop — a hash
+        collision can never alias foreign KV."""
+        page = self.page_size
+        prompt = np.ascontiguousarray(prompt, np.int32)
+        pages, parent = [], b""
+        n_full = prompt.size // page
+        for i in range(n_full):
+            tok = prompt[i * page:(i + 1) * page]
+            key = self._chain(parent, tok)
+            e = self._entries.get(key)
+            if e is None or not np.array_equal(e.tokens, tok):
+                break
+            e.last_used = self._tick()
+            pages.append(e.page)
+            parent = key
+        matched = len(pages) * page
+        # mid-page divergence: the best cached child sharing the longest
+        # head with the remaining tail is CoW material for the engine
+        partial, rem = None, prompt[matched:]
+        if rem.size:
+            best_r = 0
+            for ck in self._children_of(parent):
+                e = self._entries.get(ck)
+                if e is None:
+                    continue
+                n = min(rem.size, e.tokens.size)
+                neq = np.nonzero(e.tokens[:n] != rem[:n])[0]
+                r = int(neq[0]) if neq.size else n
+                if r > best_r:
+                    best_r, partial = r, PartialHit(e.page, r)
+                    e.last_used = self._tick()
+        return pages, matched, partial
+
+    # -------------------------------------------------------------- insert
+
+    def insert(self, prompt, slot_pages):
+        """Register the FULL pages of a completed prefill: page ``i`` of
+        ``slot_pages`` holds the KV of tokens ``[i*page, (i+1)*page)``.
+        Existing keys keep their original page (first writer wins); new
+        entries take one pool reference each."""
+        page = self.page_size
+        prompt = np.ascontiguousarray(prompt, np.int32)
+        parent = b""
+        for i in range(prompt.size // page):
+            tok = prompt[i * page:(i + 1) * page]
+            key = self._chain(parent, tok)
+            e = self._entries.get(key)
+            if e is None:
+                e = _Entry(slot_pages[i], tok.copy(), key, parent)
+                e.last_used = self._tick()
+                self.pool.incref(e.page)
+                self._entries[key] = e
+                if parent == b"":
+                    self._roots.add(key)
+                else:
+                    pe = self._entries.get(parent)
+                    if pe is not None:
+                        pe.children.add(key)
+            else:
+                e.last_used = self._tick()
+            parent = key
+
+    # ------------------------------------------------------------ eviction
+
+    def evict(self, need_pages, exclude=()) -> int:
+        """Release cache references until ``need_pages`` pages have
+        actually RETURNED to the pool (entries whose page a slot still
+        maps free no memory) or no evictable entry remains. LRU over
+        LEAF entries only, so surviving chains stay walkable. ``exclude``
+        protects pages an in-progress admission plan is about to map.
+        Returns the number of pages recycled."""
+        freed = 0
+        exclude = set(exclude)
+        while freed < need_pages:
+            leaf, lru = None, None
+            for e in self._entries.values():
+                # only entries whose page the cache ALONE holds: evicting
+                # a slot-mapped page frees nothing now, and popping such
+                # entries under an unsatisfiable request would wipe the
+                # whole cache without reclaiming a single page
+                if (e.children or e.page in exclude
+                        or self.pool.refcount(e.page) > 1):
+                    continue
+                if lru is None or e.last_used < lru:
+                    leaf, lru = e, e.last_used
+            if leaf is None:
+                break
+            self._entries.pop(leaf.key, None)
+            if leaf.parent == b"":
+                self._roots.discard(leaf.key)
+            else:
+                pe = self._entries.get(leaf.parent)
+                if pe is not None:
+                    pe.children.discard(leaf.key)
+            dead = self.pool.decref([leaf.page])
+            if dead:
+                self._recycle_cb(dead)
+                freed += len(dead)
+        return freed

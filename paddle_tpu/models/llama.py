@@ -145,7 +145,7 @@ class PagedKVCache:
     over this layout runs the Pallas ``paged_attention`` kernel."""
 
     __slots__ = ("k_pages", "v_pages", "tables", "page_size", "length",
-                 "aligned_bases")
+                 "aligned_bases", "attn_pages")
 
     def __init__(self, batch, max_len, kv_heads, head_dim, page_size=128,
                  dtype=jnp.float32):
@@ -167,6 +167,10 @@ class PagedKVCache:
         # prefill); without it, per-seq multi-token updates take the
         # always-correct per-row loop
         self.aligned_bases = False
+        # attention-visible table columns (None = all): the serving
+        # engine's dynamic tables append write-scratch columns past
+        # max_len that reads must never pay grid steps for
+        self.attn_pages = None
 
     def update(self, k_new, v_new):
         """Write (B, S, KVH, D) new keys/values at positions
@@ -264,11 +268,19 @@ def cached_attention(q, k, v, cache, offset, s):
     lengths = (clen.astype(jnp.int32) if per_seq
                else jnp.full((q.shape[0],), clen, jnp.int32))
     if paged:
+        # attention reads at most ``attn_pages`` table columns (the
+        # serving engine's dynamic tables carry trailing write-scratch
+        # columns past max_len — reads must not pay grid steps or
+        # gather width for them)
+        ap = getattr(cache, "attn_pages", None)
         if s == 1 and use_kernel:
             out = paged_attention(
                 q._value[:, 0], cache.k_pages, cache.v_pages,
-                cache.tables, lengths)
+                cache.tables, lengths, pages_per_seq=ap)
             return Tensor._from_value(out[:, None])
+        read_tables = cache.tables
+        if ap is not None and ap < read_tables.shape[1]:
+            read_tables = read_tables[:, :ap]
         # offset may be a traced scalar (chunked prefill / compiled decode
         # loop) — only take the fast prefill path when it is a STATIC zero
         if s > 1 and isinstance(offset, int) and offset == 0:
@@ -277,9 +289,9 @@ def cached_attention(q, k, v, cache, offset, s):
             return scaled_dot_product_attention(q, k, v, is_causal=True)
         # jnp fallback (kernel off/unsupported): gather the pages back
         # into the contiguous layout and run the masked composition
-        k_all = cache.k_pages[cache.tables].reshape(
+        k_all = cache.k_pages[read_tables].reshape(
             q.shape[0], -1, *cache.k_pages.shape[2:])
-        v_all = cache.v_pages[cache.tables].reshape(
+        v_all = cache.v_pages[read_tables].reshape(
             q.shape[0], -1, *cache.v_pages.shape[2:])
     else:
         k_all, v_all = cache.k, cache.v

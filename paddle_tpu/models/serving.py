@@ -34,9 +34,27 @@ selects the serial one-segment-at-a-time loop.
 decode-segment programs, and can wire JAX's persistent compilation cache,
 so first-request latency and ``stats()`` throughput stop absorbing
 compile time.
+
+KV memory is a DYNAMIC PAGE POOL (``models/kv_pool.py``), not a frozen
+slot->page map: a slot is granted pages for its prompt at admission and
+grows lazily as decode crosses page boundaries; retirement frees them.
+Admission is bounded by *available pages* — many short requests can be
+in flight where one long one fit before — with
+``serving.kv_pool_exhausted`` backpressure (the queue head defers, a
+running decode never fails: if growth outruns the pool the youngest slot
+is PREEMPTED back to the queue and later resumes bit-identically via its
+per-request key stream). Prompt prefixes are shared COPY-ON-WRITE: full
+prompt pages are content-hashed into a :class:`kv_pool.PrefixCache`, a
+new request maps already-computed pages read-only and prefills only from
+the first divergent token (a mid-page divergence pays one device page
+copy), and refcounts keep shared pages alive across the owners'
+retirements. Page-table CONTENTS change at grant time; traced shapes
+never do — the zero-post-warmup-compile invariant holds through the
+allocator path.
 """
 from __future__ import annotations
 
+import logging
 import time
 import zlib
 from collections import deque
@@ -51,8 +69,11 @@ from ..core.resilience import Deadline, InjectedFault, bump_counter, inject
 from ..core.tensor import Tensor
 from ..profiler import annotate
 from .generation import _make_paged_cache, _sample_rows
+from .kv_pool import PagePool, PrefixCache
 
 __all__ = ["ContinuousBatchingEngine", "Request", "TERMINAL_STATES"]
+
+logger = logging.getLogger("paddle_tpu.serving")
 
 # Every terminal status the engine can stamp on a Request (the frontend
 # adds admission-level "rejected"/"unavailable" on top). The router's
@@ -86,13 +107,32 @@ _M_REQS = telemetry.counter(
 # pool, not PJRT allocator bytes (the pool is allocated up front; the
 # watchdog gauges device.* cover the allocator).
 _M_KV_BYTES = telemetry.gauge(
-    "serving.kv_bytes_in_use", "KV bytes logically occupied by active "
-    "slots (whole pages, the paged-cache allocation granularity)")
+    "serving.kv_bytes_in_use", "KV bytes physically occupied by active "
+    "slots (whole pages; a prefix-shared page counts ONCE, so the gauge "
+    "never exceeds the pool)")
 _M_KV_OCC = telemetry.gauge(
     "serving.kv_slot_occupancy", "active slots / total slots")
 _M_KV_FRAG = telemetry.gauge(
-    "serving.kv_fragmentation_pct", "interior waste of occupied pages: "
-    "100 * (1 - used tokens / page-granular capacity) over active slots")
+    "serving.kv_fragmentation_pct", "allocated-but-unused tail of the "
+    "pages GRANTED to active slots: 100 * (1 - used tokens / granted "
+    "page capacity) — the waste the dynamic allocator bounds to less "
+    "than one page per slot (the static slot map wasted the whole "
+    "unreached slot tail)")
+_M_KV_PAGES_FREE = telemetry.gauge(
+    "serving.kv_pages_free", "KV pool pages on the free list (grantable "
+    "to admissions and decode growth right now)")
+_M_KV_PAGES_TOTAL = telemetry.gauge(
+    "serving.kv_pages_total", "total allocatable KV pool pages (scratch "
+    "pages excluded)")
+_M_KV_SLOT_PAGES = telemetry.gauge(
+    "serving.kv_slot_pages", "pages currently granted to one slot, by "
+    "{slot=} — the per-slot view `obs kv` renders")
+_M_PREFIX_HIT = telemetry.gauge(
+    "serving.prefix_hit_rate", "prompt tokens served from the prefix "
+    "cache / prompt tokens admitted, over the session")
+_M_PREFIX_SAVED = telemetry.counter(
+    "serving.prefix_tokens_saved", "prompt tokens whose prefill was "
+    "skipped because a cached prefix page already held their KV")
 _M_KV_REQ = telemetry.histogram(
     "serving.kv_request_bytes", "per-request KV footprint at retirement "
     "(prompt + emitted tokens, page-rounded)",
@@ -139,7 +179,8 @@ class Request:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "deadline", "tokens",
                  "status", "poisoned", "poison_checked", "error",
-                 "token_base", "trace", "t_submit", "t_first", "tenant")
+                 "token_base", "trace", "t_submit", "t_first", "tenant",
+                 "preempted")
 
     def __init__(self, rid, prompt, max_new_tokens, deadline=None,
                  token_base=0, trace=None, tenant=None):
@@ -157,6 +198,10 @@ class Request:
         self.tenant = tenant
         self.t_submit = time.monotonic()
         self.t_first = None
+        # set when the engine pulled this request off its slot to free
+        # pages (pool exhaustion): re-admission then requires coverage
+        # to the request's FULL budget so it cannot thrash in and out
+        self.preempted = False
 
     def output(self):
         return np.asarray(self.tokens[:self.max_new_tokens], np.int32)
@@ -180,6 +225,11 @@ def _bucket(n, buckets):
 _SM64_A = np.uint64(0x9E3779B97F4A7C15)
 _SM64_B = np.uint64(0xBF58476D1CE4E5B9)
 _SM64_C = np.uint64(0x94D049BB133111EB)
+
+# fixed operand width of the copy-on-write page-copy program: one
+# compiled shape regardless of how many pages a step copies (padding
+# lanes copy the dump page onto itself; larger batches loop)
+_COW_WIDTH = 8
 
 
 def _mix64(x):
@@ -210,7 +260,7 @@ class ContinuousBatchingEngine:
     def __init__(self, model, max_slots, max_len, page_size=128,
                  do_sample=False, temperature=1.0, top_k=None, top_p=None,
                  eos_token_id=None, prompt_buckets=(16, 32, 64, 128),
-                 seed=0, pipeline=None):
+                 seed=0, pipeline=None, pool_pages=None, prefix_cache=True):
         from ..jit import _FunctionalModel, _swap_lock
 
         model.eval()
@@ -220,7 +270,18 @@ class ContinuousBatchingEngine:
         self.max_slots = int(max_slots)
         page_size = min(page_size, max_len)
         if max_len % page_size:
-            max_len = -(-max_len // page_size) * page_size
+            rounded = -(-max_len // page_size) * page_size
+            # the round-up changes the caller's budget (prompt+max_new
+            # validation runs against the EFFECTIVE capacity): say so
+            # once and surface it in stats()["kv"]["max_len"]
+            logger.warning(
+                "ContinuousBatchingEngine: max_len %d rounded up to %d "
+                "(a multiple of page_size %d); stats()['kv'] reports "
+                "the effective value", max_len, rounded, page_size)
+            self._max_len_rounded_from = int(max_len)
+            max_len = rounded
+        else:
+            self._max_len_rounded_from = None
         self.max_len = int(max_len)
         self.page_size = int(page_size)
         self.do_sample = bool(do_sample)
@@ -236,35 +297,68 @@ class ContinuousBatchingEngine:
         except StopIteration:
             dtype = jnp.float32
         per_seq = self.max_len // self.page_size
-        # + a SCRATCH page row: admission groups are padded to a fixed
-        # power-of-two batch width (one compiled prefill shape per
-        # bucket x width, not one per group size) and padding rows write
-        # into scratch, never into a live slot's pages. Padding rows write
-        # at most chunk_w tokens (base 0), so scratch holds chunk_w/page
-        # pages; the row's remaining table columns alias the last scratch
-        # page (never read — masked)
-        scratch_np = max(self.prompt_buckets[-1] // self.page_size, 1)
-        n_pages = self.max_slots * per_seq + scratch_np
+        self._cols = per_seq  # attention-visible table columns
+        # DYNAMIC POOL: ``pool_pages`` allocatable pages shared by every
+        # slot (default: the historical budget of one full-length
+        # sequence per slot, so the device arrays are byte-identical to
+        # the static layout) + SCRATCH pages: admission groups are
+        # padded to a fixed power-of-two batch width (one compiled
+        # prefill shape per bucket x width, not one per group size) and
+        # padding rows write into scratch, never into a live slot's
+        # pages. Padding rows write at most chunk_w tokens (base 0), so
+        # scratch holds chunk_w/page pages.
+        chunk_w = self.prompt_buckets[-1]
+        scratch_np = max(chunk_w // self.page_size, 1)
+        n_real = (self.max_slots * per_seq if pool_pages is None
+                  else int(pool_pages))
+        if n_real < per_seq:
+            raise ValueError(
+                f"pool_pages {n_real} cannot hold one full-length "
+                f"sequence ({per_seq} pages of {self.page_size} tokens "
+                f"for max_len {self.max_len})")
+        self._pool_pages = n_real
+        n_pages = n_real + scratch_np
+        # table rows carry EXTRA trailing scratch-aliased columns: a
+        # prefix-resume prefill writes a padded bucket at an arbitrary
+        # base, so its (masked, never-read) padding tail can spill up to
+        # chunk_w tokens past max_len — those positions must map to a
+        # scratch page, not clamp onto a live one
+        self._extra_cols = -(-chunk_w // self.page_size)
+        total_cols = per_seq + self._extra_cols
         self._nl = cfg.num_hidden_layers
         self._ks = [jnp.zeros((n_pages, self.page_size, kv, cfg.head_dim),
                               dtype) for _ in range(self._nl)]
         self._vs = [jnp.zeros_like(k) for k in self._ks]
-        # interleaved slot->page map (PagedKVCache layout); row
-        # ``max_slots`` is the scratch row
-        real = (np.arange(per_seq, dtype=np.int32)[None, :] * self.max_slots
-                + np.arange(self.max_slots, dtype=np.int32)[:, None])
-        scratch_ids = self.max_slots * per_seq + np.minimum(
-            np.arange(per_seq, dtype=np.int32), scratch_np - 1)
-        # host copy kept for prefill row gathers (a NUMPY index, not a
-        # compiled device gather — the post-warmup hot path must not
-        # trigger a single compilation)
-        self._tables_np = np.concatenate([real, scratch_ids[None, :]], axis=0)
-        self._tables = jnp.asarray(self._tables_np)
+        # any table cell not backed by a granted page aliases the DUMP
+        # page (the last scratch page): writes there are garbage by
+        # construction and reads never reach it (attention masks by
+        # length < max_len)
+        self._dump_page = n_real + scratch_np - 1
+        scratch_ids = n_real + np.minimum(
+            np.arange(total_cols, dtype=np.int32), scratch_np - 1)
+        # host page table: slot rows are rebuilt from the allocator's
+        # grants (_set_table_row); row ``max_slots`` is the scratch row.
+        # Kept NUMPY-side for prefill row gathers — the post-warmup hot
+        # path must not trigger a single compilation; the device copy
+        # (_tables_device) is re-uploaded on grant, never re-traced.
+        self._tables_np = np.full((self.max_slots + 1, total_cols),
+                                  self._dump_page, np.int32)
+        self._tables_np[self.max_slots] = scratch_ids
         # per-segment invariants hoisted out of the dispatch loop: the
-        # slot-rows view never changes; the limits device copy changes
-        # only at admission and is invalidated there
-        self._tables_active = self._tables[:self.max_slots]
+        # device table/limits copies change only at grant/admission and
+        # are invalidated there
+        self._tables_active = None
         self._limits_dev = None
+        self._pool = PagePool(n_real)
+        self._prefix = (PrefixCache(self._pool, self.page_size,
+                                    self._recycle)
+                        if prefix_cache else None)
+        self._slot_pages: list[list] = [[] for _ in range(self.max_slots)]
+        # quarantine for freed pages that a dispatched-but-unconsumed
+        # program may still write (see _recycle/_mark_executed)
+        self._quarantine: list = []
+        self._disp_n = 0
+        self._exec_floor = 0
         self._functional = _FunctionalModel(model)
         # param/buffer snapshots must not race another engine's trace-time
         # param swap on a SHARED model (tracers would leak into the
@@ -291,14 +385,86 @@ class ContinuousBatchingEngine:
         self._segment_p = None
         self._build_programs()
 
+    # -------------------------------------------- page recycling safety
+    #
+    # A freed page may still be WRITTEN by a program that was dispatched
+    # before the free (every dispatched segment writes every slot's
+    # current cell, frozen slots included). Device programs execute in
+    # dispatch order, so a page is safe to re-grant once every program
+    # dispatched before the free has provably executed — which a
+    # blocking fetch of any LATER (or the same) program's outputs
+    # proves. ``_disp_n`` counts dispatches; ``_exec_floor`` is the
+    # highest dispatch index proven executed; frees tagged above the
+    # floor wait in quarantine.
+
+    def _mark_dispatch(self) -> int:
+        self._disp_n += 1
+        return self._disp_n
+
+    def _mark_executed(self, d):
+        if d <= self._exec_floor:
+            return
+        self._exec_floor = d
+        if self._quarantine:
+            keep = []
+            for tag, pages in self._quarantine:
+                if tag <= self._exec_floor:
+                    self._pool.recycle(pages)
+                else:
+                    keep.append((tag, pages))
+            self._quarantine = keep
+
+    def _recycle(self, pages):
+        """Zero-ref pages back to the free list — immediately when no
+        possibly-unexecuted program can write them, else quarantined."""
+        if not pages:
+            return
+        if self._exec_floor >= self._disp_n:
+            self._pool.recycle(pages)
+        else:
+            self._quarantine.append((self._disp_n, pages))
+
+    # --------------------------------------------------- page-table state
+
+    def _set_table_row(self, slot):
+        """Mirror the slot's granted pages into its host table row (tail
+        columns alias the dump page) and invalidate the device copy —
+        contents change, the traced shape never does."""
+        row = self._tables_np[slot]
+        pages = self._slot_pages[slot]
+        row[:len(pages)] = pages
+        row[len(pages):] = self._dump_page
+        self._tables_active = None
+
+    def _tables_device(self):
+        """Device copy of the active slot rows, rebuilt after any page
+        grant (a host->device upload, never a compilation). The TP
+        engine overrides this to commit the upload mesh-replicated."""
+        if self._tables_active is None:
+            self._tables_active = jnp.asarray(
+                self._tables_np[:self.max_slots])
+        return self._tables_active
+
+    def _free_slot_pages(self, slot):
+        """Release the slot's page grants (shared pages just drop one
+        reference; cache-held prefix pages survive for future hits)."""
+        pages, self._slot_pages[slot] = self._slot_pages[slot], []
+        if pages:
+            self._recycle(self._pool.decref(pages))
+            self._set_table_row(slot)
+
     # ------------------------------------------------------------ programs
 
-    def _caches(self, ks, vs, tables, length):
+    def _caches(self, ks, vs, tables, length, aligned=None):
         # chunked-prefill bases are chunk_w multiples: page-aligned (the
-        # bulk-write opt-in) exactly when chunk_w is a page multiple
-        aligned = self.prompt_buckets[-1] % self.page_size == 0
+        # bulk-write opt-in) exactly when chunk_w is a page multiple;
+        # the prefix-RESUME path passes aligned=False — its bases start
+        # at the first divergent token, which may sit mid-page
+        if aligned is None:
+            aligned = self.prompt_buckets[-1] % self.page_size == 0
         return [_make_paged_cache(ks[i], vs[i], tables, self.page_size,
-                                  length, aligned_bases=aligned)
+                                  length, aligned_bases=aligned,
+                                  attn_pages=self._cols)
                 for i in range(self._nl)]
 
     def _build_programs(self):
@@ -357,6 +523,29 @@ class ContinuousBatchingEngine:
                 params, ks, vs, chunk, table_rows, bases)
             return sample_true_last(logits, true_lens, keys), ks2, vs2
 
+        def resume_final(params, ks, vs, chunk, table_rows, bases,
+                         true_lens, keys):
+            # PREFIX-RESUME prefill: the divergent tail of a prompt whose
+            # head was served from the prefix cache — written at per-row
+            # bases that may sit MID-PAGE (unaligned scatter path; the
+            # CoW page copy ran first), sampling at the true last token
+            caches = self._caches(ks, vs, table_rows, bases,
+                                  aligned=False)
+            (logits, caches2), _ = functional(
+                params, buffers, (chunk,), {"caches": caches}, zero_key)
+            ks2 = [c.k_pages for c in caches2]
+            vs2 = [c.v_pages for c in caches2]
+            return sample_true_last(logits, true_lens, keys), ks2, vs2
+
+        def cow_copy(params, ks, vs, src, dst):
+            # copy-on-write page copy: duplicate shared pages a writer
+            # must append into (params ride for dispatch uniformity —
+            # XLA dead-code-eliminates them). Padding lanes copy the
+            # dump page onto itself.
+            ks2 = [k.at[dst].set(k[src]) for k in ks]
+            vs2 = [v.at[dst].set(v[src]) for v in vs]
+            return ks2, vs2
+
         def segment(params, ks, vs, tables, lengths, toks, active, limits,
                     keys):
             def body(carry, key):
@@ -387,6 +576,8 @@ class ContinuousBatchingEngine:
         self._prefill_p = jax.jit(prefill, donate_argnums=(1, 2))
         self._chunk_p = jax.jit(chunk_step, donate_argnums=(1, 2))
         self._final_chunk_p = jax.jit(final_chunk, donate_argnums=(1, 2))
+        self._resume_p = jax.jit(resume_final, donate_argnums=(1, 2))
+        self._cow_p = jax.jit(cow_copy, donate_argnums=(1, 2))
         self._segment_p = jax.jit(segment, donate_argnums=(1, 2))
 
     # --------------------------------------------------- program dispatch
@@ -516,13 +707,35 @@ class ContinuousBatchingEngine:
                 compile_(("prefill", bucket, g), self._prefill_p,
                          self._op_aval((g, bucket), i32),
                          rows_s, lens_s, keys_s)
-            if self.max_len > chunk_w and self.max_len % chunk_w == 0:
+                if self._prefix is not None:
+                    # prefix-resume prefill: same (bucket x width) grid,
+                    # plus the per-row base operand
+                    compile_(("resume", bucket, g), self._resume_p,
+                             self._op_aval((g, bucket), i32),
+                             rows_s, self._op_aval((g,), i32),
+                             lens_s, keys_s)
+            if self.max_len > chunk_w and (
+                    self.max_len % chunk_w == 0
+                    or self._pool_pages < self.max_slots * self._cols):
+                # beyond submitted long prompts (which _validate rejects
+                # on non-multiple engines), a PREEMPTED request whose
+                # folded prompt outgrew chunk_w re-admits through the
+                # chunked path (final-chunk overflow lands in the extra
+                # dump-aliased columns) — so the programs must also be
+                # warmed on non-multiple engines whose RESTRICTED pool
+                # can actually exhaust; the default full pool cannot
+                # (every slot fits a whole sequence), so those engines
+                # skip the dead compiles
                 chunk_s = self._op_aval((g, chunk_w), i32)
                 bases_s = self._op_aval((g,), i32)
                 compile_(("chunk", g), self._chunk_p, chunk_s, rows_s,
                          bases_s)
                 compile_(("final", g), self._final_chunk_p, chunk_s, rows_s,
                          bases_s, lens_s, keys_s)
+        if self._prefix is not None:
+            compile_(("cow", _COW_WIDTH), self._cow_p,
+                     self._op_aval((_COW_WIDTH,), i32),
+                     self._op_aval((_COW_WIDTH,), i32))
         seg = int(segment if segment is not None
                   else getattr(self, "_segment_len", 16))
         m = self.max_slots
@@ -573,16 +786,19 @@ class ContinuousBatchingEngine:
         return (h >> np.uint64(32)).astype(np.uint32)
 
     def _prefill_keys(self, group, g):
-        # first token of each admitted request: index ``token_base`` of
-        # its stream (0 for fresh requests; k for a failover resume that
-        # already emitted k tokens elsewhere)
+        # first token of each admitted request: index ``token_base +
+        # already-emitted`` of its stream (0 for fresh requests; k for a
+        # failover resume that emitted k tokens elsewhere; the emitted
+        # count for a PREEMPTED request re-admitting with its partial
+        # output folded into the prompt)
         shape = (g,) + self._key_shape
         if not self.do_sample:
             return self._key_zeros(shape)
         bits = np.zeros(shape, np.uint32)
         for i, (_, req) in enumerate(group):
-            bits[i] = self._req_key_block(req.rid, req.token_base,
-                                          1).reshape(self._key_shape)
+            bits[i] = self._req_key_block(
+                req.rid, req.token_base + len(req.tokens),
+                1).reshape(self._key_shape)
         return jnp.asarray(bits)
 
     def _segment_keys(self, offset):
@@ -650,6 +866,26 @@ class ContinuousBatchingEngine:
         self._run_deadline = run_deadline or Deadline.never()
         self._queue: deque[Request] = deque()
         self._slot_req: list[Request | None] = [None] * self.max_slots
+        # allocator session reset: every grant returns to the pool and
+        # the PREFIX CACHE is cleared — the param snapshot above may
+        # differ from the one the cached KV was computed under
+        self._pool = PagePool(self._pool_pages)
+        if self._prefix is not None:
+            self._prefix = PrefixCache(self._pool, self.page_size,
+                                       self._recycle)
+        self._slot_pages = [[] for _ in range(self.max_slots)]
+        self._quarantine = []
+        self._disp_n = 0
+        self._exec_floor = 0
+        self._tables_np[:self.max_slots] = self._dump_page
+        self._tables_active = None
+        self._slot_adm = [0] * self.max_slots  # admission seq per slot
+        self._adm_seq = 0
+        self._resume_base = {}
+        self._cow_pair = {}
+        self.admission_blocked = False  # pool deferred the queue head
+        self._prefix_lookup_tokens = 0
+        self._prefix_hit_tokens = 0
         self._lengths = np.ones((self.max_slots,), np.int32)  # idle: len 1
         self._cur_tok = np.zeros((self.max_slots,), np.int32)
         # per-slot length budget: prompt + max_new - 1 is the final length
@@ -758,20 +994,24 @@ class ContinuousBatchingEngine:
     def _retire(self, req, status, finished=None, slot=None):
         if req.status != "pending":
             return  # already retired (e.g. timed out inside a bisected try)
+        pages_held = 0
         if slot is not None:
             self._slot_req[slot] = None
             self._lengths[slot] = 1  # slot returns to the idle pool
+            pages_held = len(self._slot_pages[slot])
+            self._free_slot_pages(slot)
         req.status = status
         self._counts[status] = self._counts.get(status, 0) + 1
         if telemetry.enabled():
             _M_REQS.inc(status=status)
             if req.t_first is not None:
                 # the request's KV footprint at the page granularity it
-                # actually occupied (what a block allocator would free
-                # here) — only requests that were ADMITTED (prefilled
-                # into a slot); a queue-expired request held no pages
-                used = req.prompt.size + len(req.tokens)
-                pages = -(-used // self.page_size)
+                # actually occupied (the pages the allocator just freed)
+                # — only requests that were ADMITTED (prefilled into a
+                # slot); a queue-expired request held no pages
+                pages = (pages_held if pages_held else
+                         -(-(req.prompt.size + len(req.tokens))
+                           // self.page_size))
                 _M_KV_REQ.observe(pages * self.page_size
                                   * self._kv_bytes_per_token)
             if req.t_first is not None and len(req.tokens) > 1:
@@ -824,14 +1064,17 @@ class ContinuousBatchingEngine:
             return
         except Exception as e:  # isolation boundary: bisect, never crash
             if len(group) == 1:
-                _, req = group[0]
+                slot, req = group[0]
                 bump_counter("serving.poison_request")
                 req.error = e
                 # a poison retirement is a post-mortem moment: dump the
                 # flight recorder so the offender leaves forensics
                 telemetry.flight_dump("poison_request", rid=req.rid,
                                       error=repr(e))
-                self._retire(req, "failed", finished)
+                # slot= releases the admission's page grants even though
+                # the request never registered in _slot_req (the dynamic
+                # pool must not leak a failed admission's pages)
+                self._retire(req, "failed", finished, slot=slot)
                 return
         mid = len(group) // 2
         self._isolate(group[:mid], dispatch, finished)
@@ -866,15 +1109,21 @@ class ContinuousBatchingEngine:
         return self._limits_dev
 
     def _finish_admit(self, slot, req, tok, finished):
-        """Shared post-prefill bookkeeping (short AND chunked paths):
-        register the slot, count the sampled first token, set the
-        per-slot budget, and retire immediately on eos / max_new=1."""
+        """Shared post-prefill bookkeeping (short, chunked AND
+        prefix-resume paths): register the slot, count the sampled
+        token, set the per-slot budget, insert the prompt's full pages
+        into the prefix cache, and retire immediately on eos /
+        exhausted budget. A PREEMPTED request re-admits here with its
+        partial output folded into the prompt — ``len(req.tokens)``
+        already counts those emissions, so the key stream, budget, and
+        limit arithmetic stay globally indexed."""
         self._slot_req[slot] = req
+        fresh_first = not req.tokens
         req.tokens.append(int(tok))
-        self._useful += 1  # the prefill-sampled first token
-        req.t_first = time.monotonic()
-        if telemetry.enabled():
-            if req.token_base == 0:
+        self._useful += 1  # the prefill-sampled token
+        if req.t_first is None:
+            req.t_first = time.monotonic()
+            if telemetry.enabled() and req.token_base == 0 and fresh_first:
                 # FRESH attempts only: a failover continuation
                 # (token_base > 0) emitted its real first token long ago
                 # on another replica — an attempt-level sample here
@@ -887,16 +1136,26 @@ class ContinuousBatchingEngine:
                     # latency" in fleet_metrics()['tenants'])
                     _M_TTFT.observe(req.t_first - req.t_submit,
                                     tenant=str(req.tenant))
+        if telemetry.enabled():
             _M_TOKENS.inc()
         self._lengths[slot] = req.prompt.size
         self._cur_tok[slot] = int(tok)
-        self._limits[slot] = req.prompt.size + req.max_new_tokens - 1
+        # final slot length: prompt + remaining emission budget - 1
+        # (len(tokens) - 1 emissions happened in EARLIER attempts for a
+        # preempted resume; for a fresh request this is the historical
+        # prompt + max_new - 1)
+        self._limits[slot] = (req.prompt.size + req.max_new_tokens
+                              - len(req.tokens))
         self._limits_dev = None  # admission changed the device invariant
+        if self._prefix is not None:
+            # the slot's full prompt pages now hold valid KV: future
+            # prompts sharing this prefix map them instead of
+            # re-prefilling (refcounted — they outlive this request)
+            self._prefix.insert(req.prompt, self._slot_pages[slot])
         if len(req.tokens) >= req.max_new_tokens or (
                 self.eos_token_id is not None
-                and req.tokens[0] == self.eos_token_id):
-            self._slot_req[slot] = None
-            self._retire(req, "ok", finished)
+                and req.tokens[-1] == self.eos_token_id):
+            self._retire(req, "ok", finished, slot=slot)
 
     def _dispatch_prefill(self, group, bucket, finished):
         # admission batch padded to the GROUP WIDTH (smallest power of two
@@ -913,17 +1172,81 @@ class ContinuousBatchingEngine:
             true_lens[i] = req.prompt.size
             rows[i] = slot
         t0 = time.monotonic()
+        d = self._mark_dispatch()
         with annotate("serving.prefill", **self._group_trace_args(group)):
             tok0, self._ks, self._vs = self._call(
                 ("prefill", bucket, g), self._prefill_p,
                 self._params, self._ks, self._vs, jnp.asarray(padded),
                 jnp.asarray(self._tables_np[rows]), jnp.asarray(true_lens),
                 self._prefill_keys(group, g))
-            tok0 = np.asarray(tok0)
+            tok0 = np.asarray(tok0)  # blocking fetch: the program ran
+        self._mark_executed(d)
         if telemetry.enabled():
             perfwatch.observe_phase("prefill", time.monotonic() - t0)
         for i, (slot, req) in enumerate(group):
             self._finish_admit(slot, req, tok0[i], finished)
+
+    def _dispatch_resume(self, group, bucket, finished):
+        """PREFIX-RESUME admission dispatch: each row's shared prefix
+        (``_resume_base`` tokens, keyed by request IDENTITY — rids are
+        caller-supplied and may collide) is already mapped from the cache;
+        only the divergent tail — padded to ``bucket`` — is written and
+        the first token sampled at the true last position. Bases may sit
+        mid-page (the CoW copy runs first, inside THIS isolation scope —
+        a copy failure bisects like any admission failure), so the
+        program uses the unaligned scatter write path."""
+        pairs = [self._cow_pair[id(req)] for _, req in group
+                 if id(req) in self._cow_pair]
+        if pairs:
+            self._dispatch_cow(pairs)
+        g = self._group_width(len(group))
+        padded = np.zeros((g, bucket), np.int32)
+        bases = np.zeros((g,), np.int32)
+        true_lens = np.ones((g,), np.int32)
+        rows = np.full((g,), self.max_slots, np.int64)  # scratch
+        for i, (slot, req) in enumerate(group):
+            m = self._resume_base[id(req)]
+            rem = req.prompt.size - m
+            padded[i, :rem] = req.prompt[m:]
+            bases[i] = m
+            true_lens[i] = rem
+            rows[i] = slot
+        t0 = time.monotonic()
+        d = self._mark_dispatch()
+        with annotate("serving.prefill", **self._group_trace_args(group)):
+            tok0, self._ks, self._vs = self._call(
+                ("resume", bucket, g), self._resume_p,
+                self._params, self._ks, self._vs, jnp.asarray(padded),
+                jnp.asarray(self._tables_np[rows]), jnp.asarray(bases),
+                jnp.asarray(true_lens), self._prefill_keys(group, g))
+            tok0 = np.asarray(tok0)
+        self._mark_executed(d)
+        if telemetry.enabled():
+            perfwatch.observe_phase("prefill", time.monotonic() - t0)
+        for i, (slot, req) in enumerate(group):
+            self._finish_admit(slot, req, tok0[i], finished)
+
+    def _dispatch_cow(self, pairs):
+        """Copy-on-write page copies, batched through the fixed-width
+        ``("cow", _COW_WIDTH)`` program (padding lanes copy the dump page
+        onto itself). Called from ``_dispatch_resume`` — INSIDE the
+        ``_isolate`` boundary, before the group's prefill appends into
+        the copies (device program order makes the copy visible) — so a
+        device failure bisects like any admission failure, and a
+        bisection replay harmlessly re-copies (the source is read-only
+        shared content, the destination private)."""
+        for i in range(0, len(pairs), _COW_WIDTH):
+            batch = pairs[i:i + _COW_WIDTH]
+            src = np.full((_COW_WIDTH,), self._dump_page, np.int32)
+            dst = np.full((_COW_WIDTH,), self._dump_page, np.int32)
+            for j, (s, t) in enumerate(batch):
+                src[j] = s
+                dst[j] = t
+            self._mark_dispatch()
+            self._ks, self._vs = self._call(
+                ("cow", _COW_WIDTH), self._cow_p,
+                self._params, self._ks, self._vs,
+                jnp.asarray(src), jnp.asarray(dst))
 
     def _split_expired(self, items):
         live, expired = [], []
@@ -939,12 +1262,17 @@ class ContinuousBatchingEngine:
         # chunks at per-row base offsets, then one padded final chunk that
         # also samples the first token. Rows are aligned by chunk index;
         # rows already past their full chunks ride the scratch page row.
+        # A prefix-cache hit starts a row's chunks at its RESUME BASE
+        # (the shared-prefix length, page-aligned for the bulk write
+        # path) instead of 0 — the cached pages already hold that KV.
         # The request deadline is checked BETWEEN chunks: a long-context
         # admission whose budget expired mid-prefill retires as
         # ``timed_out`` without dispatching its remaining chunks.
         chunk_w = self.prompt_buckets[-1]
         scratch = self.max_slots
-        n_full = {req.rid: (req.prompt.size - 1) // chunk_w
+        start = {id(req): self._resume_base.get(id(req), 0)
+                 for _, req in group}
+        n_full = {id(req): (req.prompt.size - start[id(req)] - 1) // chunk_w
                   for _, req in group}
         live = list(group)
         expired = []
@@ -952,19 +1280,21 @@ class ContinuousBatchingEngine:
         while live:
             live, dead = self._split_expired(live)
             expired += dead
-            if not live or not any(c < n_full[req.rid] for _, req in live):
+            if not live or not any(c < n_full[id(req)] for _, req in live):
                 break
             g = self._group_width(len(live))
             chunk_arr = np.zeros((g, chunk_w), np.int32)
             bases = np.zeros((g,), np.int32)
             rows = np.full((g,), scratch, np.int64)
             for i, (slot, req) in enumerate(live):
-                if c < n_full[req.rid]:
+                if c < n_full[id(req)]:
                     p = req.prompt
-                    chunk_arr[i] = p[c * chunk_w:(c + 1) * chunk_w]
-                    bases[i] = c * chunk_w
+                    b0 = start[id(req)] + c * chunk_w
+                    chunk_arr[i] = p[b0:b0 + chunk_w]
+                    bases[i] = b0
                     rows[i] = slot
             t0 = time.monotonic()
+            self._mark_dispatch()  # async: no fetch proves execution yet
             with annotate("serving.chunked_prefill",
                           **self._group_trace_args(live)):
                 self._ks, self._vs = self._call(
@@ -983,13 +1313,14 @@ class ContinuousBatchingEngine:
             rows = np.full((g,), scratch, np.int64)
             for i, (slot, req) in enumerate(live):
                 p = req.prompt
-                done = n_full[req.rid] * chunk_w
+                done = start[id(req)] + n_full[id(req)] * chunk_w
                 rem = p.size - done
                 final_arr[i, :rem] = p[done:]
                 bases[i] = done
                 true_rem[i] = rem
                 rows[i] = slot
             t0 = time.monotonic()
+            d = self._mark_dispatch()
             with annotate("serving.chunked_prefill",
                           **self._group_trace_args(live)):
                 tok0, self._ks, self._vs = self._call(
@@ -997,14 +1328,17 @@ class ContinuousBatchingEngine:
                     self._params, self._ks, self._vs, jnp.asarray(final_arr),
                     jnp.asarray(self._tables_np[rows]), jnp.asarray(bases),
                     jnp.asarray(true_rem), self._prefill_keys(live, g))
-                tok0 = np.asarray(tok0)
+                tok0 = np.asarray(tok0)  # blocking fetch
+            self._mark_executed(d)
             if telemetry.enabled():
                 perfwatch.observe_phase("chunked_prefill",
                                         time.monotonic() - t0)
             for i, (slot, req) in enumerate(live):
                 self._finish_admit(slot, req, tok0[i], finished)
-        for _, req in expired:
-            self._retire(req, "timed_out", finished)
+        for slot, req in expired:
+            # slot= so the admission's page grants return to the pool
+            # (the request never registered in _slot_req)
+            self._retire(req, "timed_out", finished, slot=slot)
 
     def _dispatch_segment(self, mask, carry=None, key_offset=0):
         """Dispatch ONE compiled decode segment (async — no host wait).
@@ -1029,12 +1363,14 @@ class ContinuousBatchingEngine:
             active = jnp.asarray(mask)
         else:
             toks, lengths, active = carry
+        d = self._mark_dispatch()
         with annotate("serving.segment_dispatch",
                       **self._mask_trace_args(mask)):
             emitted, was_active, tok, new_lengths, still_active, \
                 self._ks, self._vs = self._call(
                     ("segment", self._segment_len), self._segment_p,
-                    self._params, self._ks, self._vs, self._tables_active,
+                    self._params, self._ks, self._vs,
+                    self._tables_device(),
                     lengths, toks, active, self._limits_device(), keys)
         self._seg_runs += 1
         if telemetry.enabled():
@@ -1044,7 +1380,7 @@ class ContinuousBatchingEngine:
                                     time.monotonic() - now)
         return {"emitted": emitted, "was_active": was_active, "tok": tok,
                 "lengths": new_lengths, "active": still_active,
-                "mask": np.asarray(mask)}
+                "mask": np.asarray(mask), "disp": d}
 
     def _consume(self, h, finished):
         """Fetch one dispatched segment's outputs (ONE host round trip for
@@ -1055,6 +1391,10 @@ class ContinuousBatchingEngine:
             jax.device_get((h["emitted"], h["was_active"], h["tok"],
                             h["lengths"], h["active"]))
         t1 = time.monotonic()
+        # the blocking fetch proves this segment (and every program
+        # dispatched before it) executed: quarantined page frees up to
+        # its dispatch index are safe to recycle
+        self._mark_executed(h["disp"])
         if telemetry.enabled():
             # the blocking fetch: device compute the pipeline did not
             # hide (plus transfer) — the device share of a decode step
@@ -1187,6 +1527,10 @@ class ContinuousBatchingEngine:
             live = np.array([r is not None for r in self._slot_req])
             self._segment_round(mask & live, finished)
             return
+        # h becomes the in-flight segment BEFORE prev's bookkeeping so
+        # pages freed by retirements inside _consume see it and
+        # quarantine (h still writes every carried slot's cell)
+        self._inflight = h
         try:
             self._consume(prev, finished)
         except Exception:  # isolation boundary: bisect, never crash
@@ -1198,7 +1542,6 @@ class ContinuousBatchingEngine:
             live = np.array([r is not None for r in self._slot_req])
             self._segment_round(prev["mask"] & live, finished)
             return
-        self._inflight = h
 
     def step(self):
         """One scheduler turn: admit queued requests into free slots
@@ -1217,14 +1560,63 @@ class ContinuousBatchingEngine:
         if self._inflight is not None and (
                 self._dirty or (self._queue and self.free_slots() > 0)):
             self._drain_pipeline(finished)
-        admitting, long_adm = [], []
-        for slot in range(self.max_slots):
-            if self._slot_req[slot] is not None or not self._queue:
-                continue
-            req = self._queue.popleft()
+        # ---- admission: FIFO over the queue, bounded by free slots AND
+        # free POOL PAGES. A head the pool cannot serve DEFERS the whole
+        # queue (no skip-ahead — a stream of small requests must not
+        # starve a big one) with serving.kv_pool_exhausted backpressure.
+        self.admission_blocked = False
+        self._resume_base = {}
+        self._cow_pair = {}
+        chunk_w = self.prompt_buckets[-1]
+        free = [s for s in range(self.max_slots)
+                if self._slot_req[s] is None]
+        admitting, long_adm, resume_adm = [], [], []
+        fi = 0
+        while self._queue and fi < len(free):
+            req = self._queue[0]
             if req.status != "pending":
+                self._queue.popleft()
                 continue
-            if req.prompt.size > self.prompt_buckets[-1]:
+            plan = self._plan_admission(req)
+            if plan is None and self._quarantine:
+                # the missing pages may be freed-but-unproven: block on
+                # the pool buffers (proves every dispatched program
+                # executed, draining the quarantine) and retry — without
+                # this, a session whose only retirement rode a failed
+                # dispatch could defer the head forever with no active
+                # slot left to trigger the _ensure_pages flush
+                jax.block_until_ready(self._ks[0])
+                self._mark_executed(self._disp_n)
+                plan = self._plan_admission(req)
+            if plan is None:
+                bump_counter("serving.kv_pool_exhausted")
+                self.admission_blocked = True
+                break
+            self._queue.popleft()
+            slot = free[fi]
+            fi += 1
+            shared, m, cow_src, newp = plan
+            self._slot_pages[slot] = list(shared) + newp
+            self._set_table_row(slot)
+            self._slot_adm[slot] = self._adm_seq
+            self._adm_seq += 1
+            self._prefix_lookup_tokens += int(req.prompt.size)
+            if m:
+                self._prefix_hit_tokens += m
+                self._resume_base[id(req)] = m
+                if telemetry.enabled():
+                    _M_PREFIX_SAVED.inc(m)
+                if cow_src is not None:
+                    # the divergent page: copy the cached content, then
+                    # append into the private copy (dispatched inside
+                    # the request's resume-group isolation scope)
+                    self._cow_pair[id(req)] = (
+                        cow_src, self._slot_pages[slot][len(shared)])
+                if req.prompt.size - m <= chunk_w:
+                    resume_adm.append((slot, req))
+                else:
+                    long_adm.append((slot, req))
+            elif req.prompt.size > chunk_w:
                 long_adm.append((slot, req))
             else:
                 admitting.append((slot, req))
@@ -1236,10 +1628,30 @@ class ContinuousBatchingEngine:
             self._isolate(
                 grp, lambda sub, b=bucket: self._dispatch_prefill(
                     sub, b, finished), finished)
+        r_by_bucket: dict[int, list] = {}
+        for slot, req in resume_adm:
+            b = _bucket(req.prompt.size - self._resume_base[id(req)],
+                        self.prompt_buckets)
+            r_by_bucket.setdefault(b, []).append((slot, req))
+        for bucket, grp in r_by_bucket.items():
+            self._isolate(
+                grp, lambda sub, b=bucket: self._dispatch_resume(
+                    sub, b, finished), finished)
         if long_adm:
             self._isolate(
                 long_adm, lambda sub: self._chunked_prefill(sub, finished),
                 finished)
+        if self._cow_pair:
+            # every copy is dispatched (or its request terminally
+            # retired) by now: release the plan-time source-page holds —
+            # the isolates never raise, so this line is always reached
+            self._recycle(self._pool.decref(
+                [s for s, _ in self._cow_pair.values()]))
+            self._cow_pair = {}
+        # decode growth: every active slot must hold pages for the next
+        # dispatch window BEFORE it is dispatched (may preempt under
+        # pool pressure — never fails a running decode)
+        self._ensure_pages(finished)
 
         active_np = np.array([r is not None for r in self._slot_req])
         if telemetry.enabled():
@@ -1284,24 +1696,197 @@ class ContinuousBatchingEngine:
             self._queue = waiting
         return finished
 
+    # ------------------------------------------------ dynamic page pool
+
+    def _growth_horizon(self) -> int:
+        """Positions the next dispatch window may write past a slot's
+        current length: one segment, or two when the pipeline may hold
+        an unconsumed segment plus a speculative one."""
+        return self._segment_len * (2 if self._pipeline else 1)
+
+    def _plan_admission(self, req):
+        """Page plan for admitting ``req``: match its prompt against the
+        prefix cache, then reserve pool pages for the unshared part.
+        Returns ``(shared_pages, resume_tokens, cow_src, new_pages)`` —
+        commits pool references on success — or ``None`` when the pool
+        (after LRU cache eviction) cannot cover the admission plus its
+        first-window decode growth: the caller defers the queue head.
+        A previously PREEMPTED request requires coverage of its FULL
+        remaining budget, so it cannot thrash straight back out."""
+        P = int(req.prompt.size)
+        page = self.page_size
+        chunk_w = self.prompt_buckets[-1]
+        shared, m, cow_src = [], 0, None
+        if self._prefix is not None and P > 1:
+            pages, matched, partial = self._prefix.match(req.prompt)
+            mtok = matched + (partial.r if partial is not None else 0)
+            # never serve the WHOLE prompt from cache: the last token
+            # must run through the model to produce sampling logits
+            mtok = min(mtok, P - 1)
+            if P - mtok > chunk_w:
+                # long divergent tail rides the page-aligned chunked
+                # path: round the resume base down to a page boundary
+                # (drops at most page_size-1 shared tokens)
+                mtok = (mtok // page) * page
+            full = mtok // page
+            shared = pages[:full]
+            m = mtok
+            if m % page:
+                # resume base sits mid-page: the covering cached page is
+                # mapped via copy-on-write (writers must not touch the
+                # shared original)
+                cow_src = pages[full] if full < len(pages) else partial.page
+        total = -(-P // page)
+        new_needed = total - len(shared)
+        remaining = req.max_new_tokens - len(req.tokens)
+        final_len = max(P + remaining - 1, P)
+        want_tokens = (final_len if req.preempted
+                       else min(P + self._growth_horizon(), final_len))
+        check_needed = max(-(-want_tokens // page), total) - len(shared)
+        if self._pool.available() < check_needed:
+            if self._prefix is not None:
+                excl = set(shared)
+                if cow_src is not None:
+                    excl.add(cow_src)
+                self._prefix.evict(
+                    check_needed - self._pool.available(), exclude=excl)
+            if self._pool.available() < check_needed:
+                return None
+        for p in shared:
+            self._pool.incref(p)
+        if cow_src is not None:
+            # hold the copy source until the CoW dispatch reads it
+            self._pool.incref(cow_src)
+        newp = self._pool.alloc(new_needed) if new_needed else []
+        return shared, m, cow_src, newp
+
+    def _ensure_pages(self, finished):
+        """Grant every active slot the pages its next dispatch window
+        can write (admission granted prompt coverage only; decode grows
+        page by page). Under pool pressure: evict prefix-cache leaves
+        first, flush the free-quarantine (draining the pipeline proves
+        execution), and as a last resort PREEMPT the youngest slot back
+        to the queue — its stream resumes bit-identically via the
+        per-request key stream, and the prefix cache usually makes the
+        re-prefill one page of work. A running decode never fails."""
+        horizon = self._growth_horizon()
+        while True:
+            need = []
+            for slot, req in enumerate(self._slot_req):
+                if req is None:
+                    continue
+                tgt = min(int(self._lengths[slot]) + horizon,
+                          int(self._limits[slot]))
+                short = (-(-tgt // self.page_size)
+                         - len(self._slot_pages[slot]))
+                if short > 0:
+                    need.append((slot, short))
+            total = sum(n for _, n in need)
+            if not total:
+                return
+            if self._pool.available() < total and self._prefix is not None:
+                self._prefix.evict(total - self._pool.available())
+            if self._pool.available() >= total:
+                for slot, n in need:
+                    self._slot_pages[slot].extend(self._pool.alloc(n))
+                    self._set_table_row(slot)
+                return
+            if self._quarantine:
+                # freed pages are waiting on execution proof: drain the
+                # pipeline (a blocking fetch) — or block on the pool
+                # buffers directly when nothing is in flight
+                if self._inflight is not None:
+                    self._dirty = True
+                    self._drain_pipeline(finished)
+                else:
+                    jax.block_until_ready(self._ks[0])
+                    self._mark_executed(self._disp_n)
+                continue
+            victims = [s for s, r in enumerate(self._slot_req)
+                       if r is not None]
+            if len(victims) <= 1:
+                # arithmetically unreachable (pool >= pages of one full
+                # sequence and a lone slot's own grants count against
+                # its need), but never spin here
+                return
+            self._preempt(max(victims, key=lambda s: self._slot_adm[s]),
+                          finished)
+
+    def _preempt(self, slot, finished):
+        """Pull the request off ``slot`` to free its pages, folding its
+        emitted tokens into the prompt (the failover-resume shape: key
+        stream indices are ``token_base + len(tokens)``, both unchanged,
+        so the eventual continuation is bit-identical). The request goes
+        back to the FRONT of the queue."""
+        req = self._slot_req[slot]
+        bump_counter("serving.kv_preempted")
+        if self._inflight is not None:
+            # the in-flight segment still decodes this slot; discard its
+            # unconsumed emissions (regenerated identically later) and
+            # sync the host view first
+            self._dirty = True
+            self._drain_pipeline(finished)
+            if self._slot_req[slot] is not req or req.status != "pending":
+                return  # retired while draining — pages already freed
+        if req.tokens:
+            req.prompt = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)])
+        req.preempted = True
+        self._slot_req[slot] = None
+        self._lengths[slot] = 1
+        self._free_slot_pages(slot)
+        self._queue.appendleft(req)
+        if telemetry.enabled():
+            telemetry.trace_event("serving.kv_preempt", trace=req.trace,
+                                  rid=req.rid, emitted=len(req.tokens))
+
     def _kv_usage(self, active_idx):
         """ONE definition of the page-granular KV arithmetic (the gauges
-        and ``kv_stats`` must never desynchronize): occupancy / bytes /
-        interior fragmentation over the active slots' host lengths."""
+        and ``kv_stats`` must never desynchronize): pool occupancy,
+        bytes, and fragmentation — the allocated-but-unused TAIL of the
+        pages granted to active slots (the dynamic-allocator waste; the
+        static slot map's waste was every slot's whole unreached tail).
+        Prefix accounting rides along: hit rate is shared prompt tokens
+        over admitted prompt tokens for the session."""
         n = len(active_idx)
+        slot_pages = getattr(self, "_slot_pages",
+                             [[] for _ in range(self.max_slots)])
         if n:
-            lens = self._lengths[active_idx].astype(np.int64)
-            used = int(lens.sum())
-            pages = int((-(-lens // self.page_size)).sum())
+            used = int(self._lengths[list(active_idx)]
+                       .astype(np.int64).sum())
+            # logical grants (shared pages count once per MAPPING): the
+            # fragmentation denominator — per-slot tail waste is defined
+            # against what each slot was granted
+            pages = sum(len(slot_pages[int(s)]) for s in active_idx)
+            # physical bytes (shared pages count ONCE): what the slots
+            # actually occupy of the pool — under prefix sharing the
+            # logical sum can exceed the pool, the byte gauge must not
+            phys = len({p for s in active_idx
+                        for p in slot_pages[int(s)]})
         else:
-            used = pages = 0
+            used = pages = phys = 0
         cap_tokens = pages * self.page_size
+        pool = getattr(self, "_pool", None)
+        free = pool.available() if pool is not None else 0
+        lookups = getattr(self, "_prefix_lookup_tokens", 0)
+        hits = getattr(self, "_prefix_hit_tokens", 0)
         return {
-            "bytes_in_use": cap_tokens * self._kv_bytes_per_token,
+            "bytes_in_use": (phys * self.page_size
+                             * self._kv_bytes_per_token),
             "slot_occupancy": n / self.max_slots if self.max_slots else 0.0,
             "fragmentation_pct": (100.0 * (1.0 - used / cap_tokens)
                                   if cap_tokens else 0.0),
             "bytes_per_token": self._kv_bytes_per_token,
+            "pages_total": self._pool_pages,
+            "pages_free": free,
+            "pages_granted": phys,
+            "prefix_cached_pages": (len(self._prefix)
+                                    if self._prefix is not None else 0),
+            "prefix_hit_rate": (hits / lookups) if lookups else 0.0,
+            "prefix_tokens_saved": hits,
+            "max_len": self.max_len,
+            "max_len_rounded_from": self._max_len_rounded_from,
+            "page_size": self.page_size,
         }
 
     def _kv_account(self, active_np):
@@ -1311,6 +1896,12 @@ class ContinuousBatchingEngine:
         _M_KV_BYTES.set(u["bytes_in_use"])
         _M_KV_OCC.set(u["slot_occupancy"])
         _M_KV_FRAG.set(u["fragmentation_pct"])
+        _M_KV_PAGES_FREE.set(u["pages_free"])
+        _M_KV_PAGES_TOTAL.set(u["pages_total"])
+        _M_PREFIX_HIT.set(u["prefix_hit_rate"])
+        for slot in range(self.max_slots):
+            _M_KV_SLOT_PAGES.set(len(self._slot_pages[slot]),
+                                 slot=slot)
 
     def kv_stats(self) -> dict:
         """Point-in-time KV accounting for THIS engine (the gauges are

@@ -220,9 +220,10 @@ class TPShardedEngine(ContinuousBatchingEngine):
         self._kv_sharding = kv_sh
         self._ks = [jax.device_put(k, kv_sh) for k in self._ks]
         self._vs = [jax.device_put(v, kv_sh) for v in self._vs]
-        self._tables = jax.device_put(self._tables, self._repl)
-        self._tables_active = jax.device_put(
-            self._tables[:self.max_slots], self._repl)
+        # the dynamic page table is re-uploaded on every grant
+        # (_tables_device below commits it replicated); drop any copy
+        # the base constructor may have cached un-meshed
+        self._tables_active = None
         if telemetry.enabled():
             _M_TP_DEGREE.set(self._tp_degree)
 
@@ -290,6 +291,15 @@ class TPShardedEngine(ContinuousBatchingEngine):
         if self._limits_dev is None:
             self._limits_dev = jax.device_put(self._limits, self._repl)
         return self._limits_dev
+
+    def _tables_device(self):
+        # page GRANTS invalidate the device table like admissions
+        # invalidate the limits: re-upload the numpy rows committed
+        # replicated on the mesh (contents change, shape never does)
+        if self._tables_active is None:
+            self._tables_active = jax.device_put(
+                self._tables_np[:self.max_slots], self._repl)
+        return self._tables_active
 
     def tp_stats(self) -> dict:
         """TP accounting: the degree, axis, and cumulative host seconds

@@ -112,13 +112,21 @@ def _decode_kernel(tables_ref, lens_ref, q_ref, k_ref, v_ref, o_ref,
         ).astype(o_ref.dtype)
 
 
-def paged_attention(q, k_pages, v_pages, block_tables, lengths):
+def paged_attention(q, k_pages, v_pages, block_tables, lengths,
+                    pages_per_seq=None):
     """Single-token attention over a paged KV cache.
 
     q: (B, H, D); k_pages/v_pages: (num_pages, page_size, KVH, D);
     block_tables: (B, pages_per_seq) int32 physical page ids;
     lengths: (B,) int32 valid context length per sequence.
     Returns (B, H, D).
+
+    ``pages_per_seq`` bounds how many table columns the grid walks per
+    sequence (static slice). Dynamic serving tables are RAGGED: rows
+    hold however many pages their slot was granted, padded with
+    scratch-alias columns the kernel must not pay grid steps for — the
+    per-page ``valid`` mask already skips DMA'd pages past ``lengths``,
+    but the grid itself is static, so the caller caps it here.
 
     Block shapes keep the last two dims equal to full array dims
     ((H, D) for q/out, (KVH, D) for pages) — the Mosaic lowering
@@ -128,6 +136,9 @@ def paged_attention(q, k_pages, v_pages, block_tables, lengths):
     """
     b, h, d = q.shape
     npages, page_size, kvh, _ = k_pages.shape
+    if (pages_per_seq is not None
+            and pages_per_seq < block_tables.shape[1]):
+        block_tables = block_tables[:, :pages_per_seq]
     pages_per_seq = block_tables.shape[1]
     scale = 1.0 / math.sqrt(d)
 

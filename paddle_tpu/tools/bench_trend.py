@@ -76,6 +76,19 @@ GATES = {
     "tp_member_death_recovery_s": 60.0,
     "tp_lost_requests": 1.0,         # 0/1+: requests lost in the drill
     "tp_stream_divergence": 1.0,     # 0/1: failover stream != reference
+    # dynamic paged-KV allocator + prefix caching (bench e9). A
+    # ("min", x) gate fails when the value lands BELOW x (the default
+    # scalar form stays an upper bound). Pre-e9 rounds lack the section
+    # — absent metrics are skipped, as for e8.
+    "kv_admit_gain": ("min", 2.0),   # dynamic / static concurrency
+    # the fragmentation DROP: granted-tail waste relative to what the
+    # static one-full-sequence-per-slot layout wastes on the same
+    # workload snapshot (< 1.0 = the allocator reclaimed real memory;
+    # the absolute pct is workload/page-size dependent, the ratio isn't)
+    "kv_frag_vs_static": 1.0,
+    "prefix_prefill_speedup": ("min", 1.0),  # shared-prefix prefill A/B
+    "prefix_hit_rate": ("min", 0.001),  # sharing actually engaged
+    "kv_serving_compiles": 1.0,      # any compile through the allocator
 }
 
 DEFAULT_RATIO_THRESHOLD = 0.9   # per-round e2e_vs_baseline alarm
@@ -279,10 +292,17 @@ def analyze(root, ratio_threshold=DEFAULT_RATIO_THRESHOLD,
     for r in rounds:
         for gate, limit in GATES.items():
             v = (r["metrics"] or {}).get(gate)
-            if v is not None and v >= limit:
+            if v is None:
+                continue
+            if isinstance(limit, tuple):
+                op, bound = limit
+            else:
+                op, bound = "max", limit
+            bad = (v < bound) if op == "min" else (v >= bound)
+            if bad:
                 gate_violations.append({
                     "kind": "gate", "round": r["name"], "metric": gate,
-                    "value": v, "limit": limit})
+                    "value": v, "limit": bound, "op": op})
     return {
         "root": os.path.abspath(root),
         "baseline": ({"device": baseline["device"],
@@ -357,9 +377,10 @@ def render_markdown(report) -> str:
                 f"{e['ratio']}x of best prior ({e['best_prior']:g} -> "
                 f"{e['latest']:g}, factor {e['factor']})")
     for e in report["gate_violations"]:
+        cmp_ = "<" if e.get("op") == "min" else ">="
         lines.append(
             f"- **gate violation** `{e['metric']}` at {e['round']}: "
-            f"{e['value']:g} >= {e['limit']:g}")
+            f"{e['value']:g} {cmp_} {e['limit']:g}")
     if report["incomparable"]:
         lines += ["", "## Incomparable rounds", ""]
         for e in report["incomparable"]:

@@ -1,7 +1,7 @@
 """``python -m paddle_tpu.tools.obs`` — the operator's observability CLI.
 
-Three subcommands over the artifacts the telemetry/perfwatch layers
-leave on disk (and the live process registry, for REPL use):
+Subcommands over the artifacts the telemetry/perfwatch layers leave on
+disk (and the live process registry, for REPL use):
 
 * ``metrics [PATH]`` — pretty-print a metrics snapshot: counters,
   gauges, and percentile summaries for every histogram. ``PATH`` is a
@@ -19,6 +19,13 @@ leave on disk (and the live process registry, for REPL use):
   transitions, the shed/reject counters with their ``{tenant,
   priority}`` attribution, and the autoscaler/brownout decision history
   (flight events) — from the live process or any snapshot/flight dump.
+* ``kv [PATH]`` — the paged-KV allocator view: page-pool occupancy and
+  free-list headroom (``serving.kv_pages_free`` / ``kv_pages_total``),
+  fragmentation (allocated-but-unused granted tail), prefix-cache hit
+  rate and tokens saved, pool-pressure counters (admission deferrals,
+  preemptions), and per-slot granted page counts
+  (``serving.kv_slot_pages{slot=}``) — from the live process or any
+  snapshot/flight dump.
 * ``fleet [PATH]`` — the membership view: per-replica (and per-TP-group)
   state, breaker, assignment, last-heartbeat age, and incarnation from
   the ``fleet.replica_*`` / ``tp.*`` series the router and group members
@@ -89,23 +96,38 @@ def _print_snapshot(snap, out=None):
         out.write("(empty snapshot)\n")
 
 
+def _load_snapshot(path):
+    """Load a metrics snapshot from ``path`` — either a bare
+    ``MetricsRegistry.snapshot()`` JSON or a flight dump (whose embedded
+    snapshot and event ring are unwrapped). Returns ``(snap, events)``
+    (``events`` is None for a bare snapshot) or ``None`` after writing
+    the error to stderr — the caller returns 2. ONE loader for every
+    subcommand, so a dump-format tweak lands in one place."""
+    try:
+        obj = json.load(open(path))
+    except (OSError, ValueError) as e:
+        sys.stderr.write(f"cannot read {path}: {e}\n")
+        return None
+    if isinstance(obj, dict) and "metrics" in obj:    # a flight dump
+        snap, events = obj.get("metrics") or {}, obj.get("events", [])
+    else:                                             # a bare snapshot
+        snap, events = obj, None
+    if not isinstance(snap, dict) or not (
+            {"counters", "gauges", "histograms"} & set(snap)):
+        sys.stderr.write(
+            f"{path} is not a metrics snapshot or flight dump\n")
+        return None
+    return snap, events
+
+
 def cmd_metrics(args) -> int:
     from ..core import telemetry
 
     if args.path:
-        try:
-            obj = json.load(open(args.path))
-        except (OSError, ValueError) as e:
-            sys.stderr.write(f"cannot read {args.path}: {e}\n")
+        loaded = _load_snapshot(args.path)
+        if loaded is None:
             return 2
-        # a flight dump embeds the snapshot under "metrics"
-        snap = obj.get("metrics") if "metrics" in obj else obj
-        if not isinstance(snap, dict) or not (
-                {"counters", "gauges", "histograms"} & set(snap)):
-            sys.stderr.write(
-                f"{args.path} is not a metrics snapshot (expected a "
-                "MetricsRegistry.snapshot() dict or a flight dump)\n")
-            return 2
+        snap, _ = loaded
     else:
         snap = telemetry.registry().snapshot()
     _print_snapshot(snap)
@@ -181,22 +203,10 @@ def cmd_slo(args) -> int:
 
     events = None
     if args.path:
-        try:
-            obj = json.load(open(args.path))
-        except (OSError, ValueError) as e:
-            sys.stderr.write(f"cannot read {args.path}: {e}\n")
+        loaded = _load_snapshot(args.path)
+        if loaded is None:
             return 2
-        if "metrics" in obj:          # a flight dump
-            snap = obj.get("metrics") or {}
-            events = obj.get("events", [])
-        else:                         # a bare registry snapshot
-            snap = obj
-        if not isinstance(snap, dict) or not (
-                {"counters", "gauges", "histograms"} & set(snap)):
-            sys.stderr.write(
-                f"{args.path} is not a metrics snapshot or flight "
-                "dump\n")
-            return 2
+        snap, events = loaded
     else:
         snap = telemetry.registry().snapshot()
         events = [{"kind": e["kind"],
@@ -262,6 +272,53 @@ def cmd_slo(args) -> int:
     return 0
 
 
+def cmd_kv(args) -> int:
+    """Paged-KV allocator view: page-pool occupancy, fragmentation,
+    prefix-cache hit rate, and per-slot granted page counts — from the
+    live process or a snapshot/flight-dump file."""
+    from ..core import perfwatch, telemetry
+
+    if args.path:
+        loaded = _load_snapshot(args.path)
+        if loaded is None:
+            return 2
+        snap, _ = loaded
+    else:
+        snap = telemetry.registry().snapshot()
+    kv = perfwatch.kv_pool_summary(snap)
+    total, free = kv["pages_total"], kv["pages_free"]
+    if total is None:
+        print("kv pool   : (no serving.kv_pages_total gauge recorded — "
+              "no engine ran with telemetry on)")
+    else:
+        used = int(total) - int(free or 0)
+        width = 30
+        fill = int(round(width * used / total)) if total else 0
+        print(f"kv pool   : {used}/{int(total)} pages granted "
+              f"[{'#' * fill}{'.' * (width - fill)}] "
+              f"({int(free or 0)} free)")
+    if kv["bytes_in_use"] is not None:
+        print(f"kv bytes  : {_fmt_num(kv['bytes_in_use'])} in use "
+              f"(page-granular, active slots)")
+    if kv["fragmentation_pct"] is not None:
+        print(f"frag      : {kv['fragmentation_pct']:.1f}% "
+              "allocated-but-unused tail of granted pages")
+    if kv["slot_occupancy"] is not None:
+        print(f"slots     : {kv['slot_occupancy']:.2f} occupancy")
+    hr = kv["prefix_hit_rate"]
+    print(f"prefix    : hit rate "
+          f"{hr if hr is None else format(hr, '.3f')}, "
+          f"{int(kv['prefix_tokens_saved'])} prompt token(s) saved")
+    print(f"pressure  : {int(kv['pool_exhausted'])} admission "
+          f"deferral(s), {int(kv['preempted'])} preemption(s)")
+    if kv["slot_pages"]:
+        print("per-slot granted pages:")
+        for slot in sorted(kv["slot_pages"]):
+            n = kv["slot_pages"][slot]
+            print(f"  slot {slot:<4} {n:>5}  {'#' * min(n, 40)}")
+    return 0
+
+
 def _labels_of(key):
     """``name{k=v,k2=v2}`` → dict of labels (the snapshot's flattened
     series-key format)."""
@@ -281,22 +338,10 @@ def cmd_fleet(args) -> int:
 
     events = None
     if args.path:
-        try:
-            obj = json.load(open(args.path))
-        except (OSError, ValueError) as e:
-            sys.stderr.write(f"cannot read {args.path}: {e}\n")
+        loaded = _load_snapshot(args.path)
+        if loaded is None:
             return 2
-        if "metrics" in obj:          # a flight dump
-            snap = obj.get("metrics") or {}
-            events = obj.get("events", [])
-        else:                         # a bare registry snapshot
-            snap = obj
-        if not isinstance(snap, dict) or not (
-                {"counters", "gauges", "histograms"} & set(snap)):
-            sys.stderr.write(
-                f"{args.path} is not a metrics snapshot or flight "
-                "dump\n")
-            return 2
+        snap, events = loaded
     else:
         snap = telemetry.registry().snapshot()
         events = telemetry.flight_recorder().events()
@@ -489,6 +534,13 @@ def main(argv=None) -> int:
     sp.add_argument("-n", type=int, default=20,
                     help="show at most N decision events")
     sp.set_defaults(fn=cmd_slo)
+    kp = sub.add_parser("kv", help="paged-KV pool occupancy, "
+                                   "fragmentation, prefix hit rate, "
+                                   "per-slot page counts")
+    kp.add_argument("path", nargs="?", default=None,
+                    help="snapshot JSON or flight dump (default: this "
+                         "process's registry)")
+    kp.set_defaults(fn=cmd_kv)
     flp = sub.add_parser("fleet", help="per-replica (and per-TP-group) "
                                        "membership, breaker state, "
                                        "incarnation, heartbeat age")

@@ -167,10 +167,11 @@ def test_second_engine_warmup_counts_as_warmup_not_serving(model):
     compile_watchdog().start().arm()  # the process already served
     c = telemetry.counter("xla.compiles_total")
     warm0 = c.value(phase="warmup")
-    # minimal shape set (1 slot, 1 bucket, no chunking): 2 programs
+    # minimal shape set (1 slot, 1 bucket, no chunking): prefill +
+    # prefix-resume + segment + the CoW page-copy program
     eng2 = _engine(model, max_slots=1, max_len=8, prompt_buckets=(8,))
-    assert eng2.warmup(segment=2)["programs"] == 2
-    assert c.value(phase="warmup") == warm0 + 2
+    assert eng2.warmup(segment=2)["programs"] == 4
+    assert c.value(phase="warmup") == warm0 + 4
     assert c.value(phase="serving") == 0
 
 
