@@ -1419,6 +1419,112 @@ except Exception as e:
     log(f"dynamic paged KV section FAILED: {type(e).__name__}: {e}")
     kv_metrics = {"kv_error": f"{type(e).__name__}: {e}"[:200]}
 
+# --------------------- (e10) disaggregated prefill/decode serving
+# Prefill and decode run on DIFFERENT replicas joined by the
+# fault-tolerant KV page transfer (models/transfer.py): an A/B against
+# a colocated fleet of identical capacity under the same trafficgen
+# mixed long-prompt/short-decode schedule (same seed => bit-identical
+# arrivals). Gated numbers: the transfer hop's own wall time stays
+# < 10% of active processing (transfer_overhead_pct), client TTFT p95
+# under the long-prompt burst stays within 2x of colocated
+# (decode_ttft_p95_ratio — the hop must not queue first tokens behind
+# the wire), and NO request is lost to the hop
+# (transfer_lost_requests).
+xfer_metrics = {}
+try:
+    from paddle_tpu.core import telemetry as _xf_tele
+    from paddle_tpu.models.frontend import (
+        ServingFrontend as _XfFE,
+        latency_summaries as _xf_lat,
+    )
+    from paddle_tpu.models.router import ServingRouter as _XfRouter
+    from paddle_tpu.models.serving import (
+        ContinuousBatchingEngine as _XfCBE,
+    )
+    from paddle_tpu.tools.trafficgen import (
+        TrafficGen as _XfGen,
+        TrafficProfile as _XfProf,
+    )
+
+    if SMOKE:
+        XF_SLOTS, XF_SEG, XF_DUR, XF_RPS = 2, 4, 3.0, 3.0
+        XF_PLEN, XF_NEW = (16, 40), (2, 6)
+    else:
+        XF_SLOTS, XF_SEG, XF_DUR, XF_RPS = 4, 4, 6.0, 6.0
+        XF_PLEN, XF_NEW = (24, 64), (2, 8)
+    log("disaggregated serving: 1 prefill + 2 decode vs 3 colocated "
+        f"replicas, {XF_DUR:g}s schedule at {XF_RPS:g} rps "
+        "(long-prompt burst mid-schedule)...")
+
+    def _xf_run(roles):
+        # fresh registry per arm: each arm's serving.ttft_s population
+        # is exactly its own requests (the decode-side import adoption
+        # records NO attempt-level TTFT sample, so the disagg arm's
+        # percentiles are client-visible submit -> first token)
+        _xf_tele.reset_telemetry()
+        router = _XfRouter(max_failovers=2)
+        for role in roles:
+            e = _XfCBE(model, max_slots=XF_SLOTS, max_len=128,
+                       page_size=32, prompt_buckets=(16, 64), seed=0)
+            router.add_replica(
+                _XfFE(e, max_queue=512, segment=XF_SEG, role=role),
+                warmup=True)
+        gen = _XfGen(_XfProf(
+            duration_s=XF_DUR, base_rps=XF_RPS, diurnal_amplitude=0.0,
+            flash_at_s=XF_DUR / 3.0, flash_duration_s=XF_DUR / 3.0,
+            flash_multiplier=3.0, prompt_len=XF_PLEN, max_new=XF_NEW,
+            vocab_size=cfg.vocab_size), seed=17)
+        st0 = router.stats()
+        rids = gen.replay_into(router, time_scale=0.25)
+        res = router.results(wait=True, timeout_s=600)
+        st1 = router.stats()
+        lost = sum(1 for r in rids if res[r].status != "ok")
+        xh = _xf_tele.histogram("fleet.transfer_s").summary()
+        out = {
+            "requests": len(rids),
+            "lost": lost,
+            "ttft_p95_s": _xf_lat()["ttft_s"]["p95"],
+            "active_s": ((st1["route_s"] + st1["pump_s"])
+                         - (st0["route_s"] + st0["pump_s"])),
+            "transfer_s": (xh["count"] or 0) * (xh["mean"] or 0.0),
+            "transfers": int(_xf_tele.counter(
+                "fleet.transfer_completed").value()),
+        }
+        router.shutdown()
+        return out
+
+    colo = _xf_run(("both", "both", "both"))
+    disagg = _xf_run(("prefill", "decode", "decode"))
+    assert disagg["transfers"] > 0, \
+        "disaggregated arm never engaged the transfer hop"
+    xfer_metrics = {
+        "disagg_requests": disagg["requests"],
+        "disagg_transfers_completed": disagg["transfers"],
+        "transfer_lost_requests": disagg["lost"] + colo["lost"],
+        "transfer_overhead_pct": round(
+            100.0 * disagg["transfer_s"] / disagg["active_s"]
+            if disagg["active_s"] > 0 else 0.0, 3),
+        "decode_ttft_p95_ms": round(
+            1e3 * (disagg["ttft_p95_s"] or 0.0), 2),
+        "colocated_ttft_p95_ms": round(
+            1e3 * (colo["ttft_p95_s"] or 0.0), 2),
+        "decode_ttft_p95_ratio": round(
+            disagg["ttft_p95_s"] / colo["ttft_p95_s"], 3)
+            if colo["ttft_p95_s"] else None,
+    }
+    log(f"disaggregated serving: {disagg['requests']} requests, "
+        f"{disagg['transfers']} page transfers, "
+        f"{xfer_metrics['transfer_lost_requests']} lost (gate: 0), "
+        f"transfer hop {xfer_metrics['transfer_overhead_pct']}% of "
+        f"active processing (gate < 10%), TTFT p95 "
+        f"{xfer_metrics['decode_ttft_p95_ms']}ms disagg vs "
+        f"{xfer_metrics['colocated_ttft_p95_ms']}ms colocated (ratio "
+        f"{xfer_metrics['decode_ttft_p95_ratio']}, gate < 2)")
+except Exception as e:
+    log(f"disaggregated serving section FAILED: "
+        f"{type(e).__name__}: {e}")
+    xfer_metrics = {"xfer_error": f"{type(e).__name__}: {e}"[:200]}
+
 # ------------------------------------------------------- (f) op microbench
 # Per-op regression gate (reference: tools/ci_op_benchmark.sh relative
 # check): ~20 hot ops + eager dispatch overhead, compared against the
@@ -1514,6 +1620,7 @@ result = {
     **ov_metrics,
     **tp_metrics,
     **kv_metrics,
+    **xfer_metrics,
     "op_bench_us": op_results,
     "op_bench_vs_baseline": op_vs_baseline,
     "op_bench_regressions": op_regressions,

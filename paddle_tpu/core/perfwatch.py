@@ -193,6 +193,8 @@ def kv_pool_summary(snapshot=None) -> dict:
     return {
         "pages_total": gauges.get("serving.kv_pages_total"),
         "pages_free": gauges.get("serving.kv_pages_free"),
+        "pages_pinned_export": gauges.get(
+            "serving.kv_pages_pinned_export"),
         "bytes_in_use": gauges.get("serving.kv_bytes_in_use"),
         "slot_occupancy": gauges.get("serving.kv_slot_occupancy"),
         "fragmentation_pct": gauges.get("serving.kv_fragmentation_pct"),

@@ -137,10 +137,11 @@ class _Pending:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "priority", "deadline",
                  "cost", "seq", "token_base", "trace", "tenant", "vft",
-                 "t0m", "t0w")
+                 "t0m", "t0w", "hold_kv", "kv_import")
 
     def __init__(self, rid, prompt, max_new_tokens, priority, deadline,
-                 seq, token_base=0, trace=None, tenant=None, vft=0.0):
+                 seq, token_base=0, trace=None, tenant=None, vft=0.0,
+                 hold_kv=False, kv_import=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
@@ -155,6 +156,8 @@ class _Pending:
         self.vft = float(vft)           # WFQ virtual finish tag
         self.t0m = time.monotonic()     # queue-wait anchor
         self.t0w = time.time()  # wall-clock: x-process trace epoch
+        self.hold_kv = bool(hold_kv)    # disaggregated prefill leg
+        self.kv_import = kv_import      # adopt this completed KV import
 
     def __lt__(self, other):
         return ((-self.priority, self.vft, self.seq)
@@ -185,8 +188,18 @@ class ServingFrontend:
                  default_max_new_tokens=64, segment=16, breaker=None,
                  breaker_threshold=5, breaker_cooldown_s=30.0,
                  watchdog=None, watch_name="serving.step", slo=None,
-                 qos=None, brownout=None):
+                 qos=None, brownout=None, role="both"):
         self.engine = engine
+        # disaggregation role this replica declares to the fleet router:
+        # "prefill" (prompt leg only), "decode" (adopts transferred KV),
+        # or "both" (colocated — the default, and the pre-disagg
+        # behavior). Advisory: the ENGINE serves whatever arrives; the
+        # router's candidate filter is what enforces pool membership,
+        # so a role mismatch degrades to colocated serving, never loss.
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(f"role must be prefill|decode|both, "
+                             f"got {role!r}")
+        self.role = role
         # SLO monitor (perfwatch): declared TTFT / per-token objectives
         # evaluated over the process registry histograms. Always present
         # (status() is cheap and gated); shedding only ever engages
@@ -283,7 +296,8 @@ class ServingFrontend:
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
                deadline_s=None, rid=None, token_base=0,
-               trace=None, tenant=None) -> int:
+               trace=None, tenant=None, hold_kv=False,
+               kv_import=None) -> int:
         """Admit one request; returns its rid. Never raises for a bad or
         shed request — the verdict lands in ``results()`` as status
         ``rejected`` (admission control / malformed / tenant over
@@ -384,7 +398,8 @@ class ServingFrontend:
                          (deadline_s if isinstance(deadline_s, Deadline)
                           else Deadline(deadline_s)), next(self._seq),
                          token_base=int(token_base), trace=trace,
-                         tenant=tenant)
+                         tenant=tenant, hold_kv=hold_kv,
+                         kv_import=kv_import)
         if telemetry.enabled():
             telemetry.trace_event("serving.submit", trace=trace, rid=rid,
                                   prompt_tokens=int(prompt.size),
@@ -508,7 +523,9 @@ class ServingFrontend:
                                      rid=entry.rid,
                                      token_base=entry.token_base,
                                      trace=entry.trace,
-                                     tenant=entry.tenant)
+                                     tenant=entry.tenant,
+                                     hold_kv=entry.hold_kv,
+                                     kv_import=entry.kv_import)
             # TTFT anchors at frontend SUBMIT time, not engine admission
             # — queue wait is part of the latency a client sees
             req.t_submit = entry.t0m
@@ -612,6 +629,34 @@ class ServingFrontend:
                                      token_base=req.token_base)
             return True
         return False
+
+    # --------------------------------------- KV page transfer passthrough
+    # The router drives the prefill→decode handoff against frontends
+    # (local here, RemoteFrontend stubs in a fleet); these delegate to
+    # the engine's primitive surface so both sides expose one API.
+
+    def export_pages(self, rid):
+        """Mint (or re-serve) the KV transfer ticket for ``rid``'s held
+        prefill pages (see ``ContinuousBatchingEngine.export_pages``)."""
+        return self.engine.export_pages(rid)
+
+    def transfer_chunk(self, ticket, idx):
+        """Serve one CRC-framed chunk of a live export."""
+        return self.engine.transfer_chunk(ticket, idx)
+
+    def import_kv_chunk(self, meta, idx, payk, payv, crc):
+        """Land one chunk of an inbound transfer (idempotent by
+        ticket + index)."""
+        return self.engine.import_kv_chunk(meta, idx, payk, payv, crc)
+
+    def release_export(self, ticket) -> bool:
+        """Drop a finished/abandoned export's page pin (idempotent)."""
+        return self.engine.release_export(ticket)
+
+    def drop_import(self, ticket) -> bool:
+        """Abandon a partial inbound transfer, freeing its local page
+        grants (idempotent)."""
+        return self.engine.drop_import(ticket)
 
     # ------------------------------------------------------------ shutdown
 
@@ -719,6 +764,7 @@ class ServingFrontend:
         return {
             "state": state,
             "ready": self.ready(),
+            "role": self.role,
             "breaker": breaker_state,
             "breaker_failures": self.breaker.failures,
             "draining": self._draining,

@@ -24,6 +24,15 @@ Three record types, CRC-framed, append-only:
   client resubmitting a retired rid after a leader change gets the
   cached result, not a duplicate execution (bounded by
   ``retired_keep``).
+* **HANDOFF** — the disaggregated prefill→decode hop in flight for one
+  rid (source replica, transfer ticket, the prefill-sampled first
+  token, prefill length). Durable (admit-grade) BEFORE the decode
+  dispatch acks, cleared (``done``) once the decode replica owns the
+  request: a standby's ``take_over()`` re-drives exactly the window in
+  which the hop could have been lost — never twice, because the clear
+  record (or the retire) erases it. Pre-handoff epoch files carry no
+  such records and replay unchanged; decode is ``rec.get``-tolerant
+  like the tenant-less ADMIT, so mixed-version fleets replay cleanly.
 
 Framing: ``[u32 length][u32 crc32][payload]`` per record, payload in the
 RPC transport's in-memory container codec (tensors as dtype/shape-tagged
@@ -205,7 +214,9 @@ class RequestJournal:
             if self._closed:
                 return False
             self._buffer.append(frame)
-            if rec.get("t") == "admit":
+            if rec.get("t") in ("admit", "handoff"):
+                # both are admit-grade: a HANDOFF must be durable before
+                # the decode dispatch acks (see flush's fsync policy)
                 self._buffer_admit = True
             self.records += 1
         self.write_s += time.monotonic() - t0
@@ -264,6 +275,48 @@ class RequestJournal:
             self.progress_records += 1
         return True
 
+    def handoff(self, rid, source=None, ticket=None, first_token=None,
+                prefill_len=0, dest=None) -> bool:
+        """Journal a prefill→decode handoff in flight for a live rid —
+        durable before the decode dispatch acks (the router flushes the
+        batch like an ADMIT), so a router crash between "prefill done"
+        and "decode replica owns it" leaves a record ``take_over()``
+        re-drives exactly once."""
+        rid = int(rid)
+        with self._lock:
+            state = self._live.get(rid)
+            if state is None:
+                return False
+            rec = {
+                "t": "handoff", "rid": rid, "source": source,
+                "ticket": ticket,
+                "first_token": (None if first_token is None
+                                else int(first_token)),
+                "prefill_len": int(prefill_len), "dest": dest,
+            }
+            if not self._append(rec):
+                return False
+            state["handoff"] = {k: rec[k] for k in
+                                ("source", "ticket", "first_token",
+                                 "prefill_len", "dest")}
+        return True
+
+    def handoff_done(self, rid) -> bool:
+        """Clear a journaled handoff: the decode replica accepted the
+        request (or the router re-prefilled it), so a takeover must NOT
+        re-drive the hop again — from here, normal PROGRESS/RETIRE
+        records cover recovery."""
+        rid = int(rid)
+        with self._lock:
+            state = self._live.get(rid)
+            if state is None or state.get("handoff") is None:
+                return False
+            if not self._append({"t": "handoff", "rid": rid,
+                                 "done": True}):
+                return False
+            state.pop("handoff", None)
+        return True
+
     def retire(self, rid, status, tokens=None, reason=None) -> bool:
         """Journal the terminal verdict: GCs the live record (compaction
         drops everything about the rid except this) and feeds the
@@ -299,9 +352,11 @@ class RequestJournal:
         at step boundaries — batched, off the decode hot path.
 
         fsync policy (``fsync=True`` deployments): only a batch
-        carrying an ADMIT takes the disk barrier — that is the record
-        whose durability is a contract (``submit()`` must not ack a rid
-        the journal could lose even to a machine crash). PROGRESS/
+        carrying an ADMIT or a HANDOFF takes the disk barrier — those
+        are the records whose durability is a contract (``submit()``
+        must not ack a rid the journal could lose even to a machine
+        crash, and a prefill→decode hop must not ack the decode
+        dispatch over a record a machine crash could lose). PROGRESS/
         RETIRE batches are written without it: losing an unsynced
         progress checkpoint only makes recovery replay from the prior
         one (bit-identical by the key-stream contract), and losing a
@@ -346,10 +401,14 @@ class RequestJournal:
                 "max_new": state["max_new"], "prio": state["prio"],
                 "deadline_s": state["deadline_s"],
                 "admit_wall": state["admit_wall"],
-                "hedge": state["hedge"]}))
+                "hedge": state["hedge"],
+                "tenant": state.get("tenant")}))
             if len(state["emitted"]):
                 frames.append(self._frame({"t": "progress", "rid": rid,
                                            "emitted": state["emitted"]}))
+            if state.get("handoff") is not None:
+                frames.append(self._frame({"t": "handoff", "rid": rid,
+                                           **state["handoff"]}))
         for rid, (status, tokens, reason) in self._retired.items():
             frames.append(self._frame({"t": "retire", "rid": rid,
                                        "status": status, "tokens": tokens,
@@ -424,6 +483,22 @@ class RequestJournal:
                 if len(emitted) > len(state["emitted"]):
                     state["emitted"] = emitted
                     self._progress_len[rid] = len(emitted)
+        elif t == "handoff":
+            state = self._live.get(rid)
+            if state is not None:
+                if rec.get("done"):
+                    state.pop("handoff", None)
+                else:
+                    # rec.get-tolerant like the tenant-less ADMIT: a
+                    # field an older writer never journaled replays as
+                    # None, not a KeyError
+                    state["handoff"] = {
+                        "source": rec.get("source"),
+                        "ticket": rec.get("ticket"),
+                        "first_token": rec.get("first_token"),
+                        "prefill_len": int(rec.get("prefill_len") or 0),
+                        "dest": rec.get("dest"),
+                    }
         elif t == "retire":
             self._apply_retire(rid, str(rec["status"]),
                                np.asarray(rec["tokens"], np.int32),

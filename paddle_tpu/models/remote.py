@@ -76,7 +76,12 @@ _servers_lock = threading.Lock()
 # block behind a decode segment, outlive the new leader's repin, and
 # then mutate state the new leader already inventoried
 _MUTATING_METHODS = frozenset(
-    {"submit", "cancel", "shutdown", "warmup", "repin"})
+    {"submit", "cancel", "shutdown", "warmup", "repin",
+     # KV page transfer: every leg either rebinds the engine's device
+     # pools (export/import dispatch donated programs) or moves page
+     # refcounts — all of it races the pump thread without the lock
+     "export_pages", "transfer_chunk", "import_kv_chunk",
+     "release_export", "drop_import"})
 
 
 def _call(server, method, *args, _fence=None, **kwargs):
@@ -248,13 +253,15 @@ class ReplicaServer:
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
                deadline_s=None, rid=None, token_base=0, trace=None,
-               tenant=None):
+               tenant=None, hold_kv=False, kv_import=None):
         """Rid-idempotent admission: a rid still LIVE here (pending or
         finished-but-unfetched) is a duplicate of a retried/redelivered
         send — acknowledge it without double-enqueueing. ``trace`` is
         the router-minted telemetry trace id off the RPC envelope; the
         frontend's spans in THIS process stitch under it. ``tenant``
-        rides the same envelope into the frontend's QoS lane."""
+        rides the same envelope into the frontend's QoS lane;
+        ``hold_kv``/``kv_import`` are the disaggregation legs (see
+        ``ServingFrontend.submit``)."""
         with self._lock:
             if rid is not None and rid in self._live:
                 bump_counter("serving.dup_submit")
@@ -263,7 +270,8 @@ class ReplicaServer:
                 np.asarray(prompt, np.int32),
                 max_new_tokens=max_new_tokens, priority=priority,
                 deadline_s=deadline_s, rid=rid, token_base=token_base,
-                trace=trace, tenant=tenant)
+                trace=trace, tenant=tenant, hold_kv=hold_kv,
+                kv_import=kv_import)
             self._live.add(got)
             return got
 
@@ -310,6 +318,33 @@ class ReplicaServer:
     def cancel(self, rid) -> bool:
         with self._lock:
             return bool(self.frontend.cancel(rid))
+
+    # ------------------------------- KV page transfer (disaggregation)
+    # All legs run under the server lock (they rebind the engine's
+    # donated device pools / move page refcounts, racing the pump);
+    # _call additionally fences them as mutating methods.
+
+    def export_pages(self, rid):
+        with self._lock:
+            return self.frontend.export_pages(rid)
+
+    def transfer_chunk(self, ticket, idx):
+        with self._lock:
+            return self.frontend.transfer_chunk(ticket, idx)
+
+    def import_kv_chunk(self, meta, idx, payk, payv, crc):
+        with self._lock:
+            return self.frontend.import_kv_chunk(
+                meta, int(idx), np.asarray(payk), np.asarray(payv),
+                int(crc))
+
+    def release_export(self, ticket) -> bool:
+        with self._lock:
+            return bool(self.frontend.release_export(ticket))
+
+    def drop_import(self, ticket) -> bool:
+        with self._lock:
+            return bool(self.frontend.drop_import(ticket))
 
     def health(self) -> dict:
         # lock-free: the snapshot, not the live frontend — a probe must
@@ -456,7 +491,7 @@ class RemoteFrontend:
 
     def submit(self, prompt, max_new_tokens=None, priority=0,
                deadline_s=None, rid=None, token_base=0, trace=None,
-               tenant=None):
+               tenant=None, hold_kv=False, kv_import=None):
         # a Deadline is monotonic and process-local: ship the REMAINING
         # seconds; the replica re-anchors it on its own clock (queue wait
         # there still counts against the budget). The telemetry trace id
@@ -469,7 +504,8 @@ class RemoteFrontend:
                          max_new_tokens=max_new_tokens,
                          priority=int(priority), deadline_s=deadline_s,
                          rid=rid, token_base=int(token_base),
-                         trace=trace, tenant=tenant)
+                         trace=trace, tenant=tenant,
+                         hold_kv=bool(hold_kv), kv_import=kv_import)
 
     def results(self, wait=False, timeout=None) -> dict:
         """Pop terminal results. ``wait=True`` polls until the replica
@@ -504,6 +540,28 @@ class RemoteFrontend:
 
     def cancel(self, rid) -> bool:
         return bool(self._rpc("cancel", rid))
+
+    # ------------------------------- KV page transfer (disaggregation)
+    # One RPC per leg; the incarnation pin in _rpc is what turns a
+    # respawned source into typed ServingUnavailable mid-transfer —
+    # models/transfer.py classifies that as "re-prefill", never
+    # silent corruption.
+
+    def export_pages(self, rid):
+        return self._rpc("export_pages", rid)
+
+    def transfer_chunk(self, ticket, idx):
+        return self._rpc("transfer_chunk", ticket, int(idx))
+
+    def import_kv_chunk(self, meta, idx, payk, payv, crc):
+        return self._rpc("import_kv_chunk", dict(meta), int(idx),
+                         np.asarray(payk), np.asarray(payv), int(crc))
+
+    def release_export(self, ticket) -> bool:
+        return bool(self._rpc("release_export", ticket))
+
+    def drop_import(self, ticket) -> bool:
+        return bool(self._rpc("drop_import", ticket))
 
     def set_fence(self, fence):
         """Pin the leader fencing token every subsequent call carries —

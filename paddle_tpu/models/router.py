@@ -69,6 +69,25 @@ a single point of failure:
   live request table AND the journal's retired cache, so a client that
   resubmits after a leader change gets the same request (or its cached
   verdict), never a duplicate execution.
+
+**Disaggregated prefill/decode (this PR).** Replicas declare a serving
+role (``ServingFrontend(role=...)``: ``prefill`` / ``decode`` /
+``both``). When the fleet has both pools, a fresh request runs as two
+legs: a one-token prefill on the prefill pool (the engine HOLDS its KV
+pages at retirement), then a chunked, CRC-framed, resumable page
+transfer (``models/transfer.py``) to a decode replica, which adopts the
+pages and produces the rest of the stream — bit-identical to the
+colocated run, because the first token is carried over and the decode
+leg's key stream continues at index 1 exactly as a colocated second
+token would. The failure matrix is typed end to end: source loss at any
+point re-prefills on a survivor (``TransferSourceError`` /
+``_abandon_transfer``); destination failures charge a bounded transfer
+budget (``max_transfer_retries``, exhaustion retires ``failed`` —
+never a hang); and a router crash mid-hop is covered by the journal's
+HANDOFF record (admit-grade durable BEFORE the decode dispatch acks),
+which ``take_over()`` re-drives exactly once via the source's
+rid-idempotent export. Roles are ADVISORY: any pool imbalance degrades
+requests to colocated serving, never to loss.
 """
 from __future__ import annotations
 
@@ -91,6 +110,12 @@ from ..core.resilience import (
 )
 from .frontend import RequestResult, latency_summaries
 from .qos import QoSPolicy, tenant_label, tenant_summaries
+from .transfer import (
+    TransferDestError,
+    TransferNoCapacity,
+    TransferSourceError,
+    transfer_pages,
+)
 
 __all__ = ["ServingRouter", "launch_fleet"]
 
@@ -116,6 +141,16 @@ _M_REP_INC = telemetry.gauge(
     "fleet.replica_incarnation", "per-replica incarnation marker: the "
     "{inc=} label carries the replica server's pinned incarnation "
     "prefix (value is always 1)")
+_M_REP_ROLE = telemetry.gauge(
+    "fleet.replica_role", "per-replica serving role marker: the "
+    "{role=} label carries prefill/decode/both (value is always 1)")
+_M_XFER_TICKET = telemetry.gauge(
+    "fleet.transfer_ticket", "live KV page-transfer tickets, one "
+    "labeled point per handoff ({rid=,ticket=,src=}; 1 in flight / "
+    "0 resolved)")
+_M_XFER_INFLIGHT = telemetry.gauge(
+    "fleet.transfer_inflight", "prefill→decode page transfers "
+    "currently in flight (awaiting a destination or mid-wire)")
 
 # a call into a replica failing with one of these is REPLICA-level
 # evidence (process dead, transport down, server deregistered), not a
@@ -128,13 +163,15 @@ class _Replica:
     """One registered replica: frontend + router-side health state."""
 
     __slots__ = ("id", "frontend", "breaker", "state", "hb", "assigned",
-                 "probes", "served", "h_cache", "h_ts", "p_cache")
+                 "probes", "served", "h_cache", "h_ts", "p_cache",
+                 "role")
 
     def __init__(self, rep_id, frontend, breaker):
         self.id = rep_id
         self.frontend = frontend
         self.breaker = breaker
         self.state = "up"            # up | draining | dead
+        self.role = "both"           # prefill | decode | both (advisory)
         self.hb = None               # store heartbeat handle
         self.assigned: set = set()   # rids currently pending here
         self.probes: set = set()     # rids riding a half-open probe slot
@@ -149,7 +186,8 @@ class _FleetRequest:
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "priority", "deadline",
                  "emitted", "live", "excluded", "failovers", "hedged",
-                 "discard", "deadline_s", "trace", "tenant")
+                 "discard", "deadline_s", "trace", "tenant", "phase",
+                 "transfers")
 
     def __init__(self, rid, prompt, max_new_tokens, priority, deadline,
                  hedged, deadline_s=None, tenant=None):
@@ -177,6 +215,13 @@ class _FleetRequest:
         self.discard: set = set()
         self.failovers = 0
         self.hedged = bool(hedged)
+        # disaggregated prefill/decode: None = colocated (the default
+        # and every fallback), "prefill" = the one-token prefill leg is
+        # out, "decode" = prefill retired, the KV handoff / decode leg
+        # owns the request. Router-volatile — the journal's HANDOFF
+        # record (not this field) is what survives a crash.
+        self.phase = None
+        self.transfers = 0           # failed transfer attempts (budget)
 
 
 class ServingRouter:
@@ -203,10 +248,15 @@ class ServingRouter:
                  heartbeat_interval=None, breaker_threshold=3,
                  breaker_cooldown_s=30.0, health_ttl=0.05,
                  journal=None, journal_root=None, leader_lease=None,
-                 standby=False, qos=None):
+                 standby=False, qos=None, max_transfer_retries=3):
         from ..core.flags import flag
 
         self.max_failovers = int(max_failovers)
+        # bounded budget for the prefill→decode page-transfer leg: a
+        # destination that keeps failing imports charges this, and
+        # exhaustion retires the request "failed" — a handoff can
+        # degrade or fail, it can never hang
+        self.max_transfer_retries = int(max_transfer_retries)
         self.health_ttl = float(health_ttl)  # remote snapshot reuse window
         self.hedge_default = bool(hedge)
         self.default_max_new_tokens = int(default_max_new_tokens)
@@ -228,6 +278,10 @@ class ServingRouter:
         self._requests: dict[int, _FleetRequest] = {}
         self._results: dict[int, RequestResult] = {}
         self._parked: list[int] = []
+        # live prefill→decode handoffs: rid -> {"ticket", "source"}.
+        # An entry exists from export (HANDOFF journaled) until the
+        # decode leg dispatches (handoff_done) or the hop is abandoned.
+        self._transfers: dict[int, dict] = {}
         self._rids = itertools.count()
         self._rep_ids = itertools.count()
         self._engine_fingerprint = None
@@ -374,6 +428,15 @@ class ServingRouter:
             f"fleet.replica.{rep_id}",
             failure_threshold=self.breaker_threshold,
             cooldown_s=self.breaker_cooldown_s))
+        # learn the replica's declared serving role (prefill / decode /
+        # both) from its health surface. ADVISORY: the candidate filter
+        # prefers matching roles but never excludes on it, so a role
+        # mismatch degrades to colocated serving, never to loss — and a
+        # frontend predating the role field registers as "both".
+        with contextlib.suppress(Exception):
+            role = (frontend.health() or {}).get("role")
+            if role in ("prefill", "decode", "both"):
+                rep.role = role
         if self._store is not None:
             self._store.set(f"{self._prefix}/member/{rep_id}", b"up")
             if not getattr(frontend, "is_remote", False):
@@ -518,6 +581,21 @@ class ServingRouter:
             if freq.live:
                 continue  # a hedge copy is still running elsewhere
             self._failover(freq, None, f"replica {rep.id} dead: {reason}")
+        # the SAME pass sweeps requests mid-handoff: a rid whose page
+        # transfer sources from this replica is no longer in
+        # rep.assigned (its prefill leg already retired), so the loop
+        # above never sees it — without this sweep a ticket in flight
+        # would strand its request until the transfer's own wire error
+        # surfaced, or forever if no transfer attempt was running
+        for rid, xfer in list(self._transfers.items()):
+            if xfer["source"] != rep.id:
+                continue
+            freq = self._requests.get(rid)
+            if freq is None:
+                self._clear_transfer(rid)
+                continue
+            self._abandon_transfer(
+                freq, f"source replica {rep.id} dead: {reason}")
 
     # --------------------------------------------------------- dispatch
 
@@ -547,11 +625,36 @@ class ServingRouter:
                 rep.h_cache, rep.h_ts = snap, time.monotonic()
         return rep.h_cache
 
+    def _disagg_active(self) -> bool:
+        """Disaggregated prefill/decode serving is on iff at least one
+        up replica declared role=prefill AND at least one up replica
+        can decode (role decode/both). Evaluated per admission, so a
+        pool that loses its last prefill (or decode) replica degrades
+        NEW requests to colocated serving instead of wedging them."""
+        has_prefill = has_decode = False
+        for rep in self._replicas.values():
+            if rep.state != "up":
+                continue
+            if rep.role == "prefill":
+                has_prefill = True
+            if rep.role in ("decode", "both"):
+                has_decode = True
+        return has_prefill and has_decode
+
     def _candidates(self, freq):
         """Eligible replicas for this request, best (least loaded)
         first. Closed-breaker replicas are preferred; half-open ones are
         used only when no closed one is eligible, and routing there
-        consumes the breaker's probe slot (the request IS the probe)."""
+        consumes the breaker's probe slot (the request IS the probe).
+
+        A disaggregated request's phase steers the pool: the prefill
+        leg prefers role prefill/both replicas, everything else (decode
+        legs AND colocated requests) prefers decode/both. The steer is
+        a sort preference, not a filter — when no matching-role replica
+        is eligible the request lands on whatever is, degrading to
+        colocated serving rather than starving."""
+        want = (("prefill", "both") if freq.phase == "prefill"
+                else ("decode", "both"))
         closed, half_open = [], []
         for rep in list(self._replicas.values()):
             if rep.state != "up" or rep.id in freq.excluded:
@@ -591,11 +694,12 @@ class ServingRouter:
             if not h["ready"]:
                 continue
             (closed if state == CircuitBreaker.CLOSED
-             else half_open).append((self._score(h), rep.id))
+             else half_open).append(
+                 ((rep.role not in want, self._score(h)), rep.id))
         pool = sorted(closed) or sorted(half_open)
         return pool
 
-    def _submit_to(self, freq, rep_id):
+    def _submit_to(self, freq, rep_id, kv_import=None):
         rep = self._replicas[rep_id]
         if rep.state != "up":
             # a candidate killed mid-dispatch (transport error on an
@@ -605,15 +709,30 @@ class ServingRouter:
         if probe and not rep.breaker.allow():
             return False
         k = len(freq.emitted)
-        prompt = (np.concatenate([freq.prompt, freq.emitted])
-                  if k else freq.prompt)
+        if freq.phase == "prefill":
+            # the PREFILL leg: full prompt, exactly one token, and the
+            # engine holds the request's KV pages for export at retire
+            # instead of recycling them
+            args = (freq.prompt, 1)
+            extra = {"token_base": 0, "hold_kv": True}
+        elif kv_import is not None:
+            # the DECODE leg of a completed handoff: the full budget
+            # from token 0, seeded by the imported pages — the engine
+            # adopts them and skips the prefill pass entirely
+            args = (freq.prompt, freq.max_new_tokens)
+            extra = {"token_base": 0, "kv_import": kv_import}
+        else:
+            prompt = (np.concatenate([freq.prompt, freq.emitted])
+                      if k else freq.prompt)
+            args = (prompt, freq.max_new_tokens - k)
+            extra = {"token_base": k}
         t0 = time.monotonic()
         try:
-            rep.frontend.submit(prompt, freq.max_new_tokens - k,
+            rep.frontend.submit(args[0], args[1],
                                 priority=freq.priority,
                                 deadline_s=freq.deadline, rid=freq.rid,
-                                token_base=k, trace=freq.trace,
-                                tenant=freq.tenant)
+                                trace=freq.trace,
+                                tenant=freq.tenant, **extra)
             self._pump_s += time.monotonic() - t0
         except StaleLeaderError as e:
             self._pump_s += time.monotonic() - t0
@@ -639,7 +758,8 @@ class ServingRouter:
             # replica placement (and failover path) off
             telemetry.trace_event("fleet.dispatch", trace=freq.trace,
                                   rid=freq.rid, replica=rep_id,
-                                  token_base=k)
+                                  token_base=extra["token_base"],
+                                  phase=freq.phase)
         return True
 
     def _dispatch(self, freq):
@@ -795,6 +915,13 @@ class ServingRouter:
                              tenant=tenant)
         self._requests[rid] = freq
         self._tenant_out[tenant] = held + cost
+        if (not freq.hedged and max_new > 1 and self._disagg_active()):
+            # disaggregated flow: the first leg is a one-token prefill
+            # on the prefill pool; the KV pages hand off to a decode
+            # replica at its retirement. Hedged requests stay colocated
+            # (two prefill arms would race one another's handoff), as
+            # do single-token requests (there is nothing to decode).
+            freq.phase = "prefill"
         t0 = time.monotonic()
         pump0 = self._pump_s  # frontend.submit time lands in pump_s
         if self._journal is not None:
@@ -866,6 +993,7 @@ class ServingRouter:
         pump0 = self._pump_s  # every frontend call below adds to pump_s
         self._sweep_liveness()
         self._route_parked()
+        self._pump_transfers()
         for rep in list(self._replicas.values()):
             if rep.state != "up":
                 continue
@@ -1080,6 +1208,11 @@ class ServingRouter:
     def _retire_ok(self, rep, freq, res):
         self._note_verdict(rep, freq.rid, ok=True)
         rep.served += 1
+        if freq.phase == "prefill":
+            # not a client verdict: the one-token prefill leg finished
+            # and the replica is holding its KV pages — begin the hop
+            self._begin_handoff(rep, freq, res)
+            return
         tokens = self._combine(freq, res)
         if tokens is None:
             self._failover(freq, None,
@@ -1179,6 +1312,11 @@ class ServingRouter:
             self._journal.retire(freq.rid, status, tokens, reason)
         with contextlib.suppress(ValueError):
             self._parked.remove(freq.rid)
+        if freq.rid in self._transfers:
+            # delivered mid-hop (cancel, timeout, exhausted budget):
+            # free the source's export pin and the ticket gauge
+            self._release_export(self._transfers[freq.rid])
+            self._clear_transfer(freq.rid)
         for rep_id in list(freq.live):
             rep = self._replicas.get(rep_id)
             if rep is None:
@@ -1205,6 +1343,236 @@ class ServingRouter:
                     # live replica only means the copy runs to completion
                     bump_counter("fleet.cancel_error")
         freq.live.clear()
+
+    # --------------------------------------- prefill→decode handoff
+
+    def _begin_handoff(self, rep, freq, res):
+        """A prefill leg retired ``ok`` on ``rep``: export its KV hold
+        as a transfer ticket, journal the hop (HANDOFF is admit-grade
+        durable BEFORE any decode dispatch can ack), then drive the
+        page transfer. Every failure here degrades to a colocated
+        replay — the known first token keeps the replayed stream
+        bit-identical."""
+        tokens = self._combine(freq, res)
+        if tokens is None or not len(tokens):
+            freq.phase = None
+            bump_counter("fleet.handoff_no_hold")
+            self._failover(freq, None,
+                           f"prefill on replica {rep.id} surfaced no "
+                           "token; replaying colocated", charge=False)
+            return
+        try:
+            ticket = rep.frontend.export_pages(freq.rid)
+        except StaleLeaderError as e:
+            self._stand_down(str(e))
+            return
+        except _TRANSPORT_ERRORS as e:
+            # the source died between retiring the prefill and the
+            # export: its pages died with it — plain failover
+            self._kill_replica(rep, f"export transport error: {e!r}")
+            if freq.rid in self._requests:
+                freq.phase = None
+                self._failover(
+                    freq, None,
+                    f"prefill source {rep.id} died before export")
+            return
+        if ticket is None:
+            # the engine holds no pages for the rid (evicted, or the
+            # prefill surfaced no first token): colocated replay
+            freq.phase = None
+            bump_counter("fleet.handoff_no_hold")
+            self._failover(freq, None,
+                           f"replica {rep.id} has no KV hold for the "
+                           "handoff; replaying colocated", charge=False)
+            return
+        freq.phase = "decode"
+        freq.emitted = np.asarray(tokens, np.int32)
+        if self._journal is not None:
+            # durable BEFORE the decode dispatch acks: a router crash
+            # anywhere in the hop leaves a record take_over() re-drives
+            # exactly once (handoff_done, or the retire, erases it)
+            self._journal.handoff(freq.rid, source=rep.id,
+                                  ticket=ticket["ticket"],
+                                  first_token=int(freq.emitted[0]),
+                                  prefill_len=int(freq.prompt.size))
+            self._journal.flush()
+        self._transfers[freq.rid] = {"ticket": ticket, "source": rep.id}
+        bump_counter("fleet.transfer_started")
+        if telemetry.enabled():
+            _M_XFER_TICKET.set(1, rid=str(freq.rid),
+                               ticket=str(ticket["ticket"])[:8],
+                               src=str(rep.id))
+            _M_XFER_INFLIGHT.set(len(self._transfers))
+            telemetry.trace_event("fleet.handoff", trace=freq.trace,
+                                  rid=freq.rid, source=rep.id,
+                                  ticket=ticket["ticket"],
+                                  pages=ticket["n_pages"])
+        self._advance_handoff(freq)
+
+    def _advance_handoff(self, freq):
+        """Drive one live handoff forward: pick a decode destination,
+        run the chunked CRC-framed transfer (``models/transfer.py``),
+        dispatch the decode leg. No eligible destination parks the hop
+        (``_pump_transfers`` retries it every step); destination
+        failures charge the bounded transfer budget; source loss
+        abandons the hop and re-prefills."""
+        xfer = self._transfers.get(freq.rid)
+        if xfer is None:
+            return
+        src = self._replicas.get(xfer["source"])
+        if src is None or src.state != "up":
+            self._abandon_transfer(
+                freq, f"source replica {xfer['source']} died before "
+                "the transfer")
+            return
+        ticket = xfer["ticket"]
+        # phase=="decode" steers _candidates to the decode pool; the
+        # SOURCE is excluded explicitly — its pages are already there,
+        # and importing onto it would collide with its own export hold
+        pool = [c for c in self._candidates(freq) if c[1] != src.id]
+        if freq.rid not in self._requests:
+            return  # a kill inside _candidates resolved the request
+        if not pool:
+            # no eligible destination AT ALL (breakers open, decode
+            # pool dead): charge the transfer budget so the hop cannot
+            # wait forever — on exhaustion degrade to a colocated
+            # re-prefill (zero loss; the source's prefix cache makes
+            # the replay cheap). TRANSIENT gaps (a cooldown expiring,
+            # a scale-out landing) resume on an earlier retry.
+            freq.transfers += 1
+            if freq.transfers > self.max_transfer_retries:
+                self._abandon_transfer(
+                    freq, "no eligible decode destination")
+            return
+        dest = None
+        for _, dest_id in pool:
+            cand = self._replicas[dest_id]
+            t0 = time.monotonic()
+            try:
+                transfer_pages(src.frontend, cand.frontend, ticket,
+                               max_chunk_retries=self.max_transfer_retries)
+                self._pump_s += time.monotonic() - t0
+                dest = cand
+                break
+            except TransferNoCapacity:
+                self._pump_s += time.monotonic() - t0
+                # backpressure, not breakage: the pool is full NOW, the
+                # same wait a colocated request queues through — try the
+                # next destination, else retry the hop next step
+                bump_counter("fleet.transfer_backpressure")
+                continue
+            except TransferSourceError as e:
+                self._pump_s += time.monotonic() - t0
+                self._abandon_transfer(freq, str(e))
+                return
+            except TransferDestError as e:
+                self._pump_s += time.monotonic() - t0
+                bump_counter("fleet.transfer_failed")
+                # breaker evidence against the destination (a dead one
+                # is ALSO killed by its next direct probe/collect), and
+                # one charge against the bounded transfer budget
+                self._note_verdict(cand, freq.rid, ok=False)
+                freq.transfers += 1
+                if freq.transfers > self.max_transfer_retries:
+                    bump_counter("fleet.transfer_budget_exhausted")
+                    self._deliver(freq, "failed", freq.emitted,
+                                  f"transfer budget exhausted: {e}")
+                return
+        if dest is None:
+            return  # every destination full; retried by _pump_transfers
+        if not self._submit_to(freq, dest.id,
+                               kv_import=ticket["ticket"]):
+            # the destination died between landing the import and the
+            # dispatch — the landed pages died with it; charge + retry
+            bump_counter("fleet.transfer_failed")
+            freq.transfers += 1
+            if (freq.transfers > self.max_transfer_retries
+                    and freq.rid in self._requests):
+                bump_counter("fleet.transfer_budget_exhausted")
+                self._deliver(freq, "failed", freq.emitted,
+                              "transfer budget exhausted: decode "
+                              "dispatch failed")
+            return
+        bump_counter("fleet.transfer_completed")
+        if self._journal is not None:
+            # the decode replica owns the request now: clear the hop so
+            # a takeover does NOT re-drive it (PROGRESS/RETIRE records
+            # cover recovery from here on)
+            self._journal.handoff_done(freq.rid)
+            self._journal.flush()
+        self._release_export(xfer)
+        self._clear_transfer(freq.rid)
+
+    def _pump_transfers(self):
+        """Retry handoffs that could not complete when they began (no
+        eligible destination yet, a destination that failed) — called
+        once per step so a parked hop resumes the moment the pool
+        allows, and a hopeless one times out instead of hanging."""
+        for rid in list(self._transfers):
+            freq = self._requests.get(rid)
+            if freq is None:
+                # delivered out from under the hop (cancel/timeout
+                # race): free the pin + gauge
+                xfer = self._transfers.get(rid)
+                if xfer is not None:
+                    self._release_export(xfer)
+                self._clear_transfer(rid)
+                continue
+            if freq.live:
+                continue  # the decode leg is already out
+            if freq.deadline.expired():
+                self._deliver(freq, "timed_out", freq.emitted,
+                              "expired awaiting the decode handoff")
+                continue
+            self._advance_handoff(freq)
+
+    def _abandon_transfer(self, freq, reason):
+        """The hop's pages are gone (source death, respawned source,
+        lost/released ticket): drop it and replay the request from the
+        known prefix — the prefill's first token is already in
+        ``emitted``, so the replay resubmits ``prompt + [first]`` with
+        ``token_base=1`` and the stream stays bit-identical."""
+        xfer = self._transfers.get(freq.rid)
+        if xfer is not None:
+            # a LIVE source still pins the exported pages (e.g. the hop
+            # was abandoned for want of a destination, not for source
+            # death): free them BEFORE the replay — the re-prefill's
+            # admission may need those very pages. No-op on a dead one.
+            self._release_export(xfer)
+        self._clear_transfer(freq.rid)
+        bump_counter("fleet.transfer_abandoned")
+        if self._journal is not None:
+            # keep the first token durable past the record we clear
+            self._journal.progress(freq.rid, freq.emitted)
+            self._journal.handoff_done(freq.rid)
+            self._journal.flush()
+        freq.phase = None
+        self._failover(freq, None, f"transfer abandoned: {reason}")
+
+    def _release_export(self, xfer):
+        """Best-effort release of the source's export pin (idempotent
+        server-side). A failure is counted, not raised: a dead source's
+        pages died with it, and a live one frees them at its next
+        engine restart at the latest."""
+        src = self._replicas.get(xfer["source"])
+        if src is None or src.state != "up":
+            return
+        try:
+            src.frontend.release_export(xfer["ticket"]["ticket"])
+        except StaleLeaderError as e:
+            self._stand_down(str(e))
+        except Exception:  # noqa: BLE001 — best-effort cleanup; the
+            # source's own death handling reclaims the pages
+            bump_counter("fleet.release_export_failed")
+
+    def _clear_transfer(self, rid):
+        xfer = self._transfers.pop(rid, None)
+        if xfer is None or not telemetry.enabled():
+            return
+        _M_XFER_TICKET.set(0, rid=str(rid),
+                           ticket=str(xfer["ticket"]["ticket"])[:8],
+                           src=str(xfer["source"]))
+        _M_XFER_INFLIGHT.set(len(self._transfers))
 
     # --------------------------------------------------- liveness sweep
 
@@ -1426,6 +1794,19 @@ class ServingRouter:
                     freq.live.add(rep.id)
                     freq.discard.add(rep.id)
                     freq.excluded.add(rep.id)
+            ho = rec.get("handoff")
+            if ho is not None:
+                # the dead leader crashed MID-HANDOFF for this rid:
+                # prefill done, decode dispatch not yet acked (the
+                # window the HANDOFF record exists for)
+                if freq.live - freq.discard:
+                    # a live copy survived after all (the decode
+                    # dispatch raced the crash): the hop completed —
+                    # clear it so a later takeover won't re-drive it
+                    self._journal.handoff_done(rid)
+                elif self._redrive_handoff(freq, ho):
+                    resubmitted += 1
+                    continue
             if not (freq.live - freq.discard):
                 if freq.discard:
                     continue  # replay resumes when the discard row lands
@@ -1433,6 +1814,52 @@ class ServingRouter:
                 if not self._dispatch(freq):
                     self._parked.append(rid)
         return len(state), adopted, resubmitted
+
+    def _redrive_handoff(self, freq, ho) -> bool:
+        """Resume one journaled mid-handoff hop after takeover. The
+        source's ``export_pages`` is rid-idempotent — the dead leader
+        never released the hold, so re-asking returns the SAME ticket
+        and the hop re-drives exactly once. Returns False when the
+        pages are gone (dead/respawned source): the caller re-prefills
+        from the journaled prefix instead — first token included, so
+        the stream is still bit-identical."""
+        if (ho.get("first_token") is not None
+                and not len(freq.emitted)):
+            # the HANDOFF record outlives any progress checkpoint for
+            # the first token: seed it so even the re-prefill path
+            # resumes mid-stream instead of recomputing
+            freq.emitted = np.asarray([ho["first_token"]], np.int32)
+        src = self._replicas.get(ho.get("source"))
+        ticket = None
+        if src is not None and src.state == "up":
+            try:
+                ticket = src.frontend.export_pages(freq.rid)
+            except StaleLeaderError:
+                # a concurrent higher-fence takeover outranks this one
+                # mid-promotion: abort (take_over rolls back to standby)
+                raise
+            except _TRANSPORT_ERRORS as e:
+                self._kill_replica(
+                    src, f"handoff re-export transport error: {e!r}")
+        if ticket is None:
+            # pages gone (source dead, respawned, or hold released):
+            # clear the hop; the normal resubmit path re-prefills
+            bump_counter("fleet.handoff_reprefill")
+            if len(freq.emitted):
+                self._journal.progress(freq.rid, freq.emitted)
+            self._journal.handoff_done(freq.rid)
+            freq.phase = None
+            return False
+        freq.phase = "decode"
+        self._transfers[freq.rid] = {"ticket": ticket, "source": src.id}
+        bump_counter("fleet.handoff_redriven")
+        if telemetry.enabled():
+            _M_XFER_TICKET.set(1, rid=str(freq.rid),
+                               ticket=str(ticket["ticket"])[:8],
+                               src=str(src.id))
+            _M_XFER_INFLIGHT.set(len(self._transfers))
+        self._advance_handoff(freq)
+        return True
 
     # ------------------------------------------------------------ admin
 
@@ -1561,6 +1988,7 @@ class ServingRouter:
                 replica=rid)
             _M_REP_ASSIGNED.set(len(rep.assigned), replica=rid)
             _M_REP_SERVED.set(rep.served, replica=rid)
+            _M_REP_ROLE.set(1, replica=rid, role=rep.role)
             inc = (rep.h_cache or {}).get("_inc")
             if inc:
                 _M_REP_INC.set(1, replica=rid, inc=str(inc)[:8])
@@ -1636,8 +2064,10 @@ class ServingRouter:
                                 "breaker": r.breaker.state(),
                                 "breaker_failures": r.breaker.failures,
                                 "assigned": len(r.assigned),
-                                "served": r.served}
+                                "served": r.served,
+                                "role": r.role}
                          for r in self._replicas.values()},
+            "transfers_inflight": len(self._transfers),
             "pending": len(self._requests),
             "role": ("standby" if self._standby
                      else "deposed" if self._deposed else "leader"),

@@ -56,6 +56,7 @@ from __future__ import annotations
 
 import logging
 import time
+import uuid
 import zlib
 from collections import deque
 
@@ -65,7 +66,13 @@ import numpy as np
 
 from ..core import perfwatch, telemetry
 from ..core.flags import define_flag, flag
-from ..core.resilience import Deadline, InjectedFault, bump_counter, inject
+from ..core.resilience import (
+    Deadline,
+    InjectedFault,
+    ServingUnavailable,
+    bump_counter,
+    inject,
+)
 from ..core.tensor import Tensor
 from ..profiler import annotate
 from .generation import _make_paged_cache, _sample_rows
@@ -137,6 +144,11 @@ _M_KV_REQ = telemetry.histogram(
     "serving.kv_request_bytes", "per-request KV footprint at retirement "
     "(prompt + emitted tokens, page-rounded)",
     buckets=tuple(float(2 ** p) for p in range(10, 31, 2)))
+_M_KV_PINNED = telemetry.gauge(
+    "serving.kv_pages_pinned_export", "pool pages pinned for KV export "
+    "(prefill handoff holds, live transfer tickets, and partially "
+    "imported chunks) — granted but invisible to the slot table, so "
+    "`obs kv` pool-pressure readings stay honest")
 
 
 _cwd = None
@@ -175,15 +187,24 @@ class Request:
     one timeline. ``t_submit``/``t_first`` anchor the TTFT and per-token
     latency histograms (monotonic; ``t_submit`` is overwritten by the
     frontend with its own admission stamp so queue wait counts).
+
+    ``hold_kv`` marks a disaggregated PREFILL request: on "ok"
+    retirement the slot's page grants move to the engine's export hold
+    table (refcounts intact) instead of the free list, awaiting an
+    ``export_pages`` ticket. ``kv_import`` names a completed import ticket
+    a DECODE-side request adopts at admission — the request seats
+    directly onto the imported pages with the prefill's first token
+    already emitted, no prefill dispatch.
     """
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "deadline", "tokens",
                  "status", "poisoned", "poison_checked", "error",
                  "token_base", "trace", "t_submit", "t_first", "tenant",
-                 "preempted")
+                 "preempted", "hold_kv", "kv_import")
 
     def __init__(self, rid, prompt, max_new_tokens, deadline=None,
-                 token_base=0, trace=None, tenant=None):
+                 token_base=0, trace=None, tenant=None, hold_kv=False,
+                 kv_import=None):
         self.rid = rid
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
@@ -202,6 +223,8 @@ class Request:
         # pages (pool exhaustion): re-admission then requires coverage
         # to the request's FULL budget so it cannot thrash in and out
         self.preempted = False
+        self.hold_kv = bool(hold_kv)
+        self.kv_import = kv_import
 
     def output(self):
         return np.asarray(self.tokens[:self.max_new_tokens], np.int32)
@@ -230,6 +253,13 @@ _SM64_C = np.uint64(0x94D049BB133111EB)
 # compiled shape regardless of how many pages a step copies (padding
 # lanes copy the dump page onto itself; larger batches loop)
 _COW_WIDTH = 8
+
+# fixed chunk width (in pages) of the KV export/import transfer
+# programs: like _COW_WIDTH, one compiled shape regardless of how many
+# pages a ticket moves — partial chunks pad with the dump page on both
+# sides (the source gathers garbage from it, the destination scatters
+# that garbage back onto its own dump page; never read)
+_XFER_WIDTH = 4
 
 
 def _mix64(x):
@@ -546,6 +576,25 @@ class ContinuousBatchingEngine:
             vs2 = [v.at[dst].set(v[src]) for v in vs]
             return ks2, vs2
 
+        def export_pages(params, ks, vs, idx):
+            # KV page EXPORT (disaggregation handoff, source side):
+            # gather one fixed-width chunk of pages from every layer
+            # into a single host-fetchable (layers, W, page, kv, hd)
+            # payload pair. The donated pools alias straight through
+            # unmodified; params ride for dispatch uniformity.
+            payk = jnp.stack([k[idx] for k in ks])
+            payv = jnp.stack([v[idx] for v in vs])
+            return ks, vs, payk, payv
+
+        def import_pages(params, ks, vs, idx, payk, payv):
+            # KV page IMPORT (destination side): scatter one received
+            # chunk into locally granted pages. Padding lanes write the
+            # dump page (source padded the payload with its own dump
+            # page — garbage lands on garbage, never read).
+            ks2 = [k.at[idx].set(payk[i]) for i, k in enumerate(ks)]
+            vs2 = [v.at[idx].set(payv[i]) for i, v in enumerate(vs)]
+            return ks2, vs2
+
         def segment(params, ks, vs, tables, lengths, toks, active, limits,
                     keys):
             def body(carry, key):
@@ -578,6 +627,8 @@ class ContinuousBatchingEngine:
         self._final_chunk_p = jax.jit(final_chunk, donate_argnums=(1, 2))
         self._resume_p = jax.jit(resume_final, donate_argnums=(1, 2))
         self._cow_p = jax.jit(cow_copy, donate_argnums=(1, 2))
+        self._export_p = jax.jit(export_pages, donate_argnums=(1, 2))
+        self._import_p = jax.jit(import_pages, donate_argnums=(1, 2))
         self._segment_p = jax.jit(segment, donate_argnums=(1, 2))
 
     # --------------------------------------------------- program dispatch
@@ -736,6 +787,16 @@ class ContinuousBatchingEngine:
             compile_(("cow", _COW_WIDTH), self._cow_p,
                      self._op_aval((_COW_WIDTH,), i32),
                      self._op_aval((_COW_WIDTH,), i32))
+        # KV page transfer (prefill/decode disaggregation): the fixed-
+        # width export/import chunk programs, warmed so page payloads
+        # move between replicas without a single post-warmup trace
+        xfer_idx_s = self._op_aval((_XFER_WIDTH,), i32)
+        pay_s = self._op_aval(
+            (len(self._ks), _XFER_WIDTH) + tuple(self._ks[0].shape[1:]),
+            self._ks[0].dtype)
+        compile_(("export", _XFER_WIDTH), self._export_p, xfer_idx_s)
+        compile_(("import", _XFER_WIDTH), self._import_p, xfer_idx_s,
+                 pay_s, pay_s)
         seg = int(segment if segment is not None
                   else getattr(self, "_segment_len", 16))
         m = self.max_slots
@@ -874,6 +935,14 @@ class ContinuousBatchingEngine:
             self._prefix = PrefixCache(self._pool, self.page_size,
                                        self._recycle)
         self._slot_pages = [[] for _ in range(self.max_slots)]
+        # KV transfer state (disaggregation): holds are "ok" hold_kv
+        # retirements awaiting a ticket; exports are live tickets;
+        # imports are destination-side chunk landings. All pin pool
+        # pages via refcounts — the fresh pool above dropped them all.
+        self._kv_holds = {}
+        self._exports = {}
+        self._export_by_rid = {}
+        self._imports = {}
         self._quarantine = []
         self._disp_n = 0
         self._exec_floor = 0
@@ -919,7 +988,8 @@ class ContinuousBatchingEngine:
         return self
 
     def submit(self, prompt, max_new_tokens, deadline_s=None, rid=None,
-               token_base=0, trace=None, tenant=None):
+               token_base=0, trace=None, tenant=None, hold_kv=False,
+               kv_import=None):
         """Enqueue one request (requires a prior ``start()``); raises
         ``ValueError`` if it can never fit a slot. ``deadline_s`` is a
         per-request budget (seconds or a ``Deadline``), measured from
@@ -934,7 +1004,12 @@ class ContinuousBatchingEngine:
         retire event with a telemetry trace id. ``tenant`` attributes
         the request's latency/token metrics to a tenant label (QoS is
         enforced ABOVE the engine — frontend quotas/WFQ, router typed
-        rejections; the scheduler itself stays tenant-blind)."""
+        rejections; the scheduler itself stays tenant-blind).
+
+        ``hold_kv=True`` marks a disaggregated prefill (pages held for
+        export at "ok" retirement); ``kv_import=<ticket id>`` seats the
+        request onto a completed KV import at admission — see
+        ``export_pages``/``import_kv_chunk``."""
         prompt = np.asarray(prompt).astype(np.int32).ravel()
         self._validate(prompt, max_new_tokens)
         if rid is None:
@@ -947,7 +1022,8 @@ class ContinuousBatchingEngine:
         deadline = (deadline_s if isinstance(deadline_s, Deadline)
                     else Deadline(deadline_s))
         req = Request(rid, prompt, max_new_tokens, deadline,
-                      token_base=token_base, trace=trace, tenant=tenant)
+                      token_base=token_base, trace=trace, tenant=tenant,
+                      hold_kv=hold_kv, kv_import=kv_import)
         self._queue.append(req)
         return req
 
@@ -999,7 +1075,21 @@ class ContinuousBatchingEngine:
             self._slot_req[slot] = None
             self._lengths[slot] = 1  # slot returns to the idle pool
             pages_held = len(self._slot_pages[slot])
-            self._free_slot_pages(slot)
+            if status == "ok" and req.hold_kv and self._slot_pages[slot]:
+                # disaggregated prefill: the slot's page grants (and
+                # their refcounts) move to the export hold table instead
+                # of the free list — quarantine/eviction cannot recycle
+                # them while a transfer is (or may be) in flight
+                pages, self._slot_pages[slot] = self._slot_pages[slot], []
+                self._set_table_row(slot)
+                self._kv_holds[req.rid] = {
+                    "pages": pages,
+                    "prefill_len": int(req.prompt.size),
+                    "first_token": int(req.tokens[0]) if req.tokens
+                    else None,
+                }
+            else:
+                self._free_slot_pages(slot)
         req.status = status
         self._counts[status] = self._counts.get(status, 0) + 1
         if telemetry.enabled():
@@ -1152,6 +1242,45 @@ class ContinuousBatchingEngine:
             # prompts sharing this prefix map them instead of
             # re-prefilling (refcounted — they outlive this request)
             self._prefix.insert(req.prompt, self._slot_pages[slot])
+        if len(req.tokens) >= req.max_new_tokens or (
+                self.eos_token_id is not None
+                and req.tokens[-1] == self.eos_token_id):
+            self._retire(req, "ok", finished, slot=slot)
+
+    def _adopt_import(self, slot, req, imp, finished):
+        """Seat a disaggregated-decode request directly onto imported
+        prefill pages: pure host bookkeeping — page-table CONTENTS and
+        scheduler state mutate, no program is traced or dispatched.
+
+        Bit-exactness contract: the source replica sampled the prefill
+        token (stream index 0 of the request's key stream, same engine
+        seed + rid everywhere), so the adopted request starts with that
+        token already in ``tokens`` and the next decode segment samples
+        stream index ``token_base + len(tokens) == 1`` — identical to
+        the colocated run's second token. TTFT was observed at the
+        prefill; no attempt-level sample here."""
+        meta = imp["meta"]
+        plen = int(meta["prefill_len"])
+        first = int(meta["first_token"])
+        self._slot_pages[slot] = list(imp["pages"])
+        self._set_table_row(slot)
+        self._slot_adm[slot] = self._adm_seq
+        self._adm_seq += 1
+        self._slot_req[slot] = req
+        req.tokens.append(first)
+        if req.t_first is None:
+            req.t_first = time.monotonic()
+        self._lengths[slot] = plen
+        self._cur_tok[slot] = first
+        self._limits[slot] = (req.prompt.size + req.max_new_tokens
+                              - len(req.tokens))
+        self._limits_dev = None  # admission changed the device invariant
+        if self._prefix is not None:
+            self._prefix.insert(req.prompt, self._slot_pages[slot])
+        bump_counter("serving.kv_import_adopted")
+        if telemetry.enabled():
+            telemetry.trace_event("serving.kv_adopt", trace=req.trace,
+                                  rid=req.rid, pages=len(imp["pages"]))
         if len(req.tokens) >= req.max_new_tokens or (
                 self.eos_token_id is not None
                 and req.tokens[-1] == self.eos_token_id):
@@ -1577,6 +1706,26 @@ class ContinuousBatchingEngine:
             if req.status != "pending":
                 self._queue.popleft()
                 continue
+            if req.kv_import is not None:
+                # disaggregated DECODE admission: adopt the completed KV
+                # import — pure host bookkeeping, no prefill dispatch.
+                # A missing/incomplete ticket (source died, chunks never
+                # finished) falls through to a normal local re-prefill.
+                imp = self._imports.pop(req.kv_import, None)
+                req.kv_import = None
+                if (imp is not None
+                        and len(imp["done"]) >= int(
+                            imp["meta"]["n_chunks"])
+                        and int(imp["meta"]["prefill_len"])
+                        == int(req.prompt.size)):
+                    self._queue.popleft()
+                    slot = free[fi]
+                    fi += 1
+                    self._adopt_import(slot, req, imp, finished)
+                    continue
+                if imp is not None:
+                    self._recycle(self._pool.decref(imp["pages"]))
+                bump_counter("serving.kv_import_miss")
             plan = self._plan_admission(req)
             if plan is None and self._quarantine:
                 # the missing pages may be freed-but-unproven: block on
@@ -1840,6 +1989,162 @@ class ContinuousBatchingEngine:
             telemetry.trace_event("serving.kv_preempt", trace=req.trace,
                                   rid=req.rid, emitted=len(req.tokens))
 
+    # -------------------------- KV page transfer (disaggregation handoff)
+    #
+    # Engine-side primitive surface for prefill/decode disaggregation:
+    # the SOURCE mints a ticket over the pages a hold_kv prefill pinned
+    # (export_kv), serves CRC-framed fixed-width chunks (transfer_chunk)
+    # and releases the pin when the handoff completes (release_export);
+    # the DESTINATION lands chunks idempotently by ticket id
+    # (import_kv_chunk) and the adopting request seats onto the landed
+    # pages at admission. The chunk programs are AOT-warmed — the whole
+    # path dispatches zero post-warmup compiles. The transfer DRIVER
+    # (retries, failover, journaling) lives in models/transfer.py and
+    # the router; the engine only moves pages.
+
+    def _pinned_pages(self) -> int:
+        """Pool pages pinned by the transfer machinery (holds + live
+        export tickets + partially imported chunks) — granted, but
+        invisible to the slot table."""
+        return (sum(len(h["pages"])
+                    for h in getattr(self, "_kv_holds", {}).values())
+                + sum(len(e["pages"])
+                      for e in getattr(self, "_exports", {}).values())
+                + sum(len(i["pages"])
+                      for i in getattr(self, "_imports", {}).values()))
+
+    def export_pages(self, rid):
+        """Mint (or re-serve) the transfer ticket over the pages a
+        ``hold_kv`` prefill retirement pinned for ``rid``. Idempotent by
+        rid — a router re-drive after a crash gets the SAME ticket, so
+        the destination's by-ticket dedup makes the whole handoff
+        exactly-once. Returns the ticket dict, or None when the rid
+        holds no exportable pages (never prefilled here, already
+        released, or a respawned engine)."""
+        tid = self._export_by_rid.get(rid)
+        if tid is not None and tid in self._exports:
+            return dict(self._exports[tid]["ticket"])
+        hold = self._kv_holds.pop(rid, None)
+        if hold is None or hold["first_token"] is None:
+            return None
+        tid = uuid.uuid4().hex
+        n_pages = len(hold["pages"])
+        ticket = {
+            "ticket": tid,
+            "rid": rid,
+            "n_pages": n_pages,
+            "chunk_pages": _XFER_WIDTH,
+            "n_chunks": -(-n_pages // _XFER_WIDTH),
+            "prefill_len": hold["prefill_len"],
+            "first_token": hold["first_token"],
+            "page_size": self.page_size,
+        }
+        self._exports[tid] = {"pages": hold["pages"], "ticket": ticket}
+        self._export_by_rid[rid] = tid
+        return dict(ticket)
+
+    def transfer_chunk(self, ticket, idx):
+        """SOURCE side: serve chunk ``idx`` of a live export as
+        ``[n_valid, payk, payv, crc32]`` — payloads are host
+        ``(layers, W, page, kv, hd)`` arrays, CRC framed over both.
+        An unknown ticket raises typed ``ServingUnavailable``: the
+        caller cannot distinguish a released ticket from a respawned
+        source, and both mean the pages are gone — re-prefill."""
+        try:
+            inject("transfer.source_death")
+        except InjectedFault as e:
+            bump_counter("transfer.source_death")
+            raise ServingUnavailable(
+                f"injected source death mid-transfer ({ticket})") from e
+        exp = self._exports.get(ticket)
+        if exp is None:
+            raise ServingUnavailable(
+                f"unknown export ticket {ticket!r}: no pinned pages "
+                "(released, or a respawned source process)")
+        sel = exp["pages"][idx * _XFER_WIDTH:(idx + 1) * _XFER_WIDTH]
+        if not sel:
+            raise ValueError(
+                f"chunk {idx} out of range for ticket {ticket!r}")
+        pad = sel + [self._dump_page] * (_XFER_WIDTH - len(sel))
+        self._ks, self._vs, payk, payv = self._call(
+            ("export", _XFER_WIDTH), self._export_p, self._params,
+            self._ks, self._vs, jnp.asarray(np.asarray(pad, np.int32)))
+        payk = np.asarray(jax.device_get(payk))
+        payv = np.asarray(jax.device_get(payv))
+        crc = zlib.crc32(payv.tobytes(), zlib.crc32(payk.tobytes()))
+        return [len(sel), payk, payv, crc]
+
+    def release_export(self, ticket) -> bool:
+        """SOURCE side: drop a finished (or abandoned) export's pin —
+        the pages decref back toward the free list. Idempotent."""
+        exp = self._exports.pop(ticket, None)
+        if exp is None:
+            return False
+        self._export_by_rid.pop(exp["ticket"]["rid"], None)
+        self._recycle(self._pool.decref(exp["pages"]))
+        return True
+
+    def import_kv_chunk(self, meta, idx, payk, payv, crc):
+        """DESTINATION side: land one CRC-framed chunk of the export
+        described by ``meta`` (the ticket dict). First chunk allocates
+        the local page grants; chunks land idempotently by ticket id +
+        index, so a resumed transfer replays duplicates harmlessly.
+        Returns ``"done"`` when every chunk has landed, ``"ok"`` on a
+        partial landing, ``"dup"`` for an already-landed index,
+        ``"crc_mismatch"`` for a corrupt frame (caller re-sends), or
+        ``"no_capacity"`` when the pool cannot grant the pages."""
+        try:
+            inject("transfer.import_fail")
+        except InjectedFault:
+            bump_counter("transfer.import_fail")
+            raise
+        tid = meta["ticket"]
+        st = self._imports.get(tid)
+        if st is None:
+            n_pages = int(meta["n_pages"])
+            pages = self._pool.alloc(n_pages)
+            if pages is None and self._prefix is not None:
+                # same pressure valve admission uses: evict unreferenced
+                # prefix pages, then retry the grant
+                self._prefix.evict(n_pages - self._pool.available())
+                pages = self._pool.alloc(n_pages)
+            if pages is None:
+                bump_counter("serving.kv_pool_exhausted")
+                return "no_capacity"
+            st = {"pages": pages, "meta": dict(meta), "done": set()}
+            self._imports[tid] = st
+        idx = int(idx)
+        n_chunks = int(st["meta"]["n_chunks"])
+        if idx in st["done"]:
+            return "done" if len(st["done"]) >= n_chunks else "dup"
+        payk = np.asarray(payk)
+        payv = np.asarray(payv)
+        if zlib.crc32(payv.tobytes(),
+                      zlib.crc32(payk.tobytes())) != int(crc):
+            bump_counter("transfer.crc_mismatch")
+            return "crc_mismatch"
+        w = int(st["meta"].get("chunk_pages", _XFER_WIDTH))
+        sel = st["pages"][idx * w:(idx + 1) * w]
+        if not sel:
+            raise ValueError(
+                f"chunk {idx} out of range for ticket {tid!r}")
+        pad = sel + [self._dump_page] * (_XFER_WIDTH - len(sel))
+        self._ks, self._vs = self._call(
+            ("import", _XFER_WIDTH), self._import_p, self._params,
+            self._ks, self._vs, jnp.asarray(np.asarray(pad, np.int32)),
+            jnp.asarray(payk), jnp.asarray(payv))
+        st["done"].add(idx)
+        return "done" if len(st["done"]) >= n_chunks else "ok"
+
+    def drop_import(self, ticket) -> bool:
+        """DESTINATION side: abandon a (possibly partial) import and
+        free its local page grants. Idempotent."""
+        st = self._imports.pop(ticket, None)
+        if st is None:
+            return False
+        self._recycle(self._pool.decref(st["pages"]))
+        return True
+
     def _kv_usage(self, active_idx):
         """ONE definition of the page-granular KV arithmetic (the gauges
         and ``kv_stats`` must never desynchronize): pool occupancy,
@@ -1880,6 +2185,7 @@ class ContinuousBatchingEngine:
             "pages_total": self._pool_pages,
             "pages_free": free,
             "pages_granted": phys,
+            "pages_pinned_export": self._pinned_pages(),
             "prefix_cached_pages": (len(self._prefix)
                                     if self._prefix is not None else 0),
             "prefix_hit_rate": (hits / lookups) if lookups else 0.0,
@@ -1898,6 +2204,7 @@ class ContinuousBatchingEngine:
         _M_KV_FRAG.set(u["fragmentation_pct"])
         _M_KV_PAGES_FREE.set(u["pages_free"])
         _M_KV_PAGES_TOTAL.set(u["pages_total"])
+        _M_KV_PINNED.set(u["pages_pinned_export"])
         _M_PREFIX_HIT.set(u["prefix_hit_rate"])
         for slot in range(self.max_slots):
             _M_KV_SLOT_PAGES.set(len(self._slot_pages[slot]),

@@ -1,0 +1,221 @@
+"""Fault-tolerant KV page transfer — the prefill→decode handoff hop.
+
+PR 14's paged allocator made a finished prefill a bounded set of pool
+pages; the per-request key streams + ``token_base`` resume (PRs 6–8)
+made a hop between replicas bit-exact *if the KV arrives intact*. This
+module is the hop itself: a driver that moves one export ticket's pages
+from a SOURCE frontend to a DESTINATION frontend in fixed-width,
+CRC-framed chunks, surviving every failure mode the fleet drills cover.
+
+The engine owns the data plane (``ContinuousBatchingEngine.export_pages``
+mints the ticket over refcount-pinned pages, ``transfer_chunk`` serves
+chunks, ``import_kv_chunk`` lands them idempotently by ticket id); the
+router owns the policy plane (who hands off to whom, journaling, the
+failover budget). This driver owns the WIRE DISCIPLINE in between:
+
+* **Chunked + resumable** — a dropped chunk (``transfer.chunk_drop``)
+  retries just that chunk; chunks that already landed dedup on the
+  destination by (ticket, index), so a resumed transfer never re-writes
+  a page and never double-counts.
+* **CRC-framed** — every chunk carries a crc32 over both payloads,
+  re-checked destination-side before any page is written; a corrupt
+  frame re-fetches from the source instead of silently corrupting KV.
+* **Typed source loss** — the transfer rides the hardened RPC transport
+  (``distributed/rpc.py``): a respawned source fails the incarnation
+  pin and an unknown/released ticket raises ``ServingUnavailable``, so
+  the caller always sees "the pages are gone, re-prefill" as a typed
+  verdict (:class:`TransferSourceError`), never silent corruption.
+* **Typed destination loss** — import-side failures
+  (``transfer.import_fail``, pool exhaustion, a dead decode replica)
+  raise :class:`TransferDestError`; the router charges them against a
+  bounded transfer budget and retries on another destination.
+
+Every chunk attempt is bounded (``max_chunk_retries``) — the driver can
+fail, it can never hang. Works identically over a local
+``ServingFrontend`` pair (tests) and ``RemoteFrontend`` stubs (fleet).
+"""
+from __future__ import annotations
+
+import time
+
+from ..core import telemetry
+from ..core.resilience import (
+    InjectedFault,
+    ServingUnavailable,
+    bump_counter,
+    inject,
+)
+
+__all__ = [
+    "TransferError",
+    "TransferSourceError",
+    "TransferDestError",
+    "TransferNoCapacity",
+    "transfer_pages",
+]
+
+# Transport-level failures the driver translates into typed verdicts.
+# InjectedFault subclasses ConnectionError, so drilled faults ride the
+# same classification as real ones.
+_TRANSPORT_ERRORS = (ConnectionError, TimeoutError, ServingUnavailable)
+
+_M_XFER_BYTES = telemetry.counter(
+    "fleet.transfer_bytes", "KV payload bytes moved by page transfers "
+    "(CRC-framed chunk payloads, both K and V)")
+_M_XFER_S = telemetry.histogram(
+    "fleet.transfer_s", "wall seconds per completed page transfer "
+    "(all chunks, retries included)")
+_M_XFER_RESUMED = telemetry.counter(
+    "fleet.transfer_resumed_chunks", "chunk attempts repeated after a "
+    "dropped or corrupt frame — each one is a resume the ticket's "
+    "idempotent import made safe")
+
+
+class TransferError(RuntimeError):
+    """Base class for page-transfer failures (always typed, never a
+    hang: every chunk attempt is bounded)."""
+
+
+class TransferSourceError(TransferError):
+    """The SOURCE lost the pages mid-transfer: replica death, a
+    respawned incarnation, or a released/unknown ticket. The only
+    recovery is a re-prefill on a surviving replica — the prefix cache
+    makes the retry cheap."""
+
+
+class TransferDestError(TransferError):
+    """The DESTINATION failed to land chunks: replica death or an
+    injected import fault. Recoverable by retrying the import on
+    another destination under the router's transfer budget."""
+
+
+class TransferNoCapacity(TransferDestError):
+    """The destination pool cannot grant the pages RIGHT NOW
+    (``no_capacity``). Backpressure, not breakage: the same admission
+    wait a colocated request queues through — the router retries the
+    hop later (or on another destination) without charging the
+    transfer budget or the destination's breaker."""
+
+
+def transfer_pages(source, dest, ticket, max_chunk_retries=3):
+    """Move one export ticket's pages from ``source`` to ``dest``.
+
+    ``ticket`` is the dict ``source.export_pages(rid)`` minted
+    (``{"ticket", "rid", "n_pages", "n_chunks", "chunk_pages",
+    "prefill_len", "first_token", "page_size"}``). Chunks are fetched
+    from the source and landed on the destination in order; each chunk
+    attempt is independently retried up to ``max_chunk_retries`` times
+    on a dropped frame (``transfer.chunk_drop``) or CRC mismatch —
+    already-landed chunks dedup destination-side, so the replay is
+    idempotent.
+
+    Returns the ticket dict on success (all chunks landed). Raises
+    :class:`TransferSourceError` when the source lost the pages
+    (re-prefill is the only recovery) or :class:`TransferDestError`
+    when the destination cannot land them (retry elsewhere). The
+    destination is asked to drop its partial import before a
+    destination-side raise, so a failed transfer leaks no pages there.
+    """
+    tid = ticket["ticket"]
+    n_chunks = int(ticket["n_chunks"])
+    t0 = time.monotonic()
+    moved = 0
+    status = "ok"
+    for idx in range(n_chunks):
+        attempts = 0
+        while True:
+            attempts += 1
+            try:
+                # the drilled wire loss: a chunk that never arrives.
+                # Consumed per ATTEMPT so a budget of N drops N frames.
+                inject("transfer.chunk_drop")
+            except InjectedFault:
+                bump_counter("transfer.chunk_drop")
+                if attempts > max_chunk_retries:
+                    _drop_partial(dest, tid)
+                    raise TransferDestError(
+                        f"ticket {tid}: chunk {idx} dropped "
+                        f"{attempts} times (budget {max_chunk_retries})")
+                _M_XFER_RESUMED.inc()
+                continue
+            try:
+                n_valid, payk, payv, crc = _fetch(source, tid, idx)
+                status = _land(dest, ticket, idx, payk, payv, crc)
+            except InjectedFault:
+                # a drilled destination import fault (the destination
+                # already counted transfer.import_fail): same bounded
+                # retry as a dropped frame
+                if attempts > max_chunk_retries:
+                    _drop_partial(dest, tid)
+                    raise TransferDestError(
+                        f"ticket {tid}: chunk {idx} import faulted "
+                        f"{attempts} times (budget {max_chunk_retries})")
+                _M_XFER_RESUMED.inc()
+                continue
+            except TransferSourceError:
+                _drop_partial(dest, tid)
+                raise
+            except _TRANSPORT_ERRORS as e:
+                # the fetch already classified source-side transport
+                # loss; anything surfacing here is the destination
+                _drop_partial(dest, tid)
+                raise TransferDestError(
+                    f"ticket {tid}: destination failed landing chunk "
+                    f"{idx}: {e!r}") from e
+            if status == "crc_mismatch":
+                if attempts > max_chunk_retries:
+                    _drop_partial(dest, tid)
+                    raise TransferDestError(
+                        f"ticket {tid}: chunk {idx} failed CRC "
+                        f"{attempts} times (budget {max_chunk_retries})")
+                _M_XFER_RESUMED.inc()
+                continue
+            if status == "no_capacity":
+                raise TransferNoCapacity(
+                    f"ticket {tid}: destination pool cannot grant "
+                    f"{ticket['n_pages']} pages right now")
+            moved += payk.nbytes + payv.nbytes
+            break
+    if status != "done" and n_chunks:
+        # every chunk acked but the destination never saw completion —
+        # a meta/ticket mismatch, not a transport fault; fail typed
+        _drop_partial(dest, tid)
+        raise TransferDestError(
+            f"ticket {tid}: all {n_chunks} chunks sent but import "
+            f"finished in state {status!r}")
+    if telemetry.enabled():
+        _M_XFER_BYTES.inc(moved)
+        _M_XFER_S.observe(time.monotonic() - t0)
+        telemetry.trace_event(
+            "fleet.transfer", rid=ticket.get("rid"), ticket=tid,
+            pages=ticket.get("n_pages"), bytes=moved)
+    return dict(ticket)
+
+
+def _fetch(source, tid, idx):
+    """One source-side chunk fetch, transport loss → typed source
+    error (a respawned source reads as ``ServingUnavailable`` via the
+    RPC incarnation pin — same verdict, same recovery)."""
+    try:
+        n_valid, payk, payv, crc = source.transfer_chunk(tid, idx)
+    except _TRANSPORT_ERRORS as e:
+        raise TransferSourceError(
+            f"ticket {tid}: source lost pages at chunk {idx}: "
+            f"{e!r}") from e
+    return n_valid, payk, payv, crc
+
+
+def _land(dest, ticket, idx, payk, payv, crc):
+    """One destination-side chunk landing (raises transport errors to
+    the caller's classification)."""
+    return dest.import_kv_chunk(ticket, idx, payk, payv, crc)
+
+
+def _drop_partial(dest, tid):
+    """Best-effort partial-import cleanup before a typed raise — the
+    destination may itself be the dead party, so failures here are
+    counted, not raised."""
+    try:
+        dest.drop_import(tid)
+    except _TRANSPORT_ERRORS:
+        bump_counter("transfer.drop_import_failed")

@@ -89,6 +89,15 @@ GATES = {
     "prefix_prefill_speedup": ("min", 1.0),  # shared-prefix prefill A/B
     "prefix_hit_rate": ("min", 0.001),  # sharing actually engaged
     "kv_serving_compiles": 1.0,      # any compile through the allocator
+    # disaggregated prefill/decode serving (bench e10): the KV
+    # page-transfer hop must stay a small share of active processing,
+    # client TTFT under the long-prompt burst must stay within 2x of
+    # the colocated arm (CPU-noise headroom on an invariant that is
+    # "no worse" in spirit), and ANY request lost to the hop is a hard
+    # fail. Pre-e10 rounds lack the section — absent metrics skip.
+    "transfer_overhead_pct": 10.0,
+    "decode_ttft_p95_ratio": 2.0,
+    "transfer_lost_requests": 1.0,   # 0/1+: requests lost in the A/B
 }
 
 DEFAULT_RATIO_THRESHOLD = 0.9   # per-round e2e_vs_baseline alarm

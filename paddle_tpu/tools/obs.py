@@ -297,6 +297,9 @@ def cmd_kv(args) -> int:
         print(f"kv pool   : {used}/{int(total)} pages granted "
               f"[{'#' * fill}{'.' * (width - fill)}] "
               f"({int(free or 0)} free)")
+    if kv.get("pages_pinned_export"):
+        print(f"pinned    : {int(kv['pages_pinned_export'])} page(s) "
+              "pinned for export (prefill done, awaiting transfer)")
     if kv["bytes_in_use"] is not None:
         print(f"kv bytes  : {_fmt_num(kv['bytes_in_use'])} in use "
               f"(page-granular, active slots)")
@@ -363,17 +366,20 @@ def cmd_fleet(args) -> int:
         row = rows.setdefault(rep, {})
         if fam == "fleet.replica_incarnation":
             row["inc"] = labels.get("inc", "?")
+        elif fam == "fleet.replica_role":
+            row["role"] = labels.get("role", "?")
         else:
             row[fam[len("fleet.replica_"):]] = v
     if rows:
         print(f"replicas ({len(rows)}):")
-        print(f"  {'id':<6} {'state':<9} {'breaker':<10} "
+        print(f"  {'id':<6} {'state':<9} {'role':<8} {'breaker':<10} "
               f"{'assigned':>8} {'served':>7} {'hb age':>8}  inc")
         for rep in sorted(rows, key=lambda r: (len(r), r)):
             row = rows[rep]
             hb = row.get("hb_age_s")
             print(f"  {rep:<6} "
                   f"{state_names.get(row.get('state'), '?'):<9} "
+                  f"{row.get('role', '-'):<8} "
                   f"{breaker_names.get(row.get('breaker'), '?'):<10} "
                   f"{int(row.get('assigned', 0)):>8} "
                   f"{int(row.get('served', 0)):>7} "
@@ -382,6 +388,23 @@ def cmd_fleet(args) -> int:
     else:
         print("replicas: (no fleet.replica_* gauges recorded — the "
               "router exports them at every fleet_metrics() call)")
+
+    # --- live page-transfer tickets (fleet.transfer_ticket flips to 0
+    # when the handoff completes, so value==1 means mid-flight)
+    tickets = [(lab.get("rid", "?"), lab.get("ticket", "?"),
+                lab.get("src", "?"))
+               for k, v in gauges.items()
+               if k.split("{", 1)[0] == "fleet.transfer_ticket"
+               and v == 1
+               for lab in (_labels_of(k),)]
+    inflight = gauges.get("fleet.transfer_inflight")
+    if tickets:
+        print(f"transfers in flight ({len(tickets)}):")
+        for rid, tid, src in sorted(tickets):
+            print(f"  rid {rid:<6} ticket {tid:<10} source {src}")
+    elif inflight:
+        print(f"transfers in flight: {int(inflight)} "
+              "(no ticket gauges in this snapshot)")
 
     # --- TP groups (tp.* series from the group member processes)
     groups = {_labels_of(k).get("group", "?"): v

@@ -232,11 +232,18 @@ def test_health_probe_answers_while_replica_lock_is_held(model, rpc_group):
 def test_remote_shutdown_drain_delivers_final_results(model, rpc_group):
     """shutdown(drain=True) resolves in-flight work on the replica and
     the final rows ride the shutdown reply — the post-shutdown results()
-    poll delivers them without a live server."""
-    _, stub = _remote_pair(model, rpc_group)
+    poll delivers them without a live server. The server runs pump=False
+    and the drill admits the request explicitly: drain finishes SLOT
+    holders and reports still-queued work "cancelled", so racing the
+    pump's first step would make the verdict a scheduling coin flip."""
+    name = next(_names)
+    server = ReplicaServer(_frontend(model), name=name, pump=False)
+    stub = RemoteFrontend(rpc_group, server=name, timeout=60.0)
     prompt = _prompts(1)[0]
     want = _reference(model, [prompt], [0], 6)[0]
     rid = stub.submit(prompt, max_new_tokens=6)
+    with server._lock:
+        server.frontend.step()          # admit: the request holds a slot
     stub.shutdown(drain=True)
     res = stub.results()  # server is deregistered; rows were stashed
     assert list(res) == [rid] and res[rid].status == "ok"

@@ -168,10 +168,11 @@ def test_second_engine_warmup_counts_as_warmup_not_serving(model):
     c = telemetry.counter("xla.compiles_total")
     warm0 = c.value(phase="warmup")
     # minimal shape set (1 slot, 1 bucket, no chunking): prefill +
-    # prefix-resume + segment + the CoW page-copy program
+    # prefix-resume + segment + the CoW page-copy program + the KV
+    # export/import chunk programs (page-transfer data plane)
     eng2 = _engine(model, max_slots=1, max_len=8, prompt_buckets=(8,))
-    assert eng2.warmup(segment=2)["programs"] == 4
-    assert c.value(phase="warmup") == warm0 + 4
+    assert eng2.warmup(segment=2)["programs"] == 6
+    assert c.value(phase="warmup") == warm0 + 6
     assert c.value(phase="serving") == 0
 
 

@@ -314,10 +314,11 @@ def test_warmup_precompiles_every_shape_zero_compiles_after():
     eng = _engine(m, max_slots=2, max_len=64, prompt_buckets=(8, 16))
     info = eng.warmup(segment=3)
     # 2 widths x 2 buckets x (prefill + prefix-resume) + 2 widths x
-    # (chunk + final) + segment + the CoW page-copy program
-    assert info["programs"] == 2 * 2 * 2 + 2 * 2 + 1 + 1
+    # (chunk + final) + segment + the CoW page-copy program + the KV
+    # export/import chunk programs (page-transfer data plane)
+    assert info["programs"] == 2 * 2 * 2 + 2 * 2 + 1 + 1 + 2
     again = eng.warmup(segment=3)          # idempotent: everything cached
-    assert again["programs"] == 0 and again["cached"] == 14
+    assert again["programs"] == 0 and again["cached"] == 16
     with count_backend_compiles() as compiles:
         rng = np.random.RandomState(8)
         prompts = [rng.randint(0, 211, (n,)).astype(np.int32)

@@ -1,0 +1,707 @@
+"""Prefill/decode disaggregation + fault-tolerant KV page transfer
+(ISSUE 16).
+
+Three layers of drills:
+
+* The TRANSFER PRIMITIVE in isolation: export tickets are minted over
+  pinned pages and are rid-idempotent; a manual export → transfer →
+  import hop reproduces the colocated stream bit-identically; every
+  drilled wire fault (``transfer.chunk_drop``, ``transfer.source_death``,
+  ``transfer.import_fail``) resolves to the typed verdict the router
+  keys its policy on, with zero page leaks on either side.
+* The ROUTER POLICY plane in-process: role-aware dispatch (advisory —
+  degraded fleets serve colocated), the handoff happy path with ZERO
+  post-warmup compiles, source death → re-prefill, destination import
+  faults → bounded budget → "failed" (never a hang), breaker trips →
+  colocated fallback, a killed source sweeping its parked transfers,
+  and the journaled HANDOFF record driving an exactly-once standby
+  re-drive.
+* The flagship CROSS-PROCESS drill: 1 prefill + 2 decode replica
+  processes over real RPC; the prefill replica is SIGKILLed with a
+  page transfer parked mid-handoff; zero requests are lost, every
+  stream is bit-identical to the uninterrupted run, the fleet degrades
+  to colocated serving, and the respawned rank rejoins and hands off
+  again.
+"""
+import itertools
+import os
+import signal
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.core import resilience, telemetry
+from paddle_tpu.core.flags import set_flags
+from paddle_tpu.distributed import rpc
+from paddle_tpu.distributed.gang import LeaderLease
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.jit import count_backend_compiles
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.frontend import ServingFrontend
+from paddle_tpu.models.journal import RequestJournal
+from paddle_tpu.models.remote import RPC_MASTER_ENV, RemoteFrontend
+from paddle_tpu.models.router import ServingRouter, launch_fleet
+from paddle_tpu.models.serving import ContinuousBatchingEngine
+from paddle_tpu.models.transfer import (
+    TransferDestError,
+    TransferNoCapacity,
+    TransferSourceError,
+    transfer_pages,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_resilience():
+    resilience.reset_faults()
+    resilience.reset_counters()
+    yield
+    resilience.reset_faults()
+    resilience.reset_counters()
+
+
+_CFG = LlamaConfig(vocab_size=97, hidden_size=16, intermediate_size=32,
+                   num_hidden_layers=1, num_attention_heads=2,
+                   max_position_embeddings=128, tie_word_embeddings=True)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    return LlamaForCausalLM(_CFG)
+
+
+def _frontend(model, role="both", max_slots=2, segment=4, seed=13):
+    eng = ContinuousBatchingEngine(model, max_slots=max_slots, max_len=64,
+                                   prompt_buckets=(8, 16), do_sample=True,
+                                   temperature=0.9, seed=seed)
+    return ServingFrontend(eng, max_queue=32, segment=segment,
+                           breaker_threshold=50, role=role)
+
+
+def _prompts(n, rng_seed=3, lo=4, hi=10):
+    rng = np.random.RandomState(rng_seed)
+    return [rng.randint(0, _CFG.vocab_size,
+                        (int(rng.randint(lo, hi)),)).astype(np.int32)
+            for _ in range(n)]
+
+
+def _reference(model, prompts, rids, max_new):
+    """Uninterrupted colocated run with the same rids — the bit-exact
+    target every disaggregated/faulted stream must reproduce."""
+    fe = _frontend(model)
+    for rid, p in zip(rids, prompts):
+        fe.submit(p, max_new_tokens=max_new, rid=rid)
+    out = fe.results(wait=True)
+    fe.shutdown()
+    return {rid: out[rid].tokens for rid in rids}
+
+
+def _prefill_hold(fe, prompt, rid):
+    """Run the prefill leg the router dispatches: full prompt, exactly
+    one token, pages held for export at retire."""
+    fe.submit(prompt, max_new_tokens=1, rid=rid, hold_kv=True)
+    res = fe.results(wait=True)[rid]
+    assert res.status == "ok" and len(res.tokens) == 1
+    return res.tokens[0]
+
+
+def _hog_pool(fe, rids, max_new=40):
+    """Fill a frontend's whole page pool with direct submissions (2
+    slots x 1 page at this scale) and pump until the pool is empty —
+    the deterministic way to park a handoff on backpressure."""
+    for rid, p in zip(rids, _prompts(len(rids), rng_seed=77)):
+        fe.submit(p, max_new_tokens=max_new, rid=rid)
+    for _ in range(20):
+        if fe.engine._pool.available() == 0:
+            return
+        fe.step()
+    raise AssertionError("hogs never exhausted the destination pool")
+
+
+# --------------------------------------------- the transfer primitive
+
+
+def test_export_ticket_minting_and_rid_idempotence(model):
+    """A hold_kv prefill pins its pages; export_pages mints ONE ticket
+    per rid (re-serving it on replays — the exactly-once anchor), an
+    unknown rid is a typed None, and release unpins. No page leaks."""
+    fe = _frontend(model, role="prefill")
+    first = _prefill_hold(fe, _prompts(1)[0], rid=5)
+    eng = fe.engine
+    assert eng._pinned_pages() > 0          # the hold outlives retire
+    t1 = fe.export_pages(5)
+    t2 = fe.export_pages(5)                 # a re-drive gets the SAME
+    assert t1["ticket"] == t2["ticket"]     # ticket (dedup key)
+    assert t1["rid"] == 5 and t1["first_token"] == first
+    assert t1["n_pages"] >= 1
+    assert t1["n_chunks"] == -(-t1["n_pages"] // t1["chunk_pages"])
+    assert fe.export_pages(999) is None     # never prefilled here
+    assert fe.release_export(t1["ticket"])
+    assert not fe.release_export(t1["ticket"])   # idempotent
+    assert eng._pinned_pages() == 0
+    assert fe.export_pages(5) is None       # released means gone
+    fe.shutdown()
+
+
+def test_manual_hop_is_bit_identical_to_colocated(model):
+    """export → transfer_pages → kv_import submit on a second frontend
+    reproduces the colocated stream bit-identically: the source sampled
+    stream index 0, the destination adopts the pages and samples stream
+    index 1 onward under the same (seed, rid) key stream."""
+    src = _frontend(model, role="prefill")
+    dst = _frontend(model, role="decode")
+    p = _prompts(1)[0]
+    ref = _reference(model, [p], [3], 6)
+    _prefill_hold(src, p, rid=3)
+    ticket = src.export_pages(3)
+    done = transfer_pages(src, dst, ticket)
+    assert done["ticket"] == ticket["ticket"]
+    dst.submit(p, max_new_tokens=6, rid=3, token_base=0,
+               kv_import=ticket["ticket"])
+    out = dst.results(wait=True)[3]
+    assert out.status == "ok"
+    np.testing.assert_array_equal(out.tokens, ref[3])
+    assert resilience.get_counter("serving.kv_import_adopted") == 1
+    assert src.release_export(ticket["ticket"])
+    assert src.engine._pinned_pages() == 0
+    assert dst.engine._pinned_pages() == 0  # adopted pages freed at retire
+    src.shutdown()
+    dst.shutdown()
+
+
+def test_chunk_drop_resumes_and_stays_bit_exact(model):
+    """A dropped frame retries just that chunk; landed chunks dedup by
+    (ticket, index) so the replay is idempotent and the adopted stream
+    is still bit-identical."""
+    src = _frontend(model, role="prefill")
+    dst = _frontend(model, role="decode")
+    p = _prompts(1)[0]
+    ref = _reference(model, [p], [4], 6)
+    _prefill_hold(src, p, rid=4)
+    ticket = src.export_pages(4)
+    set_flags({"FLAGS_fault_injection": "transfer.chunk_drop:2"})
+    transfer_pages(src, dst, ticket)        # survives both drops
+    assert resilience.get_counter("transfer.chunk_drop") == 2
+    assert telemetry.counter("fleet.transfer_resumed_chunks").value() == 2
+    dst.submit(p, max_new_tokens=6, rid=4, token_base=0,
+               kv_import=ticket["ticket"])
+    out = dst.results(wait=True)[4]
+    assert out.status == "ok"
+    np.testing.assert_array_equal(out.tokens, ref[4])
+    src.release_export(ticket["ticket"])
+    src.shutdown()
+    dst.shutdown()
+
+
+def test_chunk_drop_budget_exhaustion_is_typed_and_leak_free(model):
+    """A chunk that NEVER arrives exhausts the per-chunk retry budget
+    into a typed TransferDestError — the driver can fail, it can never
+    hang — and the destination's partial import is dropped. The source
+    pages stay pinned, so a later retry still succeeds."""
+    src = _frontend(model, role="prefill")
+    dst = _frontend(model, role="decode")
+    _prefill_hold(src, _prompts(1)[0], rid=6)
+    ticket = src.export_pages(6)
+    set_flags({"FLAGS_fault_injection": "transfer.chunk_drop:1000"})
+    with pytest.raises(TransferDestError):
+        transfer_pages(src, dst, ticket, max_chunk_retries=1)
+    assert dst.engine._imports == {}        # partial dropped, no leak
+    assert dst.engine._pinned_pages() == 0
+    resilience.reset_faults()
+    transfer_pages(src, dst, ticket)        # pages survived the failure
+    src.release_export(ticket["ticket"])
+    dst.drop_import(ticket["ticket"])
+    assert dst.engine._pinned_pages() == 0
+    src.shutdown()
+    dst.shutdown()
+
+
+def test_source_death_is_typed_source_error(model):
+    """A source lost mid-transfer — drilled kill or a respawned process
+    that no longer knows the ticket — is ALWAYS the typed
+    TransferSourceError verdict (re-prefill is the only recovery),
+    never silent corruption; the destination partial is dropped."""
+    src = _frontend(model, role="prefill")
+    dst = _frontend(model, role="decode")
+    _prefill_hold(src, _prompts(1)[0], rid=8)
+    ticket = src.export_pages(8)
+    set_flags({"FLAGS_fault_injection": "transfer.source_death:1"})
+    with pytest.raises(TransferSourceError):
+        transfer_pages(src, dst, ticket)
+    assert resilience.get_counter("transfer.source_death") == 1
+    assert dst.engine._imports == {}
+    resilience.reset_faults()
+    # the respawned-source shape of the same loss: the ticket is gone
+    src.release_export(ticket["ticket"])
+    with pytest.raises(TransferSourceError):
+        transfer_pages(src, dst, ticket)
+    assert dst.engine._pinned_pages() == 0
+    src.shutdown()
+    dst.shutdown()
+
+
+def test_import_fault_budget_is_typed_dest_error(model):
+    """Destination-side import faults retry within the chunk budget and
+    then raise the typed TransferDestError the router charges against
+    its transfer budget."""
+    src = _frontend(model, role="prefill")
+    dst = _frontend(model, role="decode")
+    _prefill_hold(src, _prompts(1)[0], rid=9)
+    ticket = src.export_pages(9)
+    set_flags({"FLAGS_fault_injection": "transfer.import_fail:1000"})
+    with pytest.raises(TransferDestError):
+        transfer_pages(src, dst, ticket, max_chunk_retries=2)
+    assert resilience.get_counter("transfer.import_fail") >= 3
+    resilience.reset_faults()
+    assert dst.engine._imports == {}
+    assert dst.engine._pinned_pages() == 0
+    src.release_export(ticket["ticket"])
+    src.shutdown()
+    dst.shutdown()
+
+
+def test_pool_exhaustion_is_backpressure_not_failure(model):
+    """A destination pool that cannot grant the pages right now raises
+    TransferNoCapacity — transient backpressure the router parks on
+    without charging the budget. The export stays pinned, and the same
+    transfer succeeds once capacity frees."""
+    src = _frontend(model, role="prefill")
+    dst = _frontend(model, role="decode")
+    p = _prompts(1)[0]
+    ref = _reference(model, [p], [2], 6)
+    _prefill_hold(src, p, rid=2)
+    ticket = src.export_pages(2)
+    _hog_pool(dst, rids=(900, 901), max_new=8)
+    with pytest.raises(TransferNoCapacity):
+        transfer_pages(src, dst, ticket)
+    assert dst.engine._imports == {}        # nothing half-landed
+    dst.results(wait=True)                  # hogs retire, pages free
+    transfer_pages(src, dst, ticket)        # same ticket now lands
+    dst.submit(p, max_new_tokens=6, rid=2, token_base=0,
+               kv_import=ticket["ticket"])
+    out = dst.results(wait=True)[2]
+    assert out.status == "ok"
+    np.testing.assert_array_equal(out.tokens, ref[2])
+    src.release_export(ticket["ticket"])
+    src.shutdown()
+    dst.shutdown()
+
+
+# ------------------------------------------- router policy, in-process
+
+
+def test_disagg_fleet_bit_identical_zero_postwarmup_compiles(model):
+    """The happy path: a prefill+decode fleet serves every request
+    through the handoff with streams bit-identical to colocated serving
+    and ZERO post-warmup compiles — the export/import chunk programs
+    are part of the warmup set, and page adoption is pure host
+    bookkeeping."""
+    prompts = _prompts(4)
+    ref = _reference(model, prompts, list(range(4)), 6)
+
+    router = ServingRouter()
+    fe_pre = _frontend(model, role="prefill")
+    fe_dec = _frontend(model, role="decode")
+    router.add_replica(fe_pre, warmup=True)
+    router.add_replica(fe_dec, warmup=True)
+    c = telemetry.counter("xla.compiles_total")
+    serving0 = c.value(phase="serving")
+    with count_backend_compiles() as compiles:
+        rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+        res = router.results(wait=True, timeout_s=600)
+    for rid in rids:
+        assert res[rid].status == "ok", res[rid]
+        np.testing.assert_array_equal(res[rid].tokens, ref[rid])
+    assert compiles == [], \
+        f"disaggregated serving compiled {len(compiles)} programs"
+    assert c.value(phase="serving") == serving0
+    assert resilience.get_counter("fleet.transfer_started") == 4
+    assert resilience.get_counter("fleet.transfer_completed") == 4
+    assert resilience.get_counter("serving.kv_import_adopted") == 4
+    # completed handoffs leak nothing on either side
+    assert fe_pre.engine._exports == {}
+    assert fe_pre.engine._pinned_pages() == 0
+    assert fe_dec.engine._pinned_pages() == 0
+    router.shutdown()
+
+
+def test_role_surface_and_colocated_degradation(model):
+    """Roles are advisory: a fleet with no decode-capable replica (or a
+    one-token budget that makes the hop pointless) serves colocated —
+    roles degrade, they never exclude. The role rides health() and the
+    fleet metrics roster."""
+    eng = ContinuousBatchingEngine(model, max_slots=2, max_len=64,
+                                   prompt_buckets=(8, 16), seed=13)
+    with pytest.raises(ValueError):
+        ServingFrontend(eng, role="shard")
+    router = ServingRouter()
+    fe_a = _frontend(model, role="prefill")
+    fe_b = _frontend(model, role="prefill")
+    assert fe_a.health()["role"] == "prefill"
+    router.add_replica(fe_a)
+    router.add_replica(fe_b)
+    prompts = _prompts(3, rng_seed=5)
+    ref = _reference(model, prompts, list(range(3)), 6)
+    rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+    res = router.results(wait=True, timeout_s=600)
+    for rid in rids:
+        assert res[rid].status == "ok"
+        np.testing.assert_array_equal(res[rid].tokens, ref[rid])
+    assert resilience.get_counter("fleet.transfer_started") == 0
+    fm = router.fleet_metrics()
+    assert {r["role"] for r in fm["replicas"].values()} == {"prefill"}
+    assert fm["transfers_inflight"] == 0
+    router.shutdown()
+
+    # prefill+decode, but a ONE-token budget: the prefill leg IS the
+    # whole request — no hop is minted
+    router2 = ServingRouter()
+    router2.add_replica(_frontend(model, role="prefill"))
+    router2.add_replica(_frontend(model, role="decode"))
+    rid = router2.submit(_prompts(1, rng_seed=6)[0], max_new_tokens=1)
+    assert router2.results(wait=True, timeout_s=600)[rid].status == "ok"
+    assert resilience.get_counter("fleet.transfer_started") == 0
+    router2.shutdown()
+
+
+def test_router_source_death_reprefills_bit_exact(model):
+    """The source dies mid-transfer: the router abandons the hop and
+    re-prefills from the journaled prefix — the client stream is still
+    bit-identical and no pages leak anywhere."""
+    prompts = _prompts(2, rng_seed=9)
+    ref = _reference(model, prompts, list(range(2)), 6)
+    router = ServingRouter()
+    fe_pre = _frontend(model, role="prefill")
+    fe_dec = _frontend(model, role="decode")
+    router.add_replica(fe_pre)
+    router.add_replica(fe_dec)
+    set_flags({"FLAGS_fault_injection": "transfer.source_death:1"})
+    rids = [router.submit(p, max_new_tokens=6) for p in prompts]
+    res = router.results(wait=True, timeout_s=600)
+    for rid in rids:
+        assert res[rid].status == "ok", res[rid]
+        np.testing.assert_array_equal(res[rid].tokens, ref[rid])
+    assert resilience.get_counter("transfer.source_death") == 1
+    assert resilience.get_counter("fleet.transfer_abandoned") >= 1
+    assert fe_pre.engine._exports == {}
+    assert fe_pre.engine._pinned_pages() == 0
+    assert fe_dec.engine._pinned_pages() == 0
+    router.shutdown()
+
+
+def test_router_import_fault_budget_retires_failed_never_hangs(model):
+    """A destination that keeps failing imports charges the bounded
+    transfer budget; exhaustion retires the request "failed" — a
+    handoff can degrade or fail, it can NEVER hang — and the abandoned
+    export is released."""
+    router = ServingRouter(breaker_threshold=50)  # keep the dest eligible
+    fe_pre = _frontend(model, role="prefill")
+    fe_dec = _frontend(model, role="decode")
+    router.add_replica(fe_pre)
+    router.add_replica(fe_dec)
+    set_flags({"FLAGS_fault_injection": "transfer.import_fail:100000"})
+    rid = router.submit(_prompts(1, rng_seed=11)[0], max_new_tokens=6)
+    res = router.results(wait=True, timeout_s=600)
+    assert res[rid].status == "failed"
+    assert resilience.get_counter("fleet.transfer_budget_exhausted") == 1
+    assert resilience.get_counter("fleet.transfer_failed") >= 3
+    resilience.reset_faults()
+    assert fe_pre.engine._exports == {}     # abandoned hop released its pin
+    assert fe_pre.engine._pinned_pages() == 0
+    assert fe_dec.engine._pinned_pages() == 0
+    router.shutdown()
+
+
+def test_router_breaker_trip_degrades_to_colocated(model):
+    """Same fault, default breaker: the failing destination's breaker
+    opens, the candidate pool empties, and the router abandons the hop
+    into a COLOCATED re-prefill — the client still gets the bit-exact
+    stream, degraded but served."""
+    p = _prompts(1, rng_seed=13)[0]
+    ref = _reference(model, [p], [0], 6)
+    router = ServingRouter()                # breaker_threshold=3
+    fe_pre = _frontend(model, role="prefill")
+    fe_dec = _frontend(model, role="decode")
+    router.add_replica(fe_pre)
+    router.add_replica(fe_dec)
+    set_flags({"FLAGS_fault_injection": "transfer.import_fail:100000"})
+    rid = router.submit(p, max_new_tokens=6)
+    res = router.results(wait=True, timeout_s=600)
+    resilience.reset_faults()
+    assert res[rid].status == "ok", res[rid]
+    np.testing.assert_array_equal(res[rid].tokens, ref[0])
+    assert resilience.get_counter("fleet.transfer_abandoned") >= 1
+    assert fe_pre.engine._exports == {}
+    assert fe_pre.engine._pinned_pages() == 0
+    assert fe_dec.engine._pinned_pages() == 0
+    router.shutdown()
+
+
+def test_killed_source_sweeps_its_parked_transfers(model):
+    """A handoff parked on destination backpressure belongs to its
+    source: when the source replica is killed, the kill sweep abandons
+    the parked hop (the pages died with the process) and the request
+    re-prefills on the survivor — bit-exact, zero lost."""
+    p = _prompts(1, rng_seed=15)[0]
+    ref = _reference(model, [p], [0], 6)
+    router = ServingRouter()
+    fe_pre = _frontend(model, role="prefill")
+    fe_dec = _frontend(model, role="decode")
+    router.add_replica(fe_pre)
+    router.add_replica(fe_dec)
+    _hog_pool(fe_dec, rids=(900, 901))      # decode pool: zero free pages
+    rid = router.submit(p, max_new_tokens=6)
+    for _ in range(200):
+        router.step()
+        if router._transfers:
+            break
+    assert rid in router._transfers, "handoff never parked"
+    assert resilience.get_counter("fleet.transfer_backpressure") >= 1
+    router.fail_replica(0, reason="drill")  # the SOURCE dies
+    assert router._transfers == {}          # sweep abandoned the hop
+    assert resilience.get_counter("fleet.transfer_abandoned") == 1
+    res = router.results(wait=True, timeout_s=600)
+    assert res[rid].status == "ok", res[rid]
+    np.testing.assert_array_equal(res[rid].tokens, ref[0])
+    assert fe_dec.engine._pinned_pages() == 0
+    router.shutdown()
+
+
+# --------------------------------------------- journal + takeover
+
+
+def test_journal_handoff_record_roundtrip(tmp_path):
+    """HANDOFF is a first-class WAL record: durable before the decode
+    dispatch acks, cleared by HANDOFF_DONE, replayed by recover() so a
+    takeover knows exactly which hops were mid-flight."""
+    j = RequestJournal(tmp_path, epoch=1)
+    assert j.admit(5, [1, 2, 3], 8)
+    assert not j.handoff(99, source=0, ticket={"ticket": "zz"})  # unknown
+    assert j.handoff(5, source=0,
+                     ticket={"ticket": "abc", "n_pages": 1, "n_chunks": 1,
+                             "chunk_pages": 4, "rid": 5, "prefill_len": 3,
+                             "first_token": 42, "page_size": 64},
+                     first_token=42, prefill_len=3, dest=None)
+    j.flush()
+    rec = RequestJournal.recover(root=tmp_path, epoch=2)
+    ho = rec.live_state()[5].get("handoff")
+    assert ho is not None
+    assert ho["source"] == 0 and ho["first_token"] == 42
+    assert ho["ticket"]["ticket"] == "abc"
+    assert rec.handoff_done(5)
+    assert not rec.handoff_done(5)          # already cleared
+    rec.flush()
+    rec2 = RequestJournal.recover(root=tmp_path, epoch=3)
+    assert rec2.live_state()[5].get("handoff") is None
+    j.close()
+    rec.close()
+    rec2.close()
+
+
+def test_takeover_redrives_parked_handoff_exactly_once(model, tmp_path):
+    """The router crashes with a journaled handoff parked mid-transfer;
+    the standby replays the WAL, re-drives the hop against the LIVE
+    source — the rid-idempotent export re-serves the SAME ticket, the
+    destination dedups by it — and the client stream completes
+    bit-exact with the prefill adopted exactly once."""
+    p = _prompts(1, rng_seed=17)[0]
+    ref = _reference(model, [p], [0], 6)
+    fe_pre = _frontend(model, role="prefill")
+    fe_dec = _frontend(model, role="decode")
+    active = ServingRouter(journal_root=str(tmp_path), fleet_prefix="xfr")
+    active.add_replica(fe_pre)
+    active.add_replica(fe_dec)
+    _hog_pool(fe_dec, rids=(900, 901))
+    rid = active.submit(p, max_new_tokens=6)
+    for _ in range(200):
+        active.step()
+        if active._transfers:
+            break
+    assert rid in active._transfers, "handoff never parked"
+    active._journal.close()                 # "crash": heap gone, WAL on disk
+
+    store = TCPStore(is_master=True)
+    standby = ServingRouter(
+        standby=True, journal_root=str(tmp_path), fleet_prefix="xfr",
+        leader_lease=LeaderLease(store, prefix="xfr", owner="standby",
+                                 ttl=1.0, interval=0.1))
+    standby.add_replica(fe_pre)             # same ids as the dead leader
+    standby.add_replica(fe_dec)
+    info = standby.take_over(timeout=30.0)
+    assert info["resubmitted"] == 1
+    assert resilience.get_counter("fleet.handoff_redriven") == 1
+    res = standby.results(wait=True, timeout_s=600)
+    assert res[rid].status == "ok", res[rid]
+    np.testing.assert_array_equal(res[rid].tokens, ref[0])
+    assert resilience.get_counter("serving.kv_import_adopted") == 1
+    assert fe_pre.engine._exports == {}     # completed hop released its pin
+    assert fe_pre.engine._pinned_pages() == 0
+    assert fe_dec.engine._pinned_pages() == 0
+    standby.shutdown()
+    store.close()
+
+
+# ------------------------------------- flagship: multi-process drill
+
+
+_XFER_REPLICA_SCRIPT = """
+import os
+
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.models.frontend import ServingFrontend
+from paddle_tpu.models.remote import replica_main
+from paddle_tpu.models.serving import ContinuousBatchingEngine
+
+CFG = LlamaConfig(vocab_size=97, hidden_size=16, intermediate_size=32,
+                  num_hidden_layers=1, num_attention_heads=2,
+                  max_position_embeddings=128, tie_word_embeddings=True)
+
+
+def build():
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    paddle.seed(0)
+    model = LlamaForCausalLM(CFG)
+    eng = ContinuousBatchingEngine(model, max_slots=2, max_len=64,
+                                   prompt_buckets=(8, 16), do_sample=True,
+                                   temperature=0.9, seed=13)
+    return ServingFrontend(eng, max_queue=32, segment=4,
+                           breaker_threshold=50,
+                           role="prefill" if rank == 0 else "decode")
+
+
+if __name__ == "__main__":
+    raise SystemExit(replica_main(build))
+"""
+
+
+def _stub(rank):
+    return RemoteFrontend(f"replica{rank}", timeout=60.0,
+                          health_timeout=10.0, retry_attempts=2,
+                          resend_after=30.0, results_wait=0.1)
+
+
+def _reference_subprocess_safe(prompts, rids, max_new):
+    """Uninterrupted reference run with the fleet's rids, on a fresh
+    deterministic model (paddle.seed(0)) — the same weights the replica
+    processes build."""
+    paddle.seed(0)
+    model = LlamaForCausalLM(_CFG)
+    return _reference(model, prompts, rids, max_new)
+
+
+def test_cross_process_disagg_kill_prefill_mid_transfer(tmp_path):
+    """THE acceptance drill across real process boundaries: 1 prefill +
+    2 decode replica PROCESSES over RPC; the prefill replica is
+    SIGKILLed with page transfers parked mid-handoff (the decode pools
+    are pinned full so the park is deterministic); zero requests are
+    lost, every stream is bit-identical to the uninterrupted run, the
+    fleet degrades to colocated serving on the decode survivors, and
+    the respawned rank rejoins and hands off again."""
+    script = tmp_path / "replica.py"
+    script.write_text(textwrap.dedent(_XFER_REPLICA_SCRIPT))
+    store = rpc.init_rpc("router", rank=0, world_size=4)
+    endpoint = f"127.0.0.1:{store.port}"
+    fleet_store = TCPStore(port=store.port)
+    router = ServingRouter(store=fleet_store, lease=1.5,
+                           heartbeat_interval=0.1, max_failovers=3)
+    rc_box = {}
+    supervisor = threading.Thread(
+        target=lambda: rc_box.update(rc=launch_fleet(
+            str(script), n_replicas=3, max_restarts=2,
+            env={RPC_MASTER_ENV: endpoint},
+            backoff_base=0.01, poll_interval=0.05)),
+        daemon=True)
+    supervisor.start()
+    try:
+        for rank in (0, 1, 2):
+            rpc.get_worker_info(f"replica{rank}", timeout=300)
+            router.add_replica(_stub(rank), replica_id=rank)
+        assert router._replicas[0].role == "prefill"
+        assert router._replicas[1].role == "decode"
+        pids = {r: int(fleet_store.get(f"fleet/pid/{r}").decode())
+                for r in (0, 1, 2)}
+
+        # warm pass: first-traffic compiles + the first handoffs
+        warm = [router.submit(p, max_new_tokens=2)
+                for p in _prompts(2, rng_seed=7)]
+        wres = router.results(wait=True, timeout_s=600)
+        assert all(wres[r].status == "ok" for r in warm)
+        assert resilience.get_counter("fleet.transfer_completed") >= 1
+
+        # ---- pin BOTH decode pools full via hold_kv hogs claimed into
+        # exports, so the next handoffs park on backpressure and the
+        # kill below lands mid-transfer deterministically
+        hog_stubs = {1: _stub(1), 2: _stub(2)}
+        hog_tickets = []
+        hog_rid = itertools.count(900)
+        for rank, st in hog_stubs.items():
+            rids = [next(hog_rid) for _ in range(2)]
+            for r, p in zip(rids, _prompts(2, rng_seed=70 + rank)):
+                st.submit(p, max_new_tokens=2, rid=r, hold_kv=True)
+            for r in rids:
+                deadline = time.monotonic() + 120
+                t = None
+                while t is None and time.monotonic() < deadline:
+                    t = st.export_pages(r)
+                    time.sleep(0.05)
+                assert t is not None, f"hog {r} never held its pages"
+                hog_tickets.append((st, t["ticket"]))
+
+        prompts_b = _prompts(4, rng_seed=11)
+        rids_b = [router.submit(p, max_new_tokens=8) for p in prompts_b]
+        deadline = time.monotonic() + 120
+        while not router._transfers and time.monotonic() < deadline:
+            router.step()
+            time.sleep(0.02)
+        assert router._transfers, "no handoff parked mid-transfer"
+
+        # ---- the kill: the prefill source dies holding parked exports
+        os.kill(pids[0], signal.SIGKILL)
+        for st, tid in hog_tickets:         # free the decode pools
+            st.release_export(tid)
+        res_b = router.results(wait=True, timeout_s=600)
+        assert set(res_b) >= set(rids_b)    # zero requests lost
+        want_b = _reference_subprocess_safe(prompts_b, rids_b, 8)
+        for rid in rids_b:
+            assert res_b[rid].status == "ok", res_b[rid]
+            np.testing.assert_array_equal(res_b[rid].tokens, want_b[rid])
+        assert router._replicas[0].state == "dead"
+        assert resilience.get_counter("fleet.replica_dead") == 1
+        assert resilience.get_counter("fleet.transfer_abandoned") >= 1
+
+        # ---- the respawned prefill rank rejoins and hands off again
+        deadline = time.monotonic() + 300
+        new_pid = None
+        while time.monotonic() < deadline:
+            try:
+                pid = int(fleet_store.get("fleet/pid/0").decode())
+            except Exception:
+                pid = pids[0]
+            if pid != pids[0]:
+                new_pid = pid
+                break
+            time.sleep(0.2)
+        assert new_pid is not None, "supervisor did not respawn the rank"
+        rpc.get_worker_info("replica0", timeout=300)
+        router.add_replica(_stub(0), replica_id=0)
+        done0 = resilience.get_counter("fleet.transfer_completed")
+        prompts_c = _prompts(2, rng_seed=13)
+        rids_c = [router.submit(p, max_new_tokens=4) for p in prompts_c]
+        res_c = router.results(wait=True, timeout_s=600)
+        want_c = _reference_subprocess_safe(prompts_c, rids_c, 4)
+        for rid in rids_c:
+            assert res_c[rid].status == "ok", res_c[rid]
+            np.testing.assert_array_equal(res_c[rid].tokens, want_c[rid])
+        assert resilience.get_counter("fleet.transfer_completed") > done0
+    finally:
+        router.shutdown()
+        supervisor.join(120)
+        rpc.shutdown()
+        fleet_store.close()
+    assert rc_box.get("rc") == 0            # every replica exited clean
