@@ -32,7 +32,10 @@ __all__ = [
     "to_static", "TrainStep", "cond", "while_loop", "scan",
     "ignore_module", "not_to_static", "StaticFunction",
     "enable_compilation_cache",
+    "fuse_elementwise_chains", "fusion_stats",
 ]
+
+from .fusion import fuse_elementwise_chains, fusion_stats  # noqa: E402
 
 
 def enable_compilation_cache(cache_dir, min_compile_time_s=0.0):

@@ -70,7 +70,8 @@ def _make_static_cache(k, v, length):
 
 
 def _make_paged_cache(kp, vp, tables, page_size, length,
-                      aligned_bases=False, attn_pages=None):
+                      aligned_bases=False, attn_pages=None,
+                      dump_page=None):
     from .llama import PagedKVCache
 
     c = PagedKVCache.__new__(PagedKVCache)
@@ -81,6 +82,9 @@ def _make_paged_cache(kp, vp, tables, page_size, length,
     # attn_pages caps how many table columns attention READS (the
     # ragged paged-attention kernel's pages-per-sequence bound)
     c.attn_pages = attn_pages
+    # sacrificial page absorbing the decode megakernel's non-append
+    # page flushes (the engine's dump page)
+    c.dump_page = dump_page
     return c
 
 

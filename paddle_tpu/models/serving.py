@@ -109,6 +109,9 @@ _M_TOKENS = telemetry.counter(
     "serving.tokens_total", "tokens emitted by the engine scheduler")
 _M_REQS = telemetry.counter(
     "serving.requests_total", "terminal request verdicts, by status")
+_M_MEGA_SEG = telemetry.counter(
+    "serving.megakernel_segments", "decode segments dispatched through "
+    "the fused megakernel program (FLAGS_decode_megakernel)")
 # KV-occupancy accounting (perfwatch): the measurement side of the
 # paged-KV roadmap item — logical occupancy of the preallocated page
 # pool, not PJRT allocator bytes (the pool is allocated up front; the
@@ -287,6 +290,12 @@ class ContinuousBatchingEngine:
     ``pipeline=False`` forces the serial scheduler for this engine.
     """
 
+    # The fused decode megakernel keeps residual + post-attention norm
+    # INSIDE the per-layer kernel, right after o_proj. Subclasses whose
+    # o_proj output is a PARTIAL sum (TP row-parallel needs a psum
+    # before the residual) must opt out.
+    _megakernel_ok = True
+
     def __init__(self, model, max_slots, max_len, page_size=128,
                  do_sample=False, temperature=1.0, top_k=None, top_p=None,
                  eos_token_id=None, prompt_buckets=(16, 32, 64, 128),
@@ -413,6 +422,15 @@ class ContinuousBatchingEngine:
         self._warmed = False
         self._prefill_p = None
         self._segment_p = None
+        # fused decode path (FLAGS_decode_megakernel): decided ONCE per
+        # engine — the fused segment program is built and AOT-warmed
+        # only when the model passes the capability probe, so the
+        # zero-post-warmup-compile invariant covers both paths
+        from ..ops.pallas.decode_megakernel import megakernel_model_supported
+
+        self._megakernel = (int(flag("FLAGS_decode_megakernel")) > 0
+                            and type(self)._megakernel_ok
+                            and megakernel_model_supported(model))
         self._build_programs()
 
     # -------------------------------------------- page recycling safety
@@ -494,7 +512,8 @@ class ContinuousBatchingEngine:
             aligned = self.prompt_buckets[-1] % self.page_size == 0
         return [_make_paged_cache(ks[i], vs[i], tables, self.page_size,
                                   length, aligned_bases=aligned,
-                                  attn_pages=self._cols)
+                                  attn_pages=self._cols,
+                                  dump_page=self._dump_page)
                 for i in range(self._nl)]
 
     def _build_programs(self):
@@ -629,7 +648,32 @@ class ContinuousBatchingEngine:
         self._cow_p = jax.jit(cow_copy, donate_argnums=(1, 2))
         self._export_p = jax.jit(export_pages, donate_argnums=(1, 2))
         self._import_p = jax.jit(import_pages, donate_argnums=(1, 2))
-        self._segment_p = jax.jit(segment, donate_argnums=(1, 2))
+        from ..ops.pallas.decode_megakernel import megakernel_scope
+
+        def segment_unfused(*args):
+            # scope(False): the per-layer megakernel hook must not fire
+            # in a declined engine's program even under forced-kernel
+            # flag modes — this program IS the unfused reference
+            with megakernel_scope(False):
+                return segment(*args)
+
+        def segment_fused(*args):
+            with megakernel_scope(True):
+                return segment(*args)
+
+        # ONE segment program, shape decided by the construction-time
+        # probe (self._megakernel): every caller — dispatch, bisection
+        # replay, fault-injecting tests that monkeypatch _segment_p —
+        # sees the same program either way.
+        if self._megakernel:
+            from ..jit.fusion import fuse_elementwise_chains
+
+            self._segment_p = jax.jit(
+                fuse_elementwise_chains(segment_fused),
+                donate_argnums=(1, 2))
+        else:
+            self._segment_p = jax.jit(segment_unfused,
+                                      donate_argnums=(1, 2))
 
     # --------------------------------------------------- program dispatch
 
@@ -800,13 +844,13 @@ class ContinuousBatchingEngine:
         seg = int(segment if segment is not None
                   else getattr(self, "_segment_len", 16))
         m = self.max_slots
-        compile_(("segment", seg), self._segment_p,
-                 self._op_aval((m, cols), i32),
-                 self._op_aval((m,), i32),
-                 self._op_aval((m,), i32),
-                 self._op_aval((m,), jnp.bool_),
-                 self._op_aval((m,), i32),
-                 self._op_aval((seg, m) + self._key_shape, kdt))
+        seg_avals = (self._op_aval((m, cols), i32),
+                     self._op_aval((m,), i32),
+                     self._op_aval((m,), i32),
+                     self._op_aval((m,), jnp.bool_),
+                     self._op_aval((m,), i32),
+                     self._op_aval((seg, m) + self._key_shape, kdt))
+        compile_(("segment", seg), self._segment_p, *seg_avals)
         return stats
 
     # ------------------------------------------------------- sampling keys
@@ -1502,6 +1546,8 @@ class ContinuousBatchingEngine:
                     self._tables_device(),
                     lengths, toks, active, self._limits_device(), keys)
         self._seg_runs += 1
+        if self._megakernel and telemetry.enabled():
+            _M_MEGA_SEG.inc()
         if telemetry.enabled():
             # host-side issue cost only: the call returns while the
             # device still runs (async dispatch)

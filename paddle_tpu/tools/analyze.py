@@ -637,18 +637,65 @@ class RepoIndex:
                         self.jit_entries.append((fi, w, fi.node.lineno))
             # value form: jax.jit(fn, ...) anywhere in the module;
             # the binding target (name or self attribute) becomes a
-            # known-jitted callable for the recompile pass
+            # known-jitted callable for the recompile pass. Pallas wrap
+            # sites conventionally close static params over partial —
+            # both `pallas_call(functools.partial(_kernel, ...))` and
+            # the local-binding spelling `k = functools.partial(
+            # _kernel, ...); pallas_call(k, ...)` register the kernel
+            # body as a jit entry for the tracer-safety sweep.
             class Scope(ast.NodeVisitor):
                 def __init__(self, idx):
                     self.idx = idx
+                    # local name -> (kernel FuncInfo, partial-bound
+                    # static names)
+                    self.partials = {}
+
+                def _wrapped_func(self, expr):
+                    """Resolve a wrap-call operand to a module-level
+                    function: a bare name, a partial(...) over one, or
+                    a local partial binding. Params bound by partial
+                    are baked Python values, hence static."""
+                    if isinstance(expr, ast.Name):
+                        hit = self.partials.get(expr.id)
+                        if hit is not None:
+                            return hit
+                        return simple.get(expr.id), set()
+                    if isinstance(expr, ast.Call):
+                        d = _dotted(expr.func)
+                        if d and d.split(".")[-1] == "partial" \
+                                and expr.args:
+                            fi, names = self._wrapped_func(
+                                expr.args[0])
+                            if fi is not None:
+                                names = names | {
+                                    kw.arg for kw in expr.keywords
+                                    if kw.arg}
+                                a = fi.node.args
+                                pos = [p.arg for p in
+                                       a.posonlyargs + a.args]
+                                names |= set(
+                                    pos[:len(expr.args) - 1])
+                            return fi, names
+                    return None, set()
+
+                def visit_Assign(self, node):
+                    if (isinstance(node.value, ast.Call)
+                            and len(node.targets) == 1
+                            and isinstance(node.targets[0], ast.Name)):
+                        d = _dotted(node.value.func)
+                        if d and d.split(".")[-1] == "partial":
+                            fi, names = self._wrapped_func(node.value)
+                            if fi is not None:
+                                self.partials[node.targets[0].id] = \
+                                    (fi, names)
+                    self.generic_visit(node)
 
                 def visit_Call(self, node):
                     w = self.idx._jit_wrapper_name(node.func)
-                    if w and node.args and isinstance(node.args[0],
-                                                      ast.Name):
-                        fi = simple.get(node.args[0].id)
+                    if w and node.args:
+                        fi, names = self._wrapped_func(node.args[0])
                         if fi is not None:
-                            fi.static_names |= \
+                            fi.static_names |= names | \
                                 self.idx._static_names_of(node, fi)
                             self.idx.jit_entries.append(
                                 (fi, w, node.lineno))

@@ -98,6 +98,17 @@ GATES = {
     "transfer_overhead_pct": 10.0,
     "decode_ttft_p95_ratio": 2.0,
     "transfer_lost_requests": 1.0,   # 0/1+: requests lost in the A/B
+    # decode megakernel (bench e11): the fused segment program must beat
+    # the unfused arm on chip, and the blocking-fetch share of a decode
+    # step (device_wait p50, fused/unfused) must not regress past noise.
+    # Pre-e11 rounds lack the section — absent metrics skip.
+    "decode_megakernel_speedup": ("min", 1.0),
+    "megakernel_device_wait_ratio": 1.25,
+    # the re-armed decode floor (PR 10 left it at 0.81x): a 3-tuple gate
+    # ("min"/"max", bound, requires_metric) applies only to rounds that
+    # CARRY requires_metric — the floor is re-gated at parity from the
+    # first e11 round onward without failing every pre-megakernel round
+    "decode_vs_streaming_floor": ("min", 1.0, "decode_megakernel_speedup"),
 }
 
 DEFAULT_RATIO_THRESHOLD = 0.9   # per-round e2e_vs_baseline alarm
@@ -119,7 +130,8 @@ _LOWER_BETTER = ("_ms", "_us", "overhead", "_error")
 # channel. These are ratios against an in-run reference (streaming
 # floor, chip peak, serial arm), so a drop is a real code regression.
 _TREND_CALIBRATED = ("mfu_pct", "vs_streaming_floor", "vs_floor",
-                     "pipeline_speedup", "mfu_vs_in_run_matmul")
+                     "pipeline_speedup", "mfu_vs_in_run_matmul",
+                     "megakernel_speedup")
 
 
 def _trendable(metric) -> bool:
@@ -304,7 +316,13 @@ def analyze(root, ratio_threshold=DEFAULT_RATIO_THRESHOLD,
             if v is None:
                 continue
             if isinstance(limit, tuple):
-                op, bound = limit
+                op, bound = limit[0], limit[1]
+                # conditional gate: armed only for rounds carrying the
+                # witness metric (a gate re-tightened mid-series must
+                # not retroactively fail the rounds before the work)
+                if len(limit) > 2 and (r["metrics"] or {}).get(
+                        limit[2]) is None:
+                    continue
             else:
                 op, bound = "max", limit
             bad = (v < bound) if op == "min" else (v >= bound)

@@ -1,0 +1,36 @@
+"""tpu-lint fixture: pallas_call kernels wrapped through
+functools.partial — the direct-argument form and the local-binding
+form must both register the kernel body as a jit entry, while params
+bound BY the partial are static (branching on them is fine).
+NOT importable production code — the analyzer only parses it."""
+import functools
+import time
+
+from jax.experimental import pallas as pl
+
+
+def _direct_kernel(x_ref, o_ref, *, causal):
+    if causal:                      # static (partial-bound): no finding
+        o_ref[...] = x_ref[...]
+    t = time.time()                 # tracer-wall-clock
+    if x_ref[0] > t:                # tracer-host-branch
+        o_ref[...] = x_ref[...] * 2.0
+
+
+def _bound_kernel(s_ref, x_ref, o_ref, *, page_size):
+    if page_size > 8:               # static (partial-bound): no finding
+        o_ref[...] = x_ref[...]
+    o_ref[...] = x_ref.item()       # tracer-concretize
+
+
+def run_direct(x):
+    # partial in the ARGUMENT position of the wrap call
+    return pl.pallas_call(
+        functools.partial(_direct_kernel, causal=True),
+        out_shape=x)(x)
+
+
+def run_bound(x, s):
+    # local partial binding, then the wrap call by name
+    kernel = functools.partial(_bound_kernel, page_size=16)
+    return pl.pallas_call(kernel, grid=(1,))(s, x)

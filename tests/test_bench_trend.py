@@ -167,6 +167,47 @@ def test_tp_gates_cover_e8_and_tolerate_old_rounds(bt, tmp_path):
                     "-q"]) == 1
 
 
+def test_megakernel_gates_cover_e11_and_rearm_decode_floor(bt, tmp_path):
+    """The e11 decode-megakernel gates: speedup must clear 1x, the
+    fused device_wait p50 ratio must stay near parity, and the decode
+    floor is RE-ARMED at >= 1.0 — but only for rounds that carry the
+    e11 section (the conditional 3-tuple gate form), so the checked-in
+    pre-megakernel rounds (r05 stands at 0.81x) stay clean."""
+    assert bt.GATES["decode_megakernel_speedup"] == ("min", 1.0)
+    assert bt.GATES["decode_vs_streaming_floor"] == (
+        "min", 1.0, "decode_megakernel_speedup")
+    # pre-e11 rounds below the floor: the conditional gate stays silent
+    old = {"n": 7, "cmd": "python bench.py", "rc": 0, "tail": "",
+           "parsed": {"platform": "cpu", "device": "cpu",
+                      "decode_vs_streaming_floor": 0.81}}
+    report = bt.analyze(str(_fixture_root(tmp_path / "old", old)))
+    assert not any(e["metric"] == "decode_vs_streaming_floor"
+                   for e in report["gate_violations"])
+    # an e11 round that failed to re-win the floor trips all three
+    bad = {"n": 8, "cmd": "python bench.py", "rc": 0, "tail": "",
+           "parsed": {"platform": "cpu", "device": "cpu",
+                      "decode_megakernel_speedup": 0.8,
+                      "megakernel_device_wait_ratio": 2.0,
+                      "decode_vs_streaming_floor": 0.81}}
+    report = bt.analyze(str(_fixture_root(tmp_path / "bad", bad)))
+    tripped = {e["metric"] for e in report["gate_violations"]
+               if e["round"] == "BENCH_r07"}
+    assert tripped == {"decode_megakernel_speedup",
+                       "megakernel_device_wait_ratio",
+                       "decode_vs_streaming_floor"}
+    assert bt.main(["--root", str(tmp_path / "bad" / "bench"),
+                    "-q"]) == 1
+    # an e11 round that re-won the floor passes every megakernel gate
+    ok = {"n": 8, "cmd": "python bench.py", "rc": 0, "tail": "",
+          "parsed": {"platform": "cpu", "device": "cpu",
+                     "decode_megakernel_speedup": 1.3,
+                     "megakernel_device_wait_ratio": 0.92,
+                     "decode_vs_streaming_floor": 1.05}}
+    report = bt.analyze(str(_fixture_root(tmp_path / "ok", ok)))
+    assert not any(e["round"] == "BENCH_r07"
+                   for e in report["gate_violations"])
+
+
 def test_unreadable_round_is_a_parse_error(bt, tmp_path):
     root = _fixture_root(tmp_path)
     (root / "BENCH_r08.json").write_text("{not json")

@@ -314,8 +314,10 @@ def test_warmup_precompiles_every_shape_zero_compiles_after():
     eng = _engine(m, max_slots=2, max_len=64, prompt_buckets=(8, 16))
     info = eng.warmup(segment=3)
     # 2 widths x 2 buckets x (prefill + prefix-resume) + 2 widths x
-    # (chunk + final) + segment + the CoW page-copy program + the KV
-    # export/import chunk programs (page-transfer data plane)
+    # (chunk + final) + segment (the megakernel-fused one when the
+    # engine's probe decided fused — still ONE program) + the CoW
+    # page-copy program + the KV export/import chunk programs
+    # (page-transfer data plane)
     assert info["programs"] == 2 * 2 * 2 + 2 * 2 + 1 + 1 + 2
     again = eng.warmup(segment=3)          # idempotent: everything cached
     assert again["programs"] == 0 and again["cached"] == 16

@@ -133,6 +133,32 @@ def test_hygiene_rules_fire_on_fixture(fixture_findings):
         "wall-clock-alias"}
 
 
+def test_partial_wrapped_pallas_kernels_are_swept(fixture_findings):
+    """Pallas kernels reach pallas_call through functools.partial (the
+    conventional way to close static params over the kernel) — both the
+    direct-argument form and the local-binding form must register the
+    kernel body as a jit entry and sweep it with the tracer rules."""
+    got = _rules_at(fixture_findings, "bad_partial_kernel.py")
+    expected = {
+        ("tracer-wall-clock", 15),    # _direct_kernel: time.time()
+        ("tracer-host-branch", 16),   # _direct_kernel: if x_ref[0] > t
+        ("tracer-concretize", 23),    # _bound_kernel: .item()
+    }
+    missing = expected - got
+    assert not missing, (
+        f"partial-wrapped kernels not swept: {sorted(missing)}")
+
+
+def test_partial_bound_params_are_static(fixture_findings):
+    """Params bound BY the partial are baked Python values — branching
+    on them is trace-time config, not a tracer leak."""
+    static_branches = [
+        f for f in fixture_findings
+        if f.path == "bad_partial_kernel.py" and f.line in (13, 21)]
+    assert not static_branches, (
+        f"partial-bound static params flagged: {static_branches}")
+
+
 def test_good_fixture_is_clean(fixture_findings):
     noise = [f for f in fixture_findings if f.path == "good_clean.py"]
     assert not noise, f"clean fixture produced findings: {noise}"
@@ -172,6 +198,7 @@ def test_repo_is_lint_clean():
     ("bad_locks.py", "lock-order-cycle"),
     ("bad_except.py", "bare-except-pass"),
     ("bad_alias.py", "wall-clock-alias"),
+    ("bad_partial_kernel.py", "tracer-concretize"),
 ])
 def test_seeded_bad_snippet_fails_the_gate(tmp_path, fixture,
                                            expected_rule):
@@ -507,7 +534,11 @@ def test_baseline_accepts_bare_list_format(tmp_path):
 def test_jit_entries_include_the_serving_programs(repo_report):
     names = {e["name"] for e in repo_report["jit_entries"]}
     assert "ContinuousBatchingEngine._build_programs.prefill" in names
-    assert "ContinuousBatchingEngine._build_programs.segment" in names
+    assert "ContinuousBatchingEngine._build_programs.segment_unfused" \
+        in names
+    # the decode megakernel reaches pallas_call via a local
+    # functools.partial binding — it must still be swept
+    assert "_megakernel" in names
     wrappers = {e["wrapper"] for e in repo_report["jit_entries"]}
     assert {"jit", "shard_map", "pallas_call"} <= wrappers
 
